@@ -1,0 +1,52 @@
+"""petastorm_tpu — a TPU-native (JAX/XLA/Pallas) data-loading framework with the capabilities of
+Petastorm: Parquet datasets with tensor columns (Unischema + codecs), a parallel row-group reader
+(``make_reader`` / ``make_batch_reader``) with shuffling, sharding, predicates, NGram windowing
+and caching, and a JAX ``DataLoader`` that yields globally-sharded ``jax.Array`` batches.
+
+Public API mirrors the reference surface (see SURVEY.md §8 parity checklist) while the
+implementation is TPU-first: deterministic multi-host planning over ``jax.process_index()``,
+Arrow record-batch streaming, async ``device_put`` prefetch, Pallas decode kernels.
+"""
+
+__version__ = "0.1.0"
+
+from petastorm_tpu.errors import (  # noqa: F401
+    DecodeFieldError,
+    EmptyResultError,
+    MetadataError,
+    NoDataAvailableError,
+    PetastormTpuError,
+    TimeoutWaitingForResultError,
+)
+from petastorm_tpu.transform import TransformSpec, transform_schema  # noqa: F401
+from petastorm_tpu.unischema import (  # noqa: F401
+    Unischema,
+    UnischemaField,
+    dict_to_record,
+    dict_to_spark_row,
+    encode_row,
+    insert_explicit_nulls,
+    match_unischema_fields,
+)
+
+
+def __getattr__(name):
+    # Heavier entry points are imported lazily so `import petastorm_tpu` stays light.
+    try:
+        if name in ("make_reader", "make_batch_reader", "Reader"):
+            from petastorm_tpu import reader
+
+            return getattr(reader, name)
+        if name == "WeightedSamplingReader":
+            from petastorm_tpu.weighted_sampling import WeightedSamplingReader
+
+            return WeightedSamplingReader
+        if name == "DataLoader":
+            from petastorm_tpu.loader import DataLoader
+
+            return DataLoader
+    except ImportError as e:
+        raise AttributeError(
+            "petastorm_tpu.%s is unavailable (%s)" % (name, e)
+        ) from e
+    raise AttributeError("module 'petastorm_tpu' has no attribute %r" % name)

@@ -1,0 +1,25 @@
+"""Framework exceptions (reference: petastorm/errors.py, petastorm/workers_pool/__init__.py)."""
+
+
+class PetastormTpuError(Exception):
+    """Base class for framework errors."""
+
+
+class DecodeFieldError(PetastormTpuError):
+    """Raised when a codec fails to decode a stored field value."""
+
+
+class NoDataAvailableError(PetastormTpuError):
+    """Raised when a reader has no row groups to read after filtering/sharding."""
+
+
+class EmptyResultError(PetastormTpuError):
+    """Results queue empty and epochs exhausted (reference: workers_pool/__init__.py)."""
+
+
+class TimeoutWaitingForResultError(PetastormTpuError):
+    """No worker produced a result within the configured timeout."""
+
+
+class MetadataError(PetastormTpuError):
+    """Dataset metadata missing or malformed (reference: PetastormMetadataError)."""

@@ -1,0 +1,46 @@
+"""User transforms applied inside workers (reference: petastorm/transform.py ~L15).
+
+``TransformSpec`` declares a function run on decoded rows (per-row path) or pandas DataFrames
+(batch path) plus the schema edits it implies, so downstream consumers (JAX loader shapes, tf.data
+signatures, torch collate) see the post-transform schema.
+
+TPU delta: a transform may instead be *device-side* — a jittable ``fn(batch_dict) -> batch_dict``
+applied after device transfer (fused by XLA into the input pipeline). Declare it with
+``device=True``; the host pipeline then skips it and the JAX loader applies it under jit.
+"""
+from __future__ import annotations
+
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+class TransformSpec:
+    def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None,
+                 device=False):
+        self.func = func
+        self.edit_fields = list(edit_fields or [])
+        self.removed_fields = list(removed_fields or [])
+        self.selected_fields = list(selected_fields) if selected_fields is not None else None
+        self.device = bool(device)
+        for f in self.edit_fields:
+            if not isinstance(f, (tuple, UnischemaField)):
+                raise ValueError("edit_fields entries must be tuples or UnischemaField; got %r" % (f,))
+
+
+def transform_schema(schema, transform_spec):
+    """Apply declared edits/removals/selection to a schema (reference: ~L40)."""
+    fields = dict(schema.fields)
+    for removed in transform_spec.removed_fields:
+        fields.pop(removed, None)
+    for edit in transform_spec.edit_fields:
+        if isinstance(edit, UnischemaField):
+            new_field = edit
+        else:
+            new_field = UnischemaField(*edit)
+        fields[new_field.name] = new_field
+    ordered = [f for name, f in fields.items()]
+    if transform_spec.selected_fields is not None:
+        missing = set(transform_spec.selected_fields) - set(fields.keys())
+        if missing:
+            raise ValueError("selected_fields %r not present after transform" % sorted(missing))
+        ordered = [fields[name] for name in transform_spec.selected_fields]
+    return Unischema(schema.name + "_transformed", ordered)

@@ -1,0 +1,193 @@
+"""Lightweight scalar type tags.
+
+The reference (petastorm/codecs.py ~L60 ``ScalarCodec``) parameterizes scalar codecs with
+``pyspark.sql.types`` instances, dragging a Spark dependency into the core data model. Here the
+core is Spark-free: these tags carry the (numpy dtype, arrow dtype, spark name) triple and are the
+single place all three type systems meet. When pyspark *is* installed the tags convert losslessly
+via :meth:`ScalarType.spark_type`; when it is not, everything else still works.
+
+These classes are also the unpickling shim for reference-written datasets: pickled petastorm
+unischemas embed ``pyspark.sql.types`` instances inside ``ScalarCodec``; our compat unpickler maps
+those module paths onto these classes (see petastorm_tpu/compat/reference.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+
+class ScalarType:
+    """Base scalar type tag. Subclasses define numpy/arrow/spark equivalents."""
+
+    #: numpy dtype string
+    numpy_dtype: str = None
+    #: pyarrow DataType factory result
+    _arrow: "pa.DataType" = None
+    #: pyspark class name (for as_spark_schema / compat unpickling)
+    spark_name: str = None
+
+    def arrow_type(self) -> "pa.DataType":
+        return self._arrow
+
+    def to_numpy_dtype(self):
+        return np.dtype(self.numpy_dtype)
+
+    def spark_type(self):
+        """Return the equivalent pyspark.sql.types instance (requires pyspark)."""
+        import pyspark.sql.types as T  # deferred; pyspark optional
+
+        return getattr(T, self.spark_name)()
+
+    def simpleString(self):  # noqa: N802 - matches pyspark API
+        return type(self).__name__.replace("Type", "").lower()
+
+    def __repr__(self):
+        return "%s()" % type(self).__name__
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __hash__(self):
+        return hash(type(self).__name__)
+
+    # pyspark type instances pickle as empty-state objects; accept that on unpickle.
+    def __setstate__(self, state):
+        pass
+
+    def __getstate__(self):
+        return {}
+
+
+class BooleanType(ScalarType):
+    numpy_dtype = "bool_"
+    _arrow = pa.bool_()
+    spark_name = "BooleanType"
+
+
+class ByteType(ScalarType):
+    numpy_dtype = "int8"
+    _arrow = pa.int8()
+    spark_name = "ByteType"
+
+
+class ShortType(ScalarType):
+    numpy_dtype = "int16"
+    _arrow = pa.int16()
+    spark_name = "ShortType"
+
+
+class IntegerType(ScalarType):
+    numpy_dtype = "int32"
+    _arrow = pa.int32()
+    spark_name = "IntegerType"
+
+
+class LongType(ScalarType):
+    numpy_dtype = "int64"
+    _arrow = pa.int64()
+    spark_name = "LongType"
+
+
+class FloatType(ScalarType):
+    numpy_dtype = "float32"
+    _arrow = pa.float32()
+    spark_name = "FloatType"
+
+
+class DoubleType(ScalarType):
+    numpy_dtype = "float64"
+    _arrow = pa.float64()
+    spark_name = "DoubleType"
+
+
+class StringType(ScalarType):
+    numpy_dtype = "object"
+    _arrow = pa.string()
+    spark_name = "StringType"
+
+
+class BinaryType(ScalarType):
+    numpy_dtype = "object"
+    _arrow = pa.binary()
+    spark_name = "BinaryType"
+
+
+class DateType(ScalarType):
+    numpy_dtype = "datetime64[D]"
+    _arrow = pa.date32()
+    spark_name = "DateType"
+
+
+class TimestampType(ScalarType):
+    numpy_dtype = "datetime64[us]"
+    _arrow = pa.timestamp("us")
+    spark_name = "TimestampType"
+
+
+class DecimalType(ScalarType):
+    """Decimal(precision, scale); decodes to python decimal.Decimal (reference behavior)."""
+
+    numpy_dtype = "object"
+    spark_name = "DecimalType"
+
+    def __init__(self, precision=10, scale=0):
+        self.precision = precision
+        self.scale = scale
+
+    def arrow_type(self):
+        return pa.decimal128(self.precision, self.scale)
+
+    def spark_type(self):
+        import pyspark.sql.types as T
+
+        return T.DecimalType(self.precision, self.scale)
+
+    def simpleString(self):  # noqa: N802
+        return "decimal(%d,%d)" % (self.precision, self.scale)
+
+    def __repr__(self):
+        return "DecimalType(%d,%d)" % (self.precision, self.scale)
+
+    def __eq__(self, other):
+        return (
+            type(self) is type(other)
+            and self.precision == other.precision
+            and self.scale == other.scale
+        )
+
+    def __hash__(self):
+        return hash((self.precision, self.scale))
+
+    def __setstate__(self, state):
+        # pyspark DecimalType pickles its __dict__ {precision, scale, hasPrecisionInfo}
+        self.precision = state.get("precision", 10)
+        self.scale = state.get("scale", 0)
+
+    def __getstate__(self):
+        return {"precision": self.precision, "scale": self.scale}
+
+
+_NUMPY_TO_TAG = {
+    np.dtype("bool"): BooleanType,
+    np.dtype("int8"): ByteType,
+    np.dtype("int16"): ShortType,
+    np.dtype("int32"): IntegerType,
+    np.dtype("int64"): LongType,
+    np.dtype("float32"): FloatType,
+    np.dtype("float64"): DoubleType,
+    np.dtype("uint8"): ShortType,  # parquet has no uint8 logical in spark land; widen
+    np.dtype("uint16"): IntegerType,
+    np.dtype("uint32"): LongType,
+}
+
+
+def tag_for_numpy_dtype(dtype, string_ok=True):
+    """Best-effort ScalarType tag for a numpy dtype (used by plain/scalar columns)."""
+    dtype = np.dtype(dtype)
+    if dtype in _NUMPY_TO_TAG:
+        return _NUMPY_TO_TAG[dtype]()
+    if dtype.kind in ("U", "S", "O") and string_ok:
+        return StringType()
+    if dtype.kind == "M":
+        return TimestampType()
+    raise ValueError("No scalar type tag for numpy dtype %r" % dtype)

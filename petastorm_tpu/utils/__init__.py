@@ -1,0 +1,64 @@
+"""Row decode driver and small helpers (reference: petastorm/utils.py ~L80 ``decode_row``)."""
+from __future__ import annotations
+
+import numpy as np
+
+from petastorm_tpu.errors import DecodeFieldError
+
+
+def decode_row(row, schema):
+    """Decode one stored row dict through codecs into a {field: numpy value} dict.
+
+    Mirrors the reference decode driver (petastorm/utils.py ~L80): codec dispatch plus nullable
+    handling; wraps codec failures with the field name for debuggability.
+    """
+    decoded = {}
+    for name, field in schema.fields.items():
+        if name not in row:
+            continue
+        value = row[name]
+        if value is None:
+            if not field.nullable:
+                raise DecodeFieldError("Field %r is not nullable but stored value is None" % name)
+            decoded[name] = None
+        elif field.codec is not None:
+            try:
+                decoded[name] = field.codec.decode(field, value)
+            except Exception as e:  # noqa: BLE001 - annotate and rethrow
+                raise DecodeFieldError("Unable to decode field %r: %s" % (name, e)) from e
+        else:
+            decoded[name] = _coerce_plain(field, value)
+    return decoded
+
+
+def _coerce_plain(field, value):
+    """Coerce a codec-less stored value to the field's declared numpy dtype."""
+    np_dtype = np.dtype(field.numpy_dtype)
+    shape = field.shape or ()
+    if len(shape) > 0:
+        return np.asarray(value, dtype=None if np_dtype.kind == "O" else np_dtype)
+    if np_dtype.kind in ("U", "S", "O"):
+        return value
+    if np_dtype.kind == "M":
+        return np.datetime64(value) if value is not None else value
+    if isinstance(value, np.ndarray) and value.ndim == 0:
+        return value[()]
+    return np_dtype.type(value)
+
+
+def pad_to_shape(array, shape, pad_value=0):
+    """Pad/validate an array against a static-or-None shape tuple; used by the JAX loader to
+    produce the fixed shapes XLA requires."""
+    if len(shape) != array.ndim:
+        raise ValueError(
+            "Shape rank %d does not match array rank %d" % (len(shape), array.ndim)
+        )
+    target = tuple(s if s is not None else a for s, a in zip(shape, array.shape))
+    if target == array.shape:
+        return array
+    pads = []
+    for t, a in zip(target, array.shape):
+        if a > t:
+            raise ValueError("Array dim %d exceeds padded max %d" % (a, t))
+        pads.append((0, t - a))
+    return np.pad(array, pads, constant_values=pad_value)

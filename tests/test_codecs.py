@@ -1,0 +1,130 @@
+"""Codec round-trip tests (reference test model: petastorm/tests/test_codecs.py)."""
+import decimal
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import types as ptypes
+from petastorm_tpu.codecs import (
+    CompressedImageCodec,
+    CompressedNdarrayCodec,
+    NdarrayCodec,
+    ScalarCodec,
+)
+from petastorm_tpu.unischema import UnischemaField
+
+
+def _roundtrip(codec, field, value):
+    return codec.decode(field, codec.encode(field, value))
+
+
+@pytest.mark.parametrize(
+    "tag,np_dtype,value",
+    [
+        (ptypes.IntegerType(), np.int32, 42),
+        (ptypes.LongType(), np.int64, -(2**40)),
+        (ptypes.FloatType(), np.float32, 1.5),
+        (ptypes.DoubleType(), np.float64, 2.25),
+        (ptypes.BooleanType(), np.bool_, True),
+        (ptypes.ShortType(), np.int16, -7),
+        (ptypes.ByteType(), np.int8, 5),
+    ],
+)
+def test_scalar_roundtrip(tag, np_dtype, value):
+    field = UnischemaField("x", np_dtype, (), ScalarCodec(tag), False)
+    out = _roundtrip(field.codec, field, value)
+    assert out == value
+    assert np.dtype(type(out)) == np.dtype(np_dtype)
+
+
+def test_scalar_string():
+    field = UnischemaField("s", np.str_, (), ScalarCodec(ptypes.StringType()), False)
+    assert _roundtrip(field.codec, field, "hello") == "hello"
+
+
+def test_scalar_decimal():
+    field = UnischemaField(
+        "d", np.object_, (), ScalarCodec(ptypes.DecimalType(10, 2)), False
+    )
+    out = _roundtrip(field.codec, field, decimal.Decimal("123.45"))
+    assert isinstance(out, decimal.Decimal)
+    assert out == decimal.Decimal("123.45")
+
+
+def test_scalar_accepts_numpy_scalar():
+    field = UnischemaField("x", np.int32, (), ScalarCodec(ptypes.IntegerType()), False)
+    assert field.codec.encode(field, np.int32(7)) == 7
+    assert field.codec.encode(field, np.array(7, dtype=np.int32)) == 7
+
+
+def test_ndarray_roundtrip(rng):
+    field = UnischemaField("m", np.float64, (3, 4), NdarrayCodec(), False)
+    value = rng.standard_normal((3, 4))
+    out = _roundtrip(field.codec, field, value)
+    np.testing.assert_array_equal(out, value)
+    # encoded payload is npy bytes
+    enc = field.codec.encode(field, value)
+    assert bytes(enc[:6]) == b"\x93NUMPY"
+
+
+def test_ndarray_ragged_dim(rng):
+    field = UnischemaField("m", np.int64, (None, 2), NdarrayCodec(), False)
+    value = rng.randint(0, 10, (5, 2)).astype(np.int64)
+    np.testing.assert_array_equal(_roundtrip(field.codec, field, value), value)
+
+
+def test_ndarray_wrong_dtype_raises(rng):
+    field = UnischemaField("m", np.float32, (2, 2), NdarrayCodec(), False)
+    with pytest.raises(ValueError, match="dtype"):
+        field.codec.encode(field, rng.standard_normal((2, 2)))  # float64
+
+
+def test_ndarray_wrong_shape_raises(rng):
+    field = UnischemaField("m", np.float64, (2, 2), NdarrayCodec(), False)
+    with pytest.raises(ValueError, match="shape|rank"):
+        field.codec.encode(field, rng.standard_normal((2, 3)))
+
+
+def test_compressed_ndarray_roundtrip(rng):
+    field = UnischemaField("m", np.float64, (8, 8), CompressedNdarrayCodec(), False)
+    value = rng.standard_normal((8, 8))
+    np.testing.assert_array_equal(_roundtrip(field.codec, field, value), value)
+    # compresses well on redundant data
+    zeros = np.zeros((8, 8))
+    assert len(field.codec.encode(field, zeros)) < len(NdarrayCodec().encode(field, zeros))
+
+
+def test_png_roundtrip_lossless(rng):
+    field = UnischemaField("im", np.uint8, (16, 16, 3), CompressedImageCodec("png"), False)
+    value = rng.randint(0, 255, (16, 16, 3)).astype(np.uint8)
+    np.testing.assert_array_equal(_roundtrip(field.codec, field, value), value)
+
+
+def test_jpeg_roundtrip_lossy(rng):
+    field = UnischemaField(
+        "im", np.uint8, (32, 32, 3), CompressedImageCodec("jpeg", quality=90), False
+    )
+    # smooth gradient compresses with low error
+    yy, xx = np.mgrid[0:32, 0:32]
+    value = np.stack([yy * 8, xx * 8, (yy + xx) * 4], axis=-1).astype(np.uint8)
+    out = _roundtrip(field.codec, field, value)
+    assert out.shape == value.shape
+    assert np.mean(np.abs(out.astype(int) - value.astype(int))) < 10
+
+
+def test_jpeg_is_device_decodable():
+    assert CompressedImageCodec("jpeg").device_decodable
+    assert not CompressedImageCodec("png").device_decodable
+    assert not NdarrayCodec().device_decodable
+
+
+def test_grayscale_png(rng):
+    field = UnischemaField("im", np.uint8, (8, 8), CompressedImageCodec("png"), False)
+    value = rng.randint(0, 255, (8, 8)).astype(np.uint8)
+    np.testing.assert_array_equal(_roundtrip(field.codec, field, value), value)
+
+
+def test_scalar_codec_from_spark_style_tag():
+    # our type tags stand in for pyspark.sql.types
+    codec = ScalarCodec(ptypes.IntegerType())
+    assert codec.arrow_dtype() == __import__("pyarrow").int32()

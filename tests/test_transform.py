@@ -1,0 +1,52 @@
+"""TransformSpec tests (reference test model: petastorm/tests/test_transform_spec.py)."""
+import numpy as np
+import pytest
+
+from petastorm_tpu import types as ptypes
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.transform import TransformSpec, transform_schema
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+@pytest.fixture
+def schema():
+    return Unischema(
+        "S",
+        [
+            UnischemaField("id", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+            UnischemaField("x", np.float64, (4,), NdarrayCodec(), False),
+            UnischemaField("y", np.float64, (), ScalarCodec(ptypes.DoubleType()), False),
+        ],
+    )
+
+
+def test_removed_fields(schema):
+    out = transform_schema(schema, TransformSpec(func=lambda r: r, removed_fields=["y"]))
+    assert list(out.fields.keys()) == ["id", "x"]
+
+
+def test_edit_fields_tuple_and_field(schema):
+    spec = TransformSpec(
+        func=lambda r: r,
+        edit_fields=[
+            ("x", np.float32, (8,), None, False),
+            UnischemaField("z", np.int32, (), None, True),
+        ],
+    )
+    out = transform_schema(schema, spec)
+    assert out.x.numpy_dtype == np.float32
+    assert out.x.shape == (8,)
+    assert out.z.nullable
+
+
+def test_selected_fields(schema):
+    spec = TransformSpec(func=lambda r: r, selected_fields=["y", "id"])
+    out = transform_schema(schema, spec)
+    assert list(out.fields.keys()) == ["y", "id"]
+    with pytest.raises(ValueError, match="not present"):
+        transform_schema(schema, TransformSpec(selected_fields=["missing"]))
+
+
+def test_device_flag(schema):
+    assert TransformSpec(func=lambda b: b, device=True).device
+    assert not TransformSpec(func=lambda b: b).device

@@ -1,0 +1,212 @@
+"""Unischema tests (reference test model: petastorm/tests/test_unischema.py)."""
+import pickle
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu import types as ptypes
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+from petastorm_tpu.unischema import (
+    Unischema,
+    UnischemaField,
+    dict_to_record,
+    encode_row,
+    insert_explicit_nulls,
+    match_unischema_fields,
+)
+from petastorm_tpu.utils import decode_row
+
+
+@pytest.fixture
+def schema():
+    return Unischema(
+        "TestSchema",
+        [
+            UnischemaField("id", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+            UnischemaField("value", np.float64, (), ScalarCodec(ptypes.DoubleType()), False),
+            UnischemaField("matrix", np.float64, (3, 4), NdarrayCodec(), False),
+            UnischemaField("image", np.uint8, (8, 8, 3), CompressedImageCodec("png"), False),
+            UnischemaField("name", np.str_, (), ScalarCodec(ptypes.StringType()), True),
+        ],
+    )
+
+
+def test_field_access(schema):
+    assert schema.id.name == "id"
+    assert schema.matrix.shape == (3, 4)
+    with pytest.raises(AttributeError):
+        schema.nonexistent
+
+
+def test_create_schema_view_by_field(schema):
+    view = schema.create_schema_view([schema.id, schema.matrix])
+    assert list(view.fields.keys()) == ["id", "matrix"]
+
+
+def test_create_schema_view_by_name_and_regex(schema):
+    view = schema.create_schema_view(["id", "ima.*"])
+    assert list(view.fields.keys()) == ["id", "image"]
+
+
+def test_create_schema_view_bad_selector(schema):
+    with pytest.raises(ValueError, match="matched no fields"):
+        schema.create_schema_view(["nope_.*"])
+
+
+def test_view_preserves_order(schema):
+    view = schema.create_schema_view(["matrix", "id"])
+    assert list(view.fields.keys()) == ["id", "matrix"]
+
+
+def test_match_unischema_fields(schema):
+    assert [f.name for f in match_unischema_fields(schema, ["i.*"])] == ["id", "image"]
+    # plain names are exact matches
+    assert [f.name for f in match_unischema_fields(schema, ["id"])] == ["id"]
+
+
+def test_namedtuple_roundtrip(schema):
+    row = schema.make_namedtuple(id=1, value=2.0, matrix=None, image=None, name="x")
+    assert row.id == 1 and row.name == "x"
+    # same type across calls (cache)
+    assert type(row) is type(schema.make_namedtuple(id=2, value=1.0, matrix=None, image=None))
+
+
+def test_insert_explicit_nulls(schema):
+    row = {"id": 1, "value": 1.0, "matrix": np.zeros((3, 4)), "image": np.zeros((8, 8, 3), np.uint8)}
+    insert_explicit_nulls(schema, row)
+    assert row["name"] is None
+    with pytest.raises(ValueError, match="not nullable"):
+        insert_explicit_nulls(schema, {"id": 1})
+
+
+def test_encode_decode_row_roundtrip(schema, rng):
+    row = {
+        "id": 7,
+        "value": 3.5,
+        "matrix": rng.standard_normal((3, 4)),
+        "image": rng.randint(0, 255, (8, 8, 3)).astype(np.uint8),
+        "name": "abc",
+    }
+    encoded = encode_row(schema, row)
+    assert isinstance(encoded["matrix"], bytearray)
+    decoded = decode_row(encoded, schema)
+    assert decoded["id"] == 7
+    np.testing.assert_array_equal(decoded["matrix"], row["matrix"])
+    np.testing.assert_array_equal(decoded["image"], row["image"])
+    assert decoded["name"] == "abc"
+
+
+def test_encode_row_unknown_field(schema):
+    with pytest.raises(ValueError, match="not part of schema"):
+        encode_row(schema, {"bogus": 1})
+
+
+def test_encode_row_null_in_non_nullable(schema):
+    with pytest.raises(ValueError, match="not nullable"):
+        encode_row(schema, {"id": None, "value": 1.0, "matrix": np.zeros((3, 4)),
+                            "image": np.zeros((8, 8, 3), np.uint8)})
+
+
+def test_as_arrow_schema(schema):
+    arrow = schema.as_arrow_schema()
+    assert arrow.field("id").type == pa.int64()
+    assert arrow.field("matrix").type == pa.binary()
+    assert arrow.field("name").type == pa.string()
+    assert arrow.field("name").nullable
+
+
+def test_from_arrow_schema():
+    arrow = pa.schema(
+        [
+            pa.field("a", pa.int32(), nullable=False),
+            pa.field("b", pa.float64()),
+            pa.field("s", pa.string()),
+            pa.field("v", pa.list_(pa.float32())),
+            pa.field("ts", pa.timestamp("us")),
+        ]
+    )
+    schema = Unischema.from_arrow_schema(arrow)
+    assert schema.a.numpy_dtype == np.dtype("int32")
+    assert schema.a.shape == ()
+    assert schema.v.shape == (None,)
+    assert schema.v.numpy_dtype == np.dtype("float32")
+    assert schema.s.numpy_dtype == np.dtype("object")
+    assert schema.ts.numpy_dtype == np.dtype("datetime64[us]")
+    assert all(f.codec is None for f in schema.fields.values())
+
+
+def test_from_arrow_schema_unsupported_omitted():
+    arrow = pa.schema([pa.field("ok", pa.int32()), pa.field("bad", pa.map_(pa.string(), pa.int32()))])
+    schema = Unischema.from_arrow_schema(arrow)
+    assert list(schema.fields.keys()) == ["ok"]
+    with pytest.raises(ValueError):
+        Unischema.from_arrow_schema(arrow, omit_unsupported_fields=False)
+
+
+def test_json_roundtrip(schema):
+    payload = schema.to_json()
+    back = Unischema.from_json(payload)
+    assert list(back.fields.keys()) == list(schema.fields.keys())
+    assert back.matrix == schema.matrix
+    assert back.image.codec.image_codec == "png"
+    assert isinstance(back.id.codec, ScalarCodec)
+
+
+def test_pickle_roundtrip(schema):
+    back = pickle.loads(pickle.dumps(schema))
+    assert list(back.fields.keys()) == list(schema.fields.keys())
+    assert back.matrix == schema.matrix
+
+
+def test_dict_to_record(schema, rng):
+    row = {
+        "id": 1,
+        "value": 0.5,
+        "matrix": rng.standard_normal((3, 4)),
+        "image": rng.randint(0, 255, (8, 8, 3)).astype(np.uint8),
+    }
+    rec = dict_to_record(schema, row)
+    assert rec["name"] is None
+    assert isinstance(rec["image"], bytearray)
+
+
+def test_arrow_write_read_roundtrip(schema, rng, tmp_path):
+    """Encoded rows are storable via pyarrow parquet and decode back exactly."""
+    import pyarrow.parquet as pq
+
+    rows = []
+    for i in range(5):
+        rows.append(
+            {
+                "id": i,
+                "value": float(i),
+                "matrix": rng.standard_normal((3, 4)),
+                "image": rng.randint(0, 255, (8, 8, 3)).astype(np.uint8),
+                "name": "row%d" % i,
+            }
+        )
+    encoded = [encode_row(schema, r) for r in rows]
+    table = pa.Table.from_pylist(
+        [{k: (bytes(v) if isinstance(v, bytearray) else v) for k, v in e.items()} for e in encoded],
+        schema=schema.as_arrow_schema(),
+    )
+    path = tmp_path / "t.parquet"
+    pq.write_table(table, path)
+    read_back = pq.read_table(path).to_pylist()
+    for orig, stored in zip(rows, read_back):
+        decoded = decode_row(stored, schema)
+        assert decoded["id"] == orig["id"]
+        np.testing.assert_array_equal(decoded["matrix"], orig["matrix"])
+        np.testing.assert_array_equal(decoded["image"], orig["image"])
+
+
+def test_many_fields_namedtuple():
+    # reference tests namedtuples >255 fields (python 3.7+ allows)
+    fields = [
+        UnischemaField("f%03d" % i, np.int32, (), ScalarCodec(ptypes.IntegerType()), False)
+        for i in range(300)
+    ]
+    schema = Unischema("big", fields)
+    row = schema.make_namedtuple(**{f.name: i for i, f in enumerate(fields)})
+    assert row.f299 == 299
