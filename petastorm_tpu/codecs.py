@@ -114,6 +114,18 @@ class ScalarCodec(DataframeColumnCodec):
     def spark_dtype(self):
         return self._scalar_type.spark_type()
 
+    def __setstate__(self, state):
+        # Reference ScalarCodec pickles as {'_spark_type': <pyspark type>} (petastorm/codecs.py
+        # ~L60); the compat unpickler maps pyspark type classes onto our tags already.
+        if "_spark_type" in state and "_scalar_type" not in state:
+            spark_type = state["_spark_type"]
+            if isinstance(spark_type, ptypes.ScalarType):
+                self._scalar_type = spark_type
+            else:
+                self._scalar_type = _tag_from_spark_type(spark_type)
+        else:
+            self.__dict__.update(state)
+
     def __repr__(self):
         return "ScalarCodec(%r)" % (self._scalar_type,)
 
@@ -242,6 +254,16 @@ class CompressedImageCodec(DataframeColumnCodec):
         import pyspark.sql.types as T
 
         return T.BinaryType()
+
+    def __setstate__(self, state):
+        # Reference CompressedImageCodec stores the cv2 extension string ('.png'/'.jpeg',
+        # petastorm/codecs.py ~L200); normalize on unpickle.
+        codec = state.get("_image_codec", "png").lstrip(".")
+        codec = "jpeg" if codec == "jpg" else codec
+        if codec not in ("png", "jpeg"):
+            raise ValueError("Unsupported image codec %r in pickled state" % codec)
+        self._image_codec = codec
+        self._quality = int(state.get("_quality", 80))
 
     def __repr__(self):
         return "CompressedImageCodec(%r, quality=%d)" % (self._image_codec, self._quality)

@@ -1,0 +1,69 @@
+"""Read datasets written by real petastorm without importing petastorm.
+
+Reference datasets persist a *pickled* ``Unischema`` under the Parquet KV key
+``dataset-toolkit.unischema.v1`` (petastorm/etl/dataset_metadata.py ~L60 ``UNISCHEMA_KEY``; the
+pre-rename key handled by petastorm/etl/legacy.py is also accepted). The pickle stream names
+``petastorm.unischema`` / ``petastorm.codecs`` / ``pyspark.sql.types`` classes; this unpickler
+maps those module paths onto our equivalents so the bytes deserialize into *our* objects —
+no petastorm, no pyspark required.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+
+_CLASS_MAP = {
+    # petastorm core → ours (same attribute names by design; __setstate__ shims cover deltas)
+    ("petastorm.unischema", "Unischema"): ("petastorm_tpu.unischema", "Unischema"),
+    ("petastorm.unischema", "UnischemaField"): ("petastorm_tpu.unischema", "UnischemaField"),
+    ("petastorm.codecs", "ScalarCodec"): ("petastorm_tpu.codecs", "ScalarCodec"),
+    ("petastorm.codecs", "NdarrayCodec"): ("petastorm_tpu.codecs", "NdarrayCodec"),
+    ("petastorm.codecs", "CompressedNdarrayCodec"): (
+        "petastorm_tpu.codecs",
+        "CompressedNdarrayCodec",
+    ),
+    ("petastorm.codecs", "CompressedImageCodec"): (
+        "petastorm_tpu.codecs",
+        "CompressedImageCodec",
+    ),
+    # legacy pre-rename package (petastorm/etl/legacy.py ~L20)
+    ("dataset_toolkit.unischema", "Unischema"): ("petastorm_tpu.unischema", "Unischema"),
+    ("dataset_toolkit.unischema", "UnischemaField"): ("petastorm_tpu.unischema", "UnischemaField"),
+    ("dataset_toolkit.codecs", "ScalarCodec"): ("petastorm_tpu.codecs", "ScalarCodec"),
+    ("dataset_toolkit.codecs", "NdarrayCodec"): ("petastorm_tpu.codecs", "NdarrayCodec"),
+    ("dataset_toolkit.codecs", "CompressedNdarrayCodec"): (
+        "petastorm_tpu.codecs",
+        "CompressedNdarrayCodec",
+    ),
+    ("dataset_toolkit.codecs", "CompressedImageCodec"): (
+        "petastorm_tpu.codecs",
+        "CompressedImageCodec",
+    ),
+}
+
+_PYSPARK_TYPE_NAMES = {
+    "BooleanType", "ByteType", "ShortType", "IntegerType", "LongType", "FloatType",
+    "DoubleType", "StringType", "BinaryType", "DateType", "TimestampType", "DecimalType",
+}
+
+
+class _ReferenceUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _CLASS_MAP:
+            target_module, target_name = _CLASS_MAP[(module, name)]
+            mod = __import__(target_module, fromlist=[target_name])
+            return getattr(mod, target_name)
+        if module.startswith("pyspark.sql.types") and name in _PYSPARK_TYPE_NAMES:
+            from petastorm_tpu import types as ptypes
+
+            return getattr(ptypes, name)
+        if module.startswith(("petastorm", "dataset_toolkit", "pyspark")):
+            raise pickle.UnpicklingError(
+                "Reference pickle references unsupported class %s.%s" % (module, name)
+            )
+        return super().find_class(module, name)
+
+
+def loads_reference_pickle(payload):
+    """Deserialize a reference-petastorm pickle into petastorm_tpu objects."""
+    return _ReferenceUnpickler(io.BytesIO(payload)).load()

@@ -1,0 +1,324 @@
+"""Dataset metadata: materialize datasets, persist/recover schemas, enumerate row groups.
+
+Capability parity with the reference ETL/metadata layer (petastorm/etl/dataset_metadata.py:
+``materialize_dataset`` ~L60, ``get_schema`` ~L250, ``get_schema_from_dataset_url`` ~L300,
+``infer_or_load_unischema`` ~L340, ``load_row_groups`` ~L150), redesigned TPU-first:
+
+- The native write path is **pyarrow**, not Spark (:func:`write_dataset` / :func:`RowWriter`);
+  a Spark-compatible :func:`materialize_dataset` contextmanager is provided for Spark jobs.
+- Native schema persistence is JSON (self-describing, no pickled classes) under
+  ``PTPU_SCHEMA_KEY``; the reference's pickled ``dataset-toolkit.unischema.v1`` key is still
+  READ (compat unpickler) so real petastorm datasets open unmodified.
+- Row-group counts are persisted per file (``PTPU_ROW_GROUPS_KEY``; reference
+  ``dataset-toolkit.num_row_groups_per_file.v1`` also read) so planning never scans every footer.
+"""
+from __future__ import annotations
+
+import json
+import os
+import posixpath
+from collections import namedtuple
+from contextlib import contextmanager
+
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+
+# Native KV keys (JSON payloads)
+PTPU_SCHEMA_KEY = b"petastorm_tpu.unischema.json.v1"
+PTPU_ROW_GROUPS_KEY = b"petastorm_tpu.num_row_groups_per_file.json.v1"
+# Reference KV keys (pickled payloads; read-only compat) — petastorm/etl/dataset_metadata.py
+REFERENCE_SCHEMA_KEY = b"dataset-toolkit.unischema.v1"
+REFERENCE_ROW_GROUPS_KEY = b"dataset-toolkit.num_row_groups_per_file.v1"
+
+_METADATA_FILES = ("_common_metadata", "_metadata")
+
+#: One unit of scheduled work: a single row group of a single file.
+RowGroupPiece = namedtuple("RowGroupPiece", ["path", "row_group", "num_rows"])
+
+
+# --------------------------------------------------------------------------------------
+# Write side
+# --------------------------------------------------------------------------------------
+
+
+class RowWriter:
+    """pyarrow-native dataset writer: encode rows through codecs, write parquet files, then
+    persist schema + row-group counts in ``_common_metadata``.
+
+    TPU-first replacement for the reference's Spark-only write path: no cluster needed to
+    create a dataset (examples, tests, single-host ETL). Spark jobs use
+    :func:`materialize_dataset` instead and land on the same metadata format.
+    """
+
+    def __init__(self, dataset_url, schema, row_group_size_mb=32, rows_per_file=None,
+                 filesystem=None, storage_options=None, compression="snappy"):
+        self._url = str(dataset_url)
+        self._schema = schema
+        self._row_group_bytes = int(row_group_size_mb) << 20
+        self._rows_per_file = rows_per_file
+        self._compression = compression
+        self._fs, self._path = get_filesystem_and_path_or_paths(
+            self._url, storage_options=storage_options, filesystem=filesystem
+        )
+        self._arrow_schema = schema.as_arrow_schema()
+        self._pending = []
+        self._pending_bytes = 0
+        self._file_index = 0
+        self._files_written = []  # (filename, row_group_count)
+        self._closed = False
+        self._fs.create_dir(self._path, recursive=True)
+
+    def write(self, row_dict):
+        """Encode and stage one {field: value} row."""
+        from petastorm_tpu.unischema import encode_row
+
+        encoded = encode_row(self._schema, row_dict)
+        clean = {
+            k: (bytes(v) if isinstance(v, bytearray) else v) for k, v in encoded.items()
+        }
+        self._pending.append(clean)
+        self._pending_bytes += sum(len(v) for v in clean.values() if isinstance(v, bytes)) + 64
+        if self._rows_per_file and len(self._pending) >= self._rows_per_file:
+            self._flush_file()
+        elif self._pending_bytes >= self._row_group_bytes * 4:
+            self._flush_file()
+
+    def write_many(self, rows):
+        for row in rows:
+            self.write(row)
+
+    def _flush_file(self):
+        if not self._pending:
+            return
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        table = pa.Table.from_pylist(self._pending, schema=self._arrow_schema)
+        fname = "part-%05d.parquet" % self._file_index
+        full = posixpath.join(self._path, fname)
+        rows_per_group = max(1, _rows_for_bytes(table, self._row_group_bytes))
+        with self._fs.open_output_stream(full) as sink:
+            pq.write_table(
+                table,
+                sink,
+                row_group_size=rows_per_group,
+                compression=self._compression,
+            )
+        num_row_groups = -(-table.num_rows // rows_per_group)  # ceil; avoids re-reading footer
+        self._files_written.append((fname, num_row_groups))
+        self._file_index += 1
+        self._pending = []
+        self._pending_bytes = 0
+
+    def close(self):
+        if self._closed:
+            return
+        self._flush_file()
+        write_petastorm_tpu_metadata(
+            self._fs, self._path, self._schema, dict(self._files_written)
+        )
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.close()
+
+
+def write_dataset(dataset_url, schema, rows, row_group_size_mb=32, rows_per_file=None,
+                  filesystem=None, storage_options=None):
+    """One-shot pyarrow-native dataset write (iterable of row dicts)."""
+    with RowWriter(dataset_url, schema, row_group_size_mb, rows_per_file,
+                   filesystem, storage_options) as w:
+        w.write_many(rows)
+
+
+def write_petastorm_tpu_metadata(fs, path, schema, row_groups_per_file):
+    """Write ``_common_metadata`` carrying the JSON schema + row-group counts."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    arrow_schema = schema.as_arrow_schema()
+    existing = dict(arrow_schema.metadata or {})
+    existing[PTPU_SCHEMA_KEY] = schema.to_json().encode("utf-8")
+    existing[PTPU_ROW_GROUPS_KEY] = json.dumps(row_groups_per_file).encode("utf-8")
+    tagged = arrow_schema.with_metadata(existing)
+    with fs.open_output_stream(posixpath.join(path, "_common_metadata")) as sink:
+        pq.write_metadata(tagged, sink)
+
+
+@contextmanager
+def materialize_dataset(spark, dataset_url, schema, row_group_size_mb=32,
+                        filesystem_factory=None):
+    """Spark-compatible materialization contextmanager (reference API name and shape kept;
+    petastorm/etl/dataset_metadata.py ~L60).
+
+    Sets ``parquet.block.size`` for row-group sizing on entry; on exit counts row groups per
+    written file and writes ``_common_metadata`` with the schema. Requires pyspark.
+    """
+    spark_config = {}
+    hadoop_conf = spark.sparkContext._jsc.hadoopConfiguration()
+    key = "parquet.block.size"
+    spark_config[key] = hadoop_conf.get(key)
+    hadoop_conf.setInt(key, int(row_group_size_mb) << 20)
+    try:
+        yield
+    finally:
+        if spark_config[key] is None:
+            hadoop_conf.unset(key)
+        else:
+            hadoop_conf.set(key, spark_config[key])
+    fs, path = get_filesystem_and_path_or_paths(dataset_url)
+    row_groups = _count_row_groups_per_file(fs, path)
+    write_petastorm_tpu_metadata(fs, path, schema, row_groups)
+
+
+def _count_row_groups_per_file(fs, path):
+    import pyarrow.parquet as pq
+
+    counts = {}
+    for full in _list_parquet_files(fs, path):
+        with fs.open_input_file(full) as f:
+            counts[posixpath.relpath(full, path)] = pq.ParquetFile(f).metadata.num_row_groups
+    return counts
+
+
+# --------------------------------------------------------------------------------------
+# Read side
+# --------------------------------------------------------------------------------------
+
+
+def _list_parquet_files(fs, path):
+    import pyarrow.fs as pafs
+
+    info = fs.get_file_info(path)
+    if info.type == pafs.FileType.File:
+        return [path]
+    selector = pafs.FileSelector(path, recursive=True)
+    files = []
+    for fi in fs.get_file_info(selector):
+        base = posixpath.basename(fi.path)
+        if fi.type == pafs.FileType.File and not base.startswith(("_", ".")):
+            if base.endswith((".parquet", ".parq")) or "." not in base:
+                files.append(fi.path)
+    return sorted(files)
+
+
+def _read_kv_metadata(fs, path):
+    """Merged KV metadata from ``_common_metadata`` and ``_metadata`` if present, else None.
+
+    Both files are consulted (keys may live in either; _common_metadata wins on conflicts).
+    """
+    import pyarrow.parquet as pq
+
+    merged = None
+    for meta_name in reversed(_METADATA_FILES):  # _metadata first so _common_metadata overrides
+        full = posixpath.join(path, meta_name)
+        try:
+            with fs.open_input_file(full) as f:
+                md = pq.read_schema(f).metadata
+        except (FileNotFoundError, OSError):
+            continue
+        if md:
+            merged = {**(merged or {}), **dict(md)}
+        elif merged is None:
+            merged = {}
+    return merged
+
+
+def get_schema(fs, path):
+    """Recover the Unischema stored with a dataset (native JSON or reference pickle).
+
+    Reference: petastorm/etl/dataset_metadata.py ``get_schema`` ~L250.
+    """
+    kv = _read_kv_metadata(fs, path)
+    if kv is None:
+        raise MetadataError(
+            "Dataset at %r has no _common_metadata/_metadata; was it written by "
+            "materialize_dataset/write_dataset? Use make_batch_reader for vanilla "
+            "Parquet stores." % path
+        )
+    if PTPU_SCHEMA_KEY in kv:
+        from petastorm_tpu.unischema import Unischema
+
+        return Unischema.from_json(kv[PTPU_SCHEMA_KEY].decode("utf-8"))
+    if REFERENCE_SCHEMA_KEY in kv:
+        from petastorm_tpu.compat.reference import loads_reference_pickle
+
+        return loads_reference_pickle(kv[REFERENCE_SCHEMA_KEY])
+    raise MetadataError(
+        "Dataset at %r has parquet metadata but no unischema key; use make_batch_reader "
+        "or regenerate metadata (petastorm-tpu-generate-metadata)." % path
+    )
+
+
+def get_schema_from_dataset_url(dataset_url, storage_options=None, filesystem=None):
+    """Reference API: URL → stored Unischema (~L300)."""
+    fs, path = get_filesystem_and_path_or_paths(
+        dataset_url, storage_options=storage_options, filesystem=filesystem
+    )
+    return get_schema(fs, path)
+
+
+def infer_or_load_unischema(fs, path):
+    """Stored Unischema if present, else infer a codec-less one from the Arrow schema.
+
+    Reference: ``infer_or_load_unischema`` ~L340.
+    """
+    try:
+        return get_schema(fs, path)
+    except MetadataError:
+        import pyarrow.parquet as pq
+
+        from petastorm_tpu.unischema import Unischema
+
+        files = _list_parquet_files(fs, path)
+        if not files:
+            raise MetadataError("No parquet files found under %r" % path)
+        with fs.open_input_file(files[0]) as f:
+            arrow_schema = pq.read_schema(f)
+        return Unischema.from_arrow_schema(arrow_schema)
+
+
+def load_row_groups(fs, path, validate=False):
+    """Enumerate :class:`RowGroupPiece` work units for a dataset.
+
+    Fast path: per-file row-group counts from KV metadata (no footer scans — reference
+    ``load_row_groups`` ~L150 semantics). Fallback: open each footer. ``num_rows`` is filled
+    when footers are read, else -1 (planning does not need it).
+    """
+    kv = _read_kv_metadata(fs, path)
+    counts = None
+    if kv is not None:
+        if PTPU_ROW_GROUPS_KEY in kv:
+            counts = json.loads(kv[PTPU_ROW_GROUPS_KEY].decode("utf-8"))
+        elif REFERENCE_ROW_GROUPS_KEY in kv:
+            from petastorm_tpu.compat.reference import loads_reference_pickle
+
+            counts = loads_reference_pickle(kv[REFERENCE_ROW_GROUPS_KEY])
+    pieces = []
+    if counts is not None and not validate:
+        for fname in sorted(counts):
+            full = fname if posixpath.isabs(fname) else posixpath.join(path, fname)
+            for rg in range(int(counts[fname])):
+                pieces.append(RowGroupPiece(full, rg, -1))
+        return pieces
+    # footer scan fallback (vanilla parquet stores)
+    import pyarrow.parquet as pq
+
+    for full in _list_parquet_files(fs, path):
+        with fs.open_input_file(full) as f:
+            md = pq.ParquetFile(f).metadata
+        for rg in range(md.num_row_groups):
+            pieces.append(RowGroupPiece(full, rg, md.row_group(rg).num_rows))
+    return pieces
+
+
+def _rows_for_bytes(table, target_bytes):
+    """Rows per row group so groups land near ``target_bytes`` (pre-compression estimate)."""
+    if table.num_rows == 0:
+        return 1
+    per_row = max(1, table.nbytes // table.num_rows)
+    return max(1, target_bytes // per_row)

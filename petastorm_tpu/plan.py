@@ -1,0 +1,130 @@
+"""Deterministic epoch planning: sharding, per-epoch shuffling, resumable cursor.
+
+This replaces the reference's runtime scheduler state (petastorm/workers_pool/ventilator.py ~L60
+``ConcurrentVentilator``: per-epoch reshuffle, ``iterations`` epochs, item feed) with a **pure
+function of (seed, epoch, shard)** — the TPU-idiomatic design: every host computes the same global
+plan and takes its slice by ``jax.process_index()``, so multi-host data parallelism needs zero
+runtime communication (same property the reference gets from ``cur_shard``/``shard_count``,
+petastorm/reader.py ~L470) and any position is checkpointable/resumable as a tiny state dict —
+the checkpoint/resume upgrade SURVEY.md §6 calls for (the reference has none).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def shard_indices(num_items, cur_shard, shard_count, shard_seed=None):
+    """Deterministic round-robin partition of ``range(num_items)`` for one shard.
+
+    Matches reference semantics (petastorm/reader.py ~L470 ``_apply_row_drop_partition``
+    neighborhood): optional seeded global permutation, then round-robin. Every shard computes
+    the same permutation, so shards are disjoint and their union is exact.
+    """
+    if shard_count is None:
+        return np.arange(num_items)
+    if not (0 <= cur_shard < shard_count):
+        raise ValueError(
+            "cur_shard must be in [0, %d), got %r" % (shard_count, cur_shard)
+        )
+    order = np.arange(num_items)
+    if shard_seed is not None:
+        order = np.random.Generator(np.random.PCG64(shard_seed)).permutation(num_items)
+    return order[cur_shard::shard_count]
+
+
+def epoch_permutation(num_items, epoch, seed, shuffle):
+    """Permutation of ``range(num_items)`` for one epoch; identity when not shuffling.
+
+    Seeded by (seed, epoch) so every host derives the identical order with no communication.
+    """
+    if not shuffle:
+        return np.arange(num_items)
+    seq = np.random.SeedSequence([0 if seed is None else int(seed), int(epoch)])
+    return np.random.Generator(np.random.PCG64(seq)).permutation(num_items)
+
+
+class EpochPlan:
+    """Resumable iterator over item indices across epochs.
+
+    ``num_epochs=None`` means infinite (reference ``num_epochs=None`` contract). State is
+    (epoch, position); :meth:`state_dict`/:meth:`load_state_dict` checkpoint it exactly.
+    """
+
+    def __init__(self, items, num_epochs=1, shuffle=False, seed=None):
+        self._items = list(items)
+        if num_epochs is not None and (not isinstance(num_epochs, int) or num_epochs < 1):
+            raise ValueError("num_epochs must be a positive integer or None, got %r" % num_epochs)
+        self._num_epochs = num_epochs
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+        self._pos = 0
+        self._perm = epoch_permutation(len(self._items), 0, seed, shuffle)
+
+    @property
+    def items(self):
+        return self._items
+
+    @property
+    def epoch(self):
+        return self._epoch
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._items:
+            raise StopIteration
+        if self._num_epochs is not None and self._epoch >= self._num_epochs:
+            raise StopIteration
+        item = self._items[int(self._perm[self._pos])]
+        self._pos += 1
+        if self._pos >= len(self._items):
+            self._pos = 0
+            self._epoch += 1
+            if self._num_epochs is None or self._epoch < self._num_epochs:
+                self._perm = epoch_permutation(
+                    len(self._items), self._epoch, self._seed, self._shuffle
+                )
+        return item
+
+    def remaining_in_epoch(self):
+        return len(self._items) - self._pos
+
+    def exhausted(self):
+        if not self._items:
+            return True
+        return self._num_epochs is not None and self._epoch >= self._num_epochs
+
+    def reset(self):
+        """Restart from epoch 0 (reference ``Reader.reset()``, petastorm/reader.py ~L700)."""
+        self._epoch = 0
+        self._pos = 0
+        self._perm = epoch_permutation(len(self._items), 0, self._seed, self._shuffle)
+
+    # -- checkpoint/resume ---------------------------------------------------------------
+
+    def state_dict(self):
+        return {
+            "epoch": self._epoch,
+            "pos": self._pos,
+            "seed": self._seed,
+            "shuffle": self._shuffle,
+            "num_epochs": self._num_epochs,
+            "num_items": len(self._items),
+        }
+
+    def load_state_dict(self, state):
+        if state["num_items"] != len(self._items):
+            raise ValueError(
+                "Checkpoint was taken over %d items; plan has %d"
+                % (state["num_items"], len(self._items))
+            )
+        self._epoch = int(state["epoch"])
+        self._pos = int(state["pos"])
+        self._seed = state["seed"]
+        self._shuffle = state["shuffle"]
+        self._num_epochs = state["num_epochs"]
+        self._perm = epoch_permutation(
+            len(self._items), self._epoch, self._seed, self._shuffle
+        )

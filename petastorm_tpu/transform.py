@@ -34,6 +34,10 @@ def transform_schema(schema, transform_spec):
     for edit in transform_spec.edit_fields:
         if isinstance(edit, UnischemaField):
             new_field = edit
+        elif len(edit) == 4:
+            # reference petastorm edit_fields contract: (name, numpy_dtype, shape, is_nullable)
+            name, numpy_dtype, shape, nullable = edit
+            new_field = UnischemaField(name, numpy_dtype, shape, None, nullable)
         else:
             new_field = UnischemaField(*edit)
         fields[new_field.name] = new_field

@@ -139,7 +139,16 @@ class Unischema:
     def make_namedtuple(self, **kwargs):
         """Build a row namedtuple from per-field kwargs (missing nullable fields -> None)."""
         typ = self.make_namedtuple_type()
-        values = {name: kwargs.get(name) for name in self._fields}
+        values = {}
+        for name, field in self._fields.items():
+            if name in kwargs:
+                values[name] = kwargs[name]
+            elif field.nullable:
+                values[name] = None
+            else:
+                raise ValueError(
+                    "Field %r is not nullable but missing from the row" % name
+                )
         return typ(**values)
 
     def make_namedtuple_type(self):

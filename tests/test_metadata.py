@@ -1,0 +1,134 @@
+"""Metadata/write-path tests (reference model: petastorm/tests/test_dataset_metadata.py)."""
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu import types as ptypes
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.errors import MetadataError
+from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+from petastorm_tpu.metadata import (
+    PTPU_SCHEMA_KEY,
+    RowGroupPiece,
+    RowWriter,
+    get_schema,
+    get_schema_from_dataset_url,
+    infer_or_load_unischema,
+    load_row_groups,
+    write_dataset,
+)
+from petastorm_tpu.unischema import Unischema, UnischemaField
+from petastorm_tpu.utils import decode_row
+
+
+@pytest.fixture
+def schema():
+    return Unischema(
+        "M",
+        [
+            UnischemaField("id", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+            UnischemaField("vec", np.float32, (4,), NdarrayCodec(), False),
+        ],
+    )
+
+
+def _rows(n, rng):
+    return [{"id": i, "vec": rng.standard_normal(4).astype(np.float32)} for i in range(n)]
+
+
+def test_write_and_recover_schema(tmp_path, schema, rng):
+    url = "file://" + str(tmp_path / "ds")
+    write_dataset(url, schema, _rows(10, rng))
+    back = get_schema_from_dataset_url(url)
+    assert list(back.fields.keys()) == ["id", "vec"]
+    assert back.vec.shape == (4,)
+    assert isinstance(back.vec.codec, NdarrayCodec)
+
+
+def test_row_group_pieces_from_kv(tmp_path, schema, rng):
+    url = str(tmp_path / "ds")
+    write_dataset(url, schema, _rows(20, rng), rows_per_file=10)
+    fs, path = get_filesystem_and_path_or_paths(url)
+    pieces = load_row_groups(fs, path)
+    assert len(pieces) >= 2
+    assert all(isinstance(p, RowGroupPiece) for p in pieces)
+    # KV fast-path does not know num_rows
+    assert all(p.num_rows == -1 for p in pieces)
+    # footer scan agrees on count
+    validated = load_row_groups(fs, path, validate=True)
+    assert len(validated) == len(pieces)
+    assert sum(p.num_rows for p in validated) == 20
+
+
+def test_rows_readable_via_pieces(tmp_path, schema, rng):
+    url = str(tmp_path / "ds")
+    rows = _rows(15, rng)
+    write_dataset(url, schema, rows, rows_per_file=8)
+    fs, path = get_filesystem_and_path_or_paths(url)
+    seen = {}
+    for piece in load_row_groups(fs, path, validate=True):
+        with fs.open_input_file(piece.path) as f:
+            table = pq.ParquetFile(f).read_row_group(piece.row_group)
+        for stored in table.to_pylist():
+            d = decode_row(stored, schema)
+            seen[d["id"]] = d["vec"]
+    assert sorted(seen.keys()) == list(range(15))
+    np.testing.assert_array_equal(seen[3], rows[3]["vec"])
+
+
+def test_vanilla_parquet_infer(tmp_path):
+    import pyarrow as pa
+
+    table = pa.table({"a": [1, 2, 3], "b": [1.0, 2.0, 3.0]})
+    p = tmp_path / "vanilla"
+    p.mkdir()
+    pq.write_table(table, p / "x.parquet")
+    fs, path = get_filesystem_and_path_or_paths(str(p))
+    schema = infer_or_load_unischema(fs, path)
+    assert schema.a.codec is None
+    with pytest.raises(MetadataError):
+        get_schema(fs, path)
+    pieces = load_row_groups(fs, path)
+    assert len(pieces) == 1 and pieces[0].num_rows == 3
+
+
+def test_common_metadata_has_native_key(tmp_path, schema, rng):
+    url = str(tmp_path / "ds")
+    write_dataset(url, schema, _rows(5, rng))
+    md = pq.read_schema(str(tmp_path / "ds" / "_common_metadata")).metadata
+    assert PTPU_SCHEMA_KEY in md
+
+
+def test_reference_pickled_schema_readable(tmp_path, schema, rng):
+    """Simulate a dataset written by real petastorm: pickled schema under the reference key."""
+    import pickle
+
+    import pyarrow as pa
+
+    url = str(tmp_path / "refds")
+    write_dataset(url, schema, _rows(5, rng))
+    # Rewrite _common_metadata with a reference-style pickled payload. The pickle references
+    # petastorm_tpu classes; rewrite module names to 'petastorm.*' to simulate the reference.
+    payload = pickle.dumps(schema, protocol=2)
+    payload = payload.replace(b"petastorm_tpu.unischema", b"petastorm.unischema")
+    payload = payload.replace(b"petastorm_tpu.codecs", b"petastorm.codecs")
+    # GLOBAL opcode module names are newline-terminated, so differing lengths are fine
+    payload = payload.replace(b"petastorm_tpu.types", b"pyspark.sql.types")
+    arrow_schema = schema.as_arrow_schema().with_metadata(
+        {b"dataset-toolkit.unischema.v1": payload}
+    )
+    pq.write_metadata(arrow_schema, str(tmp_path / "refds" / "_common_metadata"))
+    fs, path = get_filesystem_and_path_or_paths(url)
+    back = get_schema(fs, path)
+    assert list(back.fields.keys()) == ["id", "vec"]
+
+
+def test_writer_context_manager_no_metadata_on_error(tmp_path, schema, rng):
+    url = str(tmp_path / "err")
+    with pytest.raises(RuntimeError):
+        with RowWriter(url, schema) as w:
+            w.write({"id": 0, "vec": np.zeros(4, np.float32)})
+            raise RuntimeError("boom")
+    fs, path = get_filesystem_and_path_or_paths(url)
+    with pytest.raises(MetadataError):
+        get_schema(fs, path)

@@ -1,0 +1,107 @@
+"""Plan/scheduler tests: sharding determinism, epoch shuffling, checkpoint/resume."""
+import numpy as np
+import pytest
+
+from petastorm_tpu.plan import EpochPlan, epoch_permutation, shard_indices
+
+
+def test_shards_disjoint_and_exact():
+    n, k = 23, 4
+    union = []
+    for i in range(k):
+        union.extend(shard_indices(n, i, k).tolist())
+    assert sorted(union) == list(range(n))
+
+
+def test_shard_seed_deterministic_and_different():
+    a = shard_indices(100, 1, 4, shard_seed=7)
+    b = shard_indices(100, 1, 4, shard_seed=7)
+    c = shard_indices(100, 1, 4, shard_seed=8)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    # seeded shards are still disjoint/exact
+    union = np.concatenate([shard_indices(100, i, 4, shard_seed=7) for i in range(4)])
+    assert sorted(union.tolist()) == list(range(100))
+
+
+def test_shard_validation():
+    with pytest.raises(ValueError):
+        shard_indices(10, 4, 4)
+    with pytest.raises(ValueError):
+        shard_indices(10, -1, 4)
+
+
+def test_epoch_permutation_identity_when_not_shuffling():
+    np.testing.assert_array_equal(epoch_permutation(5, 3, 42, False), np.arange(5))
+
+
+def test_epoch_permutations_differ_across_epochs():
+    p0 = epoch_permutation(50, 0, 42, True)
+    p1 = epoch_permutation(50, 1, 42, True)
+    assert not np.array_equal(p0, p1)
+    np.testing.assert_array_equal(p0, epoch_permutation(50, 0, 42, True))
+
+
+def test_plan_single_epoch_order():
+    plan = EpochPlan(["a", "b", "c"], num_epochs=1, shuffle=False)
+    assert list(plan) == ["a", "b", "c"]
+
+
+def test_plan_multiple_epochs():
+    plan = EpochPlan([0, 1, 2], num_epochs=3, shuffle=False)
+    assert list(plan) == [0, 1, 2] * 3
+
+
+def test_plan_shuffled_epochs_cover_all():
+    plan = EpochPlan(list(range(10)), num_epochs=2, shuffle=True, seed=1)
+    out = list(plan)
+    assert sorted(out[:10]) == list(range(10))
+    assert sorted(out[10:]) == list(range(10))
+    assert out[:10] != out[10:]  # reshuffled per epoch
+
+
+def test_plan_infinite():
+    plan = EpochPlan([0, 1], num_epochs=None)
+    out = [next(plan) for _ in range(7)]
+    assert out == [0, 1, 0, 1, 0, 1, 0]
+    assert not plan.exhausted()
+
+
+def test_plan_empty():
+    plan = EpochPlan([], num_epochs=1)
+    assert plan.exhausted()
+    with pytest.raises(StopIteration):
+        next(plan)
+
+
+def test_plan_invalid_epochs():
+    with pytest.raises(ValueError):
+        EpochPlan([1], num_epochs=0)
+    with pytest.raises(ValueError):
+        EpochPlan([1], num_epochs=1.5)
+
+
+def test_plan_reset():
+    plan = EpochPlan([0, 1, 2], num_epochs=1, shuffle=True, seed=3)
+    first = list(plan)
+    plan.reset()
+    assert list(plan) == first
+
+
+def test_plan_checkpoint_resume():
+    plan = EpochPlan(list(range(7)), num_epochs=3, shuffle=True, seed=9)
+    consumed = [next(plan) for _ in range(10)]
+    state = plan.state_dict()
+    rest = list(plan)
+    plan2 = EpochPlan(list(range(7)), num_epochs=3, shuffle=True, seed=9)
+    plan2.load_state_dict(state)
+    assert list(plan2) == rest
+    assert len(consumed) + len(rest) == 21
+
+
+def test_plan_checkpoint_wrong_size_rejected():
+    plan = EpochPlan(list(range(5)))
+    state = plan.state_dict()
+    other = EpochPlan(list(range(6)))
+    with pytest.raises(ValueError, match="items"):
+        other.load_state_dict(state)
