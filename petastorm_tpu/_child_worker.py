@@ -1,0 +1,49 @@
+"""Clean child-process entry point for :class:`petastorm_tpu.workers.ProcessExecutor`.
+
+Children are started as ``python -m petastorm_tpu._child_worker <socket>`` — a fresh
+interpreter that NEVER re-imports the user's ``__main__`` (unlike multiprocessing spawn/
+forkserver, which fork-bombs unguarded user scripts) and never forks a threaded parent
+(deadlock hazard under JAX). This is the same design as the reference's
+``exec_in_new_process`` bootstrap (petastorm/workers_pool/exec_in_new_process.py ~L20),
+with ``multiprocessing.connection`` replacing ZeroMQ.
+
+Protocol: parent sends the pickled worker once, then items; child answers ("ok", result) or
+("exc", exception); ``None`` item = shut down.
+"""
+import pickle
+import sys
+from multiprocessing.connection import Client
+
+
+def main():
+    address = sys.argv[1]
+    authkey = sys.stdin.buffer.read(32)
+    conn = Client(address, authkey=authkey)
+    try:
+        # parent's sys.path first, so the worker pickle can resolve user modules
+        for entry in conn.recv():
+            if entry not in sys.path:
+                sys.path.append(entry)
+        worker = conn.recv()
+        while True:
+            item = conn.recv()
+            if item is None:
+                return
+            try:
+                result = worker(item)
+            except Exception as e:  # noqa: BLE001 - ship to parent
+                try:
+                    pickle.dumps(e)
+                    conn.send(("exc", e))
+                except Exception:  # unpicklable exception: reconstruct
+                    conn.send(("exc", RuntimeError("%s: %s" % (type(e).__name__, e))))
+                continue
+            conn.send(("ok", result))
+    except (EOFError, BrokenPipeError, ConnectionResetError):
+        return
+    finally:
+        conn.close()
+
+
+if __name__ == "__main__":
+    main()

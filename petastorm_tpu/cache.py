@@ -1,0 +1,139 @@
+"""Row-group result caches.
+
+Capability parity with petastorm/cache.py (``CacheBase``, ``NullCache`` ~L20) and
+petastorm/local_disk_cache.py + petastorm/local_disk_arrow_table_cache.py (~L30): memoize
+decoded row-group results on local disk keyed by (url, piece, predicate...).
+
+The reference uses the ``diskcache`` package (not available here); ``LocalDiskCache`` below is a
+small self-contained file cache: one file per key (sha256 name), pickle or Arrow IPC payloads,
+LRU-by-mtime eviction against a size limit. Reader workers cache python/numpy payloads via
+pickle; the Arrow IPC serializer serves direct users caching pyarrow Tables (memory-mapped,
+zero-copy reads).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+
+
+class CacheBase:
+    def get(self, key, fill_cache_func):
+        """Return cached value for ``key``; on miss call ``fill_cache_func()``, store, return."""
+        raise NotImplementedError
+
+    def cleanup(self):
+        pass
+
+
+class NullCache(CacheBase):
+    """No caching: always calls the fill function (reference ~L20)."""
+
+    def get(self, key, fill_cache_func):
+        return fill_cache_func()
+
+
+class LocalDiskCache(CacheBase):
+    """File-per-key local disk cache with LRU-by-mtime eviction.
+
+    ``serializer``: 'pickle' (any python value) or 'arrow' (pyarrow.Table payloads, IPC format —
+    the reference's LocalDiskArrowTableCache equivalent).
+    """
+
+    def __init__(self, path, size_limit_bytes=None, expected_row_size_bytes=None,
+                 serializer="pickle", cleanup=False, **_ignored):
+        self._path = path
+        self._size_limit = size_limit_bytes
+        self._serializer = serializer
+        self._cleanup_on_exit = cleanup
+        os.makedirs(path, exist_ok=True)
+
+    def _key_path(self, key):
+        digest = hashlib.sha256(str(key).encode("utf-8")).hexdigest()
+        ext = "arrow" if self._serializer == "arrow" else "pkl"
+        return os.path.join(self._path, "%s.%s" % (digest, ext))
+
+    def get(self, key, fill_cache_func):
+        fpath = self._key_path(key)
+        if os.path.exists(fpath):
+            try:
+                value = self._read(fpath)
+                os.utime(fpath)  # touch for LRU
+                return value
+            except Exception:  # noqa: BLE001 - corrupt entry: refill
+                os.unlink(fpath)
+        value = fill_cache_func()
+        self._write(fpath, value)
+        if self._size_limit:
+            self._evict()
+        return value
+
+    def _read(self, fpath):
+        if self._serializer == "arrow":
+            import pyarrow as pa
+
+            with pa.memory_map(fpath) as source:
+                return pa.ipc.open_file(source).read_all()
+        with open(fpath, "rb") as f:
+            return pickle.load(f)
+
+    def _write(self, fpath, value):
+        tmp = fpath + ".tmp.%d" % os.getpid()
+        if self._serializer == "arrow":
+            import pyarrow as pa
+
+            with pa.OSFile(tmp, "wb") as sink:
+                with pa.ipc.new_file(sink, value.schema) as writer:
+                    writer.write_table(value)
+        else:
+            with open(tmp, "wb") as f:
+                pickle.dump(value, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, fpath)
+
+    def _evict(self):
+        entries = []
+        total = 0
+        for name in os.listdir(self._path):
+            fpath = os.path.join(self._path, name)
+            try:
+                st = os.stat(fpath)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, st.st_size, fpath))
+            total += st.st_size
+        entries.sort()
+        for _, size, fpath in entries:
+            if total <= self._size_limit:
+                break
+            try:
+                os.unlink(fpath)
+                total -= size
+            except OSError:
+                pass
+
+    def cleanup(self):
+        if self._cleanup_on_exit:
+            import shutil
+
+            shutil.rmtree(self._path, ignore_errors=True)
+
+
+def make_cache(cache_type, cache_location=None, cache_size_limit=None,
+               cache_row_size_estimate=None, cache_extra_settings=None):
+    """Factory matching the reference's ``cache_type`` reader kwargs ('null'|'local-disk').
+
+    Reader workers cache python/numpy payloads, so the pickle serializer is used; the 'arrow'
+    serializer remains available to direct :class:`LocalDiskCache` users holding pyarrow Tables.
+    """
+    if cache_type in (None, "null"):
+        return NullCache()
+    if cache_type == "local-disk":
+        if not cache_location:
+            raise ValueError("cache_type='local-disk' requires cache_location")
+        return LocalDiskCache(
+            cache_location,
+            size_limit_bytes=cache_size_limit,
+            expected_row_size_bytes=cache_row_size_estimate,
+            **(cache_extra_settings or {}),
+        )
+    raise ValueError("Unknown cache_type %r (expected 'null' or 'local-disk')" % cache_type)

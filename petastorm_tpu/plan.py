@@ -50,13 +50,20 @@ class EpochPlan:
     (epoch, position); :meth:`state_dict`/:meth:`load_state_dict` checkpoint it exactly.
     """
 
-    def __init__(self, items, num_epochs=1, shuffle=False, seed=None):
+    def __init__(self, items, num_epochs=1, shuffle=False, seed=None, with_epoch=False,
+                 skip=None):
+        """``with_epoch=True`` yields ``(epoch, item)`` instead of ``item`` (lets a consumer
+        tag in-flight work with its dispatch epoch for exact resume). ``skip``: optional
+        ``{epoch: set(item_key)}`` of already-consumed work to omit, where item_key is
+        ``items.index``-positional ordinal."""
         self._items = list(items)
         if num_epochs is not None and (not isinstance(num_epochs, int) or num_epochs < 1):
             raise ValueError("num_epochs must be a positive integer or None, got %r" % num_epochs)
         self._num_epochs = num_epochs
         self._shuffle = shuffle
         self._seed = seed
+        self._with_epoch = with_epoch
+        self._skip = {int(k): set(v) for k, v in (skip or {}).items()}
         self._epoch = 0
         self._pos = 0
         self._perm = epoch_permutation(len(self._items), 0, seed, shuffle)
@@ -73,20 +80,27 @@ class EpochPlan:
         return self
 
     def __next__(self):
-        if not self._items:
-            raise StopIteration
-        if self._num_epochs is not None and self._epoch >= self._num_epochs:
-            raise StopIteration
-        item = self._items[int(self._perm[self._pos])]
-        self._pos += 1
-        if self._pos >= len(self._items):
-            self._pos = 0
-            self._epoch += 1
-            if self._num_epochs is None or self._epoch < self._num_epochs:
-                self._perm = epoch_permutation(
-                    len(self._items), self._epoch, self._seed, self._shuffle
-                )
-        return item
+        while True:
+            if not self._items:
+                raise StopIteration
+            if self._num_epochs is not None and self._epoch >= self._num_epochs:
+                raise StopIteration
+            epoch = self._epoch
+            ordinal = int(self._perm[self._pos])
+            self._pos += 1
+            if self._pos >= len(self._items):
+                self._pos = 0
+                self._epoch += 1
+                if self._num_epochs is None or self._epoch < self._num_epochs:
+                    self._perm = epoch_permutation(
+                        len(self._items), self._epoch, self._seed, self._shuffle
+                    )
+            if self._skip and ordinal in self._skip.get(epoch, ()):
+                continue
+            item = self._items[ordinal]
+            if self._with_epoch:
+                return (epoch, ordinal, item)
+            return item
 
     def remaining_in_epoch(self):
         return len(self._items) - self._pos
@@ -100,7 +114,18 @@ class EpochPlan:
         """Restart from epoch 0 (reference ``Reader.reset()``, petastorm/reader.py ~L700)."""
         self._epoch = 0
         self._pos = 0
+        self._skip = {}
         self._perm = epoch_permutation(len(self._items), 0, self._seed, self._shuffle)
+
+    def seek_epoch(self, epoch):
+        """Jump to the start of ``epoch`` (used by consumed-aware resume)."""
+        self._epoch = int(epoch)
+        self._pos = 0
+        self._perm = epoch_permutation(len(self._items), self._epoch, self._seed, self._shuffle)
+
+    def set_skip(self, skip):
+        """Set the {epoch: set(ordinal)} map of work to omit (consumed-aware resume)."""
+        self._skip = {int(k): set(v) for k, v in (skip or {}).items()}
 
     # -- checkpoint/resume ---------------------------------------------------------------
 

@@ -1,0 +1,193 @@
+"""Host-side shuffling buffers: decorrelate row order beyond row-group shuffling.
+
+Capability parity with petastorm/shuffling_buffer.py (``ShufflingBufferBase``,
+``NoopShufflingBuffer`` ~L40, ``RandomShufflingBuffer`` ~L80) plus a batched variant that
+operates on whole column batches (the reference's torch-specific
+petastorm/reader_impl/pytorch_shuffling_buffer.py ~L90 generalized to numpy — framework-neutral,
+so the JAX, torch and tf adapters all share it).
+
+The on-device (HBM) shuffle lives in petastorm_tpu/ops/hbm_shuffle.py; these host buffers are
+the portable path and the one used below batch-assembly granularity.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ShufflingBufferBase:
+    def add_many(self, items):
+        raise NotImplementedError
+
+    def retrieve(self):
+        raise NotImplementedError
+
+    @property
+    def can_add(self):
+        raise NotImplementedError
+
+    @property
+    def can_retrieve(self):
+        raise NotImplementedError
+
+    @property
+    def size(self):
+        raise NotImplementedError
+
+    def finish(self):
+        """Signal no more items will be added; drain remaining."""
+        raise NotImplementedError
+
+
+class NoopShufflingBuffer(ShufflingBufferBase):
+    """FIFO passthrough (reference ~L40)."""
+
+    def __init__(self):
+        from collections import deque
+
+        self._items = deque()
+        self._done = False
+
+    def add_many(self, items):
+        self._items.extend(items)
+
+    def retrieve(self):
+        return self._items.popleft()
+
+    @property
+    def can_add(self):
+        return not self._done
+
+    @property
+    def can_retrieve(self):
+        return len(self._items) > 0
+
+    @property
+    def size(self):
+        return len(self._items)
+
+    def finish(self):
+        self._done = True
+
+
+class RandomShufflingBuffer(ShufflingBufferBase):
+    """Bounded reservoir: add until capacity, retrieve uniformly at random once past the
+    retrieval threshold (reference ~L80: capacity + ``min_after_retrieve`` semantics).
+    """
+
+    def __init__(self, shuffling_buffer_capacity, min_after_retrieve, extra_capacity=1000,
+                 seed=None):
+        if min_after_retrieve > shuffling_buffer_capacity:
+            raise ValueError("min_after_retrieve must be <= capacity")
+        self._capacity = shuffling_buffer_capacity
+        self._min_after_retrieve = min_after_retrieve
+        self._extra_capacity = extra_capacity
+        self._items = []
+        self._done = False
+        self._rng = np.random.Generator(np.random.PCG64(seed))
+
+    def add_many(self, items):
+        items = list(items)
+        if self._done:
+            raise RuntimeError("Cannot add to a finished shuffling buffer")
+        if len(self._items) + len(items) > self._capacity + self._extra_capacity:
+            raise RuntimeError(
+                "Attempt to add %d items to a buffer at %d/%d capacity; honor can_add "
+                "backpressure" % (len(items), len(self._items), self._capacity)
+            )
+        self._items.extend(items)
+
+    def retrieve(self):
+        if not self.can_retrieve:
+            raise RuntimeError("Buffer below retrieval threshold and not finished")
+        idx = int(self._rng.integers(len(self._items)))
+        self._items[idx], self._items[-1] = self._items[-1], self._items[idx]
+        return self._items.pop()
+
+    @property
+    def can_add(self):
+        return len(self._items) < self._capacity and not self._done
+
+    @property
+    def can_retrieve(self):
+        if self._done:
+            return len(self._items) > 0
+        return len(self._items) > self._min_after_retrieve
+
+    @property
+    def size(self):
+        return len(self._items)
+
+    def finish(self):
+        self._done = True
+
+
+class BatchedRandomShufflingBuffer(ShufflingBufferBase):
+    """Columnar shuffle: holds {name: ndarray} column batches, retrieves random fixed-size
+    batches by index-select — one vectorized gather instead of per-row python shuffling.
+
+    Generalizes the reference's torch-only batched buffer
+    (petastorm/reader_impl/pytorch_shuffling_buffer.py ~L90) to numpy.
+    """
+
+    def __init__(self, shuffling_buffer_capacity, min_after_retrieve, batch_size, seed=None):
+        if min_after_retrieve > shuffling_buffer_capacity:
+            raise ValueError("min_after_retrieve must be <= capacity")
+        self._capacity = shuffling_buffer_capacity
+        self._min_after_retrieve = min_after_retrieve
+        self._batch_size = batch_size
+        self._columns = None  # {name: list of arrays}
+        self._num_rows = 0
+        self._done = False
+        self._rng = np.random.Generator(np.random.PCG64(seed))
+
+    def add_many(self, column_batch):
+        """column_batch: {name: np.ndarray} with equal leading dims."""
+        if self._done:
+            raise RuntimeError("Cannot add to a finished shuffling buffer")
+        names = list(column_batch.keys())
+        n = len(column_batch[names[0]])
+        if self._columns is None:
+            self._columns = {name: [] for name in names}
+        for name in names:
+            if len(column_batch[name]) != n:
+                raise ValueError("Ragged column batch: %r" % name)
+            self._columns[name].append(np.asarray(column_batch[name]))
+        self._num_rows += n
+
+    def retrieve(self):
+        """Return a {name: ndarray} batch of up to batch_size random rows."""
+        if not self.can_retrieve:
+            raise RuntimeError("Buffer below retrieval threshold and not finished")
+        self._consolidate()
+        take = min(self._batch_size, self._num_rows)
+        perm = self._rng.permutation(self._num_rows)
+        chosen, keep = perm[:take], perm[take:]
+        out = {}
+        for name, chunks in self._columns.items():
+            arr = chunks[0]
+            out[name] = arr[chosen]
+            self._columns[name] = [arr[keep]]
+        self._num_rows -= take
+        return out
+
+    def _consolidate(self):
+        for name, chunks in self._columns.items():
+            if len(chunks) > 1:
+                self._columns[name] = [np.concatenate(chunks, axis=0)]
+
+    @property
+    def can_add(self):
+        return self._num_rows < self._capacity and not self._done
+
+    @property
+    def can_retrieve(self):
+        if self._done:
+            return self._num_rows > 0
+        return self._num_rows >= self._min_after_retrieve + self._batch_size
+
+    @property
+    def size(self):
+        return self._num_rows
+
+    def finish(self):
+        self._done = True

@@ -1,0 +1,52 @@
+"""Probabilistic mixing of several readers (reference: petastorm/weighted_sampling_reader.py
+~L30 ``WeightedSamplingReader``): each ``next()`` draws one of the underlying readers with the
+given probabilities — dataset mixing for multi-corpus training."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class WeightedSamplingReader:
+    def __init__(self, readers, probabilities, seed=None):
+        if len(readers) != len(probabilities):
+            raise ValueError("readers and probabilities must have equal length")
+        p = np.asarray(probabilities, dtype=np.float64)
+        if (p < 0).any() or p.sum() <= 0:
+            raise ValueError("probabilities must be non-negative and sum to > 0")
+        self._readers = list(readers)
+        self._p = p / p.sum()
+        self._rng = np.random.Generator(np.random.PCG64(seed))
+        # mixing readers must agree on ngram-ness (reference behavior)
+        self.ngram = readers[0].ngram if hasattr(readers[0], "ngram") else None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        alive = [i for i, r in enumerate(self._readers) if r is not None]
+        while alive:
+            p = self._p[alive] / self._p[alive].sum()
+            pick = int(self._rng.choice(alive, p=p))
+            try:
+                return next(self._readers[pick])
+            except StopIteration:
+                self._readers[pick] = None
+                alive = [i for i, r in enumerate(self._readers) if r is not None]
+        raise StopIteration
+
+    def stop(self):
+        for r in self._readers:
+            if r is not None:
+                r.stop()
+
+    def join(self):
+        for r in self._readers:
+            if r is not None:
+                r.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        self.join()

@@ -17,3 +17,21 @@ import pytest
 @pytest.fixture(scope="session")
 def rng():
     return np.random.RandomState(42)
+
+
+@pytest.fixture(scope="session")
+def synthetic_dataset(tmp_path_factory):
+    """Session-scoped petastorm_tpu dataset exercising every codec (see test_common.py)."""
+    from test_common import create_test_dataset
+
+    path = tmp_path_factory.mktemp("synthetic_ds")
+    return create_test_dataset("file://" + str(path / "ds"), num_rows=30)
+
+
+@pytest.fixture(scope="session")
+def scalar_dataset(tmp_path_factory):
+    """Session-scoped vanilla-parquet dataset for make_batch_reader tests."""
+    from test_common import create_test_scalar_dataset
+
+    path = tmp_path_factory.mktemp("scalar_ds")
+    return create_test_scalar_dataset("file://" + str(path / "ds"), num_rows=30)

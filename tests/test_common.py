@@ -1,0 +1,127 @@
+"""Synthetic dataset builders shared by the test suite.
+
+Mirrors the reference's fixture strategy (petastorm/tests/test_common.py: ``TestSchema`` ~L40
+exercising every codec/type, ``create_test_dataset`` ~L100, ``create_test_scalar_dataset``
+~L180) with the Spark write path replaced by our pyarrow-native writer.
+"""
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from petastorm_tpu import types as ptypes
+from petastorm_tpu.codecs import (
+    CompressedImageCodec,
+    CompressedNdarrayCodec,
+    NdarrayCodec,
+    ScalarCodec,
+)
+from petastorm_tpu.metadata import write_dataset
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+TestSchema = Unischema(
+    "TestSchema",
+    [
+        UnischemaField("id", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+        UnischemaField("id2", np.int32, (), ScalarCodec(ptypes.IntegerType()), False),
+        UnischemaField("partition_key", np.str_, (), ScalarCodec(ptypes.StringType()), False),
+        UnischemaField("python_primitive_uint8", np.uint8, (),
+                       ScalarCodec(ptypes.ShortType()), False),
+        UnischemaField("image_png", np.uint8, (16, 16, 3), CompressedImageCodec("png"), False),
+        UnischemaField("matrix", np.float32, (8, 4), NdarrayCodec(), False),
+        UnischemaField("matrix_compressed", np.float32, (4, 4),
+                       CompressedNdarrayCodec(), False),
+        UnischemaField("decimal", np.object_, (),
+                       ScalarCodec(ptypes.DecimalType(12, 9)), False),
+        UnischemaField("sensor_name", np.str_, (), ScalarCodec(ptypes.StringType()), False),
+        UnischemaField("timestamp_ms", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+        UnischemaField("nullable_str", np.str_, (), ScalarCodec(ptypes.StringType()), True),
+    ],
+)
+
+
+def make_test_rows(num_rows, seed=0):
+    rng = np.random.RandomState(seed)
+    rows = []
+    for i in range(num_rows):
+        rows.append(
+            {
+                "id": i,
+                "id2": i % 5,
+                "partition_key": "p_%d" % (i % 3),
+                "python_primitive_uint8": np.uint8(i % 255),
+                "image_png": rng.randint(0, 255, (16, 16, 3)).astype(np.uint8),
+                "matrix": rng.standard_normal((8, 4)).astype(np.float32),
+                "matrix_compressed": rng.standard_normal((4, 4)).astype(np.float32),
+                "decimal": decimal.Decimal("%d.%09d" % (i, i)),
+                "sensor_name": "sensor_%d" % (i % 2),
+                "timestamp_ms": 1000 + i * 10,
+                "nullable_str": None if i % 4 == 0 else "val_%d" % i,
+            }
+        )
+    return rows
+
+
+class SyntheticDataset:
+    def __init__(self, url, data, path):
+        self.url = url
+        self.data = data  # list of expected row dicts
+        self.path = path
+
+
+def create_test_dataset(url, num_rows=30, rows_per_file=None, seed=0):
+    rows = make_test_rows(num_rows, seed)
+    write_dataset(url, TestSchema, rows,
+                  rows_per_file=rows_per_file or max(1, num_rows // 3))
+    from urllib.parse import urlparse
+
+    return SyntheticDataset(url, rows, urlparse(url).path)
+
+
+def create_test_scalar_dataset(url, num_rows=30, num_files=2, seed=0):
+    """Vanilla parquet (no unischema metadata) for make_batch_reader tests."""
+    from urllib.parse import urlparse
+
+    import os
+
+    rng = np.random.RandomState(seed)
+    path = urlparse(url).path or url
+    os.makedirs(path, exist_ok=True)
+    all_rows = []
+    per_file = -(-num_rows // num_files)
+    idx = 0
+    for fidx in range(num_files):
+        n = min(per_file, num_rows - idx)
+        if n <= 0:
+            break
+        data = {
+            "id": np.arange(idx, idx + n, dtype=np.int64),
+            "float_col": rng.standard_normal(n),
+            "int_col": rng.randint(-100, 100, n).astype(np.int32),
+            "string_col": np.array(["s_%d" % (idx + j) for j in range(n)], dtype=object),
+            "vector_col": [rng.standard_normal(3).tolist() for _ in range(n)],
+        }
+        table = pa.table(data)
+        pq.write_table(table, os.path.join(path, "part-%02d.parquet" % fidx),
+                       row_group_size=max(1, n // 2))
+        for j in range(n):
+            all_rows.append({k: (v[j] if not isinstance(v, list) else v[j])
+                             for k, v in data.items()})
+        idx += n
+    return SyntheticDataset(url, all_rows, path)
+
+
+def assert_rows_equal(actual_row, expected_dict, schema=TestSchema):
+    """Field-by-field comparison tolerant of jpeg/float lossiness (none here: png+exact)."""
+    for name in schema.fields:
+        actual = getattr(actual_row, name)
+        expected = expected_dict[name]
+        if expected is None:
+            assert actual is None, name
+        elif isinstance(expected, np.ndarray):
+            np.testing.assert_array_equal(actual, expected, err_msg=name)
+        elif isinstance(expected, decimal.Decimal):
+            assert decimal.Decimal(actual) == expected, name
+        else:
+            assert actual == expected, name

@@ -1,0 +1,340 @@
+"""End-to-end reader tests — every feature × every pool × both decode paths.
+
+Mirrors the reference backbone (petastorm/tests/test_end_to_end.py, SURVEY.md §5.2): a
+``reader_factory`` matrix over {dummy, thread, process} pools and {make_reader,
+make_batch_reader}, asserting identical behavior everywhere.
+"""
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.errors import NoDataAvailableError
+from petastorm_tpu.predicates import in_lambda, in_pseudorandom_split, in_set
+from petastorm_tpu.transform import TransformSpec
+
+from test_common import assert_rows_equal
+
+POOLS = ["dummy", "thread", "process"]
+
+
+def _collect_rows(reader):
+    """Reader → {id: row namedtuple} (order-insensitive comparison, reference pattern)."""
+    out = {}
+    for row in reader:
+        out[int(row.id)] = row
+    return out
+
+
+def _collect_batches(reader):
+    out = {}
+    for batch in reader:
+        for j in range(len(batch.id)):
+            out[int(batch.id[j])] = {name: getattr(batch, name)[j]
+                                     for name in batch._fields}
+    return out
+
+
+# ---------------------------------------------------------------------------- make_reader
+
+
+@pytest.mark.parametrize("pool", POOLS)
+def test_simple_read_all_pools(synthetic_dataset, pool):
+    with make_reader(synthetic_dataset.url, reader_pool_type=pool, workers_count=2,
+                     shuffle_row_groups=False) as reader:
+        rows = _collect_rows(reader)
+    assert len(rows) == 30
+    for expected in synthetic_dataset.data:
+        assert_rows_equal(rows[expected["id"]], expected)
+
+
+def test_schema_fields_subset(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id", "matrix"],
+                     reader_pool_type="dummy") as reader:
+        row = next(reader)
+        assert set(row._fields) == {"id", "matrix"}
+
+
+def test_schema_fields_regex(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=["id.*"],
+                     reader_pool_type="dummy") as reader:
+        row = next(reader)
+        assert set(row._fields) == {"id", "id2"}
+
+
+@pytest.mark.parametrize("pool", ["dummy", "thread"])
+def test_predicate_in_set(synthetic_dataset, pool):
+    with make_reader(synthetic_dataset.url,
+                     predicate=in_set({"p_0"}, "partition_key"),
+                     reader_pool_type=pool) as reader:
+        rows = _collect_rows(reader)
+    expected_ids = {r["id"] for r in synthetic_dataset.data if r["partition_key"] == "p_0"}
+    assert set(rows.keys()) == expected_ids
+
+
+def test_predicate_in_lambda(synthetic_dataset):
+    with make_reader(synthetic_dataset.url,
+                     predicate=in_lambda(["id"], lambda v: v["id"] % 2 == 0),
+                     reader_pool_type="dummy") as reader:
+        rows = _collect_rows(reader)
+    assert set(rows.keys()) == {r["id"] for r in synthetic_dataset.data if r["id"] % 2 == 0}
+
+
+def test_predicate_pseudorandom_split(synthetic_dataset):
+    split = [0.5, 0.5]
+    ids = []
+    for subset in (0, 1):
+        with make_reader(synthetic_dataset.url,
+                         predicate=in_pseudorandom_split(split, subset, "partition_key"),
+                         reader_pool_type="dummy") as reader:
+            ids.append(set(_collect_rows(reader).keys()))
+    assert ids[0].isdisjoint(ids[1])
+    assert ids[0] | ids[1] == set(range(30))
+    # deterministic across runs
+    with make_reader(synthetic_dataset.url,
+                     predicate=in_pseudorandom_split(split, 0, "partition_key"),
+                     reader_pool_type="dummy") as reader:
+        assert set(_collect_rows(reader).keys()) == ids[0]
+
+
+@pytest.mark.parametrize("factory,collect", [(make_reader, _collect_rows),
+                                             (make_batch_reader, _collect_batches)])
+def test_sharding_disjoint_exact(synthetic_dataset, factory, collect):
+    k = 3
+    union = {}
+    for shard in range(k):
+        with factory(synthetic_dataset.url, cur_shard=shard, shard_count=k,
+                     reader_pool_type="dummy", shuffle_row_groups=False) as reader:
+            got = collect(reader)
+            assert not (set(union) & set(got)), "shards overlap"
+            union.update(got)
+    assert set(union.keys()) == set(range(30))
+
+
+def test_shard_seed_changes_assignment(synthetic_dataset):
+    def ids_for(seed):
+        with make_reader(synthetic_dataset.url, cur_shard=0, shard_count=3,
+                         shard_seed=seed, reader_pool_type="dummy",
+                         shuffle_row_groups=False) as reader:
+            return set(_collect_rows(reader).keys())
+
+    assert ids_for(1) == ids_for(1)
+    assert ids_for(1) != ids_for(2) or ids_for(1) != ids_for(3)
+
+
+@pytest.mark.parametrize("num_epochs", [1, 3])
+def test_num_epochs(synthetic_dataset, num_epochs):
+    with make_reader(synthetic_dataset.url, num_epochs=num_epochs,
+                     reader_pool_type="dummy", shuffle_row_groups=False) as reader:
+        ids = [int(r.id) for r in reader]
+    assert len(ids) == 30 * num_epochs
+    assert sorted(set(ids)) == list(range(30))
+
+
+def test_infinite_epochs(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, num_epochs=None,
+                     reader_pool_type="dummy") as reader:
+        ids = [int(next(reader).id) for _ in range(75)]
+    assert len(ids) == 75
+
+
+def test_reset(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, num_epochs=1, reader_pool_type="dummy",
+                     shuffle_row_groups=False) as reader:
+        first = [int(r.id) for r in reader]
+        assert reader.last_row_consumed
+        reader.reset()
+        second = [int(r.id) for r in reader]
+    assert first == second
+
+
+def test_shuffle_row_groups_changes_order(synthetic_dataset):
+    def order(shuffle, seed=5):
+        with make_reader(synthetic_dataset.url, shuffle_row_groups=shuffle, seed=seed,
+                         reader_pool_type="dummy") as reader:
+            return [int(r.id) for r in reader]
+
+    assert order(False) == sorted(order(False))
+    assert order(True) != order(False)
+    assert sorted(order(True)) == order(False)
+
+
+def test_shuffle_row_drop_partitions(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, shuffle_row_drop_partitions=2,
+                     reader_pool_type="dummy", shuffle_row_groups=False) as reader:
+        ids = [int(r.id) for r in reader]
+    # every row exactly once, but interleaved differently than plain order
+    assert sorted(ids) == list(range(30))
+
+
+def test_transform_spec_per_row(synthetic_dataset):
+    def double_id(row):
+        row["id2"] = np.int32(row["id2"] * 2)
+        return row
+
+    spec = TransformSpec(double_id)
+    with make_reader(synthetic_dataset.url, transform_spec=spec,
+                     reader_pool_type="dummy") as reader:
+        rows = _collect_rows(reader)
+    for expected in synthetic_dataset.data:
+        assert rows[expected["id"]].id2 == expected["id2"] * 2
+
+
+def test_transform_spec_removes_field(synthetic_dataset):
+    def drop(row):
+        del row["matrix"]
+        return row
+
+    spec = TransformSpec(drop, removed_fields=["matrix"])
+    with make_reader(synthetic_dataset.url, transform_spec=spec,
+                     reader_pool_type="dummy") as reader:
+        row = next(reader)
+    assert "matrix" not in row._fields
+
+
+def test_local_disk_cache(synthetic_dataset, tmp_path):
+    kwargs = dict(cache_type="local-disk", cache_location=str(tmp_path / "cache"),
+                  reader_pool_type="dummy", shuffle_row_groups=False)
+    with make_reader(synthetic_dataset.url, **kwargs) as reader:
+        first = _collect_rows(reader)
+    # second open hits the cache (works even though data could be gone; just verify equality)
+    with make_reader(synthetic_dataset.url, **kwargs) as reader:
+        second = _collect_rows(reader)
+    assert set(first.keys()) == set(second.keys())
+    np.testing.assert_array_equal(first[3].matrix, second[3].matrix)
+
+
+def test_empty_shard_raises(tmp_path):
+    from test_common import create_test_dataset
+
+    ds = create_test_dataset("file://" + str(tmp_path / "tiny"), num_rows=2, rows_per_file=2)
+    with pytest.raises(NoDataAvailableError):
+        make_reader(ds.url, cur_shard=5, shard_count=6, reader_pool_type="dummy")
+
+
+def test_worker_exception_propagates(synthetic_dataset):
+    def boom(row):
+        raise RuntimeError("intentional transform failure")
+
+    with pytest.raises(RuntimeError, match="intentional"):
+        with make_reader(synthetic_dataset.url, transform_spec=TransformSpec(boom),
+                         reader_pool_type="thread", workers_count=2) as reader:
+            list(reader)
+
+
+# ---------------------------------------------------------------------- make_batch_reader
+
+
+@pytest.mark.parametrize("pool", POOLS)
+def test_batch_reader_all_pools(scalar_dataset, pool):
+    with make_batch_reader(scalar_dataset.url, reader_pool_type=pool,
+                           workers_count=2, shuffle_row_groups=False) as reader:
+        rows = _collect_batches(reader)
+    assert len(rows) == 30
+    for expected in scalar_dataset.data:
+        got = rows[expected["id"]]
+        assert got["string_col"] == expected["string_col"]
+        np.testing.assert_allclose(got["float_col"], expected["float_col"])
+        np.testing.assert_allclose(got["vector_col"], expected["vector_col"])
+
+
+def test_batch_reader_on_petastorm_dataset(synthetic_dataset):
+    """make_batch_reader opens petastorm-written datasets too (codec columns decoded)."""
+    with make_batch_reader(synthetic_dataset.url, schema_fields=["id", "matrix"],
+                           reader_pool_type="dummy", shuffle_row_groups=False) as reader:
+        rows = _collect_batches(reader)
+    assert len(rows) == 30
+    np.testing.assert_array_equal(rows[7]["matrix"], synthetic_dataset.data[7]["matrix"])
+
+
+def test_batch_reader_filters(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, filters=[("id", "<", 10)],
+                           reader_pool_type="dummy") as reader:
+        rows = _collect_batches(reader)
+    assert set(rows.keys()) == set(range(10))
+
+
+def test_batch_reader_predicate_vectorized(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url,
+                           predicate=in_set(set(range(0, 30, 3)), "id"),
+                           reader_pool_type="dummy") as reader:
+        rows = _collect_batches(reader)
+    assert set(rows.keys()) == set(range(0, 30, 3))
+
+
+def test_batch_reader_transform_spec(scalar_dataset):
+    def add_col(pdf):
+        pdf["doubled"] = pdf["int_col"] * 2
+        return pdf
+
+    spec = TransformSpec(add_col, edit_fields=[("doubled", np.int32, (), False)])
+    with make_batch_reader(scalar_dataset.url, transform_spec=spec,
+                           reader_pool_type="dummy") as reader:
+        batch = next(reader)
+    np.testing.assert_array_equal(batch.doubled, batch.int_col * 2)
+
+
+def test_batch_reader_epochs(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, num_epochs=2, reader_pool_type="dummy",
+                           shuffle_row_groups=False) as reader:
+        total = sum(len(b.id) for b in reader)
+    assert total == 60
+
+
+# ------------------------------------------------------------------------------- misc
+
+
+def test_reader_checkpoint_resume_exact(synthetic_dataset):
+    """Consumed row groups are never replayed; the partially-consumed one is replayed whole."""
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy", num_epochs=2,
+                     shuffle_row_groups=True, seed=7) as reader:
+        # 10 rows = exactly one full row group (3 files x 10 rows, 1 group each)
+        seen_before = [int(next(reader).id) for _ in range(10)]
+        state = reader.state_dict()
+    with make_reader(synthetic_dataset.url, reader_pool_type="dummy", num_epochs=2,
+                     shuffle_row_groups=True, seed=7) as reader2:
+        reader2.load_state_dict(state)
+        assert not reader2.last_row_consumed
+        remaining = [int(r.id) for r in reader2]
+    assert len(seen_before) + len(remaining) == 60
+    # epoch 0 completes exactly: remaining epoch-0 rows + seen = full dataset
+    assert sorted(seen_before + remaining[:20]) == list(range(30))
+
+
+def test_reader_checkpoint_resume_threaded_no_loss(synthetic_dataset):
+    """With an eager thread pool, prefetched-but-undelivered groups must NOT be skipped."""
+    with make_reader(synthetic_dataset.url, reader_pool_type="thread", workers_count=2,
+                     num_epochs=1, shuffle_row_groups=False) as reader:
+        head = [int(next(reader).id) for _ in range(5)]  # mid-row-group
+        state = reader.state_dict()
+    with make_reader(synthetic_dataset.url, reader_pool_type="thread", workers_count=2,
+                     num_epochs=1, shuffle_row_groups=False) as reader2:
+        reader2.load_state_dict(state)
+        remaining = [int(r.id) for r in reader2]
+    # nothing consumed at a row-group boundary yet -> full replay; no data loss either way
+    assert set(head) | set(remaining) == set(range(30))
+
+
+def test_batch_reader_checkpoint_resume(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, reader_pool_type="dummy", num_epochs=1,
+                           shuffle_row_groups=False) as reader:
+        first = next(reader)
+        state = reader.state_dict()
+    with make_batch_reader(scalar_dataset.url, reader_pool_type="dummy", num_epochs=1,
+                           shuffle_row_groups=False) as reader2:
+        reader2.load_state_dict(state)
+        rest_ids = [int(i) for b in reader2 for i in b.id]
+    assert sorted([int(i) for i in first.id] + rest_ids) == list(range(30))
+    assert len(rest_ids) == 30 - len(first.id)
+
+
+def test_weighted_sampling_reader(synthetic_dataset):
+    from petastorm_tpu import WeightedSamplingReader
+
+    r1 = make_reader(synthetic_dataset.url, reader_pool_type="dummy", num_epochs=1,
+                     shuffle_row_groups=False)
+    r2 = make_reader(synthetic_dataset.url, reader_pool_type="dummy", num_epochs=1,
+                     shuffle_row_groups=False)
+    with WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=3) as mixed:
+        ids = [int(r.id) for r in mixed]
+    assert len(ids) == 60  # drains both readers
+    assert sorted(set(ids)) == list(range(30))

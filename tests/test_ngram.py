@@ -1,0 +1,102 @@
+"""NGram tests (reference model: petastorm/tests/test_ngram_end_to_end.py)."""
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.ngram import NGram, valid_window_starts
+
+from test_common import TestSchema
+
+
+def test_valid_window_starts_basic():
+    ts = np.array([0, 10, 20, 30, 100, 110])
+    starts = valid_window_starts(ts, 3, delta_threshold=15)
+    np.testing.assert_array_equal(starts, [0, 1])  # [0,10,20], [10,20,30]; gap at 30->100
+
+
+def test_valid_window_starts_length_one():
+    np.testing.assert_array_equal(valid_window_starts(np.array([5, 50]), 1, 1), [0, 1])
+
+
+def test_valid_window_starts_non_overlap():
+    ts = np.arange(0, 100, 10)
+    overlapping = valid_window_starts(ts, 3, 10, overlap=True)
+    non_overlapping = valid_window_starts(ts, 3, 10, overlap=False)
+    assert len(overlapping) == 8
+    np.testing.assert_array_equal(non_overlapping, [0, 3, 6])
+
+
+def test_ngram_offsets_must_be_consecutive():
+    with pytest.raises(ValueError, match="consecutive"):
+        NGram({0: ["a"], 2: ["a"]}, 10, "ts")
+
+
+def test_ngram_form_windows():
+    ngram = NGram(
+        {0: ["id", "timestamp_ms"], 1: ["id", "timestamp_ms"]},
+        delta_threshold=10,
+        timestamp_field="timestamp_ms",
+    )
+    ngram.resolve_regex_field_names(TestSchema)
+    rows = [{"id": i, "timestamp_ms": 1000 + i * 10} for i in range(5)]
+    windows = ngram.form_ngram(rows, TestSchema.create_schema_view(["id", "timestamp_ms"]))
+    assert len(windows) == 4
+    first = windows[0]
+    assert first[0].id == 0 and first[1].id == 1
+    assert first[1].timestamp_ms - first[0].timestamp_ms == 10
+
+
+def test_ngram_delta_threshold_breaks_windows():
+    ngram = NGram({0: ["id"], 1: ["id"]}, delta_threshold=5, timestamp_field="timestamp_ms")
+    rows = [
+        {"id": 0, "timestamp_ms": 0},
+        {"id": 1, "timestamp_ms": 3},
+        {"id": 2, "timestamp_ms": 100},
+    ]
+    schema = TestSchema.create_schema_view(["id", "timestamp_ms"])
+    windows = NGram(
+        {0: ["id", "timestamp_ms"], 1: ["id", "timestamp_ms"]}, 5, "timestamp_ms"
+    ).form_ngram(rows, schema)
+    assert len(windows) == 1
+    assert windows[0][0].id == 0
+
+
+def test_ngram_end_to_end(synthetic_dataset):
+    """Windows over the synthetic dataset via make_reader (timestamps are 10ms apart)."""
+    fields = {0: ["id", "timestamp_ms"], 1: ["id", "timestamp_ms"], 2: ["id", "timestamp_ms"]}
+    ngram = NGram(fields, delta_threshold=10, timestamp_field="timestamp_ms")
+    with make_reader(synthetic_dataset.url, schema_fields=ngram,
+                     reader_pool_type="dummy", shuffle_row_groups=False) as reader:
+        windows = list(reader)
+    assert windows
+    for w in windows:
+        assert set(w.keys()) == {0, 1, 2}
+        assert w[1].id == w[0].id + 1
+        assert w[2].id == w[0].id + 2
+        assert w[1].timestamp_ms - w[0].timestamp_ms == 10
+
+
+def test_ngram_shuffled_row_groups_still_valid(synthetic_dataset):
+    ngram = NGram({0: ["id", "timestamp_ms"], 1: ["id", "timestamp_ms"]},
+                  delta_threshold=10, timestamp_field="timestamp_ms")
+    with make_reader(synthetic_dataset.url, schema_fields=ngram, seed=3,
+                     reader_pool_type="dummy", shuffle_row_groups=True) as reader:
+        for w in reader:
+            assert w[1].id == w[0].id + 1
+
+
+def test_ngram_rejects_predicate(synthetic_dataset):
+    from petastorm_tpu.predicates import in_set
+
+    ngram = NGram({0: ["id"]}, 10, "timestamp_ms")
+    with pytest.raises(ValueError, match="predicate"):
+        make_reader(synthetic_dataset.url, schema_fields=ngram,
+                    predicate=in_set({1}, "id"))
+
+
+def test_ngram_per_timestep_fields():
+    ngram = NGram({0: ["id", "sensor_name"], 1: ["id"]}, 10, "timestamp_ms")
+    ngram.resolve_regex_field_names(TestSchema)
+    assert ngram.get_field_names_at_timestep(0) == ["id", "sensor_name"]
+    assert ngram.get_field_names_at_timestep(1) == ["id"]
+    assert "timestamp_ms" in ngram.get_all_field_names()

@@ -1,0 +1,70 @@
+"""Predicate unit tests (reference model: petastorm/tests/test_predicates.py)."""
+import numpy as np
+import pytest
+
+from petastorm_tpu.predicates import (
+    in_intersection,
+    in_lambda,
+    in_negate,
+    in_pseudorandom_split,
+    in_reduce,
+    in_set,
+)
+
+
+def test_in_set():
+    p = in_set({1, 2}, "x")
+    assert p.get_fields() == {"x"}
+    assert p.do_include({"x": 1}) and not p.do_include({"x": 3})
+    np.testing.assert_array_equal(
+        p.do_include_vectorized({"x": np.array([1, 3, 2])}), [True, False, True]
+    )
+
+
+def test_in_intersection():
+    p = in_intersection({1, 5}, "tags")
+    assert p.do_include({"tags": [5, 9]})
+    assert not p.do_include({"tags": [2, 3]})
+
+
+def test_in_negate():
+    p = in_negate(in_set({1}, "x"))
+    assert p.do_include({"x": 2})
+    np.testing.assert_array_equal(
+        p.do_include_vectorized({"x": np.array([1, 2])}), [False, True]
+    )
+
+
+def test_in_reduce():
+    p = in_reduce([in_set({1, 2}, "x"), in_set({2, 3}, "x")], all)
+    assert p.do_include({"x": 2}) and not p.do_include({"x": 1})
+    p_any = in_reduce([in_set({1}, "x"), in_set({3}, "y")], any)
+    assert p_any.get_fields() == {"x", "y"}
+    assert p_any.do_include({"x": 0, "y": 3})
+
+
+def test_in_lambda_vectorized():
+    p = in_lambda(["a"], lambda v: v["a"] > 0, lambda c: c["a"] > 0)
+    np.testing.assert_array_equal(
+        p.do_include_vectorized({"a": np.array([-1, 1])}), [False, True]
+    )
+
+
+def test_pseudorandom_split_properties():
+    p0 = in_pseudorandom_split([0.3, 0.7], 0, "k")
+    p1 = in_pseudorandom_split([0.3, 0.7], 1, "k")
+    keys = ["k%d" % i for i in range(200)]
+    s0 = {k for k in keys if p0.do_include({"k": k})}
+    s1 = {k for k in keys if p1.do_include({"k": k})}
+    assert s0.isdisjoint(s1)
+    assert s0 | s1 == set(keys)
+    assert 30 < len(s0) < 90  # ~30% of 200 with slack
+    # stable across instances
+    assert {k for k in keys if in_pseudorandom_split([0.3, 0.7], 0, "k").do_include({"k": k})} == s0
+
+
+def test_pseudorandom_split_validation():
+    with pytest.raises(ValueError):
+        in_pseudorandom_split([0.5, 0.6], 0, "k")
+    with pytest.raises(ValueError):
+        in_pseudorandom_split([0.5], 1, "k")

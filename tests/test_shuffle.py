@@ -1,0 +1,80 @@
+"""Shuffling buffer tests (reference model: petastorm/tests/test_shuffling_buffer.py)."""
+import numpy as np
+import pytest
+
+from petastorm_tpu.shuffle import (
+    BatchedRandomShufflingBuffer,
+    NoopShufflingBuffer,
+    RandomShufflingBuffer,
+)
+
+
+def test_noop_fifo():
+    b = NoopShufflingBuffer()
+    b.add_many([1, 2, 3])
+    assert [b.retrieve() for _ in range(3)] == [1, 2, 3]
+    assert not b.can_retrieve
+
+
+def test_random_buffer_drains_all():
+    b = RandomShufflingBuffer(100, 10, seed=0)
+    b.add_many(range(50))
+    got = []
+    while b.can_retrieve:
+        got.append(b.retrieve())
+    assert len(got) == 50 - 10  # stops at threshold while not finished
+    b.finish()
+    while b.can_retrieve:
+        got.append(b.retrieve())
+    assert sorted(got) == list(range(50))
+
+
+def test_random_buffer_shuffles():
+    b = RandomShufflingBuffer(1000, 0, seed=1)
+    b.add_many(range(500))
+    b.finish()
+    got = [b.retrieve() for _ in range(500)]
+    assert got != list(range(500))
+    assert sorted(got) == list(range(500))
+
+
+def test_random_buffer_backpressure():
+    b = RandomShufflingBuffer(10, 2, extra_capacity=5)
+    b.add_many(range(10))
+    assert not b.can_add
+    with pytest.raises(RuntimeError, match="capacity"):
+        b.add_many(range(100))
+
+
+def test_random_buffer_threshold_validation():
+    with pytest.raises(ValueError):
+        RandomShufflingBuffer(5, 10)
+
+
+def test_batched_buffer_roundtrip():
+    b = BatchedRandomShufflingBuffer(100, 0, batch_size=8, seed=2)
+    for start in range(0, 64, 16):
+        b.add_many({"x": np.arange(start, start + 16), "y": np.ones(16)})
+    b.finish()
+    seen = []
+    while b.can_retrieve:
+        batch = b.retrieve()
+        assert set(batch.keys()) == {"x", "y"}
+        assert len(batch["x"]) == len(batch["y"]) <= 8
+        seen.extend(batch["x"].tolist())
+    assert sorted(seen) == list(range(64))
+    assert seen != list(range(64))  # shuffled
+
+
+def test_batched_buffer_threshold():
+    b = BatchedRandomShufflingBuffer(100, min_after_retrieve=20, batch_size=10)
+    b.add_many({"x": np.arange(25)})
+    assert not b.can_retrieve  # 25 < 20 + 10
+    b.add_many({"x": np.arange(10)})
+    assert b.can_retrieve
+
+
+def test_batched_buffer_ragged_rejected():
+    b = BatchedRandomShufflingBuffer(10, 0, 2)
+    with pytest.raises(ValueError, match="Ragged"):
+        b.add_many({"x": np.arange(3), "y": np.arange(4)})

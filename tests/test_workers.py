@@ -1,0 +1,116 @@
+"""Executor pool tests without the reader (reference model:
+petastorm/workers_pool/tests/test_workers_pool.py + test_ventilator.py): backpressure,
+exception propagation, stop/join — driven with toy workers."""
+import time
+
+import pytest
+
+from petastorm_tpu.errors import TimeoutWaitingForResultError
+from petastorm_tpu.plan import EpochPlan
+from petastorm_tpu.workers import (
+    ProcessExecutor,
+    SyncExecutor,
+    ThreadExecutor,
+    make_executor,
+)
+
+
+def _square(x):
+    return x * x
+
+
+class _Boom:
+    def __call__(self, x):
+        if x == 3:
+            raise ValueError("worker failure on 3")
+        return x
+
+
+@pytest.mark.parametrize("pool", ["dummy", "thread", "process"])
+def test_all_items_processed(pool):
+    ex = make_executor(pool, workers_count=3, results_queue_size=4)
+    ex.start(_square, EpochPlan(list(range(20)), num_epochs=1))
+    results = sorted(ex.results())
+    ex.stop()
+    ex.join()
+    assert results == sorted(x * x for x in range(20))
+
+
+@pytest.mark.parametrize("pool", ["thread", "process"])
+def test_exception_propagates(pool):
+    ex = make_executor(pool, workers_count=2, results_queue_size=4)
+    ex.start(_Boom(), EpochPlan(list(range(10)), num_epochs=1))
+    with pytest.raises(ValueError, match="worker failure"):
+        list(ex.results())
+    ex.join()
+
+
+def test_multiple_epochs_through_executor():
+    ex = ThreadExecutor(workers_count=2, results_queue_size=4)
+    ex.start(_square, EpochPlan([1, 2, 3], num_epochs=3))
+    assert sorted(ex.results()) == sorted([1, 4, 9] * 3)
+    ex.join()
+
+
+def test_backpressure_bounded_queue():
+    """Workers must not race ahead more than queue size + workers items."""
+    processed = []
+
+    def track(x):
+        processed.append(x)
+        return x
+
+    ex = ThreadExecutor(workers_count=1, results_queue_size=2)
+    ex.start(track, EpochPlan(list(range(100)), num_epochs=1))
+    it = ex.results()
+    next(it)
+    time.sleep(0.2)
+    assert len(processed) <= 1 + 2 + 1  # consumed + queue + in-hand
+    ex.stop()
+    ex.join()
+
+
+def test_stop_mid_stream():
+    ex = ThreadExecutor(workers_count=2, results_queue_size=2)
+    ex.start(_square, EpochPlan(list(range(1000)), num_epochs=1))
+    it = ex.results()
+    for _ in range(5):
+        next(it)
+    ex.stop()
+    ex.join()  # must not hang
+
+
+def test_timeout_raises():
+    def slow(x):
+        time.sleep(10)
+        return x
+
+    ex = ThreadExecutor(workers_count=1, results_queue_size=2, results_timeout_s=0.3)
+    ex.start(slow, EpochPlan([1], num_epochs=1))
+    with pytest.raises(TimeoutWaitingForResultError):
+        next(ex.results())
+    ex.stop()
+
+
+def test_sync_executor_lazy():
+    calls = []
+
+    def track(x):
+        calls.append(x)
+        return x
+
+    ex = SyncExecutor()
+    ex.start(track, EpochPlan(list(range(100)), num_epochs=1))
+    it = ex.results()
+    next(it)
+    assert len(calls) == 1  # fully lazy
+
+
+def test_process_executor_infinite_plan_bounded():
+    ex = ProcessExecutor(workers_count=2, results_queue_size=4)
+    ex.start(_square, EpochPlan([1, 2], num_epochs=None))
+    it = ex.results()
+    got = [next(it) for _ in range(10)]
+    assert all(v in (1, 4) for v in got)
+    ex.stop()
+    ex.join()
