@@ -61,7 +61,10 @@ class LocalDiskCache(CacheBase):
                 os.utime(fpath)  # touch for LRU
                 return value
             except Exception:  # noqa: BLE001 - corrupt entry: refill
-                os.unlink(fpath)
+                try:  # another process sharing the cache dir may have unlinked it already
+                    os.unlink(fpath)
+                except OSError:
+                    pass
         value = fill_cache_func()
         self._write(fpath, value)
         if self._size_limit:

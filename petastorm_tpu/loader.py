@@ -1,0 +1,389 @@
+"""JAX ``DataLoader``: reader batches → globally-sharded ``jax.Array`` batches.
+
+This is the TPU-native replacement for the reference's framework adapters
+(petastorm/pytorch.py ``DataLoader``/``BatchedDataLoader`` ~L120/~L260 and
+petastorm/tf_utils.py ``make_petastorm_dataset`` ~L350). Where the reference pays a
+Python-callback + host-copy per training step (``tf.py_func`` / per-row torch collate), this
+loader runs an async producer pipeline:
+
+    reader (columnar numpy) → host re-batch [+ shuffling buffer] → background queue
+        → ``jax.device_put`` with the consumer's ``Sharding`` (double/triple buffered)
+        → optional jitted on-device transform (fused by XLA)
+
+so the only per-step work on the critical path is a queue pop. Batches are *fixed-size*
+(static shapes — XLA requirement); the remainder is dropped or padded per ``last_batch``.
+
+Sharding contract (SURVEY.md §3.7): the loader accepts an arbitrary ``jax.sharding.Sharding``
+for the batch. Data parallelism is the common case (batch axis over a mesh ``dp`` axis), but a
+consumer running TP/SP can hand a sharding that splits feature/sequence axes and the loader
+will lay batches out accordingly — this is the TPU-idiomatic superset of the reference's
+``cur_shard``/``shard_count``. Under multi-process JAX each process's reader must already be
+sharded (``cur_shard=jax.process_index()``); the loader assembles the global array with
+``jax.make_array_from_process_local_data``.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+
+import numpy as np
+
+from petastorm_tpu.shuffle import BatchedRandomShufflingBuffer
+
+logger = logging.getLogger(__name__)
+
+_SENTINEL = object()
+
+
+def _is_device_dtype(arr):
+    """Only numeric/bool fixed-shape columns can live on device; strings/objects stay host."""
+    return isinstance(arr, np.ndarray) and arr.dtype.kind in "biufc" and arr.dtype.hasobject is False
+
+
+class _HostBatcher:
+    """Accumulates columnar chunks and cuts exact fixed-size batches (static shapes)."""
+
+    def __init__(self, batch_size, shuffling_queue_capacity=0, seed=None):
+        self.batch_size = batch_size
+        if shuffling_queue_capacity and shuffling_queue_capacity > 0:
+            self._buffer = BatchedRandomShufflingBuffer(
+                shuffling_queue_capacity,
+                min_after_retrieve=min(shuffling_queue_capacity // 2, shuffling_queue_capacity - 1),
+                batch_size=batch_size,
+                seed=seed,
+            )
+            self._shuffling = True
+        else:
+            self._buffer = None
+            self._shuffling = False
+            self._pending = {}
+            self._pending_rows = 0
+
+    # -- non-shuffling path: cheap concatenate-and-slice ------------------------------
+
+    def _plain_add(self, columns):
+        n = None
+        for name, arr in columns.items():
+            self._pending.setdefault(name, []).append(arr)
+            n = len(arr)
+        if n is not None:
+            self._pending_rows += n
+
+    def _plain_cut(self, final=False):
+        out = []
+        while self._pending_rows >= self.batch_size:
+            merged = {}
+            rest = {}
+            for name, chunks in self._pending.items():
+                whole = chunks[0] if len(chunks) == 1 else _concat(chunks)
+                merged[name] = whole[: self.batch_size]
+                rest[name] = [whole[self.batch_size:]]
+            self._pending = rest
+            self._pending_rows -= self.batch_size
+            out.append(merged)
+        if final and self._pending_rows > 0:
+            merged = {name: _concat(chunks) for name, chunks in self._pending.items()}
+            self._pending = {}
+            self._pending_rows = 0
+            out.append(merged)
+        return out
+
+    # -- public -----------------------------------------------------------------------
+
+    def add(self, columns):
+        """Feed one columnar chunk; returns list of ready full-size batches."""
+        if not self._shuffling:
+            self._plain_add(columns)
+            return self._plain_cut()
+        ready = []
+        self._buffer.add_many(columns)
+        while self._buffer.can_retrieve:
+            ready.append(self._buffer.retrieve())
+        return ready
+
+    def finish(self):
+        """Flush remaining rows as (possibly short) final batches."""
+        if not self._shuffling:
+            return self._plain_cut(final=True)
+        self._buffer.finish()
+        ready = []
+        while self._buffer.can_retrieve:
+            ready.append(self._buffer.retrieve())
+        return ready
+
+
+def _concat(chunks):
+    chunks = [c for c in chunks if len(c)]
+    if not chunks:
+        return np.empty((0,))
+    if len(chunks) == 1:
+        return chunks[0]
+    if any(c.dtype == object for c in chunks):
+        out = np.empty(sum(len(c) for c in chunks), dtype=object)
+        pos = 0
+        for c in chunks:
+            out[pos:pos + len(c)] = c
+            pos += len(c)
+        return out
+    return np.concatenate(chunks, axis=0)
+
+
+def _rows_to_columns(rows):
+    """Row dicts/namedtuples → columnar numpy dict (per-row ``make_reader`` path)."""
+    if not rows:
+        return {}
+    first = rows[0]
+    if hasattr(first, "_asdict"):
+        rows = [r._asdict() for r in rows]
+    names = rows[0].keys()
+    out = {}
+    for name in names:
+        values = [r[name] for r in rows]
+        try:
+            out[name] = np.asarray(values)
+        except (ValueError, TypeError):
+            arr = np.empty(len(values), dtype=object)
+            arr[:] = values
+            out[name] = arr
+        if out[name].dtype == object and all(
+            isinstance(v, np.ndarray) for v in values
+        ):
+            # ragged ndarrays stay object arrays; uniform ones stack above
+            pass
+    return out
+
+
+class DataLoader:
+    """Iterable of batches: ``{field: jax.Array}`` (device fields) laid out per ``sharding``.
+
+    Parameters
+    ----------
+    reader : petastorm_tpu.reader.Reader
+        Batch reader (columnar) or per-row reader (rows are stacked host-side).
+    batch_size : int
+        Global batch size (rows per yielded batch across all processes).
+    sharding : jax.sharding.Sharding, optional
+        Layout for yielded arrays. Default: single-device placement on the default device.
+    shuffling_queue_capacity : int
+        >0 enables a host-side row shuffling buffer (reference ``shuffling_queue_capacity``).
+    last_batch : {"drop", "pad", "partial"}
+        Remainder policy. ``drop`` (default) keeps shapes static; ``pad`` repeats final rows
+        up to ``batch_size`` and adds a boolean ``__valid__`` mask column; ``partial`` yields
+        the short batch (host numpy only fields keep working; device arrays get a new shape —
+        triggers one extra XLA compile).
+    device_transform : callable, optional
+        Jittable ``fn(batch) -> batch`` applied on device after transfer (augment/normalize —
+        XLA fuses it into the step). Defaults to ``reader.transform_spec`` when that was
+        declared ``device=True``.
+    prefetch : int
+        Device batches kept in flight (double/triple buffering). 0 disables (debug).
+    to_device : bool
+        False yields host numpy dicts (CPU-only consumers, tests, torch adapter).
+    """
+
+    def __init__(self, reader, batch_size, sharding=None, shuffling_queue_capacity=0,
+                 seed=None, last_batch="drop", device_transform=None, prefetch=2,
+                 to_device=True, host_queue_size=8):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        if last_batch not in ("drop", "pad", "partial"):
+            raise ValueError("last_batch must be drop|pad|partial, got %r" % last_batch)
+        self.reader = reader
+        self.batch_size = int(batch_size)
+        self.sharding = sharding
+        self.last_batch = last_batch
+        self.prefetch = int(prefetch)
+        self.to_device = to_device
+        self._seed = seed
+        self._shuffling_queue_capacity = shuffling_queue_capacity
+        self._host_queue_size = host_queue_size
+        self._device_transform = device_transform
+        if device_transform is None:
+            spec = getattr(reader, "transform_spec", None)
+            if spec is not None and getattr(spec, "device", False) and spec.func is not None:
+                self._device_transform = spec.func
+        self._jitted_transform = None
+        self._producer = None
+        self._queue = None
+        self._stop = threading.Event()
+        self._producer_error = None
+
+    # -- producer (background thread: reader → host batches) ---------------------------
+
+    def _produce(self):
+        batcher = _HostBatcher(self.batch_size, self._shuffling_queue_capacity, self._seed)
+        try:
+            for item in self.reader:
+                if self._stop.is_set():
+                    return
+                columns = item._asdict() if hasattr(item, "_asdict") else item
+                if not isinstance(columns, dict):
+                    raise TypeError("unexpected reader item %r" % type(item))
+                columns = {k: v for k, v in columns.items() if v is not None}
+                if columns and not all(
+                    isinstance(v, np.ndarray) and v.ndim >= 1 and
+                    len(v) == len(next(iter(columns.values())))
+                    for v in columns.values()
+                ):
+                    columns = _rows_to_columns([columns])
+                for batch in batcher.add(columns):
+                    if self._stop.is_set():
+                        return
+                    if self.last_batch == "pad":
+                        batch = self._pad(batch)
+                    self._queue.put(batch)
+            for batch in batcher.finish():
+                n = len(next(iter(batch.values()))) if batch else 0
+                if self.last_batch == "drop":
+                    # the shuffling buffer can still hold whole batches at reader
+                    # exhaustion — only the short tail is dropped
+                    if n < self.batch_size:
+                        continue
+                elif self.last_batch == "pad":
+                    batch = self._pad(batch)
+                self._queue.put(batch)
+        except Exception as e:  # noqa: BLE001 — surfaced to consumer thread
+            self._producer_error = e
+        finally:
+            try:
+                self._queue.put(_SENTINEL, timeout=60)
+            except queue.Full:
+                pass
+
+    def _pad(self, batch):
+        n = len(next(iter(batch.values()))) if batch else 0
+        if n == 0 or n == self.batch_size:
+            if batch and "__valid__" not in batch:
+                batch["__valid__"] = np.ones(n, dtype=bool)
+            return batch
+        pad = self.batch_size - n
+        out = {}
+        for name, arr in batch.items():
+            idx = np.concatenate([np.arange(n), np.full(pad, n - 1)])
+            out[name] = arr[idx] if isinstance(arr, np.ndarray) else arr
+        out["__valid__"] = np.concatenate([np.ones(n, dtype=bool), np.zeros(pad, dtype=bool)])
+        return out
+
+    # -- consumer side ------------------------------------------------------------------
+
+    def _host_batches(self):
+        self._stop.clear()
+        self._producer_error = None
+        self._queue = queue.Queue(maxsize=max(2, self._host_queue_size))
+        self._producer = threading.Thread(target=self._produce, name="ptpu-loader", daemon=True)
+        self._producer.start()
+        while True:
+            item = self._queue.get()
+            if item is _SENTINEL:
+                if self._producer_error is not None:
+                    raise self._producer_error
+                return
+            yield item
+
+    def _to_device(self, batch):
+        import jax
+
+        device = {k: v for k, v in batch.items() if _is_device_dtype(v)}
+        host = {k: v for k, v in batch.items() if k not in device}
+        if host:
+            logger.debug("Fields kept host-side (non-device dtypes): %s", sorted(host))
+        if self.sharding is None:
+            arrays = jax.device_put(device)
+        else:
+            import jax.sharding as jsh
+
+            arrays = {}
+            for name, arr in device.items():
+                s = self.sharding[name] if isinstance(self.sharding, dict) \
+                    else _matching_sharding(self.sharding, arr)
+                if jax.process_count() > 1:
+                    arrays[name] = jax.make_array_from_process_local_data(s, arr)
+                else:
+                    arrays[name] = jax.device_put(arr, s)
+        if self._device_transform is not None:
+            if self._jitted_transform is None:
+                import jax as _jax
+
+                self._jitted_transform = _jax.jit(self._device_transform)
+            arrays = self._jitted_transform(arrays)
+        arrays.update(host)
+        return arrays
+
+    def __iter__(self):
+        if not self.to_device:
+            yield from self._host_batches()
+            return
+        from collections import deque
+
+        inflight = deque()
+        for batch in self._host_batches():
+            inflight.append(self._to_device(batch))
+            if len(inflight) > max(0, self.prefetch):
+                yield inflight.popleft()
+        while inflight:
+            yield inflight.popleft()
+
+    # -- lifecycle ----------------------------------------------------------------------
+
+    def stop(self):
+        self._stop.set()
+        if self._queue is not None:
+            try:  # unblock a producer stuck on a full queue
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+
+    def join(self):
+        if self._producer is not None:
+            self._producer.join(timeout=60)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        self.join()
+        self.reader.stop()
+        self.reader.join()
+
+
+def _matching_sharding(sharding, arr):
+    """Adapt a batch-axis sharding to an array's rank (replicate the trailing axes)."""
+    import jax.sharding as jsh
+
+    if isinstance(sharding, jsh.NamedSharding):
+        spec = list(sharding.spec)
+        if len(spec) < arr.ndim:
+            spec = spec + [None] * (arr.ndim - len(spec))
+        elif len(spec) > arr.ndim:
+            spec = spec[: arr.ndim]
+        return jsh.NamedSharding(sharding.mesh, jsh.PartitionSpec(*spec))
+    return sharding
+
+
+def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1,
+                    shuffling_queue_capacity=0, reader_factory=None, **reader_kwargs):
+    """One-call convenience: ``make_batch_reader`` + :class:`DataLoader`.
+
+    ``reader_kwargs`` pass through to :func:`petastorm_tpu.reader.make_batch_reader`
+    (or ``reader_factory`` when given). Under multi-process JAX, ``cur_shard``/``shard_count``
+    default to ``jax.process_index()``/``jax.process_count()``.
+    """
+    from petastorm_tpu.reader import make_batch_reader
+
+    factory = reader_factory or make_batch_reader
+    if "cur_shard" not in reader_kwargs:
+        try:
+            import jax
+
+            if jax.process_count() > 1:
+                reader_kwargs["cur_shard"] = jax.process_index()
+                reader_kwargs["shard_count"] = jax.process_count()
+        except Exception:  # noqa: BLE001 — jax optional for host-only use
+            pass
+    reader = factory(dataset_url_or_urls, num_epochs=num_epochs, **reader_kwargs)
+    seed = reader_kwargs.get("seed") or reader_kwargs.get("shard_seed")
+    return DataLoader(reader, batch_size, sharding=sharding,
+                      shuffling_queue_capacity=shuffling_queue_capacity, seed=seed)

@@ -52,8 +52,8 @@ class EpochPlan:
 
     def __init__(self, items, num_epochs=1, shuffle=False, seed=None, with_epoch=False,
                  skip=None):
-        """``with_epoch=True`` yields ``(epoch, item)`` instead of ``item`` (lets a consumer
-        tag in-flight work with its dispatch epoch for exact resume). ``skip``: optional
+        """``with_epoch=True`` yields ``(epoch, ordinal, item)`` instead of ``item`` (lets a
+        consumer tag in-flight work with its dispatch epoch for exact resume). ``skip``: optional
         ``{epoch: set(item_key)}`` of already-consumed work to omit, where item_key is
         ``items.index``-positional ordinal."""
         self._items = list(items)

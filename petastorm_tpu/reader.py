@@ -128,11 +128,18 @@ class _WorkerBase:
 
     def _drop_partition_indices(self, piece, num_rows):
         """Deterministic 1/k row subset for shuffle_row_drop_partitions (reference
-        petastorm/reader.py ~L520 + worker ``_read_with_shuffle_row_drop``)."""
+        petastorm/reader.py ~L520 + worker ``_read_with_shuffle_row_drop``).
+
+        Seeded with crc32(path) — NOT hash(), which is PYTHONHASHSEED-randomized per
+        interpreter and would make partitions computed in different pool processes neither
+        tile nor cover the row group."""
+        import zlib
+
         piece_key, partition = piece
         k = self._drop_partitions
         seq = np.random.SeedSequence(
-            [0 if self._seed is None else int(self._seed), hash(piece_key.path) & 0x7FFFFFFF,
+            [0 if self._seed is None else int(self._seed),
+             zlib.crc32(piece_key.path.encode("utf-8")) & 0x7FFFFFFF,
              piece_key.row_group]
         )
         perm = np.random.Generator(np.random.PCG64(seq)).permutation(num_rows)
@@ -178,7 +185,13 @@ class PyDictWorker(_WorkerBase):
             mask = self._row_mask(head)
             if mask is not None and not mask.any():
                 return []
-            table = self._read_columns(piece, sorted(set(wanted) | set(first_pass)))
+            # second pass fetches only the columns the head read didn't already decode
+            remaining = sorted(set(wanted) - set(head.column_names))
+            if remaining:
+                tail = self._read_columns(piece, remaining)
+                table = _merge_tables(head, tail)
+            else:
+                table = head
         else:
             mask = None
             table = self._read_columns(piece, wanted)
@@ -252,6 +265,15 @@ class ArrowWorker(_WorkerBase):
             if name in table.column_names:
                 out[name] = _column_to_numpy(table, name, self._read_schema)
         return out
+
+
+def _merge_tables(head, tail):
+    """Column-wise merge of two same-length row-group reads into one table."""
+    import pyarrow as pa
+
+    cols = {name: head.column(name) for name in head.column_names}
+    cols.update({name: tail.column(name) for name in tail.column_names})
+    return pa.table(cols)
 
 
 def _column_to_numpy(table, name, schema):
@@ -334,11 +356,34 @@ def _stable_repr(value):
     return repr(value)
 
 
+def _predicate_key(predicate):
+    """Stable identity for a predicate: class + parameters. Callables are keyed by their
+    bytecode+consts digest (repr would embed a memory address — unstable across runs and
+    reusable across DIFFERENT lambdas, poisoning a persistent disk cache)."""
+    import hashlib
+
+    parts = [type(predicate).__name__]
+    for name, value in sorted(vars(predicate).items()):
+        if callable(value):
+            code = getattr(value, "__code__", None)
+            if code is not None:
+                digest = hashlib.sha256(
+                    code.co_code + repr(code.co_consts).encode("utf-8")
+                ).hexdigest()
+                parts.append("%s=fn:%s" % (name, digest))
+            else:
+                # unkeyable callable: unique per instance so a persistent cache never
+                # serves rows filtered by a different predicate
+                parts.append("%s=unkeyable:%d" % (name, id(value)))
+        else:
+            parts.append("%s=%s" % (name, _stable_repr(value)))
+    return "|".join(parts)
+
+
 def _cache_key(piece, schema, predicate, filters, partition, num_partitions, seed):
     predicate_key = ""
     if predicate is not None:
-        # identify a predicate by class AND parameters, not just class name
-        predicate_key = type(predicate).__name__ + _stable_repr(vars(predicate))
+        predicate_key = _predicate_key(predicate)
     return "|".join(
         [
             piece.path,
@@ -601,7 +646,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         shuffle_row_drop_partitions, filters, seed if seed is not None else shard_seed,
         ngram=ngram, ngram_schema=final_schema if ngram is not None else None,
     )
-    return Reader(
+    r = Reader(
         fs, path, final_schema, stored_schema, worker, pieces,
         num_epochs=num_epochs, shuffle_row_groups=shuffle_row_groups, seed=seed,
         cur_shard=cur_shard, shard_count=shard_count, shard_seed=shard_seed,
@@ -610,6 +655,8 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         results_queue_size=results_queue_size, is_batched_reader=False, ngram=ngram,
         results_timeout_s=results_timeout_s,
     )
+    r.transform_spec = transform_spec
+    return r
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type="thread",
@@ -645,7 +692,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         fs, read_schema, stored_schema, predicate, transform_spec, cache,
         shuffle_row_drop_partitions, filters, seed if seed is not None else shard_seed,
     )
-    return Reader(
+    r = Reader(
         fs, path, final_schema, stored_schema, worker, pieces,
         num_epochs=num_epochs, shuffle_row_groups=shuffle_row_groups, seed=seed,
         cur_shard=cur_shard, shard_count=shard_count, shard_seed=shard_seed,
@@ -654,6 +701,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         results_queue_size=results_queue_size, is_batched_reader=True,
         results_timeout_s=results_timeout_s,
     )
+    r.transform_spec = transform_spec
+    return r
 
 
 def _apply_rowgroup_selector(fs, path, pieces, rowgroup_selector):

@@ -18,10 +18,13 @@ picklable callable ``worker(item) -> result``; exceptions in workers propagate t
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 
 from petastorm_tpu.errors import TimeoutWaitingForResultError
+
+logger = logging.getLogger(__name__)
 
 _DONE = object()
 
@@ -151,7 +154,13 @@ class ThreadExecutor(ExecutorBase):
 
     def join(self):
         for t in self._threads:
-            t.join(timeout=10)
+            t.join(timeout=self._timeout)
+            if t.is_alive():
+                logger.warning(
+                    "Worker thread %s still alive after %.0fs join (blocked in IO?); "
+                    "it will exit at its next stop-event check without publishing",
+                    t.name, self._timeout,
+                )
         self._threads = []
 
 
@@ -206,8 +215,30 @@ class ProcessExecutor(ExecutorBase):
             p.stdin.write(authkey)
             p.stdin.close()
             self._procs.append(p)
-        for _ in range(self._workers_count):
-            conn = listener.accept()
+        # accept with a timeout + child liveness poll: a child that dies before connecting
+        # (import error, crash) must raise here, not hang Reader construction forever
+        listener._listener._socket.settimeout(1.0)
+        deadline = 120.0
+        waited = 0.0
+        while len(self._conns) < self._workers_count:
+            try:
+                conn = listener.accept()
+            except OSError:
+                waited += 1.0
+                for p in self._procs:
+                    if p.poll() is not None:
+                        listener.close()
+                        raise RuntimeError(
+                            "Pool child exited with code %s before connecting (run "
+                            "'python -m petastorm_tpu._child_worker' manually to debug)"
+                            % p.returncode
+                        )
+                if waited > deadline:
+                    listener.close()
+                    raise TimeoutWaitingForResultError(
+                        "Pool children did not connect within %.0fs" % deadline
+                    )
+                continue
             conn.send(list(sys.path))
             conn.send(worker)
             self._conns.append(conn)

@@ -5,10 +5,16 @@ hardware (SURVEY.md §5 "TPU-build translation"). Env must be set before jax is 
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # the driver env exports JAX_PLATFORMS=axon (real TPU)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon site hook (PYTHONPATH=/root/.axon_site) re-forces the TPU platform past the env var,
+# so pin it at the jax config level too — must happen before any backend is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

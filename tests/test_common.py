@@ -106,8 +106,7 @@ def create_test_scalar_dataset(url, num_rows=30, num_files=2, seed=0):
         pq.write_table(table, os.path.join(path, "part-%02d.parquet" % fidx),
                        row_group_size=max(1, n // 2))
         for j in range(n):
-            all_rows.append({k: (v[j] if not isinstance(v, list) else v[j])
-                             for k, v in data.items()})
+            all_rows.append({k: v[j] for k, v in data.items()})
         idx += n
     return SyntheticDataset(url, all_rows, path)
 
