@@ -1,0 +1,109 @@
+"""Driver benchmark: end-to-end data-plane throughput on the real chip.
+
+Measures the headline metric for a Petastorm-class framework: decoded training rows/sec
+through the full path — Parquet (row groups on disk) → parallel reader → host re-batch →
+``device_put`` → jitted consume step on the accelerator (which forces materialization of
+every batch on device). The reference publishes no numbers (SURVEY.md §7); `vs_baseline`
+compares against our own recorded single-host CPU-path baseline in BASELINE.md (first
+measurement: 0 ⇒ prints ratio 1.0 until a baseline lands in BASELINE_NUM below).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+# Our own measured baseline (rows/sec) for this exact config on the reference-equivalent
+# CPU decode path (recorded from the first bench session; see BASELINE.md).
+BASELINE_ROWS_PER_SEC = 4783.2  # recorded round-1 (2026-07-29), this config, 1 chip
+
+ROWS = 40_000
+ROW_GROUP = 2_000
+IMG_SHAPE = (64, 64, 3)
+BATCH = 256
+
+
+def make_dataset(root):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.RandomState(0)
+    os.makedirs(root, exist_ok=True)
+    per_file = ROWS // 4
+    flat = int(np.prod(IMG_SHAPE))
+    for fidx in range(4):
+        n = per_file
+        images = rng.randint(0, 255, (n, flat), dtype=np.uint8)
+        fsl = pa.FixedSizeListArray.from_arrays(pa.array(images.reshape(-1)), flat)
+        table = pa.table({
+            "id": np.arange(fidx * n, (fidx + 1) * n, dtype=np.int64),
+            "image": fsl,
+            "label": rng.randint(0, 1000, n).astype(np.int32),
+        })
+        pq.write_table(table, os.path.join(root, "part-%d.parquet" % fidx),
+                       row_group_size=ROW_GROUP)
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+    import jax.numpy as jnp
+
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.transform import TransformSpec
+
+    root = os.path.join(tempfile.gettempdir(), "ptpu_bench_ds")
+    marker = os.path.join(root, "_done")
+    if not os.path.exists(marker):
+        make_dataset(root)
+        open(marker, "w").close()
+
+    flat = int(np.prod(IMG_SHAPE))
+
+    def device_prep(batch):
+        # uint8 -> bf16 normalize on device: the work the TPU does per batch
+        img = batch["image"].reshape(-1, *IMG_SHAPE).astype(jnp.bfloat16) / 255.0
+        return {"image": img, "label": batch["label"], "id": batch["id"]}
+
+    spec = TransformSpec(func=device_prep, device=True)
+
+    @jax.jit
+    def consume(batch):
+        return jnp.sum(batch["image"].astype(jnp.float32)) + jnp.sum(batch["label"])
+
+    def run(num_epochs):
+        reader = make_batch_reader("file://" + root, workers_count=8,
+                                   shuffle_row_groups=True, seed=0,
+                                   num_epochs=num_epochs, transform_spec=spec)
+        loader = DataLoader(reader, BATCH, prefetch=3, host_queue_size=12)
+        n = 0
+        acc = None
+        with loader:
+            for batch in loader:
+                acc = consume(batch)
+                n += BATCH
+        jax.block_until_ready(acc)
+        return n
+
+    run(1)  # warmup: compile + page cache
+    t0 = time.perf_counter()
+    n = run(2)
+    dt = time.perf_counter() - t0
+    rows_per_sec = n / dt
+
+    vs = rows_per_sec / BASELINE_ROWS_PER_SEC if BASELINE_ROWS_PER_SEC else 1.0
+    print(json.dumps({
+        "metric": "decoded_rows_per_sec_64x64_device_fed",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -146,11 +146,6 @@ def _rows_to_columns(rows):
             arr = np.empty(len(values), dtype=object)
             arr[:] = values
             out[name] = arr
-        if out[name].dtype == object and all(
-            isinstance(v, np.ndarray) for v in values
-        ):
-            # ragged ndarrays stay object arrays; uniform ones stack above
-            pass
     return out
 
 
@@ -217,16 +212,16 @@ class DataLoader:
             for item in self.reader:
                 if self._stop.is_set():
                     return
-                columns = item._asdict() if hasattr(item, "_asdict") else item
-                if not isinstance(columns, dict):
-                    raise TypeError("unexpected reader item %r" % type(item))
-                columns = {k: v for k, v in columns.items() if v is not None}
-                if columns and not all(
-                    isinstance(v, np.ndarray) and v.ndim >= 1 and
-                    len(v) == len(next(iter(columns.values())))
-                    for v in columns.values()
-                ):
-                    columns = _rows_to_columns([columns])
+                # batched readers yield columnar dicts; per-row readers yield one row per
+                # item (branching on the reader contract, not a shape heuristic — a row
+                # whose fields are all equal-length ndarrays must NOT be read as a batch)
+                if getattr(self.reader, "is_batched_reader", False):
+                    columns = item._asdict() if hasattr(item, "_asdict") else item
+                    if not isinstance(columns, dict):
+                        raise TypeError("unexpected reader item %r" % type(item))
+                    columns = {k: v for k, v in columns.items() if v is not None}
+                else:
+                    columns = _rows_to_columns([item])
                 for batch in batcher.add(columns):
                     if self._stop.is_set():
                         return
@@ -295,8 +290,11 @@ class DataLoader:
 
             arrays = {}
             for name, arr in device.items():
-                s = self.sharding[name] if isinstance(self.sharding, dict) \
+                s = self.sharding.get(name) if isinstance(self.sharding, dict) \
                     else _matching_sharding(self.sharding, arr)
+                if s is None:  # field without an explicit sharding (e.g. __valid__)
+                    arrays[name] = jax.device_put(arr)
+                    continue
                 if jax.process_count() > 1:
                     arrays[name] = jax.make_array_from_process_local_data(s, arr)
                 else:
@@ -384,6 +382,8 @@ def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1
         except Exception:  # noqa: BLE001 — jax optional for host-only use
             pass
     reader = factory(dataset_url_or_urls, num_epochs=num_epochs, **reader_kwargs)
-    seed = reader_kwargs.get("seed") or reader_kwargs.get("shard_seed")
+    seed = reader_kwargs.get("seed")
+    if seed is None:
+        seed = reader_kwargs.get("shard_seed")
     return DataLoader(reader, batch_size, sharding=sharding,
                       shuffling_queue_capacity=shuffling_queue_capacity, seed=seed)

@@ -1,0 +1,77 @@
+"""ResNet family (v1.5 bottleneck) in flax.linen — the flagship image model.
+
+Petastorm's headline workload is feeding ImageNet/ResNet-50 training (examples/imagenet,
+BASELINE.json north-star: ResNet-50 on ImageNet-Parquet); the reference ships no model code,
+so this is the acceptance-config model our data plane is measured against. TPU notes: NHWC
+layout (XLA's native conv layout on TPU), bfloat16 compute with float32 batch-norm stats and
+params, batch stats folded for inference via ``mutable``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 (self.strides, self.strides), name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(nn.BatchNorm, use_running_average=not train,
+                                 momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(self.num_filters * 2 ** i, strides,
+                                    conv=conv, norm=norm, act=act)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = functools.partial(ResNet, stage_sizes=[2, 2, 2, 2])   # basic-block depth kept
+ResNet50 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3])   # bottleneck as above
+ResNet101 = functools.partial(ResNet, stage_sizes=[3, 4, 23, 3])
+ResNet152 = functools.partial(ResNet, stage_sizes=[3, 8, 36, 3])

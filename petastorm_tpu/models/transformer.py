@@ -1,0 +1,289 @@
+"""SPMD MoE transformer: the flagship multi-parallel training step.
+
+The reference ships no model code (it's a data library); this model exists to exercise and
+validate the full TPU parallelism surface this framework feeds (SURVEY.md §3.7): every batch
+from the DataLoader can be consumed by a training step sharded over
+
+- **dp** — batch split; gradients all-reduced over (dp, sp),
+- **pp** — GPipe microbatch pipeline over stage-stacked layer params
+  (:func:`petastorm_tpu.parallel.pipeline.spmd_pipeline`, ppermute hops),
+- **sp** — sequence split with ring attention
+  (:func:`petastorm_tpu.parallel.attention.ring_attention`),
+- **tp** — Megatron-style column/row-parallel projections (heads and FFN hidden split;
+  one psum per block),
+- **ep** — expert parallelism: top-1 gated MoE, tokens routed to expert shards with a
+  pair of ``lax.all_to_all`` (GShard-style static-capacity dispatch einsums — no dynamic
+  shapes, MXU-friendly).
+
+Everything runs inside ONE ``jax.shard_map`` over the whole mesh (fully-manual SPMD, the
+scaling-book recipe): collectives are explicit, XLA schedules them onto ICI.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from petastorm_tpu.parallel.attention import ring_attention
+from petastorm_tpu.parallel.pipeline import spmd_pipeline
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 64
+    n_heads: int = 8
+    head_dim: int = 16
+    d_ff: int = 128
+    n_stages: int = 2          # pipeline depth (== mesh pp size)
+    layers_per_stage: int = 1
+    n_experts: int = 4
+    capacity_factor: float = 2.0
+    max_seq: int = 256
+    dtype: Any = jnp.float32   # bfloat16 on real TPU
+
+
+def init_params(cfg, key):
+    """Global (unsharded) parameter pytree; stage-stacked arrays lead with n_stages."""
+    k = iter(jax.random.split(key, 16))
+    s, L = cfg.n_stages, cfg.layers_per_stage
+    d, H, hd, f, E = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_experts
+    init = lambda kk, shape, scale: (jax.random.normal(kk, shape, jnp.float32)
+                                     * scale).astype(cfg.dtype)
+    return {
+        "embed": init(next(k), (cfg.vocab, d), 0.02),
+        "pos": init(next(k), (cfg.max_seq, d), 0.02),
+        "stages": {
+            "ln1": jnp.ones((s, L, d), cfg.dtype),
+            "wqkv": init(next(k), (s, L, d, 3, H, hd), d ** -0.5),
+            "wo": init(next(k), (s, L, H, hd, d), (H * hd) ** -0.5),
+            "ln2": jnp.ones((s, L, d), cfg.dtype),
+            "wg": init(next(k), (s, L, d, E), 0.02),
+            "w1": init(next(k), (s, L, E, d, f), d ** -0.5),
+            "w2": init(next(k), (s, L, E, f, d), f ** -0.5),
+        },
+        "ln_f": jnp.ones((cfg.d_model,), cfg.dtype),
+        "unembed": init(next(k), (d, cfg.vocab), d ** -0.5),
+    }
+
+
+def model_mesh(axis_sizes=None, devices=None):
+    """Mesh for this model: always declares all five axes (size 1 where unused) so the
+    sharded step's collectives are well-formed regardless of which axes actually split."""
+    from petastorm_tpu.parallel.mesh import make_mesh
+
+    sizes = {"pp": 1, "ep": 1, "sp": 1, "tp": 1}
+    sizes.update(axis_sizes or {})
+    return make_mesh(sizes, devices=devices)
+
+
+def param_shardings(cfg, mesh):
+    """NamedShardings: stages over pp; heads/ffn-hidden over tp; experts over ep.
+
+    The mesh must declare all of dp/pp/ep/sp/tp (use :func:`model_mesh`)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    missing = {"dp", "pp", "ep", "sp", "tp"} - set(mesh.axis_names)
+    if missing:
+        raise ValueError("mesh is missing axes %s; build it with model_mesh()" % sorted(missing))
+
+    ns = lambda *spec: NamedSharding(mesh, P(*spec))
+    return {
+        "embed": ns(), "pos": ns(), "ln_f": ns(), "unembed": ns(),
+        "stages": {
+            "ln1": ns("pp"),
+            "wqkv": ns("pp", None, None, None, "tp", None),
+            "wo": ns("pp", None, "tp", None, None),
+            "ln2": ns("pp"),
+            "wg": ns("pp"),
+            "w1": ns("pp", None, "ep", None, "tp"),
+            "w2": ns("pp", None, "ep", "tp", None),
+        },
+    }
+
+
+def _rms_norm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale
+
+
+def _attention_block(x, ln, wqkv, wo, cfg):
+    """Ring attention over sp; heads local to the tp rank (column/row parallel)."""
+    h = _rms_norm(x, ln)
+    qkv = jnp.einsum("bsd,dthe->bsthe", h, wqkv)  # t=3, h=H_local, e=head_dim
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    o = ring_attention(q, k, v, axis_name="sp", causal=True)
+    out = jnp.einsum("bshe,hed->bsd", o, wo)
+    return x + lax.psum(out, ("tp",))
+
+
+def _moe_block(x, ln, wg, w1, w2, cfg, ep_size, tp_size):
+    """Top-1 expert-parallel MoE with static capacity (GShard dispatch einsums).
+
+    Tokens are split over the ``ep`` axis (each rank gates its own T/ep slice), expert
+    inputs are exchanged with an ``all_to_all`` pair, and per-rank outputs reassemble via
+    scatter + ``psum`` — whose AD transpose is a plain slice, so replicated-parameter
+    gradients are exact (an all_gather here would overcount by ep under transposition).
+    """
+    b, s, d = x.shape
+    h_full = _rms_norm(x, ln).reshape(b * s, d)
+    T, E = h_full.shape[0], cfg.n_experts
+    if T % ep_size:
+        raise ValueError("local tokens %d not divisible by ep=%d" % (T, ep_size))
+    T_loc = T // ep_size
+    if ep_size > 1:
+        ep_idx = lax.axis_index("ep")
+        h = lax.dynamic_slice(h_full, (ep_idx * T_loc, jnp.int32(0)), (T_loc, d))
+    else:
+        h = h_full
+    C = max(1, int(math.ceil(T_loc / E * cfg.capacity_factor)))
+
+    gates = jax.nn.softmax(jnp.einsum("td,de->te", h, wg).astype(jnp.float32), axis=-1)
+    eidx = jnp.argmax(gates, axis=-1)
+    onehot = jax.nn.one_hot(eidx, E, dtype=jnp.float32)                   # (T_loc, E)
+    pos_in_e = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+    keep = onehot * (pos_in_e < C)                                        # capacity drop
+    dispatch = keep[..., None] * jax.nn.one_hot(
+        pos_in_e.astype(jnp.int32), C, dtype=jnp.float32)                 # (T_loc, E, C)
+    gate_val = jnp.sum(gates * keep, axis=-1)                             # (T_loc,)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, h.astype(jnp.float32))  # (E, C, d)
+    if ep_size > 1:
+        # split E over ep ranks; gather every rank's token slots for my local experts
+        expert_in = lax.all_to_all(expert_in, "ep", split_axis=0, concat_axis=1,
+                                   tiled=True)                            # (E_loc, C*ep, d)
+    expert_in = expert_in.astype(cfg.dtype)
+    hidden = jnp.einsum("ecd,edf->ecf", expert_in, w1)                    # f = f_local (tp)
+    hidden = jax.nn.relu(hidden)
+    out = jnp.einsum("ecf,efd->ecd", hidden, w2)
+    out = lax.psum(out, ("tp",))                                          # row-parallel FFN
+    if ep_size > 1:
+        out = lax.all_to_all(out, "ep", split_axis=1, concat_axis=0, tiled=True)  # (E, C, d)
+    y = jnp.einsum("tec,ecd->td", dispatch, out.astype(jnp.float32))
+    y = y * gate_val[:, None]                                             # (T_loc, d)
+    if ep_size > 1:
+        placed = jnp.zeros((T, d), jnp.float32)
+        placed = lax.dynamic_update_slice(placed, y, (ep_idx * T_loc, jnp.int32(0)))
+        y = lax.psum(placed, ("ep",))                                     # (T, d), ep-invariant
+    else:
+        # params are typed ep-varying even on a size-1 axis; the identity psum restores an
+        # ep-invariant activation so the layer-scan carry type is stable
+        y = lax.psum(y, ("ep",))
+    return x + y.reshape(b, s, d).astype(x.dtype)
+
+
+def _make_stage_fn(cfg, ep_size, tp_size):
+    """stage_fn(stage_params, x) scanning the stage's local layer stack."""
+
+    def layer(x, lp):
+        x = _attention_block(x, lp["ln1"], lp["wqkv"], lp["wo"], cfg)
+        x = _moe_block(x, lp["ln2"], lp["wg"], lp["w1"], lp["w2"], cfg, ep_size, tp_size)
+        return x, None
+
+    def stage_fn(stage_params, x):
+        x, _ = lax.scan(lambda h, lp: layer(h, lp), x, stage_params)
+        return x
+
+    return stage_fn
+
+
+def make_train_step(cfg, mesh, n_micro=2, learning_rate=1e-2):
+    """jitted ``train_step(params, tokens, targets) -> (params, loss)``.
+
+    ``tokens``/``targets``: (batch, seq) int32, batch sharded dp, seq sharded sp
+    (``parallel.mesh.sequence_sharding``). Params laid out per :func:`param_shardings`.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ep_size = mesh.shape.get("ep", 1)
+    tp_size = mesh.shape.get("tp", 1)
+    stage_fn = _make_stage_fn(cfg, ep_size, tp_size)
+
+    def local_loss(params, tokens, targets):
+        # tokens: (b_local, s_local); embed + absolute positions (global via sp index)
+        b_loc, s_loc = tokens.shape
+        sp_idx = lax.axis_index("sp")
+        x = params["embed"][tokens]
+        pos = lax.dynamic_slice(params["pos"], (sp_idx * s_loc, 0),
+                                (s_loc, params["pos"].shape[1]))
+        x = x + pos[None]
+        if b_loc % n_micro:
+            raise ValueError("local batch %d not divisible by n_micro=%d" % (b_loc, n_micro))
+        xm = x.reshape((n_micro, b_loc // n_micro, s_loc, cfg.d_model))
+        ym = spmd_pipeline(stage_fn, params["stages"], xm, "pp")
+        y = ym.reshape((b_loc, s_loc, cfg.d_model))
+        y = _rms_norm(y, params["ln_f"])
+        logits = jnp.einsum("bsd,dv->bsv", y, params["unembed"]).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss_sum = jnp.sum(nll)
+        count = jnp.float32(b_loc * s_loc)
+        # global mean over the data axes (batch × sequence partitions)
+        return lax.psum(loss_sum, ("dp", "sp")) / lax.psum(count, ("dp", "sp"))
+
+    def sharded_step(params, tokens, targets):
+        loss, grads = jax.value_and_grad(local_loss)(params, tokens, targets)
+        new_params = jax.tree.map(lambda p, g: p - learning_rate * g.astype(p.dtype),
+                                  params, grads)
+        return new_params, loss
+
+    pspecs = jax.tree.map(lambda s: s.spec, param_shardings(cfg, mesh),
+                          is_leaf=lambda x: hasattr(x, "spec"))
+    data_spec = P("dp", "sp")
+    step = jax.shard_map(
+        sharded_step, mesh=mesh,
+        in_specs=(pspecs, data_spec, data_spec),
+        out_specs=(pspecs, P()),
+    )
+    return jax.jit(step)
+
+
+def data_sharding(mesh):
+    """Sharding the DataLoader should use for (batch, seq) token batches of this model."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P("dp", "sp"))
+
+
+def reference_loss(cfg, params, tokens, targets, n_micro=2):
+    """Dense single-device oracle replicating the sharded forward exactly (for tests)."""
+    x = params["embed"][tokens] + params["pos"][None, : tokens.shape[1]]
+    h = x
+    for s in range(cfg.n_stages):
+        for l in range(cfg.layers_per_stage):
+            lp = {k: v[s, l] for k, v in params["stages"].items()}
+            hn = _rms_norm(h, lp["ln1"])
+            qkv = jnp.einsum("bsd,dthe->bsthe", hn, lp["wqkv"])
+            from petastorm_tpu.parallel.attention import reference_attention
+
+            o = reference_attention(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal=True)
+            h = h + jnp.einsum("bshe,hed->bsd", o, lp["wo"])
+            # dense MoE with the same static capacity semantics
+            b, sq, d = h.shape
+            hm = _rms_norm(h, lp["ln2"]).reshape(b * sq, d)
+            T, E = hm.shape[0], cfg.n_experts
+            C = max(1, int(math.ceil(T / E * cfg.capacity_factor)))
+            gates = jax.nn.softmax(
+                jnp.einsum("td,de->te", hm, lp["wg"]).astype(jnp.float32), -1)
+            eidx = jnp.argmax(gates, -1)
+            onehot = jax.nn.one_hot(eidx, E, dtype=jnp.float32)
+            pos_in_e = (jnp.cumsum(onehot, 0) - 1.0) * onehot
+            keep = onehot * (pos_in_e < C)
+            dispatch = keep[..., None] * jax.nn.one_hot(
+                pos_in_e.astype(jnp.int32), C, dtype=jnp.float32)
+            gate_val = jnp.sum(gates * keep, -1)
+            ein = jnp.einsum("tec,td->ecd", dispatch, hm.astype(jnp.float32)).astype(cfg.dtype)
+            hid = jax.nn.relu(jnp.einsum("ecd,edf->ecf", ein, lp["w1"]))
+            out = jnp.einsum("ecf,efd->ecd", hid, lp["w2"])
+            y = jnp.einsum("tec,ecd->td", dispatch, out.astype(jnp.float32)) * gate_val[:, None]
+            h = h + y.reshape(b, sq, d).astype(h.dtype)
+    y = _rms_norm(h, params["ln_f"])
+    logits = jnp.einsum("bsd,dv->bsv", y, params["unembed"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, -1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    return jnp.mean(nll)

@@ -1,1 +1,24 @@
-"""parallel subpackage."""
+"""Parallelism toolkit: device meshes, batch shardings, sequence/context parallelism
+(ring attention, Ulysses), and pipeline microbatching. See SURVEY.md §3.7 for how this
+generalizes the reference's static shard arithmetic."""
+
+from petastorm_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_ORDER,
+    batch_sharding,
+    local_batch_size,
+    make_mesh,
+    sequence_sharding,
+)
+
+
+def __getattr__(name):
+    if name in ("ring_attention", "ulysses_attention", "reference_attention",
+                "ring_self_attention", "ulysses_self_attention"):
+        from petastorm_tpu.parallel import attention
+
+        return getattr(attention, name)
+    if name in ("spmd_pipeline", "pipelined_apply", "stage_sharding"):
+        from petastorm_tpu.parallel import pipeline
+
+        return getattr(pipeline, name)
+    raise AttributeError("module 'petastorm_tpu.parallel' has no attribute %r" % name)

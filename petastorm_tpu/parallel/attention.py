@@ -1,0 +1,128 @@
+"""Sequence/context parallelism: ring attention and Ulysses (all-to-all) attention.
+
+The reference has no sequence parallelism (SURVEY.md §3.7 — NGram is windowing, not
+parallelism); these are the TPU-native long-context primitives this framework adds so
+consumers of sequence-sharded batches (``parallel.mesh.sequence_sharding``) can attend over
+contexts longer than one chip's HBM:
+
+- **Ring attention**: K/V blocks rotate around the ``sp`` ring via ``lax.ppermute`` (ICI
+  neighbour hops) while each device keeps its Q block; softmax is accumulated online
+  (flash-attention style log-sum-exp carry) so nothing materializes the full score matrix.
+- **Ulysses**: ``lax.all_to_all`` reshards (seq-sharded → head-sharded), runs plain local
+  attention over the full sequence per head group, then reshards back. Cheaper at moderate
+  context when heads ≥ ring size; ring wins at extreme context.
+
+All functions are shard_map-style collectives over an axis name, jittable and
+differentiable; use :func:`ring_self_attention` / :func:`ulysses_self_attention` for the
+mesh-wrapped form.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def reference_attention(q, k, v, causal=False):
+    """Dense softmax attention (b, s, h, d) — the correctness oracle for the parallel forms."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention(q, k, v, axis_name, causal=False):
+    """Ring attention over a sharded sequence axis (inside shard_map over ``axis_name``).
+
+    Args are local blocks (b, s_local, h, d); the global sequence is the concatenation of
+    blocks in axis order. Returns the local output block. Accumulation is float32.
+    """
+    ring_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale = d ** -0.5
+    q32 = q.astype(jnp.float32)
+
+    # derive accumulators from q so they inherit its varying-manual-axes type — fresh
+    # zeros would be unvarying and the fori_loop carry types would disagree under shard_map
+    o = q32 * 0.0
+    zero_bhs = jnp.moveaxis(q32[..., 0] * 0.0, 1, 2)  # (b, h, s_loc)
+    m = zero_bhs - jnp.inf
+    l = zero_bhs
+
+    def body(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        kv_idx = (my_idx - i) % ring_size  # whose block we hold at step i
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
+        if causal:
+            q_pos = my_idx * s_loc + jnp.arange(s_loc)[:, None]
+            k_pos = kv_idx * s_loc + jnp.arange(s_loc)[None, :]
+            scores = jnp.where(q_pos >= k_pos, scores, -jnp.inf)
+        blk_max = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, blk_max)
+        # fully-masked rows keep m=-inf; guard the exp against -inf - -inf
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32)
+        )
+        perm = [(j, (j + 1) % ring_size) for j in range(ring_size)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return o, m_new, l, k_blk, v_blk
+
+    o, m, l, _, _ = lax.fori_loop(0, ring_size, body, (o, m, l, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output, not NaN
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False):
+    """Ulysses sequence parallelism (inside shard_map over ``axis_name``).
+
+    all_to_all: (b, s/N, h, d) → (b, s, h/N, d), dense attention per local head group over
+    the FULL sequence, then the inverse all_to_all. Requires heads % axis_size == 0.
+    """
+    n = lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError("Ulysses needs heads (%d) divisible by axis size (%d)" % (h, n))
+    def seq_to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    oh = reference_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(oh)
+
+
+def _mesh_wrap(fn, mesh, seq_axis, batch_axis):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(batch_axis if batch_axis in mesh.axis_names else None, seq_axis)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+
+
+def ring_self_attention(q, k, v, mesh, seq_axis="sp", batch_axis="dp", causal=False):
+    """Mesh-level ring attention: q/k/v are global (b, s, h, d) arrays sequence-sharded over
+    ``seq_axis`` (e.g. via ``parallel.mesh.sequence_sharding``)."""
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    return _mesh_wrap(fn, mesh, seq_axis, batch_axis)(q, k, v)
+
+
+def ulysses_self_attention(q, k, v, mesh, seq_axis="sp", batch_axis="dp", causal=False):
+    """Mesh-level Ulysses attention over a sequence-sharded batch."""
+    fn = functools.partial(ulysses_attention, axis_name=seq_axis, causal=causal)
+    return _mesh_wrap(fn, mesh, seq_axis, batch_axis)(q, k, v)

@@ -1,0 +1,99 @@
+"""Device-mesh construction and batch-sharding helpers.
+
+The reference's distribution story is static shard arithmetic (``cur_shard``/``shard_count``,
+petastorm/reader.py ~L470) with zero runtime communication. The TPU-native generalization is a
+``jax.sharding.Mesh`` over the pod slice: the data plane delivers batches already laid out for
+whatever (dp, pp, tp, sp, ep) the training step uses, and collectives ride ICI via XLA.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+#: Canonical mesh-axis vocabulary used across petastorm_tpu:
+#: dp = data (batch), pp = pipeline stages, sp = sequence/context, tp = tensor (model),
+#: ep = expert (MoE; commonly aliased onto dp or its own axis).
+AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")
+
+
+def make_mesh(axis_sizes=None, devices=None):
+    """Build a ``Mesh`` from ``{axis: size}``; unlisted devices fold into ``dp``.
+
+    ``axis_sizes=None`` → pure data-parallel mesh over all devices. Sizes of -1 (at most one)
+    are inferred from the device count. Axis order follows :data:`AXIS_ORDER` so the
+    fastest-varying (innermost, highest-bandwidth ICI neighbours) axis is ``tp`` — the axis
+    whose collectives are latency-critical.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axis_sizes = dict(axis_sizes or {})
+    for ax in axis_sizes:
+        if ax not in AXIS_ORDER:
+            raise ValueError("Unknown mesh axis %r (expected one of %s)" % (ax, AXIS_ORDER))
+    known = [s for s in axis_sizes.values() if s != -1]
+    n_unknown = sum(1 for s in axis_sizes.values() if s == -1)
+    if n_unknown > 1:
+        raise ValueError("At most one axis size may be -1")
+    prod = math.prod(known) if known else 1
+    if n_unknown:
+        if n % prod:
+            raise ValueError("Cannot infer -1 axis: %d devices not divisible by %d" % (n, prod))
+        inferred = n // prod
+        axis_sizes = {k: (inferred if v == -1 else v) for k, v in axis_sizes.items()}
+        prod = n
+    if "dp" not in axis_sizes:
+        if n % prod:
+            raise ValueError(
+                "Axis sizes %r do not divide device count %d" % (axis_sizes, n)
+            )
+        axis_sizes["dp"] = n // prod
+    sizes = [(ax, axis_sizes[ax]) for ax in AXIS_ORDER if ax in axis_sizes]
+    total = math.prod(s for _, s in sizes)
+    if total != n:
+        raise ValueError("Mesh %r needs %d devices, have %d" % (dict(sizes), total, n))
+    shape = tuple(s for _, s in sizes)
+    names = tuple(ax for ax, _ in sizes)
+    return Mesh(np.array(devices).reshape(shape), names)
+
+
+def batch_sharding(mesh, batch_axes=("dp",), extra_dims=0):
+    """``NamedSharding`` splitting the leading (batch) dim over ``batch_axes``.
+
+    This is what a DataLoader consumer passes as ``sharding=``: data parallelism over ``dp``
+    (optionally ``('dp', 'fsdp'-style combos)``); trailing dims replicated.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+    spec = PartitionSpec(axes if len(axes) > 1 else (axes[0] if axes else None),
+                         *([None] * extra_dims))
+    return NamedSharding(mesh, spec)
+
+
+def sequence_sharding(mesh, batch_axis="dp", seq_axis="sp"):
+    """Sharding for (batch, seq, ...) token batches: batch over dp, sequence over sp.
+
+    A long-context consumer (ring attention / Ulysses) hands this to the DataLoader so
+    sequences arrive already split along the context axis — the loader's only CP obligation
+    (SURVEY.md §6)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    b = batch_axis if batch_axis in mesh.axis_names else None
+    s = seq_axis if seq_axis in mesh.axis_names else None
+    return NamedSharding(mesh, PartitionSpec(b, s))
+
+
+def local_batch_size(global_batch_size, mesh, batch_axes=("dp",)):
+    """Rows this process must feed for a given global batch (multi-host loaders)."""
+    import jax
+
+    shards = math.prod(mesh.shape[a] for a in batch_axes if a in mesh.axis_names)
+    if global_batch_size % shards:
+        raise ValueError("global batch %d not divisible by %d-way batch sharding"
+                         % (global_batch_size, shards))
+    return global_batch_size * len(mesh.local_devices) // len(mesh.devices.flat) \
+        if jax.process_count() > 1 else global_batch_size

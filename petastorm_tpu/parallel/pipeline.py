@@ -1,0 +1,92 @@
+"""Pipeline parallelism: GPipe-schedule microbatching over a ``pp`` mesh axis.
+
+Not present in the reference (SURVEY.md §3.7 — its closest analog is the host-side
+ventilator→worker→collate pipeline). Here stages live on different devices along the ``pp``
+axis; activations hop stage-to-stage with ``lax.ppermute`` (neighbour ICI transfers), and the
+schedule runs ``n_micro + n_stages - 1`` ticks with the classic bubble. Everything is
+static-shape ``lax.scan`` — jittable, differentiable, XLA-schedulable.
+
+Layout contract: stage parameters are stacked on a leading ``n_stages`` axis and sharded over
+``pp`` (one stage per device row); inputs are microbatched (n_micro, micro_b, ...) and fully
+replicated along ``pp`` (only stage 0 consumes them, only stage n-1 emits outputs).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def spmd_pipeline(stage_fn, stage_params, microbatches, axis_name):
+    """Run inside shard_map over ``axis_name``; returns (n_micro, micro_b, ...) outputs.
+
+    ``stage_fn(params, x) -> y`` is the per-stage computation; ``stage_params`` here is the
+    LOCAL slice (leading dim 1) of the stacked stage parameters; ``microbatches`` has shape
+    (n_micro, micro_b, ...), identical on every stage.
+    """
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    params = jax.tree.map(lambda p: p[0], stage_params)  # local (1, ...) -> (...)
+    n_micro = microbatches.shape[0]
+    perm = None  # computed lazily: ppermute perm needs concrete ring size
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (clipped; predication handles the tail bubble)
+        inp = microbatches[jnp.clip(t, 0, n_micro - 1)]
+        x = jnp.where(stage == 0, inp, state)
+        y = stage_fn(params, x)
+        # last stage finishes microbatch t-(n_stages-1) at tick t
+        mb = t - (n_stages - 1)
+        write_ok = (stage == n_stages - 1) & (mb >= 0)
+        mbc = jnp.clip(mb, 0, n_micro - 1)
+        outputs = outputs.at[mbc].set(jnp.where(write_ok, y, outputs[mbc]))
+        shifted = lax.ppermute(
+            y, axis_name, [(j, (j + 1) % n_stages) for j in range(n_stages)]
+        )
+        return (shifted, outputs), None
+
+    # the carries become pp-varying after the ppermute/one-hot write, so the inits must
+    # carry that varying-axes type too; deriving from microbatches (* 0) also inherits any
+    # dp/sp varying axes the data brings in
+    state0 = lax.pcast(microbatches[0] * 0, (axis_name,), to="varying")
+    outputs0 = lax.pcast(microbatches * 0, (axis_name,), to="varying")
+    (_, outputs), _ = lax.scan(tick, (state0, outputs0), jnp.arange(n_micro + n_stages - 1))
+    # every stage's `outputs` buffer is only filled on the last stage; broadcast it back so
+    # the result is replicated along pp (psum over one-hot keeps it a collective, not a gather)
+    return lax.psum(jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+                    axis_name)
+
+
+def pipelined_apply(stage_fn, stacked_params, x, mesh, n_micro, pp_axis="pp"):
+    """Mesh-level entry: apply an ``n_stages``-deep pipeline to a global batch.
+
+    ``stacked_params``: pytree with leading axis n_stages (shard over ``pp`` with
+    ``stage_sharding``); ``x``: (batch, ...) global batch; ``n_micro`` microbatches must
+    divide batch. Returns (batch, ...) outputs.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if x.shape[0] % n_micro:
+        raise ValueError("batch %d not divisible into %d microbatches" % (x.shape[0], n_micro))
+    micro_b = x.shape[0] // n_micro
+    xm = x.reshape((n_micro, micro_b) + x.shape[1:])
+
+    fn = functools.partial(spmd_pipeline, stage_fn, axis_name=pp_axis)
+    param_specs = jax.tree.map(lambda _: P(pp_axis), stacked_params)
+    out = shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+    )(stacked_params, xm)
+    return out.reshape((x.shape[0],) + out.shape[2:])
+
+
+def stage_sharding(mesh, pp_axis="pp"):
+    """NamedSharding for stage-stacked parameters (leading axis over ``pp``)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(pp_axis))

@@ -277,7 +277,13 @@ def _merge_tables(head, tail):
 
 
 def _column_to_numpy(table, name, schema):
-    """Arrow column → numpy array; decodes codec columns, stacks list columns."""
+    """Arrow column → numpy array; decodes codec columns, stacks list columns.
+
+    List columns take the vectorized path: flatten the Arrow child buffer straight to
+    numpy and reshape — ``to_pylist`` would materialize every element as a Python object
+    (~100x slower on image-sized rows, the data-plane hot loop)."""
+    import pyarrow as pa
+
     col = table.column(name)
     field = schema.fields.get(name)
     if field is not None and field.codec is not None:
@@ -285,8 +291,42 @@ def _column_to_numpy(table, name, schema):
         decoded = [field.codec.decode(field, v) if v is not None else None for v in values]
         return _stack(decoded, field)
     if field is not None and field.shape:
-        return _stack(col.to_pylist(), field)
+        arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+        stacked = _list_column_to_numpy(arr, field)
+        if stacked is not None:
+            return stacked
+        return _stack(arr.to_pylist(), field)
     return col.to_numpy(zero_copy_only=False)
+
+
+def _list_column_to_numpy(arr, field):
+    """Vectorized (fixed-size or uniform) list-of-numeric column → (n, ...) ndarray.
+
+    Returns None when the fast path does not apply (ragged rows, nulls, non-numeric)."""
+    import pyarrow as pa
+
+    shape_known = field.shape and all(d is not None for d in field.shape)
+    if arr.null_count:
+        return None
+    if isinstance(arr.type, pa.FixedSizeListType):
+        size = arr.type.list_size
+        flat = arr.flatten().to_numpy(zero_copy_only=False)  # offset/slice-safe
+        out = flat.reshape(len(arr), size)
+    elif pa.types.is_list(arr.type) or pa.types.is_large_list(arr.type):
+        offsets = arr.offsets.to_numpy(zero_copy_only=False)
+        lengths = np.diff(offsets)
+        if len(lengths) == 0 or not (lengths == lengths[0]).all():
+            return None  # ragged: caller falls back to object rows
+        flat = arr.flatten().to_numpy(zero_copy_only=False)
+        out = flat.reshape(len(arr), int(lengths[0]))
+    else:
+        return None
+    if shape_known and int(np.prod(field.shape)) == out.shape[1]:
+        out = out.reshape((len(out),) + tuple(field.shape))
+    np_dtype = np.dtype(field.numpy_dtype)
+    if np_dtype.kind in "biufc" and out.dtype != np_dtype:
+        out = out.astype(np_dtype)
+    return out
 
 
 def _stack(values, field):

@@ -153,8 +153,11 @@ class ThreadExecutor(ExecutorBase):
             pass
 
     def join(self):
+        import time
+
+        deadline = time.monotonic() + self._timeout  # shared across threads, not per-thread
         for t in self._threads:
-            t.join(timeout=self._timeout)
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
             if t.is_alive():
                 logger.warning(
                     "Worker thread %s still alive after %.0fs join (blocked in IO?); "

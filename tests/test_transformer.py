@@ -1,0 +1,79 @@
+"""Flagship SPMD MoE transformer: sharded train step vs dense oracle on the 8-device mesh.
+
+Exercises every parallel axis at once (dp=2, pp=2, sp=2 with tp/ep size 1, and a second
+mesh with tp=2 / ep=2) — the same configuration __graft_entry__.dryrun_multichip validates.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from petastorm_tpu.models.transformer import (
+    TransformerConfig,
+    data_sharding,
+    init_params,
+    make_train_step,
+    param_shardings,
+    reference_loss,
+)
+from petastorm_tpu.models.transformer import model_mesh
+
+
+CFG = TransformerConfig(vocab=64, d_model=32, n_heads=4, head_dim=8, d_ff=32,
+                        n_stages=2, layers_per_stage=1, n_experts=4,
+                        capacity_factor=8.0,  # >= n_experts: nothing drops -> exact oracle
+                        max_seq=32)
+
+
+def _data(key, b=8, s=32):
+    kt, kg = jax.random.split(key)
+    tokens = jax.random.randint(kt, (b, s), 0, CFG.vocab)
+    targets = jax.random.randint(kg, (b, s), 0, CFG.vocab)
+    return tokens, targets
+
+
+def _put(params, tokens, targets, mesh):
+    shardings = param_shardings(CFG, mesh)
+    params = jax.tree.map(jax.device_put, params, shardings,
+                          is_leaf=lambda x: isinstance(x, jnp.ndarray))
+    ds = data_sharding(mesh)
+    return params, jax.device_put(tokens, ds), jax.device_put(targets, ds)
+
+
+@pytest.mark.parametrize("axes", [
+    {"dp": 2, "pp": 2, "sp": 2},
+    {"pp": 2, "sp": 2, "tp": 2},
+    {"pp": 2, "ep": 2, "sp": 2},
+])
+def test_train_step_matches_dense_oracle(axes):
+    mesh = model_mesh(dict(axes))
+    key = jax.random.PRNGKey(0)
+    params = init_params(CFG, key)
+    tokens, targets = _data(jax.random.PRNGKey(1))
+    expected = float(reference_loss(CFG, params, tokens, targets))
+
+    p, tok, tgt = _put(params, tokens, targets, mesh)
+    step = make_train_step(CFG, mesh, n_micro=2, learning_rate=0.1)
+    new_params, loss = step(p, tok, tgt)
+    assert abs(float(loss) - expected) < 2e-4, (float(loss), expected)
+
+    # params actually moved and stayed finite
+    delta = jax.tree.reduce(
+        lambda a, b: a + b,
+        jax.tree.map(lambda a, b: float(jnp.abs(a - b).sum()), new_params, p),
+    )
+    assert np.isfinite(delta) and delta > 0.0
+
+
+def test_loss_decreases_over_steps():
+    mesh = model_mesh({"dp": 2, "pp": 2, "sp": 2})
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    tokens, targets = _data(jax.random.PRNGKey(1))
+    p, tok, tgt = _put(params, tokens, targets, mesh)
+    step = make_train_step(CFG, mesh, n_micro=2, learning_rate=0.5)
+    losses = []
+    for _ in range(5):
+        p, loss = step(p, tok, tgt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
