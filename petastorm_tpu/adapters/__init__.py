@@ -1,1 +1,15 @@
-"""adapters subpackage."""
+"""Framework adapters: PyTorch loaders and TensorFlow dataset bridges (reference
+petastorm/pytorch.py, petastorm/tf_utils.py). Import lazily — torch/tf are optional."""
+
+
+def __getattr__(name):
+    if name in ("DataLoader", "BatchedDataLoader", "InMemBatchedDataLoader",
+                "decimal_friendly_collate"):
+        from petastorm_tpu.adapters import pytorch
+
+        return getattr(pytorch, name)
+    if name in ("make_petastorm_dataset", "tf_tensors"):
+        from petastorm_tpu.adapters import tf
+
+        return getattr(tf, name)
+    raise AttributeError("module 'petastorm_tpu.adapters' has no attribute %r" % name)

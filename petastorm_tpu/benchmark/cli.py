@@ -1,0 +1,37 @@
+"""Throughput CLI (reference petastorm/benchmark/cli.py, console script
+``petastorm-throughput``): measure rows/sec of a reader config from the command line."""
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("dataset_url")
+    parser.add_argument("--batch", action="store_true",
+                        help="use make_batch_reader (vanilla parquet) instead of make_reader")
+    parser.add_argument("--pool-type", choices=["thread", "process", "dummy"],
+                        default="thread")
+    parser.add_argument("--workers-count", type=int, default=4)
+    parser.add_argument("--schema-fields", nargs="*", default=None)
+    parser.add_argument("--warmup-rows", type=int, default=1000)
+    parser.add_argument("--measure-rows", type=int, default=10000)
+    args = parser.parse_args(argv)
+
+    from petastorm_tpu.benchmark.throughput import reader_throughput
+    from petastorm_tpu.reader import make_batch_reader, make_reader
+
+    factory = make_batch_reader if args.batch else make_reader
+    reader = factory(args.dataset_url, schema_fields=args.schema_fields,
+                     reader_pool_type=args.pool_type, workers_count=args.workers_count,
+                     num_epochs=None)
+    try:
+        result = reader_throughput(reader, args.warmup_rows, args.measure_rows)
+        print(result)
+    finally:
+        reader.stop()
+        reader.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Reader throughput harness (reference petastorm/benchmark/throughput.py
+``reader_throughput`` ~L60: warmup + timed loop, per pool type / workers / fields), extended
+with per-stage counters the reference lacks (SURVEY.md §6): read/decode vs device-feed split
+and device-idle estimation when a loader is measured.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+
+
+@dataclasses.dataclass
+class BenchmarkResult:
+    rows_per_second: float
+    rows: int
+    seconds: float
+    batches: int = 0
+    device_idle_fraction: float | None = None
+
+    def __str__(self):
+        s = "%.1f rows/s (%d rows in %.2fs)" % (self.rows_per_second, self.rows, self.seconds)
+        if self.device_idle_fraction is not None:
+            s += ", device idle %.1f%%" % (100 * self.device_idle_fraction)
+        return s
+
+
+def _count_rows(item):
+    d = item._asdict() if hasattr(item, "_asdict") else item
+    if isinstance(d, dict):
+        first = next(iter(d.values()), None)
+        if hasattr(first, "__len__") and getattr(first, "ndim", 1) >= 1:
+            return len(first)
+    return 1
+
+
+def reader_throughput(reader, warmup_rows=1000, measure_rows=10000):
+    """rows/sec of ``next(reader)`` after warmup (reference contract)."""
+    warmed = 0
+    it = iter(reader)
+    for item in it:
+        warmed += _count_rows(item)
+        if warmed >= warmup_rows:
+            break
+    n = 0
+    batches = 0
+    t0 = time.perf_counter()
+    for item in it:
+        n += _count_rows(item)
+        batches += 1
+        if n >= measure_rows:
+            break
+    dt = time.perf_counter() - t0
+    return BenchmarkResult(rows_per_second=n / dt if dt else float("inf"), rows=n,
+                           seconds=dt, batches=batches)
+
+
+def loader_throughput(loader, consume_fn=None, warmup_batches=4, measure_batches=50):
+    """End-to-end loader rows/sec including device feed; estimates device idle as the
+    fraction of wall time NOT spent inside ``consume_fn`` (the device work)."""
+    it = iter(loader)
+    for _ in range(warmup_batches):
+        batch = next(it, None)
+        if batch is None:
+            break
+        if consume_fn is not None:
+            consume_fn(batch)
+    n = 0
+    batches = 0
+    busy = 0.0
+    t0 = time.perf_counter()
+    for batch in it:
+        n += _count_rows(batch)
+        batches += 1
+        if consume_fn is not None:
+            c0 = time.perf_counter()
+            consume_fn(batch)
+            busy += time.perf_counter() - c0
+        if batches >= measure_batches:
+            break
+    dt = time.perf_counter() - t0
+    idle = None
+    if consume_fn is not None and dt > 0:
+        idle = max(0.0, 1.0 - busy / dt)
+    return BenchmarkResult(rows_per_second=n / dt if dt else float("inf"), rows=n,
+                           seconds=dt, batches=batches, device_idle_fraction=idle)

@@ -202,6 +202,14 @@ def make_train_step(cfg, mesh, n_micro=2, learning_rate=1e-2):
 
     ep_size = mesh.shape.get("ep", 1)
     tp_size = mesh.shape.get("tp", 1)
+    pp_size = mesh.shape.get("pp", 1)
+    if cfg.n_stages != pp_size:
+        raise ValueError(
+            "cfg.n_stages (%d) must equal the mesh pp size (%d): spmd_pipeline assigns "
+            "exactly one stage per pp rank" % (cfg.n_stages, pp_size)
+        )
+    if cfg.n_heads % tp_size or cfg.d_ff % tp_size or cfg.n_experts % ep_size:
+        raise ValueError("heads/d_ff/experts must divide tp/ep mesh sizes")
     stage_fn = _make_stage_fn(cfg, ep_size, tp_size)
 
     def local_loss(params, tokens, targets):

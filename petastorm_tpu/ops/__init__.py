@@ -1,1 +1,18 @@
-"""ops subpackage."""
+"""On-device data-plane ops: Pallas kernels and jitted transforms (image normalize/augment,
+HBM shuffle buffer, JPEG device-stage decode). CPU topologies run kernels in interpret mode."""
+
+
+def __getattr__(name):
+    if name in ("normalize_images", "normalize_and_augment", "random_crop"):
+        from petastorm_tpu.ops import image
+
+        return getattr(image, name)
+    if name == "DeviceShuffleBuffer":
+        from petastorm_tpu.ops.device_shuffle import DeviceShuffleBuffer
+
+        return DeviceShuffleBuffer
+    if name in ("idct_blocks", "decode_jpeg_device_stage", "ycbcr_to_rgb"):
+        from petastorm_tpu.ops import jpeg
+
+        return getattr(jpeg, name)
+    raise AttributeError("module 'petastorm_tpu.ops' has no attribute %r" % name)

@@ -1,0 +1,76 @@
+"""HBM-resident shuffle buffer: on-device sample decorrelation (SURVEY.md §8 L6).
+
+The reference shuffles rows in host python (``RandomShufflingBuffer``); at TPU batch rates
+that costs host CPU and H2D bandwidth. This buffer keeps a fixed-size ring of rows in device
+HBM and serves random batches by a single fused gather (one XLA ``take`` per column), with
+deterministic multi-host semantics: every process uses the same PRNG key stream, so sampling
+indices agree across hosts even though each host holds different shard data.
+
+All state transitions are pure jitted functions (donate-friendly); the class is a thin
+host-side cursor wrapper.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _insert(store, batch, cursor):
+    """Overwrite ring rows [cursor, cursor+b) (wrapping) with the batch."""
+    cap = next(iter(store.values())).shape[0]
+    b = next(iter(batch.values())).shape[0]
+    idx = (cursor + jnp.arange(b)) % cap
+    return {k: store[k].at[idx].set(batch[k].astype(store[k].dtype)) for k in store}
+
+
+@functools.partial(jax.jit, static_argnames=("batch_size",))
+def _sample(store, key, filled, batch_size):
+    cap = next(iter(store.values())).shape[0]
+    idx = jax.random.randint(key, (batch_size,), 0, jnp.maximum(filled, 1))
+    idx = idx % cap
+    return {k: v[idx] for k, v in store.items()}
+
+
+class DeviceShuffleBuffer:
+    """Fixed-capacity device ring + random-gather sampling.
+
+    >>> buf = DeviceShuffleBuffer(capacity=4096, example_batch=batch, key=key)
+    >>> buf.insert(batch)          # O(b) scatter in HBM
+    >>> out = buf.sample(256)      # O(b) gather, decorrelated rows
+    """
+
+    def __init__(self, capacity, example_batch, key, sharding=None):
+        self.capacity = int(capacity)
+        self._key = key
+        self._cursor = 0
+        self._filled = 0
+        store = {}
+        for name, arr in example_batch.items():
+            shape = (self.capacity,) + tuple(arr.shape[1:])
+            z = jnp.zeros(shape, arr.dtype)
+            if sharding is not None:
+                z = jax.device_put(z, sharding)
+            store[name] = z
+        self._store = store
+
+    @property
+    def filled(self):
+        return self._filled
+
+    def insert(self, batch):
+        b = len(next(iter(batch.values())))
+        if b > self.capacity:
+            raise ValueError("batch of %d exceeds capacity %d" % (b, self.capacity))
+        self._store = _insert(self._store, batch, jnp.int32(self._cursor))
+        self._cursor = (self._cursor + b) % self.capacity
+        self._filled = min(self.capacity, self._filled + b)
+        return self
+
+    def sample(self, batch_size):
+        if self._filled == 0:
+            raise ValueError("sampling from an empty shuffle buffer")
+        self._key, sub = jax.random.split(self._key)
+        return _sample(self._store, sub, jnp.int32(self._filled), batch_size)

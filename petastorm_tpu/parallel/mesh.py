@@ -88,12 +88,22 @@ def sequence_sharding(mesh, batch_axis="dp", seq_axis="sp"):
 
 
 def local_batch_size(global_batch_size, mesh, batch_axes=("dp",)):
-    """Rows this process must feed for a given global batch (multi-host loaders)."""
-    import jax
+    """Rows this process must feed for a given global batch (multi-host loaders).
 
-    shards = math.prod(mesh.shape[a] for a in batch_axes if a in mesh.axis_names)
+    The batch dim splits into prod(batch-axis sizes) chunks laid out along those mesh axes;
+    a process must supply rows for every batch-chunk coordinate that any of its local
+    devices occupies (other axes replicate and don't reduce the obligation).
+    """
+    axes = [a for a in batch_axes if a in mesh.axis_names]
+    shards = math.prod(mesh.shape[a] for a in axes) if axes else 1
     if global_batch_size % shards:
         raise ValueError("global batch %d not divisible by %d-way batch sharding"
                          % (global_batch_size, shards))
-    return global_batch_size * len(mesh.local_devices) // len(mesh.devices.flat) \
-        if jax.process_count() > 1 else global_batch_size
+    dev_grid = mesh.devices
+    local_ids = {d.id for d in mesh.local_devices}
+    axis_idx = [mesh.axis_names.index(a) for a in axes]
+    owned = set()
+    for pos in np.ndindex(*dev_grid.shape):
+        if dev_grid[pos].id in local_ids:
+            owned.add(tuple(pos[i] for i in axis_idx))
+    return global_batch_size * len(owned) // shards

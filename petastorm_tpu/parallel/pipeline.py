@@ -30,7 +30,6 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, axis_name):
     stage = lax.axis_index(axis_name)
     params = jax.tree.map(lambda p: p[0], stage_params)  # local (1, ...) -> (...)
     n_micro = microbatches.shape[0]
-    perm = None  # computed lazily: ppermute perm needs concrete ring size
 
     def tick(carry, t):
         state, outputs = carry

@@ -311,6 +311,8 @@ def _list_column_to_numpy(arr, field):
     if isinstance(arr.type, pa.FixedSizeListType):
         size = arr.type.list_size
         flat = arr.flatten().to_numpy(zero_copy_only=False)  # offset/slice-safe
+        if flat.dtype.kind not in "biufc":  # nested/non-numeric: fall back to to_pylist
+            return None
         out = flat.reshape(len(arr), size)
     elif pa.types.is_list(arr.type) or pa.types.is_large_list(arr.type):
         offsets = arr.offsets.to_numpy(zero_copy_only=False)
@@ -318,6 +320,8 @@ def _list_column_to_numpy(arr, field):
         if len(lengths) == 0 or not (lengths == lengths[0]).all():
             return None  # ragged: caller falls back to object rows
         flat = arr.flatten().to_numpy(zero_copy_only=False)
+        if flat.dtype.kind not in "biufc":
+            return None
         out = flat.reshape(len(arr), int(lengths[0]))
     else:
         return None

@@ -1,1 +1,7 @@
-"""spark subpackage."""
+"""Spark converter API (reference petastorm/spark/__init__.py re-exports)."""
+
+from petastorm_tpu.spark.spark_dataset_converter import (  # noqa: F401
+    SparkDatasetConverter,
+    make_spark_converter,
+    register_delete_dir_handler,
+)

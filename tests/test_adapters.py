@@ -1,0 +1,127 @@
+"""Framework adapter tests: torch loaders (collate, shuffling, in-mem epochs) and tf.data
+bridges (dtypes/shapes, batched and per-row paths)."""
+import numpy as np
+import pytest
+
+from petastorm_tpu.reader import make_batch_reader, make_reader
+
+
+# -- pytorch ---------------------------------------------------------------------------
+
+def test_torch_batched_dataloader(scalar_dataset):
+    import torch
+
+    from petastorm_tpu.adapters.pytorch import BatchedDataLoader
+
+    reader = make_batch_reader(scalar_dataset.url, shuffle_row_groups=False)
+    with BatchedDataLoader(reader, batch_size=7) as loader:
+        batches = list(loader)
+    total = sum(len(b["id"]) for b in batches)
+    assert total == len(scalar_dataset.data)
+    assert isinstance(batches[0]["float_col"], torch.Tensor)
+    assert batches[0]["float_col"].dtype == torch.float64
+    # strings stay numpy
+    assert not isinstance(batches[0]["string_col"], torch.Tensor)
+    ids = np.concatenate([np.asarray(b["id"]) for b in batches])
+    assert sorted(ids.tolist()) == sorted(r["id"] for r in scalar_dataset.data)
+
+
+def test_torch_batched_dataloader_shuffles(scalar_dataset):
+    from petastorm_tpu.adapters.pytorch import BatchedDataLoader
+
+    def ids(cap, seed):
+        reader = make_batch_reader(scalar_dataset.url, shuffle_row_groups=False)
+        with BatchedDataLoader(reader, batch_size=5, shuffling_queue_capacity=cap,
+                               seed=seed) as loader:
+            return np.concatenate([np.asarray(b["id"]) for b in loader]).tolist()
+
+    a, b = ids(0, 0), ids(16, 3)
+    assert sorted(a) == sorted(b)
+    assert a != b
+
+
+def test_torch_per_row_dataloader(synthetic_dataset):
+    import torch
+
+    from petastorm_tpu.adapters.pytorch import DataLoader
+
+    reader = make_reader(synthetic_dataset.url, shuffle_row_groups=False,
+                         schema_fields=["id", "matrix"])
+    with DataLoader(reader, batch_size=4) as loader:
+        batches = list(loader)
+    total = sum(len(b["id"]) for b in batches)
+    assert total == len(synthetic_dataset.data)
+    assert isinstance(batches[0]["matrix"], torch.Tensor)
+    assert batches[0]["matrix"].shape[1:] == (8, 4)
+
+
+def test_torch_inmem_loader_epochs(scalar_dataset):
+    from petastorm_tpu.adapters.pytorch import InMemBatchedDataLoader
+
+    reader = make_batch_reader(scalar_dataset.url, shuffle_row_groups=False)
+    n = len(scalar_dataset.data)
+    with InMemBatchedDataLoader(reader, batch_size=10, num_epochs=3, shuffle=True,
+                                seed=0) as loader:
+        batches = list(loader)
+    total = sum(len(b["id"]) for b in batches)
+    assert total == 3 * n
+    first_epoch = np.concatenate(
+        [np.asarray(b["id"]) for b in batches[: n // 10]])
+    assert sorted(first_epoch.tolist()) == sorted(r["id"] for r in scalar_dataset.data)
+
+
+def test_decimal_friendly_collate():
+    import decimal
+
+    import torch
+
+    from petastorm_tpu.adapters.pytorch import decimal_friendly_collate
+
+    rows = [{"a": 1, "d": decimal.Decimal("1.5")}, {"a": 2, "d": decimal.Decimal("2.5")}]
+    out = decimal_friendly_collate(rows)
+    assert isinstance(out["a"], torch.Tensor)
+    assert out["d"] == [decimal.Decimal("1.5"), decimal.Decimal("2.5")]
+
+
+# -- tensorflow ------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tf():
+    return pytest.importorskip("tensorflow")
+
+
+def test_tf_dataset_batched(tf, scalar_dataset):
+    from petastorm_tpu.adapters.tf import make_petastorm_dataset
+
+    reader = make_batch_reader(scalar_dataset.url, shuffle_row_groups=False,
+                               schema_fields=["id", "float_col", "int_col"])
+    with reader:
+        ds = make_petastorm_dataset(reader)
+        ids = []
+        for batch in ds:
+            assert batch["float_col"].dtype == tf.float64
+            assert batch["int_col"].dtype == tf.int32
+            ids.extend(batch["id"].numpy().tolist())
+    assert sorted(ids) == sorted(r["id"] for r in scalar_dataset.data)
+
+
+def test_tf_dataset_per_row(tf, synthetic_dataset):
+    from petastorm_tpu.adapters.tf import make_petastorm_dataset
+
+    reader = make_reader(synthetic_dataset.url, shuffle_row_groups=False,
+                         schema_fields=["id", "matrix"])
+    with reader:
+        ds = make_petastorm_dataset(reader)
+        rows = list(ds)
+    assert len(rows) == len(synthetic_dataset.data)
+    assert rows[0]["matrix"].shape == (8, 4)
+
+
+def test_tf_tensors_eager(tf, scalar_dataset):
+    from petastorm_tpu.adapters.tf import tf_tensors
+
+    reader = make_batch_reader(scalar_dataset.url, shuffle_row_groups=False)
+    with reader:
+        next_fn = tf_tensors(reader)
+        batch = next_fn()
+    assert "id" in batch

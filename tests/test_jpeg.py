@@ -1,0 +1,103 @@
+"""Two-stage JPEG decode vs the cv2 (libjpeg) oracle: entropy decode + Pallas IDCT."""
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from petastorm_tpu.ops.jpeg import (  # noqa: E402
+    decode_jpeg,
+    decode_jpeg_device_stage,
+    entropy_decode_jpeg,
+    idct_blocks,
+)
+
+
+def _roundtrip(img, quality=90):
+    ok, enc = cv2.imencode(".jpg", cv2.cvtColor(img, cv2.COLOR_RGB2BGR),
+                           [cv2.IMWRITE_JPEG_QUALITY, quality])
+    assert ok
+    data = enc.tobytes()
+    ref = cv2.cvtColor(cv2.imdecode(np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR),
+                       cv2.COLOR_BGR2RGB)
+    return data, ref
+
+
+def test_gradient_image_close_to_libjpeg():
+    gx = np.tile(np.linspace(0, 255, 64)[None, :], (48, 1))
+    gy = np.tile(np.linspace(0, 255, 48)[:, None], (1, 64))
+    img = np.stack([gx, gy, 0.5 * (gx + gy)], -1).astype(np.uint8)
+    data, ref = _roundtrip(img, 90)
+    ours = np.asarray(decode_jpeg(data))
+    diff = np.abs(ref.astype(int) - ours.astype(int))
+    assert diff.max() <= 4 and diff.mean() < 1.0
+
+
+def test_noise_image_within_lossy_tolerance():
+    rng = np.random.RandomState(0)
+    img = rng.randint(0, 256, (40, 56, 3), dtype=np.uint8)
+    data, ref = _roundtrip(img, 75)
+    ours = np.asarray(decode_jpeg(data))
+    diff = np.abs(ref.astype(int) - ours.astype(int))
+    assert diff.mean() < 2.0  # float IDCT vs libjpeg fixed-point islow
+    assert np.percentile(diff, 99) <= 10
+
+
+def test_odd_size_and_flat():
+    img = (np.ones((17, 19, 3)) * [10, 200, 60]).astype(np.uint8)
+    data, ref = _roundtrip(img, 80)
+    ours = np.asarray(decode_jpeg(data))
+    assert ours.shape == (17, 19, 3)
+    assert np.abs(ref.astype(int) - ours.astype(int)).max() <= 1
+
+
+def test_grayscale_near_exact():
+    rng = np.random.RandomState(1)
+    gray = rng.randint(0, 256, (40, 56), dtype=np.uint8)
+    ok, enc = cv2.imencode(".jpg", gray, [cv2.IMWRITE_JPEG_QUALITY, 95])
+    data = enc.tobytes()
+    ref = cv2.imdecode(np.frombuffer(data, np.uint8), cv2.IMREAD_GRAYSCALE)
+    ours = np.asarray(decode_jpeg(data))
+    assert ours.shape == ref.shape + (3,)
+    assert np.abs(ref.astype(int) - ours[:, :, 0].astype(int)).max() <= 1
+
+
+def test_stage1_block_structure():
+    rng = np.random.RandomState(2)
+    img = rng.randint(0, 256, (32, 48, 3), dtype=np.uint8)
+    data, _ = _roundtrip(img, 90)
+    planes = entropy_decode_jpeg(data)
+    assert planes.height == 32 and planes.width == 48
+    y = planes.components[0]
+    assert y.blocks.shape[2] == 64
+    assert y.blocks.shape[0] * 8 >= 32 and y.blocks.shape[1] * 8 >= 48
+    assert y.qtable.shape == (64,)
+
+
+def test_idct_blocks_matches_scipy_style_reference():
+    rng = np.random.RandomState(3)
+    coeffs = rng.randint(-64, 64, (10, 64)).astype(np.int32)
+    q = np.ones(64, np.int32)
+    out = np.asarray(idct_blocks(coeffs, q))
+    # dense float reference
+    a = np.zeros((8, 8))
+    for u in range(8):
+        alpha = np.sqrt(0.25) if u else np.sqrt(0.125)
+        for p in range(8):
+            a[u, p] = alpha * np.cos((2 * p + 1) * u * np.pi / 16.0)
+    basis = np.kron(a, a)
+    expected = coeffs.astype(np.float64) @ basis + 128.0
+    np.testing.assert_allclose(out, expected, atol=1e-3)
+
+
+def test_progressive_jpeg_rejected():
+    rng = np.random.RandomState(4)
+    img = rng.randint(0, 256, (32, 32, 3), dtype=np.uint8)
+    ok, enc = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 90,
+                                         cv2.IMWRITE_JPEG_PROGRESSIVE, 1])
+    with pytest.raises(ValueError, match="progressive|Unsupported"):
+        entropy_decode_jpeg(enc.tobytes())
+
+
+def test_not_a_jpeg_rejected():
+    with pytest.raises(ValueError, match="SOI"):
+        entropy_decode_jpeg(b"\x00\x01\x02")

@@ -1,0 +1,109 @@
+"""Device ops tests (interpret-mode Pallas on CPU): fused normalize, augment, crop, and the
+HBM shuffle buffer's statistics and multi-host determinism."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from petastorm_tpu.ops import (
+    DeviceShuffleBuffer,
+    normalize_and_augment,
+    normalize_images,
+    random_crop,
+)
+
+
+def test_normalize_images_matches_numpy():
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (4, 8, 16, 3), dtype=np.uint8)
+    mean = np.array([0.485, 0.456, 0.406], np.float32)
+    std = np.array([0.229, 0.224, 0.225], np.float32)
+    out = normalize_images(jnp.asarray(imgs), mean, std, out_dtype=jnp.float32)
+    expected = (imgs.astype(np.float32) / 255.0 - mean) / std
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+
+def test_normalize_images_bfloat16_and_odd_row():
+    rng = np.random.RandomState(1)
+    imgs = rng.randint(0, 256, (3, 5, 7, 3), dtype=np.uint8)  # row=105, not lane-aligned
+    out = normalize_images(jnp.asarray(imgs), [0.5] * 3, [0.5] * 3)
+    assert out.dtype == jnp.bfloat16
+    assert out.shape == (3, 5, 7, 3)
+    expected = (imgs.astype(np.float32) / 255 - 0.5) / 0.5
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32), expected, atol=2e-2)
+
+
+def test_normalize_and_augment_flip():
+    rng = np.random.RandomState(2)
+    imgs = rng.randint(0, 256, (8, 4, 6, 3), dtype=np.uint8)
+    out = normalize_and_augment(jnp.asarray(imgs), [0.0] * 3, [1.0] * 3,
+                                jax.random.PRNGKey(0), out_dtype=jnp.float32)
+    base = imgs.astype(np.float32) / 255.0
+    flipped = base[:, :, ::-1, :]
+    out_np = np.asarray(out)
+    for i in range(8):
+        ok = np.allclose(out_np[i], base[i], atol=1e-5) or \
+            np.allclose(out_np[i], flipped[i], atol=1e-5)
+        assert ok, "image %d is neither original nor flipped" % i
+
+
+def test_random_crop_shapes_and_content():
+    rng = np.random.RandomState(3)
+    imgs = rng.randint(0, 256, (5, 10, 12, 3), dtype=np.uint8)
+    out = random_crop(jnp.asarray(imgs), jax.random.PRNGKey(1), 6, 8)
+    assert out.shape == (5, 6, 8, 3)
+    # each crop must appear somewhere in its source image
+    out_np = np.asarray(out)
+    for i in range(5):
+        found = any(
+            np.array_equal(imgs[i, t:t + 6, l:l + 8], out_np[i])
+            for t in range(5) for l in range(5)
+        )
+        assert found
+
+
+def test_device_shuffle_buffer_roundtrip():
+    batch = {"x": jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+             "y": jnp.arange(8, dtype=jnp.int32)}
+    buf = DeviceShuffleBuffer(16, batch, jax.random.PRNGKey(0))
+    buf.insert(batch)
+    out = buf.sample(4)
+    assert out["x"].shape == (4, 4)
+    # sampled rows must be rows of the inserted batch
+    xs = np.asarray(batch["x"])
+    for row in np.asarray(out["x"]):
+        assert any(np.array_equal(row, r) for r in xs)
+
+
+def test_device_shuffle_buffer_wraps_and_mixes():
+    buf = None
+    seen = set()
+    for i in range(6):
+        batch = {"y": jnp.full((8,), i, jnp.int32)}
+        if buf is None:
+            buf = DeviceShuffleBuffer(16, batch, jax.random.PRNGKey(1))
+        buf.insert(batch)
+    # capacity 16 holds only the last two batches
+    for _ in range(8):
+        seen.update(np.asarray(buf.sample(8)["y"]).tolist())
+    assert seen <= {4, 5}
+    assert len(seen) == 2
+
+
+def test_device_shuffle_multihost_determinism():
+    """Same key stream -> same sampling indices regardless of resident data."""
+    b1 = {"y": jnp.arange(8, dtype=jnp.int32)}
+    b2 = {"y": jnp.arange(100, 108, dtype=jnp.int32)}
+    buf1 = DeviceShuffleBuffer(8, b1, jax.random.PRNGKey(7)).insert(b1)
+    buf2 = DeviceShuffleBuffer(8, b2, jax.random.PRNGKey(7)).insert(b2)
+    s1 = np.asarray(buf1.sample(16)["y"])
+    s2 = np.asarray(buf2.sample(16)["y"])
+    np.testing.assert_array_equal(s1 + 100, s2)
+
+
+def test_empty_sample_raises():
+    batch = {"y": jnp.arange(4)}
+    buf = DeviceShuffleBuffer(8, batch, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError):
+        buf.sample(2)
