@@ -1,0 +1,46 @@
+"""hello_world, vanilla-Parquet dataset (reference examples/hello_world/external_dataset):
+any Parquet store read with make_batch_reader / the JAX DataLoader."""
+import argparse
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from petastorm_tpu import make_batch_reader
+from petastorm_tpu.loader import DataLoader
+
+
+def generate_dataset(path, rows=100):
+    rng = np.random.RandomState(0)
+    table = pa.table({
+        "id": np.arange(rows, dtype=np.int64),
+        "value1": rng.standard_normal(rows),
+        "value2": rng.randint(0, 10, rows).astype(np.int32),
+    })
+    pq.write_table(table, path + "/data.parquet", row_group_size=20)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--path", default=None)
+    args = parser.parse_args()
+    path = args.path or tempfile.mkdtemp(prefix="external_ds")
+    generate_dataset(path)
+    url = "file://" + path
+
+    # plain iteration
+    with make_batch_reader(url) as reader:
+        total = sum(len(b.id) for b in reader)
+        print("rows:", total)
+
+    # JAX loader: batches on device
+    reader = make_batch_reader(url, shuffle_row_groups=False)
+    with DataLoader(reader, batch_size=16) as loader:
+        for batch in loader:
+            print("batch:", {k: (v.shape, str(v.dtype)) for k, v in batch.items()})
+            break
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""MNIST end-to-end (reference examples/mnist): synthetic-or-real MNIST → Parquet →
+make_batch_reader → JAX DataLoader → jitted train step on MnistCNN.
+
+The acceptance slice from SURVEY.md §8: schema inference, row-group planning, async
+device_put prefetch, sharded jax.Array batch, epoch semantics — all in ~100 lines.
+"""
+import argparse
+import tempfile
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+def generate_mnist_parquet(path, rows=2048):
+    """Writes an MNIST-shaped Parquet dataset (random pixels unless real data is at hand)."""
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, (rows, 28 * 28), dtype=np.uint8)
+    labels = rng.randint(0, 10, rows).astype(np.int32)
+    table = pa.table({
+        "image": pa.FixedSizeListArray.from_arrays(pa.array(images.reshape(-1)), 28 * 28),
+        "digit": labels,
+    })
+    pq.write_table(table, path + "/mnist.parquet", row_group_size=256)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--path", default=None)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=128)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.models.mnist import MnistCNN
+    from petastorm_tpu.transform import TransformSpec
+
+    path = args.path or tempfile.mkdtemp(prefix="mnist_pq")
+    generate_mnist_parquet(path)
+    url = "file://" + path
+
+    model = MnistCNN()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    prep = TransformSpec(
+        func=lambda b: {"image": b["image"].reshape(-1, 28, 28, 1).astype(jnp.float32) / 255.0,
+                        "digit": b["digit"]},
+        device=True)
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        def loss_fn(p):
+            logits = model.apply(p, batch["image"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["digit"]).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    t0 = time.time()
+    steps = 0
+    reader = make_batch_reader(url, num_epochs=args.epochs, transform_spec=prep,
+                               shuffle_row_groups=True, seed=0)
+    with DataLoader(reader, args.batch_size, shuffling_queue_capacity=1024) as loader:
+        for batch in loader:
+            params, opt_state, loss = train_step(params, opt_state, batch)
+            steps += 1
+    print("trained %d steps in %.1fs, final loss %.4f" % (steps, time.time() - t0,
+                                                          float(loss)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""NGram windowed reading (reference NGram usage): consecutive timestamped rows assembled
+into {offset: row} windows for sequence models."""
+import argparse
+import tempfile
+
+import numpy as np
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.metadata import write_dataset
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.types import LongType
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+SeqSchema = Unischema("SeqSchema", [
+    UnischemaField("timestamp", np.int64, (), ScalarCodec(LongType()), False),
+    UnischemaField("sensor", np.float32, (8,), NdarrayCodec(), False),
+])
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--url", default=None)
+    args = parser.parse_args()
+    url = args.url or "file://" + tempfile.mkdtemp(prefix="ngram_ds")
+
+    rng = np.random.RandomState(0)
+    write_dataset(url, SeqSchema, (
+        {"timestamp": t, "sensor": rng.standard_normal(8).astype(np.float32)}
+        for t in range(100)
+    ))
+
+    ngram = NGram(fields={-1: ["timestamp", "sensor"],
+                          0: ["timestamp", "sensor"],
+                          1: ["timestamp"]},
+                  delta_threshold=2, timestamp_field="timestamp")
+    with make_reader(url, schema_fields=ngram, shuffle_row_groups=False) as reader:
+        for i, window in enumerate(reader):
+            if i < 3:
+                print({k: (v.timestamp, getattr(v, "sensor", None) is not None)
+                       for k, v in window.items()})
+        print("windows:", i + 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Spark DataFrame → JAX/torch loaders via SparkDatasetConverter (reference
+examples/spark_dataset_converter). Requires pyspark; the Spark-free equivalent workflow
+(pyarrow write + make_batch_reader) is shown as the fallback."""
+import tempfile
+
+
+def spark_path():
+    from pyspark.sql import SparkSession
+
+    from petastorm_tpu.spark import SparkDatasetConverter, make_spark_converter
+
+    spark = SparkSession.builder.master("local[2]").getOrCreate()
+    spark.conf.set(SparkDatasetConverter.PARENT_CACHE_DIR_URL_CONF,
+                   "file://" + tempfile.mkdtemp(prefix="converter_cache"))
+    df = spark.range(1000).selectExpr("id", "rand() as feature")
+    converter = make_spark_converter(df)
+    print("materialized %d rows" % len(converter))
+    with converter.make_torch_dataloader(batch_size=64) as loader:
+        for batch in loader:
+            print("torch batch:", {k: tuple(v.shape) for k, v in batch.items()})
+            break
+    loader = converter.make_jax_dataloader(batch_size=64)
+    with loader:
+        for batch in loader:
+            print("jax batch:", {k: v.shape for k, v in batch.items()})
+            break
+    converter.delete()
+
+
+def arrow_fallback():
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.loader import make_dataloader
+
+    path = tempfile.mkdtemp(prefix="converter_fallback")
+    rng = np.random.RandomState(0)
+    pq.write_table(pa.table({"id": np.arange(1000), "feature": rng.rand(1000)}),
+                   path + "/data.parquet")
+    loader = make_dataloader("file://" + path, batch_size=64)
+    with loader:
+        for batch in loader:
+            print("jax batch (no spark):", {k: v.shape for k, v in batch.items()})
+            break
+
+
+if __name__ == "__main__":
+    try:
+        import pyspark  # noqa: F401
+
+        spark_path()
+    except ImportError:
+        print("pyspark not installed; running the pyarrow-native equivalent")
+        arrow_fallback()

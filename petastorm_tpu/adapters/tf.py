@@ -26,8 +26,7 @@ def _tf():
 
 
 def _field_tf_dtype(tf, field):
-    np_dtype = np.dtype(field.numpy_dtype) if not isinstance(field.numpy_dtype, type) \
-        else np.dtype(field.numpy_dtype)
+    np_dtype = np.dtype(field.numpy_dtype)
     kind = np_dtype.kind
     if kind in "US" or field.numpy_dtype in (str, bytes):
         return tf.string
@@ -53,7 +52,8 @@ def _schema_to_tf_shapes(schema):
 
 
 def _tf_compatible(value):
-    """Convert a decoded python/numpy value to something TF accepts."""
+    """Convert a decoded python/numpy value to something TF accepts (scalars AND object
+    ndarrays of Decimals/dates, which is how batch readers deliver decimal columns)."""
     if isinstance(value, decimal.Decimal):
         return str(value)
     if isinstance(value, datetime.datetime):
@@ -64,6 +64,12 @@ def _tf_compatible(value):
         return value.astype("datetime64[ns]").astype(np.int64)
     if value is None:
         return b""
+    if isinstance(value, np.ndarray):
+        if value.dtype == object and value.size:
+            return np.asarray([_tf_compatible(v) for v in value.reshape(-1)]) \
+                .reshape(value.shape)
+        if value.dtype.kind == "M":
+            return value.astype("datetime64[ns]").astype(np.int64)
     return value
 
 

@@ -1,1 +1,21 @@
-"""models subpackage."""
+"""Model zoo: acceptance-config models the data plane is measured against (ResNet family for
+ImageNet-Parquet, MnistCNN for hello-world, and the SPMD MoE transformer exercising
+dp/pp/ep/sp/tp). Lazy imports keep base import light (flax/jax only load on use)."""
+
+
+def __getattr__(name):
+    if name in ("ResNet", "ResNet18", "ResNet50", "ResNet101", "ResNet152",
+                "BottleneckBlock"):
+        from petastorm_tpu.models import resnet
+
+        return getattr(resnet, name)
+    if name == "MnistCNN":
+        from petastorm_tpu.models.mnist import MnistCNN
+
+        return MnistCNN
+    if name in ("TransformerConfig", "init_params", "make_train_step", "param_shardings",
+                "model_mesh", "data_sharding", "reference_loss"):
+        from petastorm_tpu.models import transformer
+
+        return getattr(transformer, name)
+    raise AttributeError("module 'petastorm_tpu.models' has no attribute %r" % name)

@@ -66,7 +66,7 @@ def normalize_images(images, mean, std, out_dtype=jnp.bfloat16):
     return out[:, :row].reshape(n, h, w, c)
 
 
-@functools.partial(jax.jit, static_argnames=("out_dtype",))
+@functools.partial(jax.jit, static_argnames=("flip", "out_dtype"))
 def normalize_and_augment(images, mean, std, key, flip=True, out_dtype=jnp.bfloat16):
     """Fused train-time prep: normalize + per-image random horizontal flip."""
     out = normalize_images(images, mean, std, out_dtype=out_dtype)

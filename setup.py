@@ -1,0 +1,37 @@
+"""Packaging for petastorm_tpu (reference setup.py parity: extras + console scripts).
+
+Console scripts mirror the reference's CLIs:
+  petastorm-tpu-generate-metadata  (reference: petastorm-generate-metadata)
+  petastorm-tpu-copy-dataset       (reference: petastorm-copy-dataset)
+  petastorm-tpu-throughput         (reference: petastorm-throughput)
+"""
+from setuptools import find_packages, setup
+
+setup(
+    name="petastorm-tpu",
+    version="0.1.0",
+    description="TPU-native Parquet data-loading framework (Petastorm-class capabilities)",
+    packages=find_packages(include=["petastorm_tpu", "petastorm_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=[
+        "numpy",
+        "pyarrow>=10",
+        "fsspec",
+    ],
+    extras_require={
+        "jax": ["jax", "flax", "optax"],
+        "tf": ["tensorflow"],
+        "torch": ["torch"],
+        "opencv": ["opencv-python-headless"],
+        "spark": ["pyspark>=3.0"],
+        "gcs": ["gcsfs"],
+        "test": ["pytest", "pytest-timeout"],
+    },
+    entry_points={
+        "console_scripts": [
+            "petastorm-tpu-generate-metadata=petastorm_tpu.tools.generate_metadata:main",
+            "petastorm-tpu-copy-dataset=petastorm_tpu.tools.copy_dataset:main",
+            "petastorm-tpu-throughput=petastorm_tpu.benchmark.cli:main",
+        ],
+    },
+)
