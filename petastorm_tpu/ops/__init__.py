@@ -11,7 +11,8 @@ def __getattr__(name):
         from petastorm_tpu.ops.device_shuffle import DeviceShuffleBuffer
 
         return DeviceShuffleBuffer
-    if name in ("idct_blocks", "decode_jpeg_device_stage", "ycbcr_to_rgb"):
+    if name in ("idct_blocks", "decode_jpeg_device_stage", "ycbcr_to_rgb",
+                "entropy_decode_jpeg_fast", "decode_jpeg_batch", "decode_jpeg"):
         from petastorm_tpu.ops import jpeg
 
         return getattr(jpeg, name)

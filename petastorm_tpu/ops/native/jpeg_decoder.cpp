@@ -1,0 +1,495 @@
+// Native baseline-JPEG entropy decoder (stage 1 of the two-stage TPU decode).
+//
+// Huffman entropy decoding is sequential and branchy -- the one part of JPEG decode that
+// cannot ride the TPU vector units -- so it runs on host as tight C++ instead of the
+// pure-Python bit loop (petastorm_tpu/ops/jpeg.py entropy_decode_jpeg, the correctness
+// oracle). Output contract is identical: per-component quantized DCT coefficient blocks
+// in natural (unzigzagged) order plus natural-order quantization tables; stage 2
+// (dequant + IDCT + upsample + color) runs on device as Pallas/XLA.
+//
+// Replaces the reference's cv2.imdecode host hot spot (petastorm/codecs.py ~L200) for the
+// make_reader/make_batch_reader decode path; built by petastorm_tpu/ops/native/__init__.py
+// with g++ at first use and called through ctypes (GIL released -> thread-pool parallel).
+//
+// Supports: 8-bit baseline sequential DCT (SOF0/SOF1), interleaved single scan, 1..4
+// components, restart intervals, 0xFF00 byte stuffing. Rejects progressive/lossless.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+// zigzag scan position k -> natural (row-major u,v) index
+const int kZigzagToNatural[64] = {
+    0,  1,  8,  16, 9,  2,  3,  10, 17, 24, 32, 25, 18, 11, 4,  5,
+    12, 19, 26, 33, 40, 48, 41, 34, 27, 20, 13, 6,  7,  14, 21, 28,
+    35, 42, 49, 56, 57, 50, 43, 36, 29, 22, 15, 23, 30, 37, 44, 51,
+    58, 59, 52, 45, 38, 31, 39, 46, 53, 60, 61, 54, 47, 55, 62, 63};
+
+struct BitReader {
+  const uint8_t* data;
+  int64_t len;
+  int64_t pos;
+  uint64_t buf;
+  int cnt;
+  bool at_marker;  // hit 0xFF <marker>: stop consuming, pad zero bits (spec allows)
+
+  void init(const uint8_t* d, int64_t l, int64_t p) {
+    data = d;
+    len = l;
+    pos = p;
+    buf = 0;
+    cnt = 0;
+    at_marker = false;
+  }
+
+  void fill() {
+    // Fast path: append 6-8 bytes at once when none is 0xFF (the overwhelmingly common
+    // case mid-scan; byte stuffing and markers take the per-byte path below).
+    if (!at_marker && pos + 8 <= len) {
+      uint64_t chunk;
+      memcpy(&chunk, data + pos, 8);
+      uint64_t t = ~chunk;  // a 0xFF byte becomes 0x00 in t
+      if (!((t - 0x0101010101010101ULL) & ~t & 0x8080808080808080ULL)) {
+        uint64_t be = __builtin_bswap64(chunk);
+        if (cnt == 0) {  // whole-word load (shift-by-64 below would be UB)
+          buf = be;
+          cnt = 64;
+          pos += 8;
+          return;
+        }
+        int take = (64 - cnt) >> 3;
+        buf = (buf << (8 * take)) | (be >> (64 - 8 * take));
+        cnt += 8 * take;
+        pos += take;
+        return;
+      }
+    }
+    while (cnt <= 56) {
+      uint8_t b = 0;
+      if (!at_marker && pos < len) {
+        b = data[pos];
+        if (b == 0xFF) {
+          uint8_t nxt = (pos + 1 < len) ? data[pos + 1] : 0xD9;
+          if (nxt == 0x00) {
+            pos += 2;  // byte-stuffed literal 0xFF
+          } else {
+            b = 0;  // real marker (RSTn/EOI): freeze pos, feed zeros
+            at_marker = true;
+          }
+        } else {
+          pos += 1;
+        }
+      }
+      buf = (buf << 8) | b;
+      cnt += 8;
+    }
+  }
+
+  // One refill guard covers a full (code ≤16 bits, value ≤11 bits) coefficient read.
+  inline void ensure28() {
+    if (cnt < 28) fill();
+  }
+
+  inline uint32_t peek16_raw() { return (uint32_t)((buf >> (cnt - 16)) & 0xFFFF); }
+
+  // Consume n (≥1) already-buffered bits.
+  inline int take(int n) {
+    cnt -= n;
+    return (int)((buf >> cnt) & ((1u << n) - 1));
+  }
+
+  // Skip to just past the next RSTn marker; reset bit state.
+  void align_restart() {
+    buf = 0;
+    cnt = 0;
+    at_marker = false;
+    while (pos + 1 < len) {
+      if (data[pos] == 0xFF && data[pos + 1] >= 0xD0 && data[pos + 1] <= 0xD7) {
+        pos += 2;
+        return;
+      }
+      pos++;
+    }
+  }
+};
+
+// Two-level Huffman decode: a 10-bit first-level LUT (2KiB, L1-resident — a flat
+// 16-bit table is 128KiB/table and both costs a per-image build and misses L1 on the
+// random peek pattern) plus the canonical mincode/maxcode/valptr fallback for the rare
+// codes longer than 10 bits. LUT entry = (code_length << 8) | symbol; 0 = fallback.
+struct HuffTable {
+  static const int kLutBits = 10;
+  uint16_t lut[1 << kLutBits];
+  int32_t maxcode[17];  // per length: largest code value, or -1
+  int32_t mincode[17];
+  int32_t valptr[17];
+  uint8_t symbols[256];
+  bool present;
+
+  void build(const uint8_t* counts, const uint8_t* syms) {
+    memset(lut, 0, sizeof(lut));
+    int total = 0;
+    for (int i = 0; i < 16; i++) total += counts[i];
+    memcpy(symbols, syms, total);
+    uint32_t code = 0;
+    int k = 0;
+    for (int length = 1; length <= 16; length++) {
+      if (counts[length - 1]) {
+        valptr[length] = k;
+        mincode[length] = (int32_t)code;
+        for (int i = 0; i < counts[length - 1]; i++) {
+          if (length <= kLutBits) {
+            uint32_t first = code << (kLutBits - length);
+            uint32_t n = 1u << (kLutBits - length);
+            uint16_t v = (uint16_t)((length << 8) | syms[k]);
+            for (uint32_t j = 0; j < n; j++) lut[first + j] = v;
+          }
+          code++;
+          k++;
+        }
+        maxcode[length] = (int32_t)code - 1;
+      } else {
+        maxcode[length] = -1;
+      }
+      code <<= 1;
+    }
+    present = true;
+  }
+
+  // 16-bit peek → (length << 8) | symbol, or 0 on invalid code.
+  inline uint32_t decode(uint32_t p16) const {
+    uint32_t e = lut[p16 >> (16 - kLutBits)];
+    if (e) return e;
+    for (int l = kLutBits + 1; l <= 16; l++) {
+      int32_t c = (int32_t)(p16 >> (16 - l));
+      if (maxcode[l] >= 0 && c <= maxcode[l])
+        return ((uint32_t)l << 8) | symbols[valptr[l] + (c - mincode[l])];
+    }
+    return 0;
+  }
+};
+
+// JPEG EXTEND: map t-bit magnitude to signed value.
+inline int extend(int v, int t) {
+  return (v >= (1 << (t - 1))) ? v : v - (1 << t) + 1;
+}
+
+inline uint16_t be16(const uint8_t* p) { return (uint16_t)((p[0] << 8) | p[1]); }
+
+}  // namespace
+
+extern "C" {
+
+// Error codes (ptpu_jpeg_error_string maps them to messages)
+enum {
+  PTPU_JPEG_OK = 0,
+  PTPU_JPEG_NOT_JPEG = -1,
+  PTPU_JPEG_UNSUPPORTED_MODE = -2,
+  PTPU_JPEG_CORRUPT = -3,
+  PTPU_JPEG_NOT_8BIT = -4,
+  PTPU_JPEG_BAD_COMPONENTS = -5,
+  PTPU_JPEG_NO_SCAN = -6,
+  PTPU_JPEG_OOM = -7,
+};
+
+typedef struct {
+  int32_t height;
+  int32_t width;
+  int32_t ncomp;
+  int32_t h_samp[4];
+  int32_t v_samp[4];
+  int32_t blocks_y[4];
+  int32_t blocks_x[4];
+  int16_t* blocks[4];      // malloc'ed: blocks_y*blocks_x*64 int16, natural order
+  uint16_t qtables[4][64]; // natural order
+} PtpuJpegCoeffs;
+
+void ptpu_jpeg_free_coeffs(PtpuJpegCoeffs* out) {
+  if (!out) return;
+  for (int i = 0; i < 4; i++) {
+    free(out->blocks[i]);
+    out->blocks[i] = nullptr;
+  }
+}
+
+const char* ptpu_jpeg_error_string(int code) {
+  switch (code) {
+    case PTPU_JPEG_OK: return "ok";
+    case PTPU_JPEG_NOT_JPEG: return "Not a JPEG (missing SOI)";
+    case PTPU_JPEG_UNSUPPORTED_MODE:
+      return "Unsupported JPEG mode (progressive/lossless/non-interleaved)";
+    case PTPU_JPEG_CORRUPT: return "Corrupt JPEG stream";
+    case PTPU_JPEG_NOT_8BIT: return "Only 8-bit baseline JPEG supported";
+    case PTPU_JPEG_BAD_COMPONENTS: return "Unsupported component count/sampling";
+    case PTPU_JPEG_NO_SCAN: return "No SOS marker found";
+    case PTPU_JPEG_OOM: return "Out of memory";
+    default: return "Unknown error";
+  }
+}
+
+int ptpu_jpeg_decode_coeffs(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out) {
+  memset(out, 0, sizeof(*out));
+  if (len < 4 || data[0] != 0xFF || data[1] != 0xD8) return PTPU_JPEG_NOT_JPEG;
+
+  int32_t qt_zz[4][64];  // DQT tables in zigzag order as parsed
+  bool qt_present[4] = {false, false, false, false};
+  static thread_local HuffTable huff_dc[4], huff_ac[4];  // ~10KiB; off-stack, re-entrant
+  for (int i = 0; i < 4; i++) {
+    huff_dc[i].present = false;
+    huff_ac[i].present = false;
+  }
+
+  struct Comp {
+    int id, h, v, tq;
+    int dc_tbl, ac_tbl;
+  } comps[4];
+  int ncomp = 0;
+  int height = 0, width = 0;
+  bool have_frame = false;
+  int restart_interval = 0;
+
+  int64_t pos = 2;
+  int rc = PTPU_JPEG_NO_SCAN;
+
+  while (pos < len) {
+    if (data[pos] != 0xFF) {
+      pos++;
+      continue;
+    }
+    if (pos + 1 >= len) break;
+    uint8_t marker = data[pos + 1];
+    pos += 2;
+    if (marker == 0xD8 || marker == 0x01 || (marker >= 0xD0 && marker <= 0xD7)) continue;
+    if (marker == 0xD9) break;  // EOI
+    if (pos + 2 > len) {
+      rc = PTPU_JPEG_CORRUPT;
+      break;
+    }
+    int seglen = be16(data + pos);
+    if (seglen < 2 || pos + seglen > len) {
+      rc = PTPU_JPEG_CORRUPT;
+      break;
+    }
+    const uint8_t* seg = data + pos + 2;
+    int segbytes = seglen - 2;
+
+    if (marker == 0xDB) {  // DQT
+      int s = 0;
+      while (s < segbytes) {
+        int pq = seg[s] >> 4, tq = seg[s] & 0xF;
+        s += 1;
+        if (tq > 3) {
+          rc = PTPU_JPEG_CORRUPT;
+          goto done;
+        }
+        if (pq) {
+          if (s + 128 > segbytes) {
+            rc = PTPU_JPEG_CORRUPT;
+            goto done;
+          }
+          for (int i = 0; i < 64; i++) qt_zz[tq][i] = be16(seg + s + 2 * i);
+          s += 128;
+        } else {
+          if (s + 64 > segbytes) {
+            rc = PTPU_JPEG_CORRUPT;
+            goto done;
+          }
+          for (int i = 0; i < 64; i++) qt_zz[tq][i] = seg[s + i];
+          s += 64;
+        }
+        qt_present[tq] = true;
+      }
+    } else if (marker == 0xC0 || marker == 0xC1) {  // SOF0/SOF1 baseline
+      if (segbytes < 6) {
+        rc = PTPU_JPEG_CORRUPT;
+        goto done;
+      }
+      int precision = seg[0];
+      if (precision != 8) {
+        rc = PTPU_JPEG_NOT_8BIT;
+        goto done;
+      }
+      height = be16(seg + 1);
+      width = be16(seg + 3);
+      ncomp = seg[5];
+      if (ncomp < 1 || ncomp > 4 || segbytes < 6 + 3 * ncomp) {
+        rc = PTPU_JPEG_BAD_COMPONENTS;
+        goto done;
+      }
+      for (int i = 0; i < ncomp; i++) {
+        comps[i].id = seg[6 + 3 * i];
+        comps[i].h = seg[7 + 3 * i] >> 4;
+        comps[i].v = seg[7 + 3 * i] & 0xF;
+        comps[i].tq = seg[8 + 3 * i];
+        if (comps[i].h < 1 || comps[i].h > 4 || comps[i].v < 1 || comps[i].v > 4 ||
+            comps[i].tq > 3) {
+          rc = PTPU_JPEG_BAD_COMPONENTS;
+          goto done;
+        }
+      }
+      have_frame = true;
+    } else if (marker == 0xC4) {  // DHT
+      int s = 0;
+      while (s + 17 <= segbytes) {
+        int tc = seg[s] >> 4, th = seg[s] & 0xF;
+        if (th > 3 || tc > 1) {
+          rc = PTPU_JPEG_CORRUPT;
+          goto done;
+        }
+        const uint8_t* counts = seg + s + 1;
+        int total = 0;
+        for (int i = 0; i < 16; i++) total += counts[i];
+        if (s + 17 + total > segbytes) {
+          rc = PTPU_JPEG_CORRUPT;
+          goto done;
+        }
+        if (tc == 0)
+          huff_dc[th].build(counts, seg + s + 17);
+        else
+          huff_ac[th].build(counts, seg + s + 17);
+        s += 17 + total;
+      }
+    } else if (marker == 0xDD) {  // DRI
+      if (segbytes < 2) {
+        rc = PTPU_JPEG_CORRUPT;
+        goto done;
+      }
+      restart_interval = be16(seg);
+    } else if (marker == 0xC2 || marker == 0xC3 || marker == 0xC5 || marker == 0xC6 ||
+               marker == 0xC7 || marker == 0xC9 || marker == 0xCA || marker == 0xCB ||
+               marker == 0xCD || marker == 0xCE || marker == 0xCF) {
+      rc = PTPU_JPEG_UNSUPPORTED_MODE;
+      goto done;
+    } else if (marker == 0xDA) {  // SOS
+      if (!have_frame) {
+        rc = PTPU_JPEG_CORRUPT;
+        goto done;
+      }
+      int ns = seg[0];
+      if (ns != ncomp || segbytes < 1 + 2 * ns) {
+        // non-interleaved multi-scan baseline: rare; caller falls back to host decode
+        rc = PTPU_JPEG_UNSUPPORTED_MODE;
+        goto done;
+      }
+      for (int i = 0; i < ns; i++) {
+        int cs = seg[1 + 2 * i];
+        int found = -1;
+        for (int c = 0; c < ncomp; c++)
+          if (comps[c].id == cs) found = c;
+        if (found < 0) {
+          rc = PTPU_JPEG_CORRUPT;
+          goto done;
+        }
+        comps[found].dc_tbl = seg[2 + 2 * i] >> 4;
+        comps[found].ac_tbl = seg[2 + 2 * i] & 0xF;
+      }
+      for (int c = 0; c < ncomp; c++) {
+        if (!huff_dc[comps[c].dc_tbl].present || !huff_ac[comps[c].ac_tbl].present ||
+            !qt_present[comps[c].tq]) {
+          rc = PTPU_JPEG_CORRUPT;
+          goto done;
+        }
+      }
+
+      // ---- entropy-coded scan ----
+      int hmax = 1, vmax = 1;
+      for (int c = 0; c < ncomp; c++) {
+        if (comps[c].h > hmax) hmax = comps[c].h;
+        if (comps[c].v > vmax) vmax = comps[c].v;
+      }
+      int mcus_x = (width + 8 * hmax - 1) / (8 * hmax);
+      int mcus_y = (height + 8 * vmax - 1) / (8 * vmax);
+
+      out->height = height;
+      out->width = width;
+      out->ncomp = ncomp;
+      for (int c = 0; c < ncomp; c++) {
+        int bx = mcus_x * comps[c].h;
+        int by = mcus_y * comps[c].v;
+        out->h_samp[c] = comps[c].h;
+        out->v_samp[c] = comps[c].v;
+        out->blocks_y[c] = by;
+        out->blocks_x[c] = bx;
+        out->blocks[c] = (int16_t*)calloc((size_t)by * bx * 64, sizeof(int16_t));
+        if (!out->blocks[c]) {
+          rc = PTPU_JPEG_OOM;
+          goto done;
+        }
+        const int32_t* zz = qt_zz[comps[c].tq];
+        for (int k = 0; k < 64; k++)
+          out->qtables[c][kZigzagToNatural[k]] = (uint16_t)zz[k];
+      }
+
+      BitReader br;
+      br.init(data, len, pos + seglen);
+      int pred[4] = {0, 0, 0, 0};
+      int mcu_count = 0;
+      for (int my = 0; my < mcus_y; my++) {
+        for (int mx = 0; mx < mcus_x; mx++) {
+          if (restart_interval && mcu_count && mcu_count % restart_interval == 0) {
+            br.align_restart();
+            pred[0] = pred[1] = pred[2] = pred[3] = 0;
+          }
+          for (int c = 0; c < ncomp; c++) {
+            const HuffTable& dc_tab = huff_dc[comps[c].dc_tbl];
+            const HuffTable& ac_tab = huff_ac[comps[c].ac_tbl];
+            for (int v = 0; v < comps[c].v; v++) {
+              for (int hh = 0; hh < comps[c].h; hh++) {
+                int brow = my * comps[c].v + v;
+                int bcol = mx * comps[c].h + hh;
+                int16_t* blk =
+                    out->blocks[c] + ((size_t)brow * out->blocks_x[c] + bcol) * 64;
+                // DC (code ≤16 + magnitude ≤11 bits: one refill guard covers both)
+                br.ensure28();
+                uint32_t e = dc_tab.decode(br.peek16_raw());
+                if (!e) {
+                  rc = PTPU_JPEG_CORRUPT;
+                  goto done;
+                }
+                br.cnt -= e >> 8;
+                int t = e & 0xFF;
+                if (t) pred[c] += extend(br.take(t), t);
+                blk[0] = (int16_t)pred[c];
+                // AC
+                int k = 1;
+                while (k < 64) {
+                  br.ensure28();
+                  e = ac_tab.decode(br.peek16_raw());
+                  if (!e) {
+                    rc = PTPU_JPEG_CORRUPT;
+                    goto done;
+                  }
+                  br.cnt -= e >> 8;
+                  int r = (e & 0xFF) >> 4, s = e & 0xF;
+                  if (s == 0) {
+                    if (r == 15) {
+                      k += 16;
+                      continue;
+                    }
+                    break;  // EOB
+                  }
+                  k += r;
+                  if (k > 63) break;
+                  blk[kZigzagToNatural[k]] = (int16_t)extend(br.take(s), s);
+                  k++;
+                }
+              }
+            }
+          }
+          mcu_count++;
+        }
+      }
+      rc = PTPU_JPEG_OK;
+      goto done;
+    }
+    pos += seglen;
+  }
+
+done:
+  if (rc != PTPU_JPEG_OK) ptpu_jpeg_free_coeffs(out);
+  return rc;
+}
+
+}  // extern "C"
