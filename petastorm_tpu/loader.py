@@ -276,9 +276,44 @@ class DataLoader:
                 return
             yield item
 
+    def _decode_staged(self, batch):
+        """Finish two-stage codec decode on device: staging-payload columns (e.g. JPEG
+        DCT coefficient planes produced by ``decode_on_device=True`` readers) become
+        device arrays via one batched codec dispatch per column."""
+        fields = getattr(self.reader, "device_decode_fields", None)
+        if not fields:
+            return batch, {}
+        import jax
+
+        batch = dict(batch)
+        decoded = {}
+        for name in fields:
+            arr = batch.pop(name, None)
+            if arr is None:
+                continue
+            field = self.reader.schema.fields[name]
+            staged = list(arr)
+            if any(s is None for s in staged):
+                raise ValueError(
+                    "Field %r has null rows; nullable columns are not supported with "
+                    "decode_on_device (pad or filter nulls upstream)" % name
+                )
+            out = field.codec.device_decode_batch(field, staged)
+            if self.sharding is not None:
+                s = self.sharding.get(name) if isinstance(self.sharding, dict) \
+                    else _matching_sharding(self.sharding, out)
+                if s is not None:
+                    if jax.process_count() > 1:
+                        out = jax.make_array_from_process_local_data(s, np.asarray(out))
+                    else:
+                        out = jax.device_put(out, s)
+            decoded[name] = out
+        return batch, decoded
+
     def _to_device(self, batch):
         import jax
 
+        batch, staged = self._decode_staged(batch)
         device = {k: v for k, v in batch.items() if _is_device_dtype(v)}
         host = {k: v for k, v in batch.items() if k not in device}
         if host:
@@ -299,6 +334,7 @@ class DataLoader:
                     arrays[name] = jax.make_array_from_process_local_data(s, arr)
                 else:
                     arrays[name] = jax.device_put(arr, s)
+        arrays.update(staged)
         if self._device_transform is not None:
             if self._jitted_transform is None:
                 import jax as _jax
@@ -310,7 +346,15 @@ class DataLoader:
 
     def __iter__(self):
         if not self.to_device:
-            yield from self._host_batches()
+            # staged decode still has to finish (decode runs on device, delivery is
+            # host numpy) so CPU-only consumers see images, not coefficient payloads
+            if getattr(self.reader, "device_decode_fields", None):
+                for batch in self._host_batches():
+                    rest, staged = self._decode_staged(batch)
+                    rest.update({k: np.asarray(v) for k, v in staged.items()})
+                    yield rest
+            else:
+                yield from self._host_batches()
             return
         from collections import deque
 

@@ -366,18 +366,29 @@ def entropy_decode_jpeg_fast(data):
     falls back to the pure-Python oracle when the native build is unavailable.
 
     This is the data-plane entry point: ctypes releases the GIL so reader thread pools
-    run stage-1 decode truly in parallel."""
+    run stage-1 decode truly in parallel. Raises ValueError on streams the two-stage
+    path cannot handle (progressive, CMYK, corrupt) — the codec layer catches that and
+    falls back to full host decode per stream."""
     from petastorm_tpu.ops import native
 
     if native.native_available():
         height, width, comps = native.jpeg_decode_coeffs_native(data)
-        return JpegPlanes(
+        planes = JpegPlanes(
             height=height,
             width=width,
             components=[JpegComponent(blocks, qtable, h, v)
                         for blocks, qtable, h, v in comps],
         )
-    return entropy_decode_jpeg(data)
+    else:
+        planes = entropy_decode_jpeg(data)
+    if len(planes.components) not in (1, 3):
+        # stage 2 models grayscale and YCbCr only; 2-component or Adobe CMYK streams
+        # must not reach the jitted decoder (wrong colors / shape errors inside jit)
+        raise ValueError(
+            "Unsupported JPEG component count %d (expected 1 or 3)"
+            % len(planes.components)
+        )
+    return planes
 
 
 # -- batched stage 2 (one device dispatch per image batch) -----------------------------

@@ -369,7 +369,8 @@ int ptpu_jpeg_decode_coeffs(const uint8_t* data, int64_t len, PtpuJpegCoeffs* ou
       }
       int ns = seg[0];
       if (ns != ncomp || segbytes < 1 + 2 * ns) {
-        // non-interleaved multi-scan baseline: rare; caller falls back to host decode
+        // non-interleaved multi-scan baseline: rare; the codec's host_stage_decode
+        // catches the resulting ValueError and falls back to full cv2 host decode
         rc = PTPU_JPEG_UNSUPPORTED_MODE;
         goto done;
       }
@@ -450,6 +451,10 @@ int ptpu_jpeg_decode_coeffs(const uint8_t* data, int64_t len, PtpuJpegCoeffs* ou
                 }
                 br.cnt -= e >> 8;
                 int t = e & 0xFF;
+                if (t > 11) {  // 8-bit baseline DC category ≤ 11; larger → corrupt DHT
+                  rc = PTPU_JPEG_CORRUPT;
+                  goto done;
+                }
                 if (t) pred[c] += extend(br.take(t), t);
                 blk[0] = (int16_t)pred[c];
                 // AC
@@ -469,6 +474,10 @@ int ptpu_jpeg_decode_coeffs(const uint8_t* data, int64_t len, PtpuJpegCoeffs* ou
                       continue;
                     }
                     break;  // EOB
+                  }
+                  if (s > 10) {  // 8-bit baseline AC size ≤ 10; also keeps the 28-bit
+                    rc = PTPU_JPEG_CORRUPT;  // ensure28 window sufficient (16+10 < 28)
+                    goto done;
                   }
                   k += r;
                   if (k > 63) break;

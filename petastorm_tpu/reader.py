@@ -64,7 +64,8 @@ class _WorkerBase:
     MAX_OPEN_FILES = 64
 
     def __init__(self, filesystem, read_schema, stored_schema, predicate, transform_spec,
-                 cache, shuffle_row_drop_partitions, filters, seed):
+                 cache, shuffle_row_drop_partitions, filters, seed,
+                 device_fields=frozenset()):
         self._fs = filesystem
         self._read_schema = read_schema  # fields to deliver (pre-transform view)
         self._stored_schema = stored_schema  # full stored schema (decode source of truth)
@@ -74,6 +75,7 @@ class _WorkerBase:
         self._drop_partitions = shuffle_row_drop_partitions
         self._filters = filters
         self._seed = seed
+        self._device_fields = frozenset(device_fields)  # host-stage-only decode columns
         self._local = None  # threading.local built lazily (not picklable)
 
     def __getstate__(self):
@@ -163,7 +165,8 @@ class PyDictWorker(_WorkerBase):
     def __call__(self, item):
         piece, _partition = item
         cache_key = _cache_key(piece, self._read_schema, self._predicate, self._filters,
-                               item[1], self._drop_partitions, self._seed)
+                               item[1], self._drop_partitions, self._seed,
+                               self._device_fields)
         rows = self._cache.get(cache_key, lambda: self._load_rows(item))
         if self._transform_spec is not None and not self._transform_spec.device \
                 and self._transform_spec.func is not None:
@@ -210,7 +213,7 @@ class PyDictWorker(_WorkerBase):
         decode_view = self._stored_schema.create_schema_view(
             [c for c in table.column_names if c in self._stored_schema.fields]
         )
-        return [decode_row(r, decode_view) for r in stored_rows]
+        return [decode_row(r, decode_view, self._device_fields) for r in stored_rows]
 
     def _form_ngram_dicts(self, rows):
         schema = self._ngram_schema if self._ngram_schema is not None else self._read_schema
@@ -228,7 +231,8 @@ class ArrowWorker(_WorkerBase):
     def __call__(self, item):
         piece, _partition = item
         cache_key = _cache_key(piece, self._read_schema, self._predicate, self._filters,
-                               item[1], self._drop_partitions, self._seed)
+                               item[1], self._drop_partitions, self._seed,
+                               self._device_fields)
         columns = self._cache.get(cache_key, lambda: self._load_columns(item))
         if self._transform_spec is not None and not self._transform_spec.device \
                 and self._transform_spec.func is not None:
@@ -263,7 +267,8 @@ class ArrowWorker(_WorkerBase):
         out = {}
         for name in wanted:
             if name in table.column_names:
-                out[name] = _column_to_numpy(table, name, self._read_schema)
+                out[name] = _column_to_numpy(table, name, self._read_schema,
+                                             self._device_fields)
         return out
 
 
@@ -276,18 +281,26 @@ def _merge_tables(head, tail):
     return pa.table(cols)
 
 
-def _column_to_numpy(table, name, schema):
+def _column_to_numpy(table, name, schema, device_fields=()):
     """Arrow column → numpy array; decodes codec columns, stacks list columns.
 
     List columns take the vectorized path: flatten the Arrow child buffer straight to
     numpy and reshape — ``to_pylist`` would materialize every element as a Python object
-    (~100x slower on image-sized rows, the data-plane hot loop)."""
+    (~100x slower on image-sized rows, the data-plane hot loop). Codec columns named in
+    ``device_fields`` run only the host half of the two-stage decode and come back as an
+    object array of staging payloads the JAX loader finishes on device."""
     import pyarrow as pa
 
     col = table.column(name)
     field = schema.fields.get(name)
     if field is not None and field.codec is not None:
         values = col.to_pylist()
+        if name in device_fields:
+            staged = [field.codec.host_stage_decode(field, v) if v is not None else None
+                      for v in values]
+            out = np.empty(len(staged), dtype=object)
+            out[:] = staged
+            return out
         decoded = [field.codec.decode(field, v) if v is not None else None for v in values]
         return _stack(decoded, field)
     if field is not None and field.shape:
@@ -424,21 +437,25 @@ def _predicate_key(predicate):
     return "|".join(parts)
 
 
-def _cache_key(piece, schema, predicate, filters, partition, num_partitions, seed):
+def _cache_key(piece, schema, predicate, filters, partition, num_partitions, seed,
+               device_fields=frozenset()):
     predicate_key = ""
     if predicate is not None:
         predicate_key = _predicate_key(predicate)
-    return "|".join(
-        [
-            piece.path,
-            str(piece.row_group),
-            ",".join(schema.fields.keys()),
-            predicate_key,
-            repr(filters) if filters else "",
-            "%s/%s" % (partition, num_partitions),
-            str(seed) if num_partitions > 1 else "",
-        ]
-    )
+    parts = [
+        piece.path,
+        str(piece.row_group),
+        ",".join(schema.fields.keys()),
+        predicate_key,
+        repr(filters) if filters else "",
+        "%s/%s" % (partition, num_partitions),
+        str(seed) if num_partitions > 1 else "",
+    ]
+    if device_fields:
+        # device-staged payloads differ from host-decoded ones — never cross-serve.
+        # Appended only when active so pre-existing persistent cache keys stay valid.
+        parts.append("dev:%s" % ",".join(sorted(device_fields)))
+    return "|".join(parts)
 
 
 # --------------------------------------------------------------------------------------
@@ -649,6 +666,33 @@ class Reader:
 # --------------------------------------------------------------------------------------
 
 
+def _resolve_device_fields(schema, decode_on_device, ngram=None, transform_spec=None):
+    """Fields whose codec decode should stop at the host staging half (stage 1)."""
+    if not decode_on_device:
+        return frozenset()
+    if ngram is not None:
+        raise ValueError("decode_on_device is not supported with NGram readers")
+    if transform_spec is not None and not transform_spec.device \
+            and transform_spec.func is not None:
+        raise ValueError(
+            "decode_on_device is not compatible with a host transform_spec: the "
+            "transform would receive coefficient staging payloads, not decoded images. "
+            "Use a device transform (TransformSpec(..., device=True)) or the "
+            "DataLoader's device_transform instead."
+        )
+    fields = frozenset(
+        name for name, f in schema.fields.items()
+        if f.codec is not None and getattr(f.codec, "device_decodable", False)
+    )
+    if not fields:
+        logger.warning(
+            "decode_on_device=True but the read schema has no device-decodable codec "
+            "fields (only CompressedImageCodec('jpeg') columns qualify); reading "
+            "proceeds fully host-decoded"
+        )
+    return fields
+
+
 def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", workers_count=4,
                 results_queue_size=16, shuffle_row_groups=True, shuffle_row_drop_partitions=1,
                 predicate=None, rowgroup_selector=None, num_epochs=1,
@@ -656,10 +700,16 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
                 cache_type="null", cache_location=None, cache_size_limit=None,
                 cache_row_size_estimate=None, cache_extra_settings=None,
                 transform_spec=None, filters=None, storage_options=None, filesystem=None,
-                results_timeout_s=300.0):
+                results_timeout_s=300.0, decode_on_device=False):
     """Open a petastorm(-tpu) dataset for per-row decoded reading (reference ~L60).
 
     ``schema_fields`` may be a list of names/regexes/UnischemaFields or an :class:`NGram`.
+
+    ``decode_on_device=True`` routes device-decodable codec columns (JPEG) through the
+    two-stage decode: workers run only the native entropy decode, and rows carry DCT
+    coefficient staging payloads that :class:`petastorm_tpu.loader.DataLoader` finishes
+    on device in one batched Pallas dispatch per batch. Consume such readers through the
+    DataLoader (or call ``ops.decode_jpeg_batch`` yourself).
     """
     fs, path = get_filesystem_and_path_or_paths(dataset_url, storage_options, filesystem)
     stored_schema = get_schema(fs, path)
@@ -685,9 +735,12 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
 
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
+    device_fields = _resolve_device_fields(read_schema, decode_on_device, ngram,
+                                           transform_spec)
     worker = PyDictWorker(
         fs, read_schema, stored_schema, predicate, transform_spec, cache,
         shuffle_row_drop_partitions, filters, seed if seed is not None else shard_seed,
+        device_fields=device_fields,
         ngram=ngram, ngram_schema=final_schema if ngram is not None else None,
     )
     r = Reader(
@@ -700,6 +753,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         results_timeout_s=results_timeout_s,
     )
     r.transform_spec = transform_spec
+    r.device_decode_fields = device_fields
     return r
 
 
@@ -710,8 +764,12 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       cache_type="null", cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, cache_extra_settings=None,
                       transform_spec=None, filters=None, storage_options=None,
-                      filesystem=None, results_timeout_s=300.0):
-    """Open ANY Parquet store for vectorized columnar batches (reference ~L200)."""
+                      filesystem=None, results_timeout_s=300.0, decode_on_device=False):
+    """Open ANY Parquet store for vectorized columnar batches (reference ~L200).
+
+    ``decode_on_device``: see :func:`make_reader` — device-decodable codec columns come
+    back as staging payloads for the DataLoader's batched on-device decode.
+    """
     fs, path = get_filesystem_and_path_or_paths(
         dataset_url_or_urls, storage_options, filesystem
     )
@@ -732,9 +790,12 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
 
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
+    device_fields = _resolve_device_fields(read_schema, decode_on_device,
+                                           transform_spec=transform_spec)
     worker = ArrowWorker(
         fs, read_schema, stored_schema, predicate, transform_spec, cache,
         shuffle_row_drop_partitions, filters, seed if seed is not None else shard_seed,
+        device_fields=device_fields,
     )
     r = Reader(
         fs, path, final_schema, stored_schema, worker, pieces,
@@ -746,6 +807,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         results_timeout_s=results_timeout_s,
     )
     r.transform_spec = transform_spec
+    r.device_decode_fields = device_fields
     return r
 
 

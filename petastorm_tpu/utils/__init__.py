@@ -6,11 +6,15 @@ import numpy as np
 from petastorm_tpu.errors import DecodeFieldError
 
 
-def decode_row(row, schema):
+def decode_row(row, schema, device_fields=()):
     """Decode one stored row dict through codecs into a {field: numpy value} dict.
 
     Mirrors the reference decode driver (petastorm/utils.py ~L80): codec dispatch plus nullable
     handling; wraps codec failures with the field name for debuggability.
+
+    Fields named in ``device_fields`` run only the HOST half of their codec's two-stage
+    decode (``host_stage_decode``): the row carries a staging object (e.g. JPEG DCT
+    coefficient planes) that the JAX loader finishes on device in one batched dispatch.
     """
     decoded = {}
     for name, field in schema.fields.items():
@@ -23,7 +27,10 @@ def decode_row(row, schema):
             decoded[name] = None
         elif field.codec is not None:
             try:
-                decoded[name] = field.codec.decode(field, value)
+                if name in device_fields:
+                    decoded[name] = field.codec.host_stage_decode(field, value)
+                else:
+                    decoded[name] = field.codec.decode(field, value)
             except Exception as e:  # noqa: BLE001 - annotate and rethrow
                 raise DecodeFieldError("Unable to decode field %r: %s" % (name, e)) from e
         else:

@@ -79,6 +79,37 @@ def create_test_dataset(url, num_rows=30, rows_per_file=None, seed=0):
     return SyntheticDataset(url, rows, urlparse(url).path)
 
 
+JpegSchema = Unischema(
+    "JpegSchema",
+    [
+        UnischemaField("id", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+        UnischemaField("image_jpeg", np.uint8, (32, 48, 3),
+                       CompressedImageCodec("jpeg", quality=90), False),
+        UnischemaField("label", np.int32, (), ScalarCodec(ptypes.IntegerType()), False),
+    ],
+)
+
+
+def create_test_jpeg_dataset(url, num_rows=24, seed=0):
+    """JPEG-codec dataset for the two-stage on-device decode path (smooth images keep
+    the lossy round-trip deterministic enough to compare against the host decoder)."""
+    rng = np.random.RandomState(seed)
+    rows = []
+    for i in range(num_rows):
+        base = rng.randint(0, 256, (8, 12)).astype(np.float32)
+        img = np.kron(base, np.ones((4, 4), np.float32))  # blocky/smooth content
+        img = np.stack([img, np.flipud(img), np.fliplr(img)], -1)
+        rows.append({
+            "id": i,
+            "image_jpeg": img.clip(0, 255).astype(np.uint8),
+            "label": np.int32(i % 7),
+        })
+    write_dataset(url, JpegSchema, rows, rows_per_file=max(1, num_rows // 3))
+    from urllib.parse import urlparse
+
+    return SyntheticDataset(url, rows, urlparse(url).path)
+
+
 def create_test_scalar_dataset(url, num_rows=30, num_files=2, seed=0):
     """Vanilla parquet (no unischema metadata) for make_batch_reader tests."""
     from urllib.parse import urlparse

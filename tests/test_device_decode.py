@@ -1,0 +1,209 @@
+"""End-to-end on-device JPEG decode: reader ships coefficient staging payloads, the
+DataLoader finishes decode on device in one batched dispatch (SURVEY.md §8 hard part #1;
+reference host hot spot: petastorm/codecs.py ~L200 cv2.imdecode)."""
+import numpy as np
+import pytest
+
+pytest.importorskip("cv2")
+
+from petastorm_tpu.loader import DataLoader  # noqa: E402
+from petastorm_tpu.ngram import NGram  # noqa: E402
+from petastorm_tpu.ops.jpeg import JpegPlanes  # noqa: E402
+from petastorm_tpu.reader import make_batch_reader, make_reader  # noqa: E402
+from test_common import JpegSchema, create_test_jpeg_dataset  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def jpeg_dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp("jpeg_ds")
+    return create_test_jpeg_dataset("file://" + str(path / "ds"), num_rows=24)
+
+
+def _host_decoded(dataset):
+    """Expected images: the portable host path (cv2 decode of the stored bytes)."""
+    field = JpegSchema.fields["image_jpeg"]
+    out = {}
+    for row in dataset.data:
+        encoded = field.codec.encode(field, row["image_jpeg"])
+        out[row["id"]] = field.codec.decode(field, encoded)
+    return out
+
+
+def test_make_reader_ships_staging_payloads(jpeg_dataset):
+    with make_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        assert reader.device_decode_fields == frozenset({"image_jpeg"})
+        row = next(iter(reader))
+        assert isinstance(row.image_jpeg, JpegPlanes)
+        assert row.image_jpeg.height == 32 and row.image_jpeg.width == 48
+
+
+def test_loader_device_decode_per_row_path(jpeg_dataset):
+    expected = _host_decoded(jpeg_dataset)
+    reader = make_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                         shuffle_row_groups=False)
+    seen = 0
+    with DataLoader(reader, batch_size=6) as loader:
+        for batch in loader:
+            imgs = np.asarray(batch["image_jpeg"])
+            ids = np.asarray(batch["id"])
+            assert imgs.dtype == np.uint8 and imgs.shape == (6, 32, 48, 3)
+            for i, rid in enumerate(ids):
+                ref = expected[int(rid)]
+                diff = np.abs(imgs[i].astype(int) - ref.astype(int))
+                assert diff.mean() < 2.0 and np.percentile(diff, 99) <= 12
+                seen += 1
+    assert seen == 24
+
+
+def test_loader_device_decode_batch_path(jpeg_dataset):
+    expected = _host_decoded(jpeg_dataset)
+    reader = make_batch_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    assert reader.device_decode_fields == frozenset({"image_jpeg"})
+    seen = 0
+    with DataLoader(reader, batch_size=8) as loader:
+        for batch in loader:
+            imgs = np.asarray(batch["image_jpeg"])
+            ids = np.asarray(batch["id"])
+            assert imgs.shape == (8, 32, 48, 3)
+            for i, rid in enumerate(ids):
+                ref = expected[int(rid)]
+                diff = np.abs(imgs[i].astype(int) - ref.astype(int))
+                assert diff.mean() < 2.0
+                seen += 1
+    assert seen == 24
+
+
+def test_device_decode_sharded_batches(jpeg_dataset):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+    reader = make_batch_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    with DataLoader(reader, batch_size=8, sharding=sharding) as loader:
+        batch = next(iter(loader))
+        img = batch["image_jpeg"]
+        assert img.shape == (8, 32, 48, 3)
+        assert img.sharding.is_equivalent_to(
+            NamedSharding(mesh, PartitionSpec("dp", None, None, None)), 4)
+
+
+def test_device_decode_then_device_transform(jpeg_dataset):
+    import jax.numpy as jnp
+
+    def normalize(batch):
+        out = dict(batch)
+        out["image_jpeg"] = batch["image_jpeg"].astype(jnp.float32) / 255.0
+        return out
+
+    reader = make_batch_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    with DataLoader(reader, batch_size=8, device_transform=normalize) as loader:
+        batch = next(iter(loader))
+        img = np.asarray(batch["image_jpeg"])
+        assert img.dtype == np.float32
+        assert 0.0 <= img.min() and img.max() <= 1.0
+
+
+def test_decode_on_device_rejects_ngram(jpeg_dataset):
+    fields = {0: ["id", "image_jpeg"], 1: ["id"]}
+    ngram = NGram(fields=fields, delta_threshold=10, timestamp_field="id")
+    with pytest.raises(ValueError, match="NGram"):
+        make_reader(jpeg_dataset.url, schema_fields=ngram, decode_on_device=True)
+
+
+def test_decode_on_device_noop_without_jpeg_fields(jpeg_dataset):
+    with make_reader(jpeg_dataset.url, schema_fields=["id", "label"],
+                     decode_on_device=True, num_epochs=1) as reader:
+        assert reader.device_decode_fields == frozenset()
+        row = next(iter(reader))
+        assert isinstance(row.id, np.int64)
+
+
+def test_host_stage_falls_back_per_stream_on_progressive():
+    """Streams the two-stage path can't handle (progressive JPEG) fall back to cv2 in
+    host_stage_decode, and device_decode_batch merges them back at the right rows."""
+    import cv2
+
+    from petastorm_tpu.codecs import CompressedImageCodec
+
+    field = JpegSchema.fields["image_jpeg"]
+    codec = field.codec
+    rng = np.random.RandomState(9)
+    img = np.kron(rng.randint(0, 256, (8, 12)).astype(np.float32),
+                  np.ones((4, 4), np.float32))
+    img = np.stack([img, img, img], -1).astype(np.uint8)
+    baseline = bytes(codec.encode(field, img))
+    ok, prog = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 90,
+                                          cv2.IMWRITE_JPEG_PROGRESSIVE, 1])
+    assert ok
+    staged = [codec.host_stage_decode(field, baseline),
+              codec.host_stage_decode(field, prog.tobytes()),
+              codec.host_stage_decode(field, baseline)]
+    assert isinstance(staged[0], JpegPlanes)
+    assert isinstance(staged[1], np.ndarray)  # fell back to full host decode
+    out = np.asarray(codec.device_decode_batch(field, staged))
+    assert out.shape == (3, 32, 48, 3)
+    np.testing.assert_array_equal(out[0], out[2])
+    ref = codec.decode(field, baseline)
+    assert np.abs(out[1].astype(int) - ref.astype(int)).mean() < 3.0
+
+
+def test_to_device_false_still_delivers_decoded_images(jpeg_dataset):
+    expected = _host_decoded(jpeg_dataset)
+    reader = make_batch_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    with DataLoader(reader, batch_size=8, to_device=False) as loader:
+        batch = next(iter(loader))
+        assert isinstance(batch["image_jpeg"], np.ndarray)
+        assert batch["image_jpeg"].dtype == np.uint8
+        assert batch["image_jpeg"].shape == (8, 32, 48, 3)
+        ref = expected[int(batch["id"][0])]
+        assert np.abs(batch["image_jpeg"][0].astype(int) - ref.astype(int)).mean() < 2.0
+
+
+def test_decode_on_device_rejects_host_transform(jpeg_dataset):
+    from petastorm_tpu.transform import TransformSpec
+
+    spec = TransformSpec(func=lambda r: r)
+    with pytest.raises(ValueError, match="host transform_spec"):
+        make_reader(jpeg_dataset.url, decode_on_device=True, transform_spec=spec)
+
+
+def test_native_rejects_corrupt_category_codes():
+    """Corrupt DHT streams (DC category > 11) must raise, not hit UB."""
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable")
+    import cv2
+
+    rng = np.random.RandomState(10)
+    ok, enc = cv2.imencode(".jpg", rng.randint(0, 256, (16, 16, 3), dtype=np.uint8),
+                           [cv2.IMWRITE_JPEG_QUALITY, 90])
+    data = bytearray(enc.tobytes())
+    # find the DC DHT (FFC4, tc=0) and poison EVERY symbol to 200 (> max category 11),
+    # so whichever code the scan hits first carries an invalid magnitude category
+    i = data.find(b"\xff\xc4")
+    assert i > 0 and data[i + 4] >> 4 == 0
+    total = sum(data[i + 5:i + 21])
+    for j in range(total):
+        data[i + 21 + j] = 200
+    with pytest.raises(ValueError):
+        native.jpeg_decode_coeffs_native(bytes(data))
+
+
+def test_cache_key_distinguishes_device_payloads():
+    from petastorm_tpu.reader import _cache_key
+
+    class Piece:
+        path = "/p"
+        row_group = 0
+
+    host = _cache_key(Piece, JpegSchema, None, None, 0, 1, None)
+    dev = _cache_key(Piece, JpegSchema, None, None, 0, 1, None,
+                     frozenset({"image_jpeg"}))
+    assert host != dev
