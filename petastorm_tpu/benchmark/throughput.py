@@ -16,11 +16,14 @@ class BenchmarkResult:
     seconds: float
     batches: int = 0
     device_idle_fraction: float | None = None
+    stages: dict | None = None  # loader PipelineStats snapshot, when measured
 
     def __str__(self):
         s = "%.1f rows/s (%d rows in %.2fs)" % (self.rows_per_second, self.rows, self.seconds)
         if self.device_idle_fraction is not None:
             s += ", device idle %.1f%%" % (100 * self.device_idle_fraction)
+        if self.stages:
+            s += ", stages=%r" % (self.stages,)
         return s
 
 
@@ -81,5 +84,7 @@ def loader_throughput(loader, consume_fn=None, warmup_batches=4, measure_batches
     idle = None
     if consume_fn is not None and dt > 0:
         idle = max(0.0, 1.0 - busy / dt)
+    stats = getattr(loader, "stats", None)
     return BenchmarkResult(rows_per_second=n / dt if dt else float("inf"), rows=n,
-                           seconds=dt, batches=batches, device_idle_fraction=idle)
+                           seconds=dt, batches=batches, device_idle_fraction=idle,
+                           stages=stats.snapshot() if stats is not None else None)

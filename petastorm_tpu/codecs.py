@@ -255,7 +255,8 @@ class CompressedImageCodec(DataframeColumnCodec):
     def decode(self, unischema_field, encoded):
         import cv2
 
-        img = cv2.imdecode(np.frombuffer(bytes(encoded), dtype=np.uint8), cv2.IMREAD_UNCHANGED)
+        # np.frombuffer reads bytes/bytearray/memoryview alike — no intermediate copy
+        img = cv2.imdecode(np.frombuffer(encoded, dtype=np.uint8), cv2.IMREAD_UNCHANGED)
         if img is None:
             raise ValueError("cv2.imdecode failed for field %r" % unischema_field.name)
         return img.astype(np.dtype(unischema_field.numpy_dtype), copy=False)

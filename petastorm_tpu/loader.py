@@ -26,14 +26,56 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 
 import numpy as np
 
 from petastorm_tpu.shuffle import BatchedRandomShufflingBuffer
+from petastorm_tpu.utils import stack_as_column
 
 logger = logging.getLogger(__name__)
 
 _SENTINEL = object()
+
+
+class PipelineStats:
+    """Cheap per-stage counters for the loader pipeline (SURVEY.md §6: the reference
+    exports nothing; the north-star metric is device idle, which needs a stage split).
+
+    All times are cumulative seconds since the last ``reset()``:
+
+    - ``read_s``: producer time blocked on the reader (parquet IO + worker decode)
+    - ``batch_s``: producer time re-batching/shuffling host rows
+    - ``decode_s``: consumer time in batched on-device codec decode dispatch
+    - ``h2d_s``: consumer time in ``device_put`` / global-array assembly
+    - ``queue_wait_s``: consumer time starved waiting on the host-batch queue
+    """
+
+    __slots__ = ("rows", "batches", "read_s", "batch_s", "decode_s", "h2d_s",
+                 "queue_wait_s")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.rows = 0
+        self.batches = 0
+        self.read_s = 0.0
+        self.batch_s = 0.0
+        self.decode_s = 0.0
+        self.h2d_s = 0.0
+        self.queue_wait_s = 0.0
+
+    def snapshot(self):
+        return {
+            "rows": self.rows,
+            "batches": self.batches,
+            "read_s": round(self.read_s, 4),
+            "batch_s": round(self.batch_s, 4),
+            "decode_s": round(self.decode_s, 4),
+            "h2d_s": round(self.h2d_s, 4),
+            "queue_wait_s": round(self.queue_wait_s, 4),
+        }
 
 
 def _is_device_dtype(arr):
@@ -57,36 +99,49 @@ class _HostBatcher:
         else:
             self._buffer = None
             self._shuffling = False
-            self._pending = {}
+            self._pending = {}  # {name: deque of (array, offset)} — remainder stays put
             self._pending_rows = 0
 
-    # -- non-shuffling path: cheap concatenate-and-slice ------------------------------
+    # -- non-shuffling path: chunk deque, O(batch) per cut ------------------------------
+    #
+    # Batches are assembled from whole/partial chunk VIEWS; the remainder is tracked as
+    # an offset into the head chunk instead of re-sliced into a fresh array every cut
+    # (the previous whole[batch_size:] copy was O(rowgroup^2/batch) bytes per row group).
 
     def _plain_add(self, columns):
         n = None
         for name, arr in columns.items():
-            self._pending.setdefault(name, []).append(arr)
+            self._pending.setdefault(name, []).append([arr, 0])
             n = len(arr)
         if n is not None:
             self._pending_rows += n
 
+    def _cut_one(self, take):
+        merged = {}
+        for name, chunks in self._pending.items():
+            parts = []
+            need = take
+            while need > 0:
+                arr, off = chunks[0]
+                avail = len(arr) - off
+                if avail > need:
+                    parts.append(arr[off:off + need])
+                    chunks[0][1] = off + need
+                    need = 0
+                else:
+                    parts.append(arr[off:] if off else arr)
+                    chunks.pop(0)
+                    need -= avail
+            merged[name] = parts[0] if len(parts) == 1 else _concat(parts)
+        self._pending_rows -= take
+        return merged
+
     def _plain_cut(self, final=False):
         out = []
         while self._pending_rows >= self.batch_size:
-            merged = {}
-            rest = {}
-            for name, chunks in self._pending.items():
-                whole = chunks[0] if len(chunks) == 1 else _concat(chunks)
-                merged[name] = whole[: self.batch_size]
-                rest[name] = [whole[self.batch_size:]]
-            self._pending = rest
-            self._pending_rows -= self.batch_size
-            out.append(merged)
+            out.append(self._cut_one(self.batch_size))
         if final and self._pending_rows > 0:
-            merged = {name: _concat(chunks) for name, chunks in self._pending.items()}
-            self._pending = {}
-            self._pending_rows = 0
-            out.append(merged)
+            out.append(self._cut_one(self._pending_rows))
         return out
 
     # -- public -----------------------------------------------------------------------
@@ -129,24 +184,22 @@ def _concat(chunks):
     return np.concatenate(chunks, axis=0)
 
 
-def _rows_to_columns(rows):
-    """Row dicts/namedtuples → columnar numpy dict (per-row ``make_reader`` path)."""
+def _rows_to_columns(rows, object_fields=()):
+    """Row dicts/namedtuples → columnar numpy dict (per-row ``make_reader`` path).
+
+    ``object_fields`` are forced to object dtype: device-decode staging columns may mix
+    JpegPlanes payloads with host-fallback ndarrays across rows, and letting np.asarray
+    pick a per-batch layout would break downstream concatenation."""
     if not rows:
         return {}
     first = rows[0]
     if hasattr(first, "_asdict"):
         rows = [r._asdict() for r in rows]
     names = rows[0].keys()
-    out = {}
-    for name in names:
-        values = [r[name] for r in rows]
-        try:
-            out[name] = np.asarray(values)
-        except (ValueError, TypeError):
-            arr = np.empty(len(values), dtype=object)
-            arr[:] = values
-            out[name] = arr
-    return out
+    return {
+        name: stack_as_column([r[name] for r in rows], force_object=name in object_fields)
+        for name in names
+    }
 
 
 class DataLoader:
@@ -157,7 +210,12 @@ class DataLoader:
     reader : petastorm_tpu.reader.Reader
         Batch reader (columnar) or per-row reader (rows are stacked host-side).
     batch_size : int
-        Global batch size (rows per yielded batch across all processes).
+        GLOBAL batch size: rows per yielded batch across all processes. Under
+        multi-process JAX with a ``NamedSharding`` whose batch axis spans processes,
+        each process cuts only its local share (``batch_size / batch-shards ×
+        locally-owned shard positions``) and the global array is assembled from the
+        process-local parts; with one process (or a replicated batch axis) local ==
+        global.
     sharding : jax.sharding.Sharding, optional
         Layout for yielded arrays. Default: single-device placement on the default device.
     shuffling_queue_capacity : int
@@ -175,17 +233,26 @@ class DataLoader:
         Device batches kept in flight (double/triple buffering). 0 disables (debug).
     to_device : bool
         False yields host numpy dicts (CPU-only consumers, tests, torch adapter).
+    pad_shapes : dict, optional
+        Ragged-field policy (SURVEY.md §8 hard part #2): ``{field: max_shape}`` pads
+        every row of a ragged tensor field up to ``max_shape`` (zeros) and adds a
+        boolean ``<field>__mask`` column marking the valid region, so the column
+        reaches the device with a static shape. Rows exceeding the declared max raise.
+        Ragged tensor fields WITHOUT a declared max raise at transfer time.
     """
 
     def __init__(self, reader, batch_size, sharding=None, shuffling_queue_capacity=0,
                  seed=None, last_batch="drop", device_transform=None, prefetch=2,
-                 to_device=True, host_queue_size=8):
+                 to_device=True, host_queue_size=8, pad_shapes=None):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if last_batch not in ("drop", "pad", "partial"):
             raise ValueError("last_batch must be drop|pad|partial, got %r" % last_batch)
         self.reader = reader
         self.batch_size = int(batch_size)
+        #: rows THIS process cuts per batch (== batch_size unless the sharding's batch
+        #: axis spans multiple processes — ADVICE r1: batch_size is documented global)
+        self.local_batch_size = _resolve_local_batch(self.batch_size, sharding)
         self.sharding = sharding
         self.last_batch = last_batch
         self.prefetch = int(prefetch)
@@ -193,6 +260,7 @@ class DataLoader:
         self._seed = seed
         self._shuffling_queue_capacity = shuffling_queue_capacity
         self._host_queue_size = host_queue_size
+        self._pad_shapes = dict(pad_shapes) if pad_shapes else {}
         self._device_transform = device_transform
         if device_transform is None:
             spec = getattr(reader, "transform_spec", None)
@@ -203,13 +271,22 @@ class DataLoader:
         self._queue = None
         self._stop = threading.Event()
         self._producer_error = None
+        self.stats = PipelineStats()
 
     # -- producer (background thread: reader → host batches) ---------------------------
 
     def _produce(self):
-        batcher = _HostBatcher(self.batch_size, self._shuffling_queue_capacity, self._seed)
+        batcher = _HostBatcher(self.local_batch_size, self._shuffling_queue_capacity,
+                               self._seed)
+        stats = self.stats
         try:
-            for item in self.reader:
+            it = iter(self.reader)
+            while True:
+                t0 = time.perf_counter()
+                item = next(it, _SENTINEL)
+                stats.read_s += time.perf_counter() - t0
+                if item is _SENTINEL:
+                    break
                 if self._stop.is_set():
                     return
                 # batched readers yield columnar dicts; per-row readers yield one row per
@@ -221,8 +298,16 @@ class DataLoader:
                         raise TypeError("unexpected reader item %r" % type(item))
                     columns = {k: v for k, v in columns.items() if v is not None}
                 else:
-                    columns = _rows_to_columns([item])
-                for batch in batcher.add(columns):
+                    columns = _rows_to_columns(
+                        [item],
+                        object_fields=getattr(self.reader, "device_decode_fields", ()),
+                    )
+                t0 = time.perf_counter()
+                if self._pad_shapes:
+                    columns = _pad_ragged_columns(columns, self._pad_shapes)
+                ready = batcher.add(columns)
+                stats.batch_s += time.perf_counter() - t0
+                for batch in ready:
                     if self._stop.is_set():
                         return
                     if self.last_batch == "pad":
@@ -233,7 +318,7 @@ class DataLoader:
                 if self.last_batch == "drop":
                     # the shuffling buffer can still hold whole batches at reader
                     # exhaustion — only the short tail is dropped
-                    if n < self.batch_size:
+                    if n < self.local_batch_size:
                         continue
                 elif self.last_batch == "pad":
                     batch = self._pad(batch)
@@ -248,15 +333,18 @@ class DataLoader:
 
     def _pad(self, batch):
         n = len(next(iter(batch.values()))) if batch else 0
-        if n == 0 or n == self.batch_size:
+        if n == 0 or n == self.local_batch_size:
             if batch and "__valid__" not in batch:
                 batch["__valid__"] = np.ones(n, dtype=bool)
             return batch
-        pad = self.batch_size - n
+        pad = self.local_batch_size - n
+        idx = np.concatenate([np.arange(n), np.full(pad, n - 1)])
         out = {}
         for name, arr in batch.items():
-            idx = np.concatenate([np.arange(n), np.full(pad, n - 1)])
-            out[name] = arr[idx] if isinstance(arr, np.ndarray) else arr
+            if isinstance(arr, np.ndarray):
+                out[name] = arr[idx]
+            else:  # non-ndarray sequence: repeat the last element so every column is
+                out[name] = list(arr) + [arr[-1]] * pad  # batch_size long (ADVICE r1)
         out["__valid__"] = np.concatenate([np.ones(n, dtype=bool), np.zeros(pad, dtype=bool)])
         return out
 
@@ -265,15 +353,21 @@ class DataLoader:
     def _host_batches(self):
         self._stop.clear()
         self._producer_error = None
+        self.stats.reset()
         self._queue = queue.Queue(maxsize=max(2, self._host_queue_size))
         self._producer = threading.Thread(target=self._produce, name="ptpu-loader", daemon=True)
         self._producer.start()
+        stats = self.stats
         while True:
+            t0 = time.perf_counter()
             item = self._queue.get()
+            stats.queue_wait_s += time.perf_counter() - t0
             if item is _SENTINEL:
                 if self._producer_error is not None:
                     raise self._producer_error
                 return
+            stats.batches += 1
+            stats.rows += len(next(iter(item.values()))) if item else 0
             yield item
 
     def _decode_staged(self, batch):
@@ -313,9 +407,21 @@ class DataLoader:
     def _to_device(self, batch):
         import jax
 
+        t0 = time.perf_counter()
         batch, staged = self._decode_staged(batch)
+        self.stats.decode_s += time.perf_counter() - t0
+        t0 = time.perf_counter()
         device = {k: v for k, v in batch.items() if _is_device_dtype(v)}
         host = {k: v for k, v in batch.items() if k not in device}
+        for name, arr in host.items():
+            if isinstance(arr, np.ndarray) and arr.dtype == object and len(arr) \
+                    and isinstance(arr[0], (np.ndarray, list, tuple)):
+                raise ValueError(
+                    "Field %r holds ragged tensors and cannot reach the device with a "
+                    "static shape. Declare DataLoader(pad_shapes={%r: (max_dims...)}) "
+                    "to zero-pad it (a %s__mask column marks the valid region)."
+                    % (name, name, name)
+                )
         if host:
             logger.debug("Fields kept host-side (non-device dtypes): %s", sorted(host))
         if self.sharding is None:
@@ -335,6 +441,7 @@ class DataLoader:
                 else:
                     arrays[name] = jax.device_put(arr, s)
         arrays.update(staged)
+        self.stats.h2d_s += time.perf_counter() - t0
         if self._device_transform is not None:
             if self._jitted_transform is None:
                 import jax as _jax
@@ -389,6 +496,70 @@ class DataLoader:
         self.join()
         self.reader.stop()
         self.reader.join()
+
+
+def _pad_ragged_columns(columns, pad_shapes):
+    """Zero-pad ragged tensor columns to their declared max shape + a validity mask.
+
+    Runs in the producer (before shuffling/batching) so downstream stages only ever
+    see static shapes."""
+    columns = dict(columns)
+    for name, target in pad_shapes.items():
+        col = columns.get(name)
+        if col is None:
+            continue
+        if isinstance(col, np.ndarray) and col.dtype != object:
+            if col.shape[1:] == tuple(target):  # already uniform at the max: mask-only
+                columns[name + "__mask"] = np.ones(col.shape, dtype=bool)
+                continue
+            col = list(col)  # uniform but below max: pad like the ragged case
+        rows = [np.asarray(r) for r in col]
+        target = tuple(target)
+        out = np.zeros((len(rows),) + target, dtype=rows[0].dtype if rows else np.float64)
+        mask = np.zeros((len(rows),) + target, dtype=bool)
+        for i, r in enumerate(rows):
+            if r.ndim != len(target):
+                raise ValueError(
+                    "pad_shapes[%r]=%r has rank %d but row %d has rank %d"
+                    % (name, target, len(target), i, r.ndim)
+                )
+            if any(a > t for a, t in zip(r.shape, target)):
+                raise ValueError(
+                    "Row %d of field %r has shape %r exceeding declared pad max %r"
+                    % (i, name, r.shape, target)
+                )
+            region = tuple(slice(0, s) for s in r.shape)
+            out[i][region] = r
+            mask[i][region] = True
+        columns[name] = out
+        columns[name + "__mask"] = mask
+    return columns
+
+
+def _resolve_local_batch(batch_size, sharding):
+    """Rows this process feeds per global batch of ``batch_size`` (1 process → all).
+
+    A global batch that does not divide over the sharding's batch axis raises
+    (misconfiguration must not silently feed P×-larger batches)."""
+    try:
+        import jax
+        import jax.sharding as jsh
+    except ImportError:  # jax optional for host-only use
+        return batch_size
+    if sharding is None or jax.process_count() == 1:
+        return batch_size
+    if isinstance(sharding, dict):  # per-field dict: use the first named sharding
+        named = [s for s in sharding.values() if isinstance(s, jsh.NamedSharding)]
+        sharding = named[0] if named else None
+    if not isinstance(sharding, jsh.NamedSharding):
+        return batch_size
+    from petastorm_tpu.parallel.mesh import local_batch_size
+
+    spec0 = sharding.spec[0] if len(sharding.spec) else None
+    if spec0 is None:
+        return batch_size  # batch axis replicated: every process feeds all rows
+    axes = (spec0,) if isinstance(spec0, str) else tuple(spec0)
+    return local_batch_size(batch_size, sharding.mesh, batch_axes=axes)
 
 
 def _matching_sharding(sharding, arr):

@@ -363,7 +363,7 @@ int ptpu_jpeg_decode_coeffs(const uint8_t* data, int64_t len, PtpuJpegCoeffs* ou
       rc = PTPU_JPEG_UNSUPPORTED_MODE;
       goto done;
     } else if (marker == 0xDA) {  // SOS
-      if (!have_frame) {
+      if (!have_frame || segbytes < 1) {
         rc = PTPU_JPEG_CORRUPT;
         goto done;
       }

@@ -65,6 +65,14 @@ class in_intersection(PredicateBase):  # noqa: N801
     def do_include(self, values):
         return bool(self._values.intersection(values[self._field]))
 
+    def do_include_vectorized(self, columns):
+        # rows are ragged collections (object column); the per-row set intersection is
+        # inherent, but skip the base class's per-row dict construction
+        vals = self._values
+        col = columns[self._field]
+        return np.fromiter((bool(vals.intersection(v)) for v in col),
+                           dtype=bool, count=len(col))
+
 
 class in_negate(PredicateBase):  # noqa: N801
     def __init__(self, predicate):
@@ -156,5 +164,21 @@ class in_pseudorandom_split(PredicateBase):  # noqa: N801
         return self._lo <= u < self._hi
 
     def do_include_vectorized(self, columns):
-        col = columns[self._field]
-        return np.asarray([self._lo <= self._unit_hash(v) < self._hi for v in col], dtype=bool)
+        """Hash each UNIQUE value once and map back through the inverse index — on
+        categorical split keys (user ids etc.) this collapses the md5 loop to the
+        distinct values; the md5 itself must stay per-value to keep split semantics
+        identical to ``do_include``."""
+        col = np.asarray(columns[self._field])
+        try:
+            uniq, inverse = np.unique(col, return_inverse=True)
+        except TypeError:  # unorderable mixed objects
+            uniq, inverse = col, np.arange(len(col))
+        md5 = hashlib.md5
+        # int.from_bytes(digest[:4]) == int(hexdigest[:8], 16): same unit interval value
+        units = np.fromiter(
+            (int.from_bytes(md5(str(v).encode("utf-8")).digest()[:4], "big")
+             for v in uniq),
+            dtype=np.uint32, count=len(uniq),
+        ).astype(np.float64) / float(0xFFFFFFFF)
+        mask = (self._lo <= units) & (units < self._hi)
+        return mask[inverse]

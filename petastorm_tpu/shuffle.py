@@ -135,8 +135,10 @@ class BatchedRandomShufflingBuffer(ShufflingBufferBase):
         self._capacity = shuffling_buffer_capacity
         self._min_after_retrieve = min_after_retrieve
         self._batch_size = batch_size
-        self._columns = None  # {name: list of arrays}
-        self._num_rows = 0
+        self._staged = None  # {name: list of arrays} awaiting consolidation
+        self._store = None  # {name: preallocated ndarray}; rows [0, _store_rows) valid
+        self._store_rows = 0  # consolidated rows currently in _store
+        self._num_rows = 0  # total rows (consolidated + staged)
         self._done = False
         self._rng = np.random.Generator(np.random.PCG64(seed))
 
@@ -146,34 +148,68 @@ class BatchedRandomShufflingBuffer(ShufflingBufferBase):
             raise RuntimeError("Cannot add to a finished shuffling buffer")
         names = list(column_batch.keys())
         n = len(column_batch[names[0]])
-        if self._columns is None:
-            self._columns = {name: [] for name in names}
+        if self._staged is None:
+            self._staged = {name: [] for name in names}
         for name in names:
             if len(column_batch[name]) != n:
                 raise ValueError("Ragged column batch: %r" % name)
-            self._columns[name].append(np.asarray(column_batch[name]))
+            self._staged[name].append(np.asarray(column_batch[name]))
         self._num_rows += n
 
     def retrieve(self):
-        """Return a {name: ndarray} batch of up to batch_size random rows."""
+        """Return a {name: ndarray} batch of up to batch_size random rows.
+
+        O(batch) data movement per call: selected rows are copied out and the holes are
+        back-filled from the buffer tail in place (the previous full-buffer gather of
+        the kept rows copied the entire buffer's bytes on every retrieve)."""
         if not self.can_retrieve:
             raise RuntimeError("Buffer below retrieval threshold and not finished")
         self._consolidate()
-        take = min(self._batch_size, self._num_rows)
-        perm = self._rng.permutation(self._num_rows)
-        chosen, keep = perm[:take], perm[take:]
+        n = self._num_rows
+        take = min(self._batch_size, n)
+        chosen = np.sort(self._rng.choice(n, size=take, replace=False))
         out = {}
-        for name, chunks in self._columns.items():
-            arr = chunks[0]
-            out[name] = arr[chosen]
-            self._columns[name] = [arr[keep]]
+        tail_start = n - take
+        # tail rows that were NOT chosen backfill the holes chosen left below tail_start
+        chosen_in_tail = chosen[chosen >= tail_start]
+        holes = chosen[chosen < tail_start]
+        tail_mask = np.ones(take, dtype=bool)
+        tail_mask[chosen_in_tail - tail_start] = False
+        for name, store in self._store.items():
+            out[name] = store[chosen]  # fancy indexing already allocates fresh rows
+            if len(holes):
+                store[holes] = store[tail_start:n][tail_mask]
         self._num_rows -= take
+        self._store_rows = self._num_rows
         return out
 
     def _consolidate(self):
-        for name, chunks in self._columns.items():
-            if len(chunks) > 1:
-                self._columns[name] = [np.concatenate(chunks, axis=0)]
+        """Move staged chunks into the preallocated store (grown geometrically)."""
+        if not self._staged:
+            return
+        base = self._store_rows
+        for name, chunks in self._staged.items():
+            if not chunks:
+                continue
+            add = sum(len(c) for c in chunks)
+            store = None if self._store is None else self._store.get(name)
+            need = base + add
+            if store is None or len(store) < need:
+                grown = max(need, 0 if store is None else 2 * len(store),
+                            self._capacity + self._batch_size)
+                first = chunks[0]
+                if self._store is None:
+                    self._store = {}
+                new = np.empty((grown,) + first.shape[1:], dtype=first.dtype)
+                if store is not None:
+                    new[:base] = store[:base]
+                self._store[name] = store = new
+            pos = base
+            for c in chunks:
+                store[pos:pos + len(c)] = c
+                pos += len(c)
+            self._staged[name] = []
+        self._store_rows = self._num_rows
 
     @property
     def can_add(self):

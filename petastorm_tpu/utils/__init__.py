@@ -53,6 +53,25 @@ def _coerce_plain(field, value):
     return np_dtype.type(value)
 
 
+def stack_as_column(values, force_object=False):
+    """Pack per-row values into one column array: a stacked ndarray when rows are
+    uniform, an object array otherwise (ragged rows, staging payloads, strings).
+
+    ``force_object=True`` skips the stacking attempt — required for columns whose rows
+    may MIX ndarrays and non-array payloads (e.g. device-decode staging objects with
+    per-stream host fallbacks), where np.asarray would pick a layout per batch and
+    downstream concatenation would break.
+    """
+    if not force_object:
+        try:
+            return np.asarray(values)
+        except (ValueError, TypeError):
+            pass
+    arr = np.empty(len(values), dtype=object)
+    arr[:] = values
+    return arr
+
+
 def pad_to_shape(array, shape, pad_value=0):
     """Pad/validate an array against a static-or-None shape tuple; used by the JAX loader to
     produce the fixed shapes XLA requires."""

@@ -1,0 +1,66 @@
+"""Subprocess body for test_multiprocess_loader: one JAX process of a 2-process CPU
+cluster driving the sharded-reader → DataLoader → global-jax.Array contract."""
+import json
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["PTPU_MP_COORD"],
+    num_processes=int(os.environ["PTPU_MP_NPROC"]),
+    process_id=int(os.environ["PTPU_MP_PID"]),
+)
+
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec  # noqa: E402
+
+from petastorm_tpu.loader import DataLoader  # noqa: E402
+from petastorm_tpu.reader import make_batch_reader  # noqa: E402
+
+
+def main():
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8  # 4 per process
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+
+    reader = make_batch_reader(
+        os.environ["PTPU_MP_URL"],
+        cur_shard=jax.process_index(),
+        shard_count=jax.process_count(),
+        shard_seed=0,
+        shuffle_row_groups=False,
+        num_epochs=1,
+    )
+    loader = DataLoader(reader, batch_size=16, sharding=sharding)
+    local_ids = []
+    global_batch_shape = None
+    global_ids = None
+    with loader:
+        for batch in loader:
+            arr = batch["id"]
+            global_batch_shape = list(arr.shape)
+            # rows this process actually contributed
+            for shard in arr.addressable_shards:
+                local_ids.extend(np.asarray(shard.data).ravel().tolist())
+            # full global content visible identically on every process
+            from jax.experimental import multihost_utils
+
+            gathered = multihost_utils.process_allgather(arr, tiled=True)
+            ids = np.asarray(gathered).ravel().tolist()
+            global_ids = (global_ids or []) + ids
+
+    out = {
+        "process_count": jax.process_count(),
+        "local_batch_size": loader.local_batch_size,
+        "global_batch_shape": global_batch_shape,
+        "local_ids": sorted(set(local_ids)),
+        "global_ids": sorted(global_ids),
+    }
+    with open(os.environ["PTPU_MP_OUT"], "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()

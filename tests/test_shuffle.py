@@ -78,3 +78,58 @@ def test_batched_buffer_ragged_rejected():
     b = BatchedRandomShufflingBuffer(10, 0, 2)
     with pytest.raises(ValueError, match="Ragged"):
         b.add_many({"x": np.arange(3), "y": np.arange(4)})
+
+
+def test_batched_buffer_incremental_adds_and_retrieves_interleaved():
+    """Exercises the preallocated-store path: staged chunks, growth, hole backfill."""
+    rng = np.random.RandomState(0)
+    b = BatchedRandomShufflingBuffer(64, min_after_retrieve=16, batch_size=8, seed=3)
+    seen = []
+    next_id = 0
+    for _ in range(30):
+        n = rng.randint(1, 20)
+        ids = np.arange(next_id, next_id + n)
+        b.add_many({"id": ids, "x": ids.astype(np.float64) * 0.5})
+        next_id += n
+        while b.can_retrieve:
+            out = b.retrieve()
+            np.testing.assert_array_equal(out["x"], out["id"] * 0.5)  # rows stay aligned
+            seen.extend(out["id"].tolist())
+    b.finish()
+    while b.can_retrieve:
+        out = b.retrieve()
+        np.testing.assert_array_equal(out["x"], out["id"] * 0.5)
+        seen.extend(out["id"].tolist())
+    assert sorted(seen) == list(range(next_id))  # exact permutation, no loss/dup
+
+
+def test_batched_buffer_statistical_shuffle_quality():
+    """Reference asserts statistical quality (SURVEY §5.3), not just 'order differs':
+    with capacity >= N the output order must be rank-decorrelated from the input."""
+    n = 2000
+    b = BatchedRandomShufflingBuffer(n, min_after_retrieve=0, batch_size=50, seed=7)
+    for start in range(0, n, 200):
+        b.add_many({"id": np.arange(start, start + 200)})
+    b.finish()
+    out = []
+    while b.can_retrieve:
+        out.extend(b.retrieve()["id"].tolist())
+    assert sorted(out) == list(range(n))
+    positions = np.empty(n)
+    positions[np.asarray(out)] = np.arange(n)
+    rho = np.corrcoef(np.arange(n), positions)[0, 1]  # Spearman on identity input
+    assert abs(rho) < 0.15, rho
+    displacement = np.abs(positions - np.arange(n)).mean()
+    assert displacement > n / 6, displacement  # uniform shuffle expectation ~ n/3
+
+
+def test_random_buffer_statistical_shuffle_quality():
+    n = 2000
+    b = RandomShufflingBuffer(n, 0, seed=5)
+    b.add_many(range(n))
+    b.finish()
+    out = [b.retrieve() for _ in range(n)]
+    positions = np.empty(n)
+    positions[np.asarray(out)] = np.arange(n)
+    rho = np.corrcoef(np.arange(n), positions)[0, 1]
+    assert abs(rho) < 0.15, rho
