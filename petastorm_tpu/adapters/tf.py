@@ -57,6 +57,15 @@ def _tf_compatible(value):
     if isinstance(value, decimal.Decimal):
         return str(value)
     if isinstance(value, datetime.datetime):
+        if value.tzinfo is None:
+            # naive datetimes are UTC by convention (upstream behavior): timegm reads
+            # the struct_time as UTC — value.timestamp() would apply the LOCAL zone
+            # and make the same dataset yield different int64s per machine (ADVICE r1)
+            import calendar
+
+            epoch_us = calendar.timegm(value.utctimetuple()) * 1_000_000 \
+                + value.microsecond
+            return np.int64(epoch_us * 1000)
         return np.int64(int(value.timestamp() * 1e9))
     if isinstance(value, datetime.date):
         return np.int64((value - datetime.date(1970, 1, 1)).days)
@@ -131,11 +140,18 @@ def tf_tensors(reader, shuffling_queue_capacity=0, min_after_dequeue=0):
 
     Returns a structure of tensors that advances the reader each time it is evaluated.
     In TF2 eager this delegates to a dataset iterator.
+
+    ``min_after_dequeue`` maps onto tf.data semantics as a floor on the shuffle buffer
+    (the reference's ``tf.train.shuffle_batch`` used it as the minimum buffered rows
+    for shuffle quality): the effective buffer is
+    ``max(shuffling_queue_capacity, min_after_dequeue + 1)``.
     """
     tf = _tf()
-    if shuffling_queue_capacity and shuffling_queue_capacity > 0:
+    buffer_size = max(int(shuffling_queue_capacity or 0), int(min_after_dequeue or 0) + 1
+                      if min_after_dequeue else 0)
+    if buffer_size > 1:
         ds = make_petastorm_dataset(reader).shuffle(
-            shuffling_queue_capacity, seed=None, reshuffle_each_iteration=True)
+            buffer_size, seed=None, reshuffle_each_iteration=True)
     else:
         ds = make_petastorm_dataset(reader)
     if tf.executing_eagerly():

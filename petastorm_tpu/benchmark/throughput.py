@@ -67,6 +67,9 @@ def loader_throughput(loader, consume_fn=None, warmup_batches=4, measure_batches
             break
         if consume_fn is not None:
             consume_fn(batch)
+    stats = getattr(loader, "stats", None)
+    if stats is not None:
+        stats.reset()  # the stage split must cover only the measured window below
     n = 0
     batches = 0
     busy = 0.0

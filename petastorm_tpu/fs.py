@@ -9,6 +9,14 @@ TPU-first delta: built directly on ``pyarrow.fs`` (which wraps GCS/S3/HDFS nativ
 fsspec bridge for anything else — no hand-rolled namenode HA logic; pyarrow's HDFS client already
 consumes ``core-site.xml``. GCS is the north-star source (BASELINE.json reads ImageNet-Parquet
 from GCS), so ``gs://`` resolves through pyarrow's GcsFileSystem when available, else gcsfs.
+
+HDFS HA compat decision (replaces petastorm/hdfs/namenode.py ~L40–L200 entirely): pass the
+HA *nameservice id* as the URL authority — ``hdfs://nameservice1/path`` — and libhdfs (behind
+``pyarrow.fs.HadoopFileSystem``) performs namenode resolution + failover from
+``core-site.xml``/``hdfs-site.xml`` (``dfs.nameservices``/``dfs.ha.namenodes.*``), which is the
+same config surface the reference's ``HdfsNamenodeResolver``/``HAHdfsClient`` parsed by hand.
+``hdfs:///path`` (no authority) maps to host ``"default"`` = libhdfs's fs.defaultFS.
+URL→constructor dispatch is covered by mocked tests (tests/test_fs.py) without a cluster.
 """
 from __future__ import annotations
 

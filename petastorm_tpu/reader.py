@@ -18,6 +18,7 @@ hive-partitioned directory pruning is not yet wired into piece enumeration.
 from __future__ import annotations
 
 import logging
+import re
 import threading
 
 import numpy as np
@@ -498,25 +499,58 @@ def _stable_repr(value):
     return repr(value)
 
 
+_RUN_SALT = None
+
+_ADDR_RE = re.compile(r" at 0x[0-9a-fA-F]+")
+
+
 def _predicate_key(predicate):
-    """Stable identity for a predicate: class + parameters. Callables are keyed by their
-    bytecode+consts digest (repr would embed a memory address — unstable across runs and
-    reusable across DIFFERENT lambdas, poisoning a persistent disk cache)."""
+    """Stable identity for a predicate: class + parameters. Callables are keyed by
+    bytecode + consts + DEFAULTS + CLOSURE VALUES (ADVICE r1: two lambdas with the same
+    bytecode but different captured thresholds must not collide in a persistent cache);
+    repr would embed a memory address — unstable across runs and reusable across
+    different lambdas."""
     import hashlib
 
+    global _RUN_SALT
     parts = [type(predicate).__name__]
     for name, value in sorted(vars(predicate).items()):
         if callable(value):
             code = getattr(value, "__code__", None)
+            payload = None
             if code is not None:
-                digest = hashlib.sha256(
-                    code.co_code + repr(code.co_consts).encode("utf-8")
-                ).hexdigest()
+                payload = [code.co_code, repr(code.co_consts).encode("utf-8")]
+                defaults = getattr(value, "__defaults__", None)
+                if defaults:
+                    payload.append(_stable_repr(defaults).encode("utf-8"))
+                kwdefaults = getattr(value, "__kwdefaults__", None)
+                if kwdefaults:
+                    payload.append(_stable_repr(kwdefaults).encode("utf-8"))
+                closure = getattr(value, "__closure__", None)
+                if closure:
+                    try:
+                        cells = tuple(c.cell_contents for c in closure)
+                        payload.append(_stable_repr(cells).encode("utf-8"))
+                    except ValueError:  # unreadable cell: treat as unkeyable
+                        payload = None
+            if payload is not None and any(_ADDR_RE.search(p.decode("utf-8", "ignore"))
+                                           for p in payload[1:]):
+                # captured objects whose repr embeds a memory address ('<function f at
+                # 0x..>') are unstable across runs AND can collide on address reuse —
+                # the exact poisoning class this key exists to prevent; salt instead
+                payload = None
+            if payload is not None:
+                digest = hashlib.sha256(b"\x00".join(payload)).hexdigest()
                 parts.append("%s=fn:%s" % (name, digest))
             else:
-                # unkeyable callable: unique per instance so a persistent cache never
-                # serves rows filtered by a different predicate
-                parts.append("%s=unkeyable:%d" % (name, id(value)))
+                # unkeyable callable: salt the key per RUN so in-memory reuse works but
+                # a persistent cache from another run can never serve mismatched rows
+                # (id() alone can recur across runs — ADVICE r1)
+                if _RUN_SALT is None:
+                    import os as _os
+
+                    _RUN_SALT = _os.urandom(8).hex()
+                parts.append("%s=unkeyable:%s:%d" % (name, _RUN_SALT, id(value)))
         else:
             parts.append("%s=%s" % (name, _stable_repr(value)))
     return "|".join(parts)

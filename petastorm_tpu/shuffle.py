@@ -6,8 +6,8 @@ operates on whole column batches (the reference's torch-specific
 petastorm/reader_impl/pytorch_shuffling_buffer.py ~L90 generalized to numpy — framework-neutral,
 so the JAX, torch and tf adapters all share it).
 
-The on-device (HBM) shuffle lives in petastorm_tpu/ops/hbm_shuffle.py; these host buffers are
-the portable path and the one used below batch-assembly granularity.
+The on-device (HBM) shuffle lives in petastorm_tpu/ops/device_shuffle.py; these host buffers
+are the portable path and the one used below batch-assembly granularity.
 """
 from __future__ import annotations
 
@@ -167,7 +167,9 @@ class BatchedRandomShufflingBuffer(ShufflingBufferBase):
         self._consolidate()
         n = self._num_rows
         take = min(self._batch_size, n)
-        chosen = np.sort(self._rng.choice(n, size=take, replace=False))
+        # keep chosen UNSORTED: the gather order is the intra-batch shuffle (sorting
+        # would emit rows in buffer-insertion order — FIFO when take ≈ n)
+        chosen = self._rng.choice(n, size=take, replace=False)
         out = {}
         tail_start = n - take
         # tail rows that were NOT chosen backfill the holes chosen left below tail_start
@@ -195,8 +197,11 @@ class BatchedRandomShufflingBuffer(ShufflingBufferBase):
             store = None if self._store is None else self._store.get(name)
             need = base + add
             if store is None or len(store) < need:
-                grown = max(need, 0 if store is None else 2 * len(store),
-                            self._capacity + self._batch_size)
+                # grow geometrically toward (not eagerly to) the capacity ceiling: a
+                # small dataset must not allocate capacity-sized buffers up front
+                limit = max(need, self._capacity + self._batch_size)
+                grown = need if store is None else max(need, 2 * len(store))
+                grown = min(grown, limit)
                 first = chunks[0]
                 if self._store is None:
                     self._store = {}

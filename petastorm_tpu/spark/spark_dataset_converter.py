@@ -41,6 +41,24 @@ def _require_pyspark():
         ) from e
 
 
+#: DataFrame protocol the converter actually consumes. Anything satisfying it works —
+#: a real pyspark DataFrame, a pyspark-connect proxy, or the fake-session contract
+#: fixtures in tests/test_spark_contract.py (pyspark is not installed in this image;
+#: see BASELINE.md "Environment constraints").
+_DATAFRAME_PROTOCOL = ("sparkSession", "schema", "write", "count")
+
+
+def _require_pyspark_or_compatible(df):
+    try:
+        import pyspark  # noqa: F401
+
+        return
+    except ImportError:
+        if all(hasattr(df, attr) for attr in _DATAFRAME_PROTOCOL):
+            return  # duck-typed DataFrame: the converter only uses the protocol above
+    _require_pyspark()
+
+
 def register_delete_dir_handler(handler):
     """Override how cache dirs are deleted (reference ``register_delete_dir_handler``)."""
     global _delete_handler
@@ -161,22 +179,59 @@ class _TfDatasetContextManager:
 
 
 def _normalize_precision(df, dtype):
-    """float64→float32 (or as asked) normalization before materialization (reference)."""
+    """float64→float32 (or as asked) normalization before materialization (reference).
+
+    With pyspark absent, falls back to the protocol form: columns whose
+    ``dataType.typeName()`` is the source type are re-cast via
+    ``df.withColumn(name, df[name].cast(target_typename))`` — the exact calls a real
+    DataFrame would see, so the fake-session contract tests assert them.
+    """
     if dtype is None:
         return df
-    from pyspark.sql.functions import col
-    from pyspark.sql.types import DoubleType, FloatType
+    target_name = {"float32": "float", "float64": "double"}[dtype]
+    source_name = "double" if dtype == "float32" else "float"
+    try:
+        from pyspark.sql.functions import col
+        from pyspark.sql.types import DoubleType, FloatType
 
-    target = {"float32": FloatType(), "float64": DoubleType()}[dtype]
-    source = DoubleType() if dtype == "float32" else FloatType()
-    for field in df.schema.fields:
-        if field.dataType == source:
-            df = df.withColumn(field.name, col(field.name).cast(target))
-    return df
+        target = FloatType() if dtype == "float32" else DoubleType()
+        source = DoubleType() if dtype == "float32" else FloatType()
+        for field in df.schema.fields:
+            if field.dataType == source:
+                df = df.withColumn(field.name, col(field.name).cast(target))
+        return df
+    except ImportError:
+        for field in df.schema.fields:
+            type_name = getattr(field.dataType, "typeName", lambda: None)()
+            if type_name == source_name:
+                df = df.withColumn(field.name, df[field.name].cast(target_name))
+        return df
+
+
+def _df_plan_string(df):
+    """Stable textual identity of the DataFrame's analyzed plan (cache key basis)."""
+    jdf = getattr(df, "_jdf", None)
+    if jdf is not None:
+        try:
+            return jdf.queryExecution().analyzed().toString()
+        except Exception:  # noqa: BLE001 - connect/duck-typed frames
+            pass
+    semantic_hash = getattr(df, "semanticHash", None)
+    if callable(semantic_hash):
+        return "semanticHash:%s" % semantic_hash()
+    # No plan identity at all: schema alone is NOT content identity — two frames over
+    # different data with equal schemas would share a cache entry and silently serve
+    # the wrong materialized rows. Refuse instead.
+    raise ValueError(
+        "Cannot derive a cache identity for %r: it exposes neither _jdf (pyspark) nor "
+        "semanticHash(). Implement semanticHash() on the DataFrame, or bypass the "
+        "converter cache by materializing manually (petastorm_tpu.metadata.write_dataset "
+        "+ make_batch_reader)." % type(df).__name__
+    )
 
 
 def _df_cache_key(df, parent_dir, compression_codec, dtype):
-    plan = df._jdf.queryExecution().analyzed().toString()
+    plan = _df_plan_string(df)
     payload = "|".join([plan, parent_dir or "", compression_codec or "", dtype or ""])
     return hashlib.sha256(payload.encode("utf-8")).hexdigest()
 
@@ -188,7 +243,7 @@ def make_spark_converter(df, parquet_row_group_size_bytes=32 * 1024 * 1024,
     Cache keyed by (analyzed plan, options): re-converting the same DataFrame reuses the
     materialized files (reference ``make_spark_converter`` ~L400).
     """
-    _require_pyspark()
+    _require_pyspark_or_compatible(df)
     spark = df.sparkSession
     parent = spark.conf.get(_CACHE_DIR_CONF, None)
     if not parent:

@@ -1,0 +1,37 @@
+"""Spark RDD helper (reference: petastorm/spark_utils.py ~L30 ``dataset_as_rdd``).
+
+Reads a petastorm(-tpu) dataset back into a Spark RDD of namedtuple rows. The per-piece
+decode reuses the reader's own :class:`~petastorm_tpu.reader.PyDictWorker` (picklable —
+the same property the process pool relies on), so executors run the identical
+column-pruned read + codec decode path as ``make_reader``.
+
+Works against any session object exposing ``sparkContext.parallelize`` (real pyspark, or
+the fake-session contract fixtures — pyspark is not installed in this image)."""
+from __future__ import annotations
+
+
+def dataset_as_rdd(dataset_url, spark_session, schema_fields=None,
+                   storage_options=None, filesystem=None):
+    """Return an RDD of decoded namedtuple rows for the dataset at ``dataset_url``."""
+    from petastorm_tpu.cache import NullCache
+    from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+    from petastorm_tpu.metadata import get_schema, load_row_groups
+    from petastorm_tpu.reader import PyDictWorker
+
+    fs, path = get_filesystem_and_path_or_paths(dataset_url, storage_options, filesystem)
+    stored_schema = get_schema(fs, path)
+    read_schema = (
+        stored_schema.create_schema_view(schema_fields) if schema_fields else stored_schema
+    )
+    pieces = load_row_groups(fs, path)
+    worker = PyDictWorker(fs, read_schema, stored_schema, None, None, NullCache(),
+                          1, None, None)
+    row_type = read_schema.make_namedtuple_type()
+    field_names = list(read_schema.fields.keys())
+
+    def piece_to_rows(piece):
+        rows = worker((piece, 0))
+        return [row_type(**{name: r.get(name) for name in field_names}) for r in rows]
+
+    rdd = spark_session.sparkContext.parallelize(pieces, max(1, len(pieces)))
+    return rdd.flatMap(piece_to_rows)

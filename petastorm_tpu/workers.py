@@ -218,34 +218,50 @@ class ProcessExecutor(ExecutorBase):
             p.stdin.write(authkey)
             p.stdin.close()
             self._procs.append(p)
-        # accept with a timeout + child liveness poll: a child that dies before connecting
-        # (import error, crash) must raise here, not hang Reader construction forever
-        listener._listener._socket.settimeout(1.0)
+        # accept on a helper thread + child liveness poll on this one: a child that dies
+        # before connecting (import error, crash) must raise here, not hang Reader
+        # construction forever. Public API only — no reaching into Listener internals
+        # for socket timeouts (ADVICE r1: private attrs break across Python versions
+        # and made every OSError look like a poll tick).
+        accepted = queue.Queue()
+
+        def _accept_loop():
+            try:
+                for _ in range(self._workers_count):
+                    accepted.put(listener.accept())
+            except Exception as e:  # noqa: BLE001 — surfaced to the main thread
+                accepted.put(e)
+
+        acceptor = threading.Thread(target=_accept_loop, name="ptpu-accept", daemon=True)
+        acceptor.start()
         deadline = 120.0
         waited = 0.0
-        while len(self._conns) < self._workers_count:
-            try:
-                conn = listener.accept()
-            except OSError:
-                waited += 1.0
-                for p in self._procs:
-                    if p.poll() is not None:
-                        listener.close()
-                        raise RuntimeError(
-                            "Pool child exited with code %s before connecting (run "
-                            "'python -m petastorm_tpu._child_worker' manually to debug)"
-                            % p.returncode
+        try:
+            while len(self._conns) < self._workers_count:
+                try:
+                    item = accepted.get(timeout=1.0)
+                except queue.Empty:
+                    waited += 1.0
+                    for p in self._procs:
+                        if p.poll() is not None:
+                            raise RuntimeError(
+                                "Pool child exited with code %s before connecting (run "
+                                "'python -m petastorm_tpu._child_worker' manually to "
+                                "debug)" % p.returncode
+                            )
+                    if waited > deadline:
+                        raise TimeoutWaitingForResultError(
+                            "Pool children did not connect within %.0fs" % deadline
                         )
-                if waited > deadline:
-                    listener.close()
-                    raise TimeoutWaitingForResultError(
-                        "Pool children did not connect within %.0fs" % deadline
-                    )
-                continue
-            conn.send(list(sys.path))
-            conn.send(worker)
-            self._conns.append(conn)
-        listener.close()
+                    continue
+                if isinstance(item, Exception):
+                    raise item
+                conn = item
+                conn.send(list(sys.path))
+                conn.send(worker)
+                self._conns.append(conn)
+        finally:
+            listener.close()  # also unblocks the acceptor thread if we raised
         plan_iter = iter(plan)
         self._active = self._workers_count
         for i, conn in enumerate(self._conns):

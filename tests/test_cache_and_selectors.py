@@ -105,3 +105,64 @@ def test_missing_index_raises(synthetic_dataset):
     indexes = get_row_group_indexes(fs, path)
     with pytest.raises(ValueError, match="no index named"):
         SingleIndexSelector("nope", ["v"]).select_row_groups(indexes)
+
+
+def test_local_disk_cache_concurrent_processes(tmp_path):
+    """Multiple PROCESSES share one cache dir (the multi-process-safety claim in
+    cache.py): concurrent fill + read of the same keys must never corrupt entries or
+    return mismatched values."""
+    import subprocess
+    import sys
+
+    script = r"""
+import pickle, sys
+import numpy as np
+sys.path.insert(0, %r)
+from petastorm_tpu.cache import LocalDiskCache
+
+cache = LocalDiskCache(%r, size_limit_bytes=None)
+rng = np.random.RandomState(int(sys.argv[1]))
+for round_ in range(30):
+    for key in range(8):
+        expected = np.full((64,), key, dtype=np.int64)
+        got = cache.get("k-%%d" %% key, lambda k=key: np.full((64,), k, dtype=np.int64))
+        assert (got == expected).all(), (key, got[:4])
+print("ok")
+"""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cache_dir = str(tmp_path / "shared_cache")
+    procs = [
+        subprocess.Popen([sys.executable, "-c", script % (repo, cache_dir), str(i)],
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(4)
+    ]
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0 and "ok" in out, out[-2000:]
+
+
+def test_local_disk_cache_concurrent_threads(tmp_path):
+    import threading
+
+    from petastorm_tpu.cache import LocalDiskCache
+
+    cache = LocalDiskCache(str(tmp_path / "tcache"))
+    errors = []
+
+    def worker(seed):
+        try:
+            for _ in range(50):
+                for key in range(6):
+                    got = cache.get("k-%d" % key, lambda k=key: list(range(k, k + 10)))
+                    assert got == list(range(key, key + 10))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors

@@ -1,0 +1,81 @@
+"""URL→filesystem dispatch with mocked pyarrow constructors (reference model:
+petastorm/hdfs/tests/test_hdfs_namenode.py — no cluster, assert the resolution logic)."""
+import pyarrow.fs as pafs
+import pytest
+
+from petastorm_tpu.fs import get_dataset_path, get_filesystem_and_path_or_paths
+
+
+class _Recorder:
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, *args, **kwargs):
+        self.calls.append((args, kwargs))
+        return pafs.LocalFileSystem()  # any real FS satisfies the return contract
+
+
+def test_hdfs_url_delegates_host_port(monkeypatch):
+    rec = _Recorder()
+    monkeypatch.setattr(pafs, "HadoopFileSystem", rec)
+    fs, path = get_filesystem_and_path_or_paths("hdfs://namenode-host:8020/data/ds")
+    assert path == "/data/ds"
+    (args, kwargs), = rec.calls
+    assert args == ("namenode-host", 8020)
+
+
+def test_hdfs_ha_nameservice_authority_passes_through(monkeypatch):
+    """HA contract: the nameservice id is handed to libhdfs verbatim; failover happens
+    inside the Hadoop client from core-site.xml (see fs.py module docstring)."""
+    rec = _Recorder()
+    monkeypatch.setattr(pafs, "HadoopFileSystem", rec)
+    fs, path = get_filesystem_and_path_or_paths("hdfs://nameservice1/data/ds")
+    (args, kwargs), = rec.calls
+    assert args == ("nameservice1", 0)  # port 0 = resolve via hadoop conf
+    assert path == "/data/ds"
+
+
+def test_hdfs_url_without_authority_uses_default_fs(monkeypatch):
+    rec = _Recorder()
+    monkeypatch.setattr(pafs, "HadoopFileSystem", rec)
+    get_filesystem_and_path_or_paths("hdfs:///data/ds")
+    (args, kwargs), = rec.calls
+    assert args == ("default", 0)
+
+
+def test_hdfs_storage_options_forwarded(monkeypatch):
+    rec = _Recorder()
+    monkeypatch.setattr(pafs, "HadoopFileSystem", rec)
+    get_filesystem_and_path_or_paths("hdfs://nn:9000/x",
+                                     storage_options={"user": "alice"})
+    (args, kwargs), = rec.calls
+    assert kwargs == {"user": "alice"}
+
+
+def test_s3_url_dispatch(monkeypatch):
+    rec = _Recorder()
+    monkeypatch.setattr(pafs, "S3FileSystem", rec)
+    fs, path = get_filesystem_and_path_or_paths("s3://bucket/prefix/ds")
+    assert path == "bucket/prefix/ds"
+    assert len(rec.calls) == 1
+
+
+def test_mixed_scheme_urls_rejected():
+    with pytest.raises(ValueError, match="share scheme"):
+        get_filesystem_and_path_or_paths(["file:///a", "s3://b/c"])
+
+
+def test_user_filesystem_passthrough(tmp_path):
+    fs = pafs.LocalFileSystem()
+    got_fs, path = get_filesystem_and_path_or_paths(
+        "hdfs://ignored/data/ds", filesystem=fs)
+    assert got_fs is fs  # user-supplied FS wins; no constructor dispatch
+    assert path == "/data/ds"
+
+
+def test_get_dataset_path():
+    from urllib.parse import urlparse
+
+    assert get_dataset_path(urlparse("file:///a/b")) == "/a/b"
+    assert get_dataset_path(urlparse("s3://bucket/a/b")) == "bucket/a/b"
+    assert get_dataset_path(urlparse("hdfs://nn/a/b")) == "/a/b"

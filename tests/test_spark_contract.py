@@ -1,0 +1,311 @@
+"""Fake-session contract tests for the Spark surface (VERDICT r1 #6: pyspark is not
+installable in this image — BASELINE.md records that — so the converter/materialize/RDD
+logic is executed against duck-typed fakes implementing exactly the DataFrame/session
+protocol the code consumes, with REAL parquet written/read underneath)."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.spark.spark_dataset_converter import (
+    SparkDatasetConverter,
+    make_spark_converter,
+    _materialized,
+)
+
+
+# -- fakes implementing the consumed protocol ------------------------------------------
+
+
+class FakeConf(dict):
+    def get(self, key, default=None):
+        return super().get(key, default)
+
+    def set(self, key, value):
+        self[key] = value
+
+
+class FakeHadoopConf(dict):
+    def get(self, key):
+        return super().get(key)
+
+    def setInt(self, key, value):  # noqa: N802 - hadoop API name
+        self[key] = int(value)
+
+    def set(self, key, value):
+        self[key] = value
+
+    def unset(self, key):
+        self.pop(key, None)
+
+
+class FakeJsc:
+    def __init__(self):
+        self._hadoop = FakeHadoopConf()
+
+    def hadoopConfiguration(self):  # noqa: N802
+        return self._hadoop
+
+
+class FakeRDD:
+    def __init__(self, items):
+        self._items = list(items)
+
+    def flatMap(self, fn):  # noqa: N802
+        out = []
+        for item in self._items:
+            out.extend(fn(item))
+        return FakeRDD(out)
+
+    def collect(self):
+        return list(self._items)
+
+
+class FakeSparkContext:
+    def __init__(self):
+        self._jsc = FakeJsc()
+
+    def parallelize(self, items, num_slices=None):
+        return FakeRDD(items)
+
+
+class FakeSparkSession:
+    def __init__(self):
+        self.conf = FakeConf()
+        self.sparkContext = FakeSparkContext()
+
+
+class FakeDataType:
+    def __init__(self, name):
+        self._name = name
+
+    def typeName(self):  # noqa: N802
+        return self._name
+
+
+class FakeField:
+    def __init__(self, name, type_name):
+        self.name = name
+        self.dataType = FakeDataType(type_name)
+
+
+class FakeSchema:
+    def __init__(self, fields):
+        self.fields = fields
+
+    def __repr__(self):
+        return "FakeSchema(%r)" % [(f.name, f.dataType.typeName()) for f in self.fields]
+
+
+class FakeColumn:
+    def __init__(self, name):
+        self.name = name
+
+    def cast(self, type_name):
+        return ("cast", self.name, type_name)
+
+
+class FakeWriter:
+    def __init__(self, df):
+        self._df = df
+        self.options = {}
+        self.write_mode = None
+
+    def mode(self, m):
+        self.write_mode = m
+        return self
+
+    def option(self, k, v):
+        self.options[k] = v
+        return self
+
+    def parquet(self, url):
+        path = url[len("file://"):] if url.startswith("file://") else url
+        os.makedirs(path, exist_ok=True)
+        pq.write_table(self._df._to_arrow(), os.path.join(path, "part-00000.parquet"))
+
+
+class FakeDataFrame:
+    """Implements the converter's _DATAFRAME_PROTOCOL over a plain column dict."""
+
+    def __init__(self, session, columns, type_names):
+        self.sparkSession = session
+        self._columns = dict(columns)
+        self.schema = FakeSchema(
+            [FakeField(n, type_names[n]) for n in columns]
+        )
+        self.casts = []
+
+    def __getitem__(self, name):
+        return FakeColumn(name)
+
+    def withColumn(self, name, expr):  # noqa: N802
+        assert expr[0] == "cast"
+        _, col_name, target = expr
+        self.casts.append((col_name, target))
+        out = FakeDataFrame(self.sparkSession, self._columns,
+                            {f.name: f.dataType.typeName() for f in self.schema.fields})
+        out._columns[name] = np.asarray(self._columns[col_name],
+                                        np.float32 if target == "float" else np.float64)
+        out.schema = FakeSchema([
+            FakeField(f.name, target if f.name == name else f.dataType.typeName())
+            for f in self.schema.fields
+        ])
+        out.casts = self.casts
+        return out
+
+    @property
+    def write(self):
+        return FakeWriter(self)
+
+    def count(self):
+        return len(next(iter(self._columns.values())))
+
+    def semanticHash(self):  # noqa: N802
+        return hash(tuple(sorted(self._columns)))  # plan identity = column set here
+
+    def _to_arrow(self):
+        return pa.table(self._columns)
+
+
+@pytest.fixture
+def session(tmp_path):
+    s = FakeSparkSession()
+    s.conf.set(SparkDatasetConverter.PARENT_CACHE_DIR_URL_CONF,
+               "file://" + str(tmp_path / "conv_cache"))
+    yield s
+    _materialized.clear()
+
+
+def _frame(session, n=16, extra=()):
+    cols = {
+        "x": np.arange(n, dtype=np.float64),
+        "y": np.arange(n, dtype=np.int64),
+    }
+    types = {"x": "double", "y": "bigint"}
+    for name in extra:
+        cols[name] = np.ones(n)
+        types[name] = "double"
+    return FakeDataFrame(session, cols, types)
+
+
+# -- converter contract ----------------------------------------------------------------
+
+
+def test_converter_materializes_and_jax_loader_reads_back(session):
+    df = _frame(session)
+    conv = make_spark_converter(df)
+    assert len(conv) == 16
+    loader = conv.make_jax_dataloader(batch_size=8, num_epochs=1,
+                                      shuffle_row_groups=False)
+    rows = []
+    with loader:
+        for batch in loader:
+            rows.extend(np.asarray(batch["y"]).tolist())
+    loader.reader.stop()
+    loader.reader.join()
+    assert sorted(rows) == list(range(16))
+
+
+def test_converter_precision_normalization_casts_doubles(session):
+    df = _frame(session)
+    conv = make_spark_converter(df, dtype="float32")
+    # the cast protocol was exercised on the double column only
+    assert ("x", "float") in df.casts and all(c[0] != "y" for c in df.casts)
+    path = conv.cache_dir_url[len("file://"):]
+    stored = pq.read_table(path)
+    assert stored.schema.field("x").type == pa.float32()
+
+
+def test_converter_cache_hit_and_distinct_plans(session):
+    df = _frame(session)
+    c1 = make_spark_converter(df)
+    c2 = make_spark_converter(_frame(session))  # same logical plan -> cache hit
+    assert c1 is c2
+    c3 = make_spark_converter(_frame(session, extra=("z",)))  # different plan
+    assert c3 is not c1 and c3.cache_dir_url != c1.cache_dir_url
+
+
+def test_converter_delete_removes_dir_and_cache_entry(session):
+    df = _frame(session)
+    conv = make_spark_converter(df)
+    path = conv.cache_dir_url[len("file://"):]
+    assert os.path.isdir(path)
+    conv.delete()
+    assert not os.path.exists(path)
+    conv2 = make_spark_converter(_frame(session))
+    assert conv2 is not conv  # cache entry was forgotten -> re-materialized
+
+
+# -- materialize_dataset contract -------------------------------------------------------
+
+
+def test_materialize_dataset_with_fake_session(tmp_path):
+    from petastorm_tpu import types as ptypes
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.metadata import get_schema, materialize_dataset
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu.unischema import Unischema, UnischemaField, encode_row
+
+    schema = Unischema("M", [
+        UnischemaField("id", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+        UnischemaField("vec", np.float32, (4,), NdarrayCodec(), False),
+    ])
+    session = FakeSparkSession()
+    hadoop = session.sparkContext._jsc.hadoopConfiguration()
+    url = "file://" + str(tmp_path / "mds")
+    rng = np.random.RandomState(0)
+    rows = [{"id": i, "vec": rng.standard_normal(4).astype(np.float32)}
+            for i in range(10)]
+
+    with materialize_dataset(session, url, schema, row_group_size_mb=8):
+        # the ctx sets row-group sizing for executors (restored after)
+        assert hadoop.get("parquet.block.size") == 8 << 20
+        encoded = [encode_row(schema, r) for r in rows]
+        table = pa.table({
+            "id": [e["id"] for e in encoded],
+            "vec": [bytes(e["vec"]) for e in encoded],
+        }, schema=schema.as_arrow_schema())
+        os.makedirs(str(tmp_path / "mds"), exist_ok=True)
+        pq.write_table(table, str(tmp_path / "mds" / "part-0.parquet"))
+    assert hadoop.get("parquet.block.size") is None  # restored
+
+    # _common_metadata landed: schema recoverable, reader round-trips rows
+    from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+
+    fs, path = get_filesystem_and_path_or_paths(url)
+    recovered = get_schema(fs, path)
+    assert list(recovered.fields) == ["id", "vec"]
+    with make_reader(url, num_epochs=1, shuffle_row_groups=False) as reader:
+        got = {row.id: row.vec for row in reader}
+    for r in rows:
+        np.testing.assert_array_almost_equal(got[r["id"]], r["vec"])
+
+
+# -- dataset_as_rdd contract ------------------------------------------------------------
+
+
+def test_dataset_as_rdd_with_fake_session(tmp_path):
+    from test_common import create_test_dataset
+
+    from petastorm_tpu.spark_utils import dataset_as_rdd
+
+    ds = create_test_dataset("file://" + str(tmp_path / "rdd_ds"), num_rows=12)
+    session = FakeSparkSession()
+    rdd = dataset_as_rdd(ds.url, session, schema_fields=["id", "matrix"])
+    rows = rdd.collect()
+    assert len(rows) == 12
+    by_id = {r.id: r for r in rows}
+    for expected in ds.data:
+        np.testing.assert_array_almost_equal(by_id[expected["id"]].matrix,
+                                             expected["matrix"])
+    assert set(rows[0]._fields) == {"id", "matrix"}
+
+
+def test_dict_to_spark_row_requires_pyspark_cleanly():
+    from petastorm_tpu.unischema import dict_to_spark_row
+
+    with pytest.raises(ImportError, match="pyspark"):
+        dict_to_spark_row(None, {})
