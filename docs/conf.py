@@ -1,0 +1,25 @@
+# Sphinx configuration (reference parity: petastorm ships docs/ + readthedocs; this
+# image has no sphinx installed, so the docs build runs on RTD/CI, not locally).
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(".."))
+
+project = "petastorm_tpu"
+author = "petastorm_tpu developers"
+release = "0.2.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+    "sphinx.ext.intersphinx",
+]
+autodoc_mock_imports = ["jax", "jaxlib", "flax", "optax", "cv2", "torch",
+                        "tensorflow", "pyspark"]
+intersphinx_mapping = {
+    "python": ("https://docs.python.org/3", None),
+    "numpy": ("https://numpy.org/doc/stable/", None),
+}
+html_theme = "alabaster"
+master_doc = "index"

@@ -7,8 +7,10 @@ forkserver, which fork-bombs unguarded user scripts) and never forks a threaded 
 ``exec_in_new_process`` bootstrap (petastorm/workers_pool/exec_in_new_process.py ~L20),
 with ``multiprocessing.connection`` replacing ZeroMQ.
 
-Protocol: parent sends the pickled worker once, then items; child answers ("ok", result) or
-("exc", exception); ``None`` item = shut down.
+Protocol: parent sends sys.path, the serializer name, then the pickled worker; then
+items. Child answers ``("ok", kind, nframes)`` followed by ``nframes`` raw frames from
+the wire serializer (pickle-5 out-of-band buffers or Arrow IPC — see
+petastorm_tpu/serializers.py), or ``("exc", exception)``; ``None`` item = shut down.
 """
 import pickle
 import sys
@@ -24,6 +26,9 @@ def main():
         for entry in conn.recv():
             if entry not in sys.path:
                 sys.path.append(entry)
+        from petastorm_tpu.serializers import make_serializer
+
+        serializer = make_serializer(conn.recv())
         worker = conn.recv()
         while True:
             item = conn.recv()
@@ -31,6 +36,7 @@ def main():
                 return
             try:
                 result = worker(item)
+                kind, frames = serializer.serialize(result)
             except Exception as e:  # noqa: BLE001 - ship to parent
                 try:
                     pickle.dumps(e)
@@ -38,7 +44,9 @@ def main():
                 except Exception:  # unpicklable exception: reconstruct
                     conn.send(("exc", RuntimeError("%s: %s" % (type(e).__name__, e))))
                 continue
-            conn.send(("ok", result))
+            conn.send(("ok", kind, len(frames)))
+            for frame in frames:
+                conn.send_bytes(frame)
     except (EOFError, BrokenPipeError, ConnectionResetError):
         return
     finally:

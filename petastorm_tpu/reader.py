@@ -595,7 +595,8 @@ class Reader:
                  cur_shard=None, shard_count=None, shard_seed=None,
                  shuffle_row_drop_partitions=1,
                  reader_pool_type="thread", workers_count=4, results_queue_size=16,
-                 is_batched_reader=False, ngram=None, results_timeout_s=300.0):
+                 is_batched_reader=False, ngram=None, results_timeout_s=300.0,
+                 wire_serializer="pickle"):
         self._fs = filesystem
         self._path = path
         self.schema = schema
@@ -626,7 +627,7 @@ class Reader:
                                with_epoch=True)
         self._num_items = len(items)
         self._pool_args = (reader_pool_type, workers_count, results_queue_size,
-                           results_timeout_s)
+                           results_timeout_s, wire_serializer)
         self._executor = None
         self._results_iter = None
         self._buffer = []
@@ -819,7 +820,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
                 cache_type="null", cache_location=None, cache_size_limit=None,
                 cache_row_size_estimate=None, cache_extra_settings=None,
                 transform_spec=None, filters=None, storage_options=None, filesystem=None,
-                results_timeout_s=300.0, decode_on_device=False):
+                results_timeout_s=300.0, decode_on_device=False, wire_serializer=None):
     """Open a petastorm(-tpu) dataset for per-row decoded reading (reference ~L60).
 
     ``schema_fields`` may be a list of names/regexes/UnischemaFields or an :class:`NGram`.
@@ -870,6 +871,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         reader_pool_type=reader_pool_type, workers_count=workers_count,
         results_queue_size=results_queue_size, is_batched_reader=False, ngram=ngram,
         results_timeout_s=results_timeout_s,
+        wire_serializer=wire_serializer or "pickle",
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields
@@ -883,11 +885,17 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       cache_type="null", cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None, cache_extra_settings=None,
                       transform_spec=None, filters=None, storage_options=None,
-                      filesystem=None, results_timeout_s=300.0, decode_on_device=False):
+                      filesystem=None, results_timeout_s=300.0, decode_on_device=False,
+                      wire_serializer=None):
     """Open ANY Parquet store for vectorized columnar batches (reference ~L200).
 
     ``decode_on_device``: see :func:`make_reader` — device-decodable codec columns come
     back as staging payloads for the DataLoader's batched on-device decode.
+
+    ``wire_serializer``: process-pool result wire format; defaults to ``"arrow"`` here
+    (columnar batches ride Arrow IPC — reference ``ArrowTableSerializer`` parity) and
+    ``"pickle"`` for :func:`make_reader` row payloads. Thread/dummy pools share memory
+    and ignore it.
     """
     fs, path = get_filesystem_and_path_or_paths(
         dataset_url_or_urls, storage_options, filesystem
@@ -924,6 +932,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         reader_pool_type=reader_pool_type, workers_count=workers_count,
         results_queue_size=results_queue_size, is_batched_reader=True,
         results_timeout_s=results_timeout_s,
+        wire_serializer=wire_serializer or "arrow",
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields

@@ -182,10 +182,14 @@ class ProcessExecutor(ExecutorBase):
     """
 
     def __init__(self, workers_count=4, results_queue_size=16, results_timeout_s=300.0,
-                 **_ignored):
+                 serializer="pickle", **_ignored):
         self._workers_count = workers_count
         self._queue_size = results_queue_size
         self._timeout = results_timeout_s
+        self._serializer_name = serializer
+        from petastorm_tpu.serializers import make_serializer
+
+        self._serializer = make_serializer(serializer)
         self._procs = []
         self._conns = []
         self._threads = []
@@ -209,11 +213,18 @@ class ProcessExecutor(ExecutorBase):
         address = os.path.join(self._tmpdir, "sock")
         authkey = os.urandom(32)
         listener = Listener(address, family="AF_UNIX", authkey=authkey)
+        # children must find petastorm_tpu BEFORE the bootstrap handshake can hand them
+        # the parent's sys.path — put the package root on PYTHONPATH explicitly (the
+        # parent may have found it via sys.path.insert, which does not propagate)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        child_pp = os.environ.get("PYTHONPATH", "")
+        child_pp = pkg_root + ((os.pathsep + child_pp) if child_pp else "")
         for _ in range(self._workers_count):
             p = subprocess.Popen(
                 [sys.executable, "-m", "petastorm_tpu._child_worker", address],
                 stdin=subprocess.PIPE,
-                env={**os.environ, "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
+                env={**os.environ, "PYTHONPATH": child_pp,
+                     "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
             )
             p.stdin.write(authkey)
             p.stdin.close()
@@ -258,6 +269,7 @@ class ProcessExecutor(ExecutorBase):
                     raise item
                 conn = item
                 conn.send(list(sys.path))
+                conn.send(self._serializer_name)
                 conn.send(worker)
                 self._conns.append(conn)
         finally:
@@ -280,14 +292,20 @@ class ProcessExecutor(ExecutorBase):
                         break
                 try:
                     conn.send(item)
-                    status, payload = conn.recv()
+                    header = conn.recv()
+                    if header[0] == "exc":
+                        self._put(_ExcResult(header[1]))
+                        break
+                    _, kind, nframes = header
+                    frames = [conn.recv_bytes() for _ in range(nframes)]
+                    result = self._serializer.deserialize(kind, frames)
                 except (EOFError, BrokenPipeError, ConnectionResetError) as e:
                     self._put(_ExcResult(RuntimeError("worker process died: %s" % e)))
                     break
-                if status == "exc":
-                    self._put(_ExcResult(payload))
+                except Exception as e:  # noqa: BLE001 — a bad frame must surface, not
+                    self._put(_ExcResult(e))  # silently truncate the dataset
                     break
-                self._put(payload)
+                self._put(result)
             try:
                 conn.send(None)  # orderly shutdown
             except (BrokenPipeError, OSError):
@@ -354,14 +372,19 @@ class ProcessExecutor(ExecutorBase):
 
 
 def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size=16,
-                  results_timeout_s=300.0):
-    """Factory matching the reference's ``reader_pool_type`` kwarg ('thread'|'process'|'dummy')."""
+                  results_timeout_s=300.0, serializer="pickle"):
+    """Factory matching the reference's ``reader_pool_type`` kwarg ('thread'|'process'|'dummy').
+
+    ``serializer`` ('pickle'|'arrow') selects the process-pool wire format (reference
+    Pickle/ArrowTable serializer parity); thread/dummy pools share memory and ignore it.
+    """
     if reader_pool_type in ("dummy", "sync"):
         return SyncExecutor()
     if reader_pool_type == "thread":
         return ThreadExecutor(workers_count, results_queue_size, results_timeout_s)
     if reader_pool_type == "process":
-        return ProcessExecutor(workers_count, results_queue_size, results_timeout_s)
+        return ProcessExecutor(workers_count, results_queue_size, results_timeout_s,
+                               serializer=serializer)
     raise ValueError(
         "Unknown reader_pool_type %r (expected 'thread', 'process' or 'dummy')"
         % reader_pool_type

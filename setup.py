@@ -9,9 +9,11 @@ from setuptools import find_packages, setup
 
 setup(
     name="petastorm-tpu",
-    version="0.1.0",
+    version="0.2.0",
     description="TPU-native Parquet data-loading framework (Petastorm-class capabilities)",
     packages=find_packages(include=["petastorm_tpu", "petastorm_tpu.*"]),
+    # the native C++ sources ship with the wheel: kernels compile at first use via g++
+    package_data={"petastorm_tpu.ops.native": ["*.cpp"]},
     python_requires=">=3.10",
     install_requires=[
         "numpy",
@@ -25,7 +27,9 @@ setup(
         "opencv": ["opencv-python-headless"],
         "spark": ["pyspark>=3.0"],
         "gcs": ["gcsfs"],
-        "test": ["pytest", "pytest-timeout"],
+        # everything the suite exercises (CI installs .[test])
+        "test": ["pytest", "pytest-timeout", "jax", "flax", "optax", "pandas",
+                 "opencv-python-headless", "torch", "tensorflow"],
     },
     entry_points={
         "console_scripts": [
