@@ -277,6 +277,40 @@ class CompressedImageCodec(DataframeColumnCodec):
         except ValueError:
             return self.decode(unischema_field, encoded)
 
+    def host_stage_decode_batch(self, unischema_field, values):
+        """Sequence of encoded blobs (``None`` entries preserved) → list of staging
+        payloads, one native call per row group when possible.
+
+        The batched stage 1 (petastorm_tpu/ops/jpeg.py ``entropy_decode_jpeg_batch``)
+        entropy-decodes every same-layout stream into stacked buffers in one
+        GIL-released native call; streams it cannot handle (progressive, corrupt,
+        layout differs from the group) fall back to :meth:`host_stage_decode`
+        individually, so the output mixes ``JpegPlanes`` and host-decoded ndarrays
+        exactly like the per-row path."""
+        if not self.device_decodable:
+            raise NotImplementedError("on-device decode is only available for jpeg")
+        idx = [i for i, v in enumerate(values) if v is not None]
+        out = [None] * len(values)
+        if not idx:
+            return out
+        blobs = [bytes(values[i]) for i in idx]
+        planes = None
+        try:
+            from petastorm_tpu.ops.jpeg import entropy_decode_jpeg_batch
+
+            planes = entropy_decode_jpeg_batch(blobs)
+        except (ValueError, RuntimeError):
+            planes = None
+        if planes is None:
+            for i in idx:
+                out[i] = self.host_stage_decode(unischema_field, values[i])
+            return out
+        for j, i in enumerate(idx):
+            p = planes[j]
+            out[i] = p if p is not None \
+                else self.host_stage_decode(unischema_field, blobs[j])
+        return out
+
     def device_decode_batch(self, unischema_field, staged):
         """Coefficient planes (one per row) → (n, ...) uint8 device array, one batched
         Pallas dispatch. Matches :meth:`decode`'s per-row contract: cv2 returns images

@@ -46,13 +46,16 @@ class PipelineStats:
 
     - ``read_s``: producer time blocked on the reader (parquet IO + worker decode)
     - ``batch_s``: producer time re-batching/shuffling host rows
-    - ``decode_s``: consumer time in batched on-device codec decode dispatch
-    - ``h2d_s``: consumer time in ``device_put`` / global-array assembly
-    - ``queue_wait_s``: consumer time starved waiting on the host-batch queue
+    - ``decode_s``: transfer-thread time in batched on-device codec decode dispatch
+    - ``h2d_s``: transfer-thread time in ``device_put`` / global-array assembly
+    - ``queue_wait_s``: transfer-thread time starved waiting on the host-batch queue
+    - ``device_queue_wait_s``: consumer time starved waiting on the device-batch queue
+      (the end-user-visible starvation — nonzero means the pipeline cannot keep the
+      accelerator fed)
     """
 
     __slots__ = ("rows", "batches", "read_s", "batch_s", "decode_s", "h2d_s",
-                 "queue_wait_s")
+                 "queue_wait_s", "device_queue_wait_s")
 
     def __init__(self):
         self.reset()
@@ -65,6 +68,7 @@ class PipelineStats:
         self.decode_s = 0.0
         self.h2d_s = 0.0
         self.queue_wait_s = 0.0
+        self.device_queue_wait_s = 0.0
 
     def snapshot(self):
         return {
@@ -75,6 +79,7 @@ class PipelineStats:
             "decode_s": round(self.decode_s, 4),
             "h2d_s": round(self.h2d_s, 4),
             "queue_wait_s": round(self.queue_wait_s, 4),
+            "device_queue_wait_s": round(self.device_queue_wait_s, 4),
         }
 
 
@@ -269,6 +274,8 @@ class DataLoader:
         self._jitted_transform = None
         self._producer = None
         self._queue = None
+        self._dev_queue = None
+        self._transfer_thread = None
         self._stop = threading.Event()
         self._producer_error = None
         self.stats = PipelineStats()
@@ -305,6 +312,16 @@ class DataLoader:
                 t0 = time.perf_counter()
                 if self._pad_shapes:
                     columns = _pad_ragged_columns(columns, self._pad_shapes)
+                if self._shuffling_queue_capacity:
+                    # rows linger in the shuffling buffer across row groups: staged
+                    # payloads that are views into a row group's stacked buffers must be
+                    # detached or one straggler row pins its whole group's memory
+                    for name in getattr(self.reader, "device_decode_fields", ()):
+                        col = columns.get(name)
+                        if col is not None and col.dtype == object:
+                            for i, v in enumerate(col):
+                                if hasattr(v, "detach"):
+                                    col[i] = v.detach()
                 ready = batcher.add(columns)
                 stats.batch_s += time.perf_counter() - t0
                 for batch in ready:
@@ -326,10 +343,7 @@ class DataLoader:
         except Exception as e:  # noqa: BLE001 — surfaced to consumer thread
             self._producer_error = e
         finally:
-            try:
-                self._queue.put(_SENTINEL, timeout=60)
-            except queue.Full:
-                pass
+            _put_sentinel(self._queue, self._stop)
 
     def _pad(self, batch):
         n = len(next(iter(batch.values()))) if batch else 0
@@ -463,30 +477,68 @@ class DataLoader:
             else:
                 yield from self._host_batches()
             return
-        from collections import deque
+        if self.prefetch <= 0:  # synchronous transfer (debug)
+            for batch in self._host_batches():
+                yield self._to_device(batch)
+            return
+        # Async transfer thread: host batches → decode dispatch + device_put → a small
+        # device-batch queue. Keeping dispatch OFF the consumer thread both overlaps
+        # H2D/decode with the training step and absorbs device-service latency spikes
+        # (a slow dispatch drains the queue instead of stalling the step).
+        dev_q = queue.Queue(maxsize=max(1, self.prefetch))
+        self._dev_queue = dev_q
+        transfer_error = []
 
-        inflight = deque()
-        for batch in self._host_batches():
-            inflight.append(self._to_device(batch))
-            if len(inflight) > max(0, self.prefetch):
-                yield inflight.popleft()
-        while inflight:
-            yield inflight.popleft()
+        def _transfer():
+            try:
+                for batch in self._host_batches():
+                    if self._stop.is_set():
+                        return
+                    dev_q.put(self._to_device(batch))
+            except Exception as e:  # noqa: BLE001 — surfaced to consumer thread
+                transfer_error.append(e)
+            finally:
+                _put_sentinel(dev_q, self._stop)
+
+        self._transfer_thread = threading.Thread(
+            target=_transfer, name="ptpu-transfer", daemon=True)
+        self._transfer_thread.start()
+        stats = self.stats
+        finished = False
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = dev_q.get()
+                stats.device_queue_wait_s += time.perf_counter() - t0
+                if item is _SENTINEL:
+                    finished = True
+                    if transfer_error:
+                        raise transfer_error[0]
+                    return
+                yield item
+        finally:
+            if not finished:
+                # iterator abandoned mid-epoch (break / del): stop the pipeline so the
+                # transfer thread does not keep pinning prefetched device batches
+                self.stop()
 
     # -- lifecycle ----------------------------------------------------------------------
 
     def stop(self):
         self._stop.set()
-        if self._queue is not None:
-            try:  # unblock a producer stuck on a full queue
-                while True:
-                    self._queue.get_nowait()
-            except queue.Empty:
-                pass
+        for q in (self._queue, self._dev_queue):
+            if q is not None:
+                try:  # unblock a producer/transfer thread stuck on a full queue
+                    while True:
+                        q.get_nowait()
+                except queue.Empty:
+                    pass
 
     def join(self):
         if self._producer is not None:
             self._producer.join(timeout=60)
+        if self._transfer_thread is not None:
+            self._transfer_thread.join(timeout=60)
 
     def __enter__(self):
         return self
@@ -496,6 +548,19 @@ class DataLoader:
         self.join()
         self.reader.stop()
         self.reader.join()
+
+
+def _put_sentinel(q, stop_event):
+    """Deliver the end-of-stream sentinel even when the consumer is slow: keep retrying
+    until the put lands or the loader is stopped (a timed-out put must NOT drop the
+    sentinel — the consumer would block forever on an empty queue)."""
+    while True:
+        try:
+            q.put(_SENTINEL, timeout=1)
+            return
+        except queue.Full:
+            if stop_event.is_set():
+                return
 
 
 def _pad_ragged_columns(columns, pad_shapes):

@@ -46,6 +46,26 @@ class JpegPlanes:
     height: int
     width: int
     components: list        # [Y, Cb, Cr] or [Y]
+    #: set by the row-group batched stage 1: ``(coeffs_tuple, qtabs_array, row_index)``
+    #: where each component's ``blocks`` is a zero-copy view into ``coeffs_tuple[c]``.
+    #: Lets :func:`stack_jpeg_coefficients` re-assemble batches by slicing/gathering the
+    #: parent buffers instead of np.stack over per-row objects.
+    batch_ref: tuple | None = None
+
+    def detach(self):
+        """Return an equivalent ``JpegPlanes`` that owns its own coefficient copies.
+
+        A ``batch_ref`` row pins its ENTIRE row group's stacked buffers alive (its
+        blocks are views); long-lived rows — e.g. stragglers in a shuffling buffer that
+        interleaves many row groups — must be detached so host memory scales with rows
+        in flight, not row groups touched."""
+        if self.batch_ref is None:
+            return self
+        comps = [
+            JpegComponent(c.blocks.copy(), c.qtable.copy(), c.h_samp, c.v_samp)
+            for c in self.components
+        ]
+        return JpegPlanes(self.height, self.width, comps, batch_ref=None)
 
 
 class _HuffTable:
@@ -391,6 +411,43 @@ def entropy_decode_jpeg_fast(data):
     return planes
 
 
+def entropy_decode_jpeg_batch(blobs):
+    """Row-group batched stage 1: list of JPEG byte strings → list of :class:`JpegPlanes`
+    (or ``None`` per stream the batch decoder could not handle — caller re-decodes those
+    individually).
+
+    One native call decodes every same-layout stream straight into stacked coefficient
+    buffers (no per-image ctypes overhead, no copies, GIL released throughout); each
+    returned ``JpegPlanes`` holds zero-copy views into those buffers plus a ``batch_ref``
+    so downstream batching can slice the parent arrays directly.
+
+    Raises RuntimeError when the native decoder is unavailable and ValueError when the
+    first stream has no usable baseline layout (callers fall back to the per-image path).
+    """
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        raise RuntimeError("native jpeg decoder unavailable: %s" % native.native_error())
+    layout, coeffs, qtabs, status = native.jpeg_decode_coeffs_batch_native(blobs)
+    height, width, comps_layout = layout
+    if len(comps_layout) not in (1, 3):
+        raise ValueError(
+            "Unsupported JPEG component count %d (expected 1 or 3)" % len(comps_layout)
+        )
+    qtabs = qtabs.astype(np.int32)  # per-image contract dtype (one cast per row group)
+    out = []
+    for i in range(len(blobs)):
+        if status[i] != 0:
+            out.append(None)
+            continue
+        comps = [
+            JpegComponent(coeffs[c][i].reshape(by, bx, 64), qtabs[i, c], h, v)
+            for c, (h, v, by, bx) in enumerate(comps_layout)
+        ]
+        out.append(JpegPlanes(height, width, comps, batch_ref=(coeffs, qtabs, i)))
+    return out
+
+
 # -- batched stage 2 (one device dispatch per image batch) -----------------------------
 
 
@@ -475,8 +532,41 @@ def stack_jpeg_coefficients(planes_list):
     """Stack same-layout :class:`JpegPlanes` into per-component batch arrays.
 
     Returns ``(coeffs, qtabs)``: tuples with one ``(n, by*bx, 64)`` int16 and one
-    ``(n, 64)`` int32 array per component — the host-side staging format the batched
-    device stage consumes."""
+    ``(n, 64)`` int array per component — the host-side staging format the batched
+    device stage consumes.
+
+    Fast path: rows produced by :func:`entropy_decode_jpeg_batch` carry a ``batch_ref``
+    into their row group's stacked buffers; when every row shares one parent, batches
+    are a slice (consecutive rows — zero copy) or one fancy-index gather of the parent
+    instead of an np.stack over hundreds of per-row objects."""
+    ref = planes_list[0].batch_ref
+    if ref is not None:
+        parent_coeffs, parent_qtabs, _ = ref
+        idx = np.empty(len(planes_list), dtype=np.intp)
+        ok = True
+        for j, p in enumerate(planes_list):
+            r = p.batch_ref
+            if r is None or r[0] is not parent_coeffs:
+                ok = False
+                break
+            idx[j] = r[2]
+        if ok:
+            n = len(idx)
+            first = int(idx[0])
+            consecutive = int(idx[-1]) == first + n - 1 and \
+                np.array_equal(idx, np.arange(first, first + n))
+            coeffs = []
+            qtabs = []
+            for c in range(len(planes_list[0].components)):
+                parent = parent_coeffs[c]
+                qt = parent_qtabs[:, c, :]
+                if consecutive:
+                    coeffs.append(parent[first:first + n])
+                    qtabs.append(qt[first:first + n])
+                else:
+                    coeffs.append(parent[idx])
+                    qtabs.append(qt[idx])
+            return tuple(coeffs), tuple(qtabs)
     ncomp = len(planes_list[0].components)
     coeffs = []
     qtabs = []

@@ -192,6 +192,7 @@ enum {
   PTPU_JPEG_BAD_COMPONENTS = -5,
   PTPU_JPEG_NO_SCAN = -6,
   PTPU_JPEG_OOM = -7,
+  PTPU_JPEG_LAYOUT_MISMATCH = -8,
 };
 
 typedef struct {
@@ -205,6 +206,18 @@ typedef struct {
   int16_t* blocks[4];      // malloc'ed: blocks_y*blocks_x*64 int16, natural order
   uint16_t qtables[4][64]; // natural order
 } PtpuJpegCoeffs;
+
+// Decode layout: everything that shapes the stacked coefficient buffers (the batch
+// API requires every stream in a batch to share one).
+typedef struct {
+  int32_t height;
+  int32_t width;
+  int32_t ncomp;
+  int32_t h_samp[4];
+  int32_t v_samp[4];
+  int32_t blocks_y[4];
+  int32_t blocks_x[4];
+} PtpuJpegLayout;
 
 void ptpu_jpeg_free_coeffs(PtpuJpegCoeffs* out) {
   if (!out) return;
@@ -225,11 +238,20 @@ const char* ptpu_jpeg_error_string(int code) {
     case PTPU_JPEG_BAD_COMPONENTS: return "Unsupported component count/sampling";
     case PTPU_JPEG_NO_SCAN: return "No SOS marker found";
     case PTPU_JPEG_OOM: return "Out of memory";
+    case PTPU_JPEG_LAYOUT_MISMATCH:
+      return "JPEG layout differs from the batch layout";
     default: return "Unknown error";
   }
 }
 
-int ptpu_jpeg_decode_coeffs(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out) {
+// Core decoder. When ``dst``/``qdst`` are given (batch mode) the coefficient blocks are
+// written into the caller's buffers (dst[c]: blocks_y*blocks_x*64 int16 each, qdst:
+// ncomp*64 uint16 natural order) after verifying the stream's layout equals ``expect``;
+// nothing is allocated and nothing must be freed. Otherwise blocks are malloc'ed into
+// ``out`` (ptpu_jpeg_free_coeffs frees them).
+static int decode_impl(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out,
+                       const PtpuJpegLayout* expect, int16_t* const* dst,
+                       uint16_t* qdst) {
   memset(out, 0, sizeof(*out));
   if (len < 4 || data[0] != 0xFF || data[1] != 0xD8) return PTPU_JPEG_NOT_JPEG;
 
@@ -406,6 +428,11 @@ int ptpu_jpeg_decode_coeffs(const uint8_t* data, int64_t len, PtpuJpegCoeffs* ou
       out->height = height;
       out->width = width;
       out->ncomp = ncomp;
+      if (expect && (height != expect->height || width != expect->width ||
+                     ncomp != expect->ncomp)) {
+        rc = PTPU_JPEG_LAYOUT_MISMATCH;
+        goto done;
+      }
       for (int c = 0; c < ncomp; c++) {
         int bx = mcus_x * comps[c].h;
         int by = mcus_y * comps[c].v;
@@ -413,14 +440,25 @@ int ptpu_jpeg_decode_coeffs(const uint8_t* data, int64_t len, PtpuJpegCoeffs* ou
         out->v_samp[c] = comps[c].v;
         out->blocks_y[c] = by;
         out->blocks_x[c] = bx;
-        out->blocks[c] = (int16_t*)calloc((size_t)by * bx * 64, sizeof(int16_t));
-        if (!out->blocks[c]) {
-          rc = PTPU_JPEG_OOM;
+        if (expect && (comps[c].h != expect->h_samp[c] || comps[c].v != expect->v_samp[c] ||
+                       by != expect->blocks_y[c] || bx != expect->blocks_x[c])) {
+          rc = PTPU_JPEG_LAYOUT_MISMATCH;
           goto done;
         }
+        if (dst) {
+          out->blocks[c] = dst[c];
+          memset(dst[c], 0, (size_t)by * bx * 64 * sizeof(int16_t));
+        } else {
+          out->blocks[c] = (int16_t*)calloc((size_t)by * bx * 64, sizeof(int16_t));
+          if (!out->blocks[c]) {
+            rc = PTPU_JPEG_OOM;
+            goto done;
+          }
+        }
         const int32_t* zz = qt_zz[comps[c].tq];
+        uint16_t* qout = qdst ? qdst + (size_t)c * 64 : out->qtables[c];
         for (int k = 0; k < 64; k++)
-          out->qtables[c][kZigzagToNatural[k]] = (uint16_t)zz[k];
+          qout[kZigzagToNatural[k]] = (uint16_t)zz[k];
       }
 
       BitReader br;
@@ -497,8 +535,99 @@ int ptpu_jpeg_decode_coeffs(const uint8_t* data, int64_t len, PtpuJpegCoeffs* ou
   }
 
 done:
-  if (rc != PTPU_JPEG_OK) ptpu_jpeg_free_coeffs(out);
+  if (rc != PTPU_JPEG_OK && !dst) ptpu_jpeg_free_coeffs(out);
   return rc;
+}
+
+int ptpu_jpeg_decode_coeffs(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out) {
+  return decode_impl(data, len, out, nullptr, nullptr, nullptr);
+}
+
+// Parse only as far as the frame header; fills the decode layout without touching the
+// entropy-coded scan. Used by the batch API to size the stacked buffers.
+int ptpu_jpeg_parse_layout(const uint8_t* data, int64_t len, PtpuJpegLayout* out) {
+  memset(out, 0, sizeof(*out));
+  if (len < 4 || data[0] != 0xFF || data[1] != 0xD8) return PTPU_JPEG_NOT_JPEG;
+  int64_t pos = 2;
+  while (pos < len) {
+    if (data[pos] != 0xFF) {
+      pos++;
+      continue;
+    }
+    if (pos + 1 >= len) break;
+    uint8_t marker = data[pos + 1];
+    pos += 2;
+    if (marker == 0xD8 || marker == 0x01 || (marker >= 0xD0 && marker <= 0xD7)) continue;
+    if (marker == 0xD9) break;
+    if (pos + 2 > len) return PTPU_JPEG_CORRUPT;
+    int seglen = be16(data + pos);
+    if (seglen < 2 || pos + seglen > len) return PTPU_JPEG_CORRUPT;
+    const uint8_t* seg = data + pos + 2;
+    int segbytes = seglen - 2;
+    if (marker == 0xC0 || marker == 0xC1) {
+      if (segbytes < 6) return PTPU_JPEG_CORRUPT;
+      if (seg[0] != 8) return PTPU_JPEG_NOT_8BIT;
+      out->height = be16(seg + 1);
+      out->width = be16(seg + 3);
+      out->ncomp = seg[5];
+      if (out->ncomp < 1 || out->ncomp > 4 || segbytes < 6 + 3 * out->ncomp)
+        return PTPU_JPEG_BAD_COMPONENTS;
+      int hmax = 1, vmax = 1;
+      for (int i = 0; i < out->ncomp; i++) {
+        out->h_samp[i] = seg[7 + 3 * i] >> 4;
+        out->v_samp[i] = seg[7 + 3 * i] & 0xF;
+        if (out->h_samp[i] < 1 || out->h_samp[i] > 4 || out->v_samp[i] < 1 ||
+            out->v_samp[i] > 4)
+          return PTPU_JPEG_BAD_COMPONENTS;
+        if (out->h_samp[i] > hmax) hmax = out->h_samp[i];
+        if (out->v_samp[i] > vmax) vmax = out->v_samp[i];
+      }
+      int mcus_x = (out->width + 8 * hmax - 1) / (8 * hmax);
+      int mcus_y = (out->height + 8 * vmax - 1) / (8 * vmax);
+      for (int i = 0; i < out->ncomp; i++) {
+        out->blocks_x[i] = mcus_x * out->h_samp[i];
+        out->blocks_y[i] = mcus_y * out->v_samp[i];
+      }
+      return PTPU_JPEG_OK;
+    }
+    if (marker == 0xC2 || marker == 0xC3 || marker == 0xC5 || marker == 0xC6 ||
+        marker == 0xC7 || marker == 0xC9 || marker == 0xCA || marker == 0xCB ||
+        marker == 0xCD || marker == 0xCE || marker == 0xCF)
+      return PTPU_JPEG_UNSUPPORTED_MODE;
+    pos += seglen;
+  }
+  return PTPU_JPEG_NO_SCAN;
+}
+
+// Batched decode: n streams (stream i = datas[i][0..lens[i])), all expected to share
+// ``expect``'s layout, written into caller-allocated stacked buffers:
+//   out_blocks[c] : (n, blocks_y[c]*blocks_x[c], 64) int16, C-contiguous
+//   out_qtabs     : (n, ncomp, 64) uint16, natural order
+// status[i] = PTPU_JPEG_OK or the stream's error code (its slice is left zeroed; the
+// caller re-decodes failed rows individually). Returns the number of failed streams.
+// One call decodes a whole row group with the GIL released.
+int ptpu_jpeg_decode_batch(const uint8_t* const* datas, const int64_t* lens, int32_t n,
+                           const PtpuJpegLayout* expect, int16_t* const* out_blocks,
+                           uint16_t* out_qtabs, int32_t* status) {
+  size_t stride[4];
+  for (int c = 0; c < expect->ncomp && c < 4; c++)
+    stride[c] = (size_t)expect->blocks_y[c] * expect->blocks_x[c] * 64;
+  int failures = 0;
+  for (int32_t i = 0; i < n; i++) {
+    int16_t* dst[4] = {nullptr, nullptr, nullptr, nullptr};
+    for (int c = 0; c < expect->ncomp && c < 4; c++)
+      dst[c] = out_blocks[c] + (size_t)i * stride[c];
+    PtpuJpegCoeffs tmp;
+    int rc = decode_impl(datas[i], lens[i], &tmp, expect, dst,
+                         out_qtabs + (size_t)i * expect->ncomp * 64);
+    status[i] = rc;
+    if (rc != PTPU_JPEG_OK) {
+      failures++;
+      for (int c = 0; c < expect->ncomp && c < 4; c++)
+        memset(dst[c], 0, stride[c] * sizeof(int16_t));
+    }
+  }
+  return failures;
 }
 
 }  // extern "C"

@@ -316,11 +316,15 @@ def _column_to_numpy(table, name, schema, device_fields=()):
         if name in device_fields:
             from petastorm_tpu.utils import stack_as_column
 
-            return stack_as_column(
-                [field.codec.host_stage_decode(field, v) if v is not None else None
-                 for v in values],
-                force_object=True,
-            )
+            batch_stage = getattr(field.codec, "host_stage_decode_batch", None)
+            if batch_stage is not None:
+                # one native call stages the whole row group (stacked coefficient
+                # buffers; per-row payloads are zero-copy views into them)
+                staged = batch_stage(field, values)
+            else:
+                staged = [field.codec.host_stage_decode(field, v) if v is not None
+                          else None for v in values]
+            return stack_as_column(staged, force_object=True)
         np_dtype = np.dtype(field.numpy_dtype)
         shape_known = field.shape and all(d is not None for d in field.shape)
         if shape_known and np_dtype.kind in "biufc" \

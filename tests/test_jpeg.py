@@ -232,3 +232,116 @@ def test_progressive_jpeg_rejected():
 def test_not_a_jpeg_rejected():
     with pytest.raises(ValueError, match="SOI"):
         entropy_decode_jpeg(b"\x00\x01\x02")
+
+
+def test_rowgroup_batched_stage1_matches_per_image():
+    """entropy_decode_jpeg_batch: one native call over a row group must produce planes
+    identical to the per-image path, with zero-copy views carrying a batch_ref."""
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    from petastorm_tpu.ops.jpeg import (entropy_decode_jpeg_batch,
+                                        entropy_decode_jpeg_fast)
+
+    rng = np.random.RandomState(21)
+    blobs = []
+    for quality in (75, 85, 95, 90):
+        ok, enc = cv2.imencode(".jpg", rng.randint(0, 256, (48, 64, 3), dtype=np.uint8),
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+        assert ok
+        blobs.append(enc.tobytes())
+    batch = entropy_decode_jpeg_batch(blobs)
+    assert all(p is not None for p in batch)
+    for p, blob in zip(batch, blobs):
+        ref = entropy_decode_jpeg_fast(blob)
+        assert (p.height, p.width) == (ref.height, ref.width)
+        assert p.batch_ref is not None
+        for pc, rc in zip(p.components, ref.components):
+            assert (pc.h_samp, pc.v_samp) == (rc.h_samp, rc.v_samp)
+            np.testing.assert_array_equal(pc.blocks, rc.blocks)
+            np.testing.assert_array_equal(pc.qtable, rc.qtable)
+            # views into the stacked parent, not copies
+            assert pc.blocks.base is not None
+
+
+def test_rowgroup_batched_stage1_bad_rows_are_none():
+    """A corrupt stream or a layout-mismatched stream mid-batch yields None at that
+    position; every other stream still decodes."""
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    from petastorm_tpu.ops.jpeg import entropy_decode_jpeg_batch
+
+    rng = np.random.RandomState(22)
+    ok, enc = cv2.imencode(".jpg", rng.randint(0, 256, (32, 48, 3), dtype=np.uint8),
+                           [cv2.IMWRITE_JPEG_QUALITY, 90])
+    good = enc.tobytes()
+    ok, enc = cv2.imencode(".jpg", rng.randint(0, 256, (64, 48, 3), dtype=np.uint8),
+                           [cv2.IMWRITE_JPEG_QUALITY, 90])
+    other_layout = enc.tobytes()
+    batch = entropy_decode_jpeg_batch([good, good[:40], other_layout, good])
+    assert batch[0] is not None and batch[3] is not None
+    assert batch[1] is None  # truncated
+    assert batch[2] is None  # layout differs from the batch layout
+    np.testing.assert_array_equal(batch[0].components[0].blocks,
+                                  batch[3].components[0].blocks)
+
+
+def test_stack_jpeg_coefficients_view_fast_path():
+    """Batch-ref rows must stack via parent slicing/gather, equal to np.stack of the
+    per-row objects, for consecutive, shuffled, and mixed-parent inputs."""
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    from petastorm_tpu.ops.jpeg import (entropy_decode_jpeg_batch,
+                                        entropy_decode_jpeg_fast,
+                                        stack_jpeg_coefficients)
+
+    rng = np.random.RandomState(23)
+    blobs = []
+    for _ in range(6):
+        ok, enc = cv2.imencode(".jpg", rng.randint(0, 256, (32, 32, 3), dtype=np.uint8),
+                               [cv2.IMWRITE_JPEG_QUALITY, 88])
+        blobs.append(enc.tobytes())
+    batch = entropy_decode_jpeg_batch(blobs)
+    ref_rows = [entropy_decode_jpeg_fast(b) for b in blobs]
+
+    for pick in ([0, 1, 2, 3], [4, 1, 5, 0]):  # consecutive slice; shuffled gather
+        got_c, got_q = stack_jpeg_coefficients([batch[i] for i in pick])
+        exp_c, exp_q = stack_jpeg_coefficients([ref_rows[i] for i in pick])
+        for g, e in zip(got_c, exp_c):
+            np.testing.assert_array_equal(g, e)
+        for g, e in zip(got_q, exp_q):
+            np.testing.assert_array_equal(g, e)
+    # mixed parents (rows from two row groups) falls back to np.stack and still matches
+    batch2 = entropy_decode_jpeg_batch(blobs[:3])
+    got_c, got_q = stack_jpeg_coefficients([batch[5], batch2[1]])
+    exp_c, exp_q = stack_jpeg_coefficients([ref_rows[5], ref_rows[1]])
+    for g, e in zip(got_c, exp_c):
+        np.testing.assert_array_equal(g, e)
+
+
+def test_codec_host_stage_decode_batch_contract():
+    """CompressedImageCodec.host_stage_decode_batch: Nones preserved, undecodable rows
+    come back as host-decoded ndarrays, good rows as JpegPlanes."""
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.ops.jpeg import JpegPlanes
+    from petastorm_tpu.unischema import UnischemaField
+
+    rng = np.random.RandomState(24)
+    codec = CompressedImageCodec("jpeg", 90)
+    field = UnischemaField("image", np.uint8, (32, 48, 3), codec, False)
+    img = rng.randint(0, 256, (32, 48, 3), dtype=np.uint8)
+    blob = bytes(codec.encode(field, img))
+    ok, enc = cv2.imencode(".jpg", rng.randint(0, 256, (32, 48, 3), dtype=np.uint8),
+                           [cv2.IMWRITE_JPEG_QUALITY, 90, cv2.IMWRITE_JPEG_PROGRESSIVE, 1])
+    progressive = enc.tobytes()
+
+    out = codec.host_stage_decode_batch(field, [blob, None, progressive, blob])
+    assert isinstance(out[0], (JpegPlanes, np.ndarray))
+    assert out[1] is None
+    assert isinstance(out[2], np.ndarray)  # progressive -> host cv2 fallback
+    assert out[2].shape == (32, 48, 3)
