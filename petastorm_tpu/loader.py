@@ -528,10 +528,14 @@ class DataLoader:
         self._stop.set()
         for q in (self._queue, self._dev_queue):
             if q is not None:
-                try:  # unblock a producer/transfer thread stuck on a full queue
+                # unblock a producer/transfer thread stuck on a full queue. Catches
+                # Exception rather than queue.Empty: stop() can run from a generator
+                # finalizer during interpreter shutdown, when the queue module's
+                # globals (incl. Empty) may already be torn down to None.
+                try:
                     while True:
                         q.get_nowait()
-                except queue.Empty:
+                except Exception:  # noqa: BLE001
                     pass
 
     def join(self):
@@ -554,11 +558,12 @@ def _put_sentinel(q, stop_event):
     """Deliver the end-of-stream sentinel even when the consumer is slow: keep retrying
     until the put lands or the loader is stopped (a timed-out put must NOT drop the
     sentinel — the consumer would block forever on an empty queue)."""
+    full = queue.Full  # bound early: may run during interpreter teardown
     while True:
         try:
             q.put(_SENTINEL, timeout=1)
             return
-        except queue.Full:
+        except full:
             if stop_event.is_set():
                 return
 

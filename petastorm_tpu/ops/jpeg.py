@@ -67,6 +67,12 @@ class JpegPlanes:
         ]
         return JpegPlanes(self.height, self.width, comps, batch_ref=None)
 
+    def __reduce__(self):
+        # pickle (process-pool IPC, disk cache) must ship ONLY this row: the default
+        # reduce would serialize batch_ref's entire row-group buffers per row
+        d = self.detach()
+        return (JpegPlanes, (d.height, d.width, d.components, None))
+
 
 class _HuffTable:
     __slots__ = ("lookup", "max_len")

@@ -214,7 +214,20 @@ class PyDictWorker(_WorkerBase):
         decode_view = self._stored_schema.create_schema_view(
             [c for c in table.column_names if c in self._stored_schema.fields]
         )
-        return [decode_row(r, decode_view, self._device_fields) for r in stored_rows]
+        staged = {}
+        for name in self._device_fields:
+            # whole-row-group batched stage 1 (one native call), same as the batch path;
+            # decode_row then just picks up each row's pre-staged payload
+            field = decode_view.fields.get(name)
+            batch_stage = getattr(field.codec, "host_stage_decode_batch", None) \
+                if field is not None else None
+            if batch_stage is not None:
+                staged[name] = batch_stage(field, [r.get(name) for r in stored_rows])
+        rows = []
+        for i, r in enumerate(stored_rows):
+            prestaged = {name: col[i] for name, col in staged.items()}
+            rows.append(decode_row(r, decode_view, self._device_fields, prestaged))
+        return rows
 
     def _form_ngram_dicts(self, rows):
         schema = self._ngram_schema if self._ngram_schema is not None else self._read_schema

@@ -6,7 +6,7 @@ import numpy as np
 from petastorm_tpu.errors import DecodeFieldError
 
 
-def decode_row(row, schema, device_fields=()):
+def decode_row(row, schema, device_fields=(), prestaged=None):
     """Decode one stored row dict through codecs into a {field: numpy value} dict.
 
     Mirrors the reference decode driver (petastorm/utils.py ~L80): codec dispatch plus nullable
@@ -15,6 +15,8 @@ def decode_row(row, schema, device_fields=()):
     Fields named in ``device_fields`` run only the HOST half of their codec's two-stage
     decode (``host_stage_decode``): the row carries a staging object (e.g. JPEG DCT
     coefficient planes) that the JAX loader finishes on device in one batched dispatch.
+    ``prestaged`` supplies this row's already-staged payloads for device fields the
+    caller batch-decoded at the row-group level (one native call for the whole group).
     """
     decoded = {}
     for name, field in schema.fields.items():
@@ -28,7 +30,10 @@ def decode_row(row, schema, device_fields=()):
         elif field.codec is not None:
             try:
                 if name in device_fields:
-                    decoded[name] = field.codec.host_stage_decode(field, value)
+                    if prestaged is not None and name in prestaged:
+                        decoded[name] = prestaged[name]
+                    else:
+                        decoded[name] = field.codec.host_stage_decode(field, value)
                 else:
                     decoded[name] = field.codec.decode(field, value)
             except Exception as e:  # noqa: BLE001 - annotate and rethrow

@@ -193,3 +193,47 @@ def test_ragged_pad_max_exceeded_raises(tmp_path):
     with pytest.raises(ValueError, match="exceeding declared pad max"):
         with DataLoader(reader, batch_size=8, pad_shapes={"vec": (4,)}) as loader:
             list(loader)
+
+
+def test_transfer_error_propagates_to_device_consumer(scalar_dataset):
+    """Errors raised on the transfer thread (decode/device_put) must surface in the
+    consumer, not deadlock it — the sentinel is delivered even after the failure."""
+    reader = make_batch_reader(scalar_dataset.url)
+    loader = DataLoader(reader, batch_size=4, prefetch=2,
+                        device_transform=lambda batch: 1 / 0)
+    with loader, pytest.raises(ZeroDivisionError):
+        for _ in loader:
+            pass
+
+
+def test_abandoned_iterator_stops_pipeline(scalar_dataset):
+    """Breaking out of iteration mid-epoch must stop the producer and transfer threads
+    (prefetched device batches would otherwise stay pinned for the process lifetime)."""
+    import time
+
+    reader = make_batch_reader(scalar_dataset.url, num_epochs=None)
+    loader = DataLoader(reader, batch_size=4, prefetch=2)
+    it = iter(loader)
+    next(it)
+    del it
+    deadline = time.time() + 10
+    while time.time() < deadline and (
+            loader._transfer_thread.is_alive() or loader._producer.is_alive()):
+        time.sleep(0.05)
+    assert not loader._transfer_thread.is_alive()
+    assert not loader._producer.is_alive()
+    reader.stop()
+    reader.join()
+
+
+def test_stats_populate_through_device_path(scalar_dataset):
+    reader = make_batch_reader(scalar_dataset.url)
+    loader = DataLoader(reader, batch_size=8, prefetch=2)
+    with loader:
+        n = sum(1 for _ in loader)
+    snap = loader.stats.snapshot()
+    assert snap["batches"] == n > 0
+    assert snap["rows"] == n * 8
+    assert set(snap) == {"rows", "batches", "read_s", "batch_s", "decode_s", "h2d_s",
+                         "queue_wait_s", "device_queue_wait_s"}
+    assert snap["read_s"] >= 0 and snap["device_queue_wait_s"] >= 0
