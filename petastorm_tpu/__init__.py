@@ -45,6 +45,10 @@ def __getattr__(name):
             from petastorm_tpu.loader import DataLoader
 
             return DataLoader
+        if name == "InMemDataLoader":
+            from petastorm_tpu.loader import InMemDataLoader
+
+            return InMemDataLoader
     except ImportError as e:
         raise AttributeError(
             "petastorm_tpu.%s is unavailable (%s)" % (name, e)

@@ -646,6 +646,114 @@ def _matching_sharding(sharding, arr):
     return sharding
 
 
+class InMemDataLoader:
+    """Epochs served entirely from device memory: load the dataset (or its shard) to
+    HBM ONCE, then every batch is a single on-device permutation gather — zero host
+    work, zero H2D after the fill.
+
+    TPU-native analog of the reference's ``InMemBatchedDataLoader``
+    (petastorm/pytorch.py ~L380), which re-collates host tensors per epoch; here the
+    shuffle itself runs on device (one fused ``take`` per column), so epoch iteration
+    costs no host CPU and no transfer — the right shape for small/medium datasets
+    (MNIST-scale fine-tuning, eval sets) on big accelerators.
+
+    Parameters
+    ----------
+    reader : Reader
+        Source reader; consumed ONCE during construction (its ``num_epochs`` should be
+        1). Device-decode staging columns are finished on device during the fill.
+    batch_size : int
+        Rows per yielded batch.
+    num_epochs : int or None
+        Epochs to serve; ``None`` = infinite.
+    shuffle : bool
+        Reshuffle every epoch with a fresh fold of ``seed`` (deterministic).
+    sharding : jax.sharding.Sharding, optional
+        Layout for the resident store AND the yielded batches (e.g. batch axis over a
+        ``dp`` mesh axis).
+    last_batch : {"drop", "partial"}
+        Remainder policy per epoch (``pad`` is pointless here — resize the store).
+    """
+
+    def __init__(self, reader, batch_size, num_epochs=1, shuffle=True, seed=0,
+                 sharding=None, last_batch="drop", device_transform=None):
+        if last_batch not in ("drop", "partial"):
+            raise ValueError("last_batch must be drop|partial, got %r" % last_batch)
+        import jax
+        import jax.numpy as jnp
+
+        self.batch_size = int(batch_size)
+        self.num_epochs = num_epochs
+        self.shuffle = shuffle
+        self.last_batch = last_batch
+        self._seed = int(seed)
+        self._device_transform = device_transform
+        self._jitted_transform = None
+        # fill: reuse the streaming DataLoader (handles staged on-device decode and the
+        # sharding layout), then concatenate the chunks on device
+        chunks = []
+        dropped = set()
+        with DataLoader(reader, self.batch_size, sharding=sharding,
+                        last_batch="partial", prefetch=2) as fill:
+            for batch in fill:
+                kept = {}
+                for k, v in batch.items():
+                    # host-only columns (strings/objects) cannot live in HBM — dropped
+                    if isinstance(v, np.ndarray) and not _is_device_dtype(v):
+                        dropped.add(k)
+                    else:
+                        kept[k] = v
+                chunks.append(kept)
+        if dropped:
+            logger.warning("InMemDataLoader dropped host-only fields: %s",
+                           sorted(dropped))
+        if not chunks:
+            raise ValueError("reader yielded no rows to load in memory")
+        self._store = {
+            k: jnp.concatenate([jnp.asarray(c[k]) for c in chunks], axis=0)
+            for k in chunks[0]
+        }
+        self.rows = int(next(iter(self._store.values())).shape[0])
+
+        def _gather(store, idx):
+            return {k: v[idx] for k, v in store.items()}
+
+        self._gather = jax.jit(_gather)
+
+    def __len__(self):
+        full, rem = divmod(self.rows, self.batch_size)
+        return full + (1 if rem and self.last_batch == "partial" else 0)
+
+    def __iter__(self):
+        import jax
+        import jax.numpy as jnp
+
+        epoch = 0
+        while self.num_epochs is None or epoch < self.num_epochs:
+            if self.shuffle:
+                key = jax.random.fold_in(jax.random.PRNGKey(self._seed), epoch)
+                perm = jax.random.permutation(key, self.rows)
+            else:
+                perm = jnp.arange(self.rows)
+            for start in range(0, self.rows, self.batch_size):
+                idx = perm[start:start + self.batch_size]
+                if len(idx) < self.batch_size and self.last_batch == "drop":
+                    break
+                batch = self._gather(self._store, idx)
+                if self._device_transform is not None:
+                    if self._jitted_transform is None:
+                        self._jitted_transform = jax.jit(self._device_transform)
+                    batch = self._jitted_transform(batch)
+                yield batch
+            epoch += 1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._store = None  # release HBM
+
+
 def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1,
                     shuffling_queue_capacity=0, reader_factory=None, **reader_kwargs):
     """One-call convenience: ``make_batch_reader`` + :class:`DataLoader`.
