@@ -16,17 +16,38 @@ def main(argv=None):
     parser.add_argument("--schema-fields", nargs="*", default=None)
     parser.add_argument("--warmup-rows", type=int, default=1000)
     parser.add_argument("--measure-rows", type=int, default=10000)
+    parser.add_argument("--loader", action="store_true",
+                        help="measure through the JAX DataLoader (device feed + stage "
+                             "counters + device-idle estimate) instead of the bare reader")
+    parser.add_argument("--decode-on-device", action="store_true",
+                        help="two-stage JPEG decode (requires --loader for the device half)")
+    parser.add_argument("--loader-batch-size", type=int, default=256)
     args = parser.parse_args(argv)
 
     from petastorm_tpu.benchmark.throughput import reader_throughput
     from petastorm_tpu.reader import make_batch_reader, make_reader
 
     factory = make_batch_reader if args.batch else make_reader
+    kwargs = {}
+    if args.decode_on_device:
+        kwargs["decode_on_device"] = True
     reader = factory(args.dataset_url, schema_fields=args.schema_fields,
                      reader_pool_type=args.pool_type, workers_count=args.workers_count,
-                     num_epochs=None)
+                     num_epochs=None, **kwargs)
     try:
-        result = reader_throughput(reader, args.warmup_rows, args.measure_rows)
+        if args.loader:
+            from petastorm_tpu.benchmark.throughput import loader_throughput
+            from petastorm_tpu.loader import DataLoader
+
+            loader = DataLoader(reader, args.loader_batch_size)
+            bs = args.loader_batch_size
+            result = loader_throughput(
+                loader,
+                warmup_batches=max(1, args.warmup_rows // bs),
+                measure_batches=max(1, args.measure_rows // bs),
+            )
+        else:
+            result = reader_throughput(reader, args.warmup_rows, args.measure_rows)
         print(result)
     finally:
         reader.stop()

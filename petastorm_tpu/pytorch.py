@@ -1,0 +1,12 @@
+"""Migration alias: the reference exposes its torch adapters as ``petastorm.pytorch``
+(petastorm/pytorch.py); users switching frameworks keep their import path —
+``from petastorm_tpu.pytorch import DataLoader, BatchedDataLoader``.
+
+Canonical home: :mod:`petastorm_tpu.adapters.pytorch`.
+"""
+from petastorm_tpu.adapters.pytorch import (  # noqa: F401
+    BatchedDataLoader,
+    DataLoader,
+    InMemBatchedDataLoader,
+    decimal_friendly_collate,
+)

@@ -1,0 +1,10 @@
+"""Migration alias: the reference exposes its TF adapters as ``petastorm.tf_utils``
+(petastorm/tf_utils.py); users switching frameworks keep their import path —
+``from petastorm_tpu.tf_utils import make_petastorm_dataset, tf_tensors``.
+
+Canonical home: :mod:`petastorm_tpu.adapters.tf`.
+"""
+from petastorm_tpu.adapters.tf import (  # noqa: F401
+    make_petastorm_dataset,
+    tf_tensors,
+)

@@ -114,3 +114,27 @@ def test_process_executor_infinite_plan_bounded():
     assert all(v in (1, 4) for v in got)
     ex.stop()
     ex.join()
+
+
+def _slow_square(x):
+    time.sleep(0.3)
+    return x * x
+
+
+def test_process_child_killed_mid_run_surfaces_cleanly():
+    """A worker process dying mid-task (OOM-kill, segfault) must surface as a clean
+    'worker process died' error at results(), never hang the consumer (SURVEY §6:
+    failure detection — the reference propagates worker exceptions but a silently
+    killed zmq worker hangs it until the results timeout)."""
+    import os
+    import signal
+
+    ex = ProcessExecutor(workers_count=2, results_queue_size=4, results_timeout_s=60)
+    ex.start(_slow_square, EpochPlan(list(range(40)), num_epochs=1))
+    time.sleep(1.0)  # children connected and mid-task
+    os.kill(ex._procs[0].pid, signal.SIGKILL)
+    with pytest.raises(RuntimeError, match="worker process died"):
+        for _ in ex.results():
+            pass
+    ex.stop()
+    ex.join()
