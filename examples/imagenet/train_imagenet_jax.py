@@ -37,6 +37,9 @@ def main():
     parser.add_argument("--num-classes", type=int, default=1000)
     parser.add_argument("--host-decode", action="store_true",
                         help="disable the two-stage on-device JPEG decode (baseline)")
+    parser.add_argument("--augment", action="store_true",
+                        help="on-device random crop (stored size must exceed 224) + "
+                             "horizontal flip, keyed per batch by the loader")
     args = parser.parse_args()
 
     mesh = make_mesh()  # all local devices on a 'dp' axis
@@ -64,6 +67,19 @@ def main():
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_stats, opt_state, loss
 
+    device_transform = None
+    if args.augment:
+        from petastorm_tpu.ops.image import random_crop
+
+        def device_transform(batch, key):
+            img = batch["image"]
+            kc, kf = jax.random.split(key)
+            if img.shape[1] > 224 and img.shape[2] > 224:
+                img = random_crop(img, kc, 224, 224)
+            flips = jax.random.bernoulli(kf, 0.5, (img.shape[0],))
+            img = jnp.where(flips[:, None, None, None], img[:, :, ::-1, :], img)
+            return {**batch, "image": img}
+
     reader = make_batch_reader(
         args.dataset_url, workers_count=args.workers, num_epochs=None,
         shuffle_row_groups=True, decode_on_device=not args.host_decode,
@@ -71,7 +87,8 @@ def main():
     )
     step = 0
     t0 = time.time()
-    with DataLoader(reader, args.batch_size, sharding=sharding) as loader:
+    with DataLoader(reader, args.batch_size, sharding=sharding,
+                    device_transform=device_transform) as loader:
         for batch in loader:
             params, batch_stats, opt_state, loss = train_step(
                 params, batch_stats, opt_state, batch["image"],

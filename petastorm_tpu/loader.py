@@ -233,7 +233,9 @@ class DataLoader:
     device_transform : callable, optional
         Jittable ``fn(batch) -> batch`` applied on device after transfer (augment/normalize —
         XLA fuses it into the step). Defaults to ``reader.transform_spec`` when that was
-        declared ``device=True``.
+        declared ``device=True``. A two-argument ``fn(batch, key) -> batch`` receives a
+        fresh ``jax.random`` key per batch (folded from ``seed`` and a batch counter) —
+        the hook for random augmentation (crop/flip) on device.
     prefetch : int
         Device batches kept in flight (double/triple buffering). 0 disables (debug).
     to_device : bool
@@ -272,6 +274,8 @@ class DataLoader:
             if spec is not None and getattr(spec, "device", False) and spec.func is not None:
                 self._device_transform = spec.func
         self._jitted_transform = None
+        self._transform_takes_key = False
+        self._transform_step = 0
         self._producer = None
         self._queue = None
         self._dev_queue = None
@@ -458,10 +462,24 @@ class DataLoader:
         self.stats.h2d_s += time.perf_counter() - t0
         if self._device_transform is not None:
             if self._jitted_transform is None:
+                import inspect
+
                 import jax as _jax
 
+                try:
+                    n_params = len(inspect.signature(
+                        self._device_transform).parameters)
+                except (TypeError, ValueError):
+                    n_params = 1
+                self._transform_takes_key = n_params >= 2
                 self._jitted_transform = _jax.jit(self._device_transform)
-            arrays = self._jitted_transform(arrays)
+            if self._transform_takes_key:
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(self._seed or 0), self._transform_step)
+                self._transform_step += 1
+                arrays = self._jitted_transform(arrays, key)
+            else:
+                arrays = self._jitted_transform(arrays)
         arrays.update(host)
         return arrays
 
@@ -729,6 +747,16 @@ class InMemDataLoader:
         import jax.numpy as jnp
 
         epoch = 0
+        step = 0
+        takes_key = False
+        if self._device_transform is not None:
+            import inspect
+
+            try:
+                takes_key = len(inspect.signature(
+                    self._device_transform).parameters) >= 2
+            except (TypeError, ValueError):
+                takes_key = False
         while self.num_epochs is None or epoch < self.num_epochs:
             if self.shuffle:
                 key = jax.random.fold_in(jax.random.PRNGKey(self._seed), epoch)
@@ -743,7 +771,13 @@ class InMemDataLoader:
                 if self._device_transform is not None:
                     if self._jitted_transform is None:
                         self._jitted_transform = jax.jit(self._device_transform)
-                    batch = self._jitted_transform(batch)
+                    if takes_key:
+                        tkey = jax.random.fold_in(
+                            jax.random.PRNGKey(self._seed + 1), step)
+                        batch = self._jitted_transform(batch, tkey)
+                    else:
+                        batch = self._jitted_transform(batch)
+                step += 1
                 yield batch
             epoch += 1
 

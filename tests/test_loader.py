@@ -279,3 +279,24 @@ def test_inmem_loader_drop_and_transform(scalar_dataset):
     for b in batches:
         assert b["id"].shape[0] == 7
         np.testing.assert_array_equal(np.asarray(b["id2"]), np.asarray(b["id"]) * 2)
+
+
+def test_device_transform_with_key_varies_per_batch(scalar_dataset):
+    """A two-arg device_transform receives a fresh fold of the seed per batch —
+    the on-device random-augmentation hook."""
+    import jax
+
+    def transform(batch, key):
+        noise = jax.random.uniform(key, ())
+        return {**batch, "noise": noise}
+
+    reader = make_batch_reader(scalar_dataset.url)
+    loader = DataLoader(reader, batch_size=8, seed=7, device_transform=transform)
+    with loader:
+        noises = [float(b["noise"]) for b in loader]
+    assert len(set(noises)) == len(noises)  # fresh key each batch
+
+    reader = make_batch_reader(scalar_dataset.url)
+    with DataLoader(reader, batch_size=8, seed=7, device_transform=transform) as again:
+        replay = [float(b["noise"]) for b in again]
+    assert replay == noises  # deterministic in the seed
