@@ -300,3 +300,36 @@ def test_device_transform_with_key_varies_per_batch(scalar_dataset):
     with DataLoader(reader, batch_size=8, seed=7, device_transform=transform) as again:
         replay = [float(b["noise"]) for b in again]
     assert replay == noises  # deterministic in the seed
+
+
+def test_sequence_sharded_batch_delivery(tmp_path):
+    """SURVEY §6: the loader's context-parallel obligation — when the consumer's
+    sharding splits the sequence axis (dp×sp), batches arrive laid out that way."""
+    import jax
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    path = tmp_path / "seq_ds"
+    path.mkdir()
+    n, seq = 32, 16
+    tokens = np.arange(n * seq, dtype=np.int32).reshape(n, seq)
+    table = pa.table({
+        "id": np.arange(n, dtype=np.int64),
+        "tokens": pa.FixedSizeListArray.from_arrays(tokens.reshape(-1), seq),
+    })
+    pq.write_table(table, str(path / "part-0.parquet"), row_group_size=16)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
+    sharding = {"tokens": NamedSharding(mesh, P("dp", "sp")),
+                "id": NamedSharding(mesh, P("dp"))}
+    reader = make_batch_reader("file://" + str(path), shuffle_row_groups=False,
+                               num_epochs=1)
+    with DataLoader(reader, batch_size=8, sharding=sharding) as loader:
+        batch = next(iter(loader))
+    arr = batch["tokens"]
+    assert arr.shape == (8, seq)
+    assert len(arr.sharding.device_set) == 8
+    shard = arr.addressable_shards[0]
+    assert shard.data.shape == (8 // 2, seq // 4)  # batch over dp, sequence over sp
+    np.testing.assert_array_equal(np.asarray(arr), tokens[:8])
