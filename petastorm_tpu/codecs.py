@@ -265,7 +265,7 @@ class CompressedImageCodec(DataframeColumnCodec):
         """JPEG bytes → quantized DCT coefficient planes (native C++ entropy decode,
         GIL-released — the reader pool's parallel half of the two-stage decode).
 
-        Streams the two-stage path cannot handle (progressive, CMYK, corrupt-for-us)
+        Streams the two-stage path cannot handle (lossless/arithmetic, CMYK, corrupt-for-us)
         fall back to the full host decode per row; the loader stacks those alongside
         the device-decoded rows."""
         if not self.device_decodable:
@@ -283,7 +283,7 @@ class CompressedImageCodec(DataframeColumnCodec):
 
         The batched stage 1 (petastorm_tpu/ops/jpeg.py ``entropy_decode_jpeg_batch``)
         entropy-decodes every same-layout stream into stacked buffers in one
-        GIL-released native call; streams it cannot handle (progressive, corrupt,
+        GIL-released native call; streams it cannot handle (lossless/arithmetic, corrupt,
         layout differs from the group) fall back to :meth:`host_stage_decode`
         individually, so the output mixes ``JpegPlanes`` and host-decoded ndarrays
         exactly like the per-row path."""

@@ -393,7 +393,7 @@ def entropy_decode_jpeg_fast(data):
 
     This is the data-plane entry point: ctypes releases the GIL so reader thread pools
     run stage-1 decode truly in parallel. Raises ValueError on streams the two-stage
-    path cannot handle (progressive, CMYK, corrupt) — the codec layer catches that and
+    path cannot handle (lossless/arithmetic, CMYK, corrupt) — the codec layer catches that and
     falls back to full host decode per stream."""
     from petastorm_tpu.ops import native
 

@@ -176,12 +176,13 @@ def jpeg_decode_coeffs_batch_native(blobs):
       parsed from the first stream
     - ``coeffs``: tuple of ``(n, blocks_y*blocks_x, 64)`` int16 arrays, one per component
     - ``qtabs``: ``(n, ncomp, 64)`` uint16 natural-order quantization tables
-    - ``status``: ``(n,)`` int32 — 0 decoded; nonzero = that stream failed (progressive /
-      corrupt / different layout; its slice is zeroed) and the caller must re-decode it
-      individually (e.g. cv2 host fallback).
+    - ``status``: ``(n,)`` int32 — 0 decoded; nonzero = that stream failed
+      (lossless/arithmetic mode / corrupt / different layout; its slice is zeroed) and
+      the caller must re-decode it individually (e.g. cv2 host fallback). Baseline and
+      progressive streams both decode natively.
 
-    Raises ValueError when the FIRST stream has no parseable baseline layout (caller
-    falls back to per-image decode for the whole batch)."""
+    Raises ValueError when the FIRST stream has no parseable baseline-or-progressive
+    layout (caller falls back to per-image decode for the whole batch)."""
     import numpy as np
 
     lib = _load()

@@ -1,4 +1,5 @@
-// Native baseline-JPEG entropy decoder (stage 1 of the two-stage TPU decode).
+// Native JPEG entropy decoder (stage 1 of the two-stage TPU decode): baseline
+// (SOF0/SOF1) and progressive (SOF2).
 //
 // Huffman entropy decoding is sequential and branchy -- the one part of JPEG decode that
 // cannot ride the TPU vector units -- so it runs on host as tight C++ instead of the
@@ -11,12 +12,27 @@
 // make_reader/make_batch_reader decode path; built by petastorm_tpu/ops/native/__init__.py
 // with g++ at first use and called through ctypes (GIL released -> thread-pool parallel).
 //
-// Supports: 8-bit baseline sequential DCT (SOF0/SOF1), interleaved single scan, 1..4
-// components, restart intervals, 0xFF00 byte stuffing. Rejects progressive/lossless.
+// Supports: 8-bit baseline sequential DCT (SOF0/SOF1, interleaved single scan) AND
+// 8-bit progressive DCT (SOF2: DC/AC spectral selection, successive approximation,
+// interleaved DC scans, per-component AC scans, EOB runs), 1..4 components, restart
+// intervals, 0xFF00 byte stuffing. Rejects lossless/arithmetic/hierarchical modes.
 
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+
+// Error codes (ptpu_jpeg_error_string maps them to messages)
+enum {
+  PTPU_JPEG_OK = 0,
+  PTPU_JPEG_NOT_JPEG = -1,
+  PTPU_JPEG_UNSUPPORTED_MODE = -2,
+  PTPU_JPEG_CORRUPT = -3,
+  PTPU_JPEG_NOT_8BIT = -4,
+  PTPU_JPEG_BAD_COMPONENTS = -5,
+  PTPU_JPEG_NO_SCAN = -6,
+  PTPU_JPEG_OOM = -7,
+  PTPU_JPEG_LAYOUT_MISMATCH = -8,
+};
 
 namespace {
 
@@ -178,22 +194,243 @@ inline int extend(int v, int t) {
 
 inline uint16_t be16(const uint8_t* p) { return (uint16_t)((p[0] << 8) | p[1]); }
 
+// Frame component state shared by the baseline and progressive scan decoders.
+struct JComp {
+  int id, h, v, tq;
+  int dc_tbl, ac_tbl;
+};
+
+// One progressive scan (ITU-T T.81 §G): DC or AC band, first pass or successive-
+// approximation refinement. Coefficients accumulate into blocks16[c] (the padded
+// interleaved grid, stride out_bx[c]); scans arrive in any spec-legal order.
+// On success sets *end_pos to the next marker after the entropy-coded data.
+// Returns a PTPU_JPEG_* code.
+struct ProgScanArgs {
+  const uint8_t* data;
+  int64_t len;
+  int64_t start;
+  const JComp* comps;
+  const int* scan_comps;  // indices into comps, scan order
+  int ns;
+  int Ss, Se, Ah, Al;
+  const HuffTable* huff_dc;  // [4]
+  const HuffTable* huff_ac;  // [4]
+  int restart_interval;
+  int width, height, hmax, vmax, mcus_x, mcus_y;
+  int16_t* const* blocks;   // per frame component c: padded grid
+  const int* out_bx;        // per frame component: padded blocks_x (row stride)
+};
+
+inline int prog_find_next_marker(const uint8_t* data, int64_t len, int64_t from,
+                                 int64_t* out) {
+  for (int64_t p = from; p + 1 < len; p++) {
+    if (data[p] == 0xFF && data[p + 1] != 0x00 &&
+        !(data[p + 1] >= 0xD0 && data[p + 1] <= 0xD7))
+      {
+        *out = p;
+        return PTPU_JPEG_OK;
+      }
+  }
+  *out = len;
+  return PTPU_JPEG_OK;  // no further marker: treated as end of stream
+}
+
+int decode_progressive_scan(const ProgScanArgs& a, int64_t* end_pos) {
+  BitReader br;
+  br.init(a.data, a.len, a.start);
+  int pred[4] = {0, 0, 0, 0};
+  int eobrun = 0;
+  const int p1 = 1 << a.Al;
+  const int m1 = -(1 << a.Al);
+  int mcu_count = 0;
+
+  auto restart_check = [&]() {
+    if (a.restart_interval && mcu_count && mcu_count % a.restart_interval == 0) {
+      br.align_restart();
+      pred[0] = pred[1] = pred[2] = pred[3] = 0;
+      eobrun = 0;
+    }
+  };
+
+  if (a.Ss == 0) {
+    // ---- DC scan (Se must be 0) ----
+    if (a.Se != 0) return PTPU_JPEG_CORRUPT;
+    if (a.ns > 1) {
+      // interleaved DC scan: full-frame MCU traversal over the scan components
+      for (int my = 0; my < a.mcus_y; my++) {
+        for (int mx = 0; mx < a.mcus_x; mx++) {
+          restart_check();
+          for (int s = 0; s < a.ns; s++) {
+            int c = a.scan_comps[s];
+            const JComp& comp = a.comps[c];
+            for (int v = 0; v < comp.v; v++) {
+              for (int hh = 0; hh < comp.h; hh++) {
+                int brow = my * comp.v + v;
+                int bcol = mx * comp.h + hh;
+                int16_t* blk = a.blocks[c] + ((size_t)brow * a.out_bx[c] + bcol) * 64;
+                br.ensure28();
+                if (a.Ah == 0) {
+                  uint32_t e = a.huff_dc[comp.dc_tbl].decode(br.peek16_raw());
+                  if (!e) return PTPU_JPEG_CORRUPT;
+                  br.cnt -= e >> 8;
+                  int t = e & 0xFF;
+                  if (t > 11) return PTPU_JPEG_CORRUPT;
+                  if (t) pred[c] += extend(br.take(t), t);
+                  blk[0] = (int16_t)(pred[c] * p1);  // value << Al
+                } else {
+                  if (br.take(1)) blk[0] = (int16_t)(blk[0] | p1);
+                }
+              }
+            }
+          }
+          mcu_count++;
+        }
+      }
+    } else {
+      // single-component DC scan: non-interleaved block geometry
+      int c = a.scan_comps[0];
+      const JComp& comp = a.comps[c];
+      int cw = (a.width * comp.h + a.hmax - 1) / a.hmax;   // ceil(X * Hi / Hmax)
+      int ch = (a.height * comp.v + a.vmax - 1) / a.vmax;
+      int wb = (cw + 7) / 8, hb = (ch + 7) / 8;
+      for (int brow = 0; brow < hb; brow++) {
+        for (int bcol = 0; bcol < wb; bcol++) {
+          restart_check();
+          int16_t* blk = a.blocks[c] + ((size_t)brow * a.out_bx[c] + bcol) * 64;
+          br.ensure28();
+          if (a.Ah == 0) {
+            uint32_t e = a.huff_dc[comp.dc_tbl].decode(br.peek16_raw());
+            if (!e) return PTPU_JPEG_CORRUPT;
+            br.cnt -= e >> 8;
+            int t = e & 0xFF;
+            if (t > 11) return PTPU_JPEG_CORRUPT;
+            if (t) pred[c] += extend(br.take(t), t);
+            blk[0] = (int16_t)(pred[c] * p1);
+          } else {
+            if (br.take(1)) blk[0] = (int16_t)(blk[0] | p1);
+          }
+          mcu_count++;
+        }
+      }
+    }
+  } else {
+    // ---- AC scan: always single-component (T.81 §G.1.1.1.1) ----
+    if (a.ns != 1) return PTPU_JPEG_UNSUPPORTED_MODE;
+    if (a.Se > 63 || a.Ss > a.Se) return PTPU_JPEG_CORRUPT;
+    int c = a.scan_comps[0];
+    const JComp& comp = a.comps[c];
+    const HuffTable& ac = a.huff_ac[comp.ac_tbl];
+    int cw = (a.width * comp.h + a.hmax - 1) / a.hmax;
+    int ch = (a.height * comp.v + a.vmax - 1) / a.vmax;
+    int wb = (cw + 7) / 8, hb = (ch + 7) / 8;
+    for (int brow = 0; brow < hb; brow++) {
+      for (int bcol = 0; bcol < wb; bcol++) {
+        restart_check();
+        int16_t* blk = a.blocks[c] + ((size_t)brow * a.out_bx[c] + bcol) * 64;
+        if (a.Ah == 0) {
+          // first pass over this band
+          if (eobrun > 0) {
+            eobrun--;
+          } else {
+            int k = a.Ss;
+            while (k <= a.Se) {
+              br.ensure28();
+              uint32_t e = ac.decode(br.peek16_raw());
+              if (!e) return PTPU_JPEG_CORRUPT;
+              br.cnt -= e >> 8;
+              int r = (e & 0xFF) >> 4, s = e & 0xF;
+              if (s == 0) {
+                if (r != 15) {
+                  eobrun = (1 << r) - 1;
+                  if (r) {
+                    br.ensure28();
+                    eobrun += br.take(r);
+                  }
+                  break;  // end of band for this block
+                }
+                k += 16;
+              } else {
+                if (s > 10) return PTPU_JPEG_CORRUPT;
+                k += r;
+                if (k > a.Se) return PTPU_JPEG_CORRUPT;
+                blk[kZigzagToNatural[k]] = (int16_t)(extend(br.take(s), s) * p1);
+                k++;
+              }
+            }
+          }
+        } else {
+          // refinement pass (libjpeg jdphuff.c decode_mcu_AC_refine structure)
+          int k = a.Ss;
+          if (eobrun == 0) {
+            while (k <= a.Se) {
+              br.ensure28();
+              uint32_t e = ac.decode(br.peek16_raw());
+              if (!e) return PTPU_JPEG_CORRUPT;
+              br.cnt -= e >> 8;
+              int r = (e & 0xFF) >> 4, s = e & 0xF;
+              int newval = 0;
+              if (s == 0) {
+                if (r != 15) {
+                  // NOT (1<<r)-1: the tail handler below consumes the current
+                  // block's remaining correction bits and decrements (libjpeg
+                  // decode_mcu_AC_refine); with the -1 form an r==0 EOB would skip
+                  // those bits and desynchronize the stream
+                  eobrun = (1 << r);
+                  if (r) {
+                    br.ensure28();
+                    eobrun += br.take(r);
+                  }
+                  break;  // tail correction below consumes the rest of the band
+                }
+                // r == 15: skip over 16 zero-history coefficients
+              } else {
+                if (s != 1) return PTPU_JPEG_CORRUPT;
+                br.ensure28();
+                newval = br.take(1) ? p1 : m1;
+              }
+              while (k <= a.Se) {
+                int16_t* cf = blk + kZigzagToNatural[k];
+                if (*cf != 0) {
+                  br.ensure28();
+                  if (br.take(1) && (*cf & p1) == 0)
+                    *cf = (int16_t)(*cf + (*cf >= 0 ? p1 : m1));
+                } else {
+                  if (r == 0) break;
+                  r--;
+                }
+                k++;
+              }
+              if (s && k <= a.Se) {
+                blk[kZigzagToNatural[k]] = (int16_t)newval;
+              }
+              k++;
+            }
+          }
+          if (eobrun > 0) {
+            // correction bits for the remaining nonzero history in the band
+            while (k <= a.Se) {
+              int16_t* cf = blk + kZigzagToNatural[k];
+              if (*cf != 0) {
+                br.ensure28();
+                if (br.take(1) && (*cf & p1) == 0)
+                  *cf = (int16_t)(*cf + (*cf >= 0 ? p1 : m1));
+              }
+              k++;
+            }
+            eobrun--;
+          }
+        }
+        mcu_count++;
+      }
+    }
+  }
+  return prog_find_next_marker(a.data, a.len, br.pos > a.start ? br.pos : a.start,
+                               end_pos);
+}
+
 }  // namespace
 
 extern "C" {
-
-// Error codes (ptpu_jpeg_error_string maps them to messages)
-enum {
-  PTPU_JPEG_OK = 0,
-  PTPU_JPEG_NOT_JPEG = -1,
-  PTPU_JPEG_UNSUPPORTED_MODE = -2,
-  PTPU_JPEG_CORRUPT = -3,
-  PTPU_JPEG_NOT_8BIT = -4,
-  PTPU_JPEG_BAD_COMPONENTS = -5,
-  PTPU_JPEG_NO_SCAN = -6,
-  PTPU_JPEG_OOM = -7,
-  PTPU_JPEG_LAYOUT_MISMATCH = -8,
-};
 
 typedef struct {
   int32_t height;
@@ -232,7 +469,7 @@ const char* ptpu_jpeg_error_string(int code) {
     case PTPU_JPEG_OK: return "ok";
     case PTPU_JPEG_NOT_JPEG: return "Not a JPEG (missing SOI)";
     case PTPU_JPEG_UNSUPPORTED_MODE:
-      return "Unsupported JPEG mode (progressive/lossless/non-interleaved)";
+      return "Unsupported JPEG mode (lossless/arithmetic/non-interleaved-baseline)";
     case PTPU_JPEG_CORRUPT: return "Corrupt JPEG stream";
     case PTPU_JPEG_NOT_8BIT: return "Only 8-bit baseline JPEG supported";
     case PTPU_JPEG_BAD_COMPONENTS: return "Unsupported component count/sampling";
@@ -263,13 +500,14 @@ static int decode_impl(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out,
     huff_ac[i].present = false;
   }
 
-  struct Comp {
-    int id, h, v, tq;
-    int dc_tbl, ac_tbl;
-  } comps[4];
+  JComp comps[4];
   int ncomp = 0;
   int height = 0, width = 0;
   bool have_frame = false;
+  bool progressive = false;
+  bool allocated = false;
+  int scans_done = 0;
+  int hmax = 1, vmax = 1, mcus_x = 0, mcus_y = 0;
   int restart_interval = 0;
 
   int64_t pos = 2;
@@ -323,7 +561,8 @@ static int decode_impl(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out,
         }
         qt_present[tq] = true;
       }
-    } else if (marker == 0xC0 || marker == 0xC1) {  // SOF0/SOF1 baseline
+    } else if (marker == 0xC0 || marker == 0xC1 || marker == 0xC2) {
+      // SOF0/SOF1 baseline, SOF2 progressive
       if (segbytes < 6) {
         rc = PTPU_JPEG_CORRUPT;
         goto done;
@@ -333,6 +572,14 @@ static int decode_impl(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out,
         rc = PTPU_JPEG_NOT_8BIT;
         goto done;
       }
+      if (have_frame) {
+        // a second frame header is illegal (T.81: one frame per non-hierarchical
+        // stream) and would re-derive geometry the coefficient buffers no longer
+        // match — reject instead of writing through stale pointers/strides
+        rc = PTPU_JPEG_CORRUPT;
+        goto done;
+      }
+      progressive = (marker == 0xC2);
       height = be16(seg + 1);
       width = be16(seg + 3);
       ncomp = seg[5];
@@ -350,7 +597,11 @@ static int decode_impl(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out,
           rc = PTPU_JPEG_BAD_COMPONENTS;
           goto done;
         }
+        if (comps[i].h > hmax) hmax = comps[i].h;
+        if (comps[i].v > vmax) vmax = comps[i].v;
       }
+      mcus_x = (width + 8 * hmax - 1) / (8 * hmax);
+      mcus_y = (height + 8 * vmax - 1) / (8 * vmax);
       have_frame = true;
     } else if (marker == 0xC4) {  // DHT
       int s = 0;
@@ -379,10 +630,10 @@ static int decode_impl(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out,
         goto done;
       }
       restart_interval = be16(seg);
-    } else if (marker == 0xC2 || marker == 0xC3 || marker == 0xC5 || marker == 0xC6 ||
+    } else if (marker == 0xC3 || marker == 0xC5 || marker == 0xC6 ||
                marker == 0xC7 || marker == 0xC9 || marker == 0xCA || marker == 0xCB ||
                marker == 0xCD || marker == 0xCE || marker == 0xCF) {
-      rc = PTPU_JPEG_UNSUPPORTED_MODE;
+      rc = PTPU_JPEG_UNSUPPORTED_MODE;  // lossless / arithmetic / hierarchical
       goto done;
     } else if (marker == 0xDA) {  // SOS
       if (!have_frame || segbytes < 1) {
@@ -390,12 +641,11 @@ static int decode_impl(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out,
         goto done;
       }
       int ns = seg[0];
-      if (ns != ncomp || segbytes < 1 + 2 * ns) {
-        // non-interleaved multi-scan baseline: rare; the codec's host_stage_decode
-        // catches the resulting ValueError and falls back to full cv2 host decode
-        rc = PTPU_JPEG_UNSUPPORTED_MODE;
+      if (ns < 1 || ns > 4 || segbytes < 1 + 2 * ns + 3) {
+        rc = PTPU_JPEG_CORRUPT;
         goto done;
       }
+      int scan_comps[4];
       for (int i = 0; i < ns; i++) {
         int cs = seg[1 + 2 * i];
         int found = -1;
@@ -407,58 +657,117 @@ static int decode_impl(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out,
         }
         comps[found].dc_tbl = seg[2 + 2 * i] >> 4;
         comps[found].ac_tbl = seg[2 + 2 * i] & 0xF;
+        scan_comps[i] = found;
       }
-      for (int c = 0; c < ncomp; c++) {
-        if (!huff_dc[comps[c].dc_tbl].present || !huff_ac[comps[c].ac_tbl].present ||
-            !qt_present[comps[c].tq]) {
-          rc = PTPU_JPEG_CORRUPT;
-          goto done;
-        }
-      }
+      int Ss = seg[1 + 2 * ns];
+      int Se = seg[2 + 2 * ns];
+      int Ah = seg[3 + 2 * ns] >> 4;
+      int Al = seg[3 + 2 * ns] & 0xF;
 
-      // ---- entropy-coded scan ----
-      int hmax = 1, vmax = 1;
-      for (int c = 0; c < ncomp; c++) {
-        if (comps[c].h > hmax) hmax = comps[c].h;
-        if (comps[c].v > vmax) vmax = comps[c].v;
-      }
-      int mcus_x = (width + 8 * hmax - 1) / (8 * hmax);
-      int mcus_y = (height + 8 * vmax - 1) / (8 * vmax);
-
-      out->height = height;
-      out->width = width;
-      out->ncomp = ncomp;
-      if (expect && (height != expect->height || width != expect->width ||
-                     ncomp != expect->ncomp)) {
-        rc = PTPU_JPEG_LAYOUT_MISMATCH;
-        goto done;
-      }
-      for (int c = 0; c < ncomp; c++) {
-        int bx = mcus_x * comps[c].h;
-        int by = mcus_y * comps[c].v;
-        out->h_samp[c] = comps[c].h;
-        out->v_samp[c] = comps[c].v;
-        out->blocks_y[c] = by;
-        out->blocks_x[c] = bx;
-        if (expect && (comps[c].h != expect->h_samp[c] || comps[c].v != expect->v_samp[c] ||
-                       by != expect->blocks_y[c] || bx != expect->blocks_x[c])) {
-          rc = PTPU_JPEG_LAYOUT_MISMATCH;
-          goto done;
-        }
-        if (dst) {
-          out->blocks[c] = dst[c];
-          memset(dst[c], 0, (size_t)by * bx * 64 * sizeof(int16_t));
-        } else {
-          out->blocks[c] = (int16_t*)calloc((size_t)by * bx * 64, sizeof(int16_t));
-          if (!out->blocks[c]) {
-            rc = PTPU_JPEG_OOM;
+      if (!allocated) {
+        // first scan: verify layout, set up (or adopt) coefficient storage
+        for (int c = 0; c < ncomp; c++) {
+          if (!qt_present[comps[c].tq]) {
+            rc = PTPU_JPEG_CORRUPT;
             goto done;
           }
         }
-        const int32_t* zz = qt_zz[comps[c].tq];
-        uint16_t* qout = qdst ? qdst + (size_t)c * 64 : out->qtables[c];
-        for (int k = 0; k < 64; k++)
-          qout[kZigzagToNatural[k]] = (uint16_t)zz[k];
+        out->height = height;
+        out->width = width;
+        out->ncomp = ncomp;
+        if (expect && (height != expect->height || width != expect->width ||
+                       ncomp != expect->ncomp)) {
+          rc = PTPU_JPEG_LAYOUT_MISMATCH;
+          goto done;
+        }
+        for (int c = 0; c < ncomp; c++) {
+          int bx = mcus_x * comps[c].h;
+          int by = mcus_y * comps[c].v;
+          out->h_samp[c] = comps[c].h;
+          out->v_samp[c] = comps[c].v;
+          out->blocks_y[c] = by;
+          out->blocks_x[c] = bx;
+          if (expect && (comps[c].h != expect->h_samp[c] ||
+                         comps[c].v != expect->v_samp[c] ||
+                         by != expect->blocks_y[c] || bx != expect->blocks_x[c])) {
+            rc = PTPU_JPEG_LAYOUT_MISMATCH;
+            goto done;
+          }
+          if (dst) {
+            out->blocks[c] = dst[c];
+            memset(dst[c], 0, (size_t)by * bx * 64 * sizeof(int16_t));
+          } else {
+            out->blocks[c] = (int16_t*)calloc((size_t)by * bx * 64, sizeof(int16_t));
+            if (!out->blocks[c]) {
+              rc = PTPU_JPEG_OOM;
+              goto done;
+            }
+          }
+          const int32_t* zz = qt_zz[comps[c].tq];
+          uint16_t* qout = qdst ? qdst + (size_t)c * 64 : out->qtables[c];
+          for (int k = 0; k < 64; k++)
+            qout[kZigzagToNatural[k]] = (uint16_t)zz[k];
+        }
+        allocated = true;
+      }
+
+      if (progressive) {
+        // table presence: DC-first scans need DC tables; AC scans need the AC table;
+        // DC refinement (Ah>0, Ss==0) is raw bits, no table
+        for (int i = 0; i < ns; i++) {
+          const JComp& sc = comps[scan_comps[i]];
+          if (Ss == 0 && Ah == 0 && !huff_dc[sc.dc_tbl].present) {
+            rc = PTPU_JPEG_CORRUPT;
+            goto done;
+          }
+          if (Ss > 0 && !huff_ac[sc.ac_tbl].present) {
+            rc = PTPU_JPEG_CORRUPT;
+            goto done;
+          }
+        }
+        ProgScanArgs pargs;
+        pargs.data = data;
+        pargs.len = len;
+        pargs.start = pos + seglen;
+        pargs.comps = comps;
+        pargs.scan_comps = scan_comps;
+        pargs.ns = ns;
+        pargs.Ss = Ss;
+        pargs.Se = Se;
+        pargs.Ah = Ah;
+        pargs.Al = Al;
+        pargs.huff_dc = huff_dc;
+        pargs.huff_ac = huff_ac;
+        pargs.restart_interval = restart_interval;
+        pargs.width = width;
+        pargs.height = height;
+        pargs.hmax = hmax;
+        pargs.vmax = vmax;
+        pargs.mcus_x = mcus_x;
+        pargs.mcus_y = mcus_y;
+        pargs.blocks = out->blocks;
+        pargs.out_bx = out->blocks_x;
+        int64_t next_pos = 0;
+        rc = decode_progressive_scan(pargs, &next_pos);
+        if (rc != PTPU_JPEG_OK) goto done;
+        scans_done++;
+        rc = PTPU_JPEG_NO_SCAN;  // re-armed; success is decided at EOI
+        pos = next_pos;
+        continue;  // keep parsing markers: DHT/DRI/SOS/EOI follow
+      }
+
+      // ---- baseline: one interleaved scan covering every component ----
+      if (ns != ncomp) {
+        // non-interleaved multi-scan baseline: rare; the codec's host_stage_decode
+        // catches the resulting ValueError and falls back to full cv2 host decode
+        rc = PTPU_JPEG_UNSUPPORTED_MODE;
+        goto done;
+      }
+      for (int c = 0; c < ncomp; c++) {
+        if (!huff_dc[comps[c].dc_tbl].present || !huff_ac[comps[c].ac_tbl].present) {
+          rc = PTPU_JPEG_CORRUPT;
+          goto done;
+        }
       }
 
       BitReader br;
@@ -533,6 +842,8 @@ static int decode_impl(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out,
     }
     pos += seglen;
   }
+  // progressive streams succeed at EOI (or end of data) once any scan landed
+  if (progressive && allocated && scans_done > 0) rc = PTPU_JPEG_OK;
 
 done:
   if (rc != PTPU_JPEG_OK && !dst) ptpu_jpeg_free_coeffs(out);
@@ -564,7 +875,7 @@ int ptpu_jpeg_parse_layout(const uint8_t* data, int64_t len, PtpuJpegLayout* out
     if (seglen < 2 || pos + seglen > len) return PTPU_JPEG_CORRUPT;
     const uint8_t* seg = data + pos + 2;
     int segbytes = seglen - 2;
-    if (marker == 0xC0 || marker == 0xC1) {
+    if (marker == 0xC0 || marker == 0xC1 || marker == 0xC2) {  // baseline + progressive
       if (segbytes < 6) return PTPU_JPEG_CORRUPT;
       if (seg[0] != 8) return PTPU_JPEG_NOT_8BIT;
       out->height = be16(seg + 1);
@@ -590,7 +901,7 @@ int ptpu_jpeg_parse_layout(const uint8_t* data, int64_t len, PtpuJpegLayout* out
       }
       return PTPU_JPEG_OK;
     }
-    if (marker == 0xC2 || marker == 0xC3 || marker == 0xC5 || marker == 0xC6 ||
+    if (marker == 0xC3 || marker == 0xC5 || marker == 0xC6 ||
         marker == 0xC7 || marker == 0xC9 || marker == 0xCA || marker == 0xCB ||
         marker == 0xCD || marker == 0xCE || marker == 0xCF)
       return PTPU_JPEG_UNSUPPORTED_MODE;
