@@ -43,6 +43,13 @@ class LoaderBase:
     """Iterator + shutdown plumbing shared by the torch loaders (reference ~L80)."""
 
     def __init__(self, reader):
+        if getattr(reader, "device_decode_fields", None):
+            raise ValueError(
+                "Reader was built with decode_on_device=True: its image columns carry "
+                "device staging payloads only the JAX DataLoader can finish. Use "
+                "petastorm_tpu.loader.DataLoader, or rebuild the reader with "
+                "decode_on_device=False for the torch path."
+            )
         self.reader = reader
         self._stopped = False
 

@@ -82,6 +82,16 @@ def _tf_compatible(value):
     return value
 
 
+def _reject_device_decode_reader(reader):
+    if getattr(reader, "device_decode_fields", None):
+        raise ValueError(
+            "Reader was built with decode_on_device=True: its image columns carry "
+            "device staging payloads only the JAX DataLoader can finish. Use "
+            "petastorm_tpu.loader.DataLoader, or rebuild the reader with "
+            "decode_on_device=False for the TF path."
+        )
+
+
 def make_petastorm_dataset(reader):
     """``tf.data.Dataset`` over a reader (reference ``make_petastorm_dataset`` ~L350).
 
@@ -89,6 +99,7 @@ def make_petastorm_dataset(reader):
     NGram readers yield ``{timestep: dict}`` structures.
     """
     tf = _tf()
+    _reject_device_decode_reader(reader)
     schema = reader.schema
 
     if reader.ngram is not None:
@@ -147,6 +158,7 @@ def tf_tensors(reader, shuffling_queue_capacity=0, min_after_dequeue=0):
     ``max(shuffling_queue_capacity, min_after_dequeue + 1)``.
     """
     tf = _tf()
+    _reject_device_decode_reader(reader)
     buffer_size = max(int(shuffling_queue_capacity or 0), int(min_after_dequeue or 0) + 1
                       if min_after_dequeue else 0)
     if buffer_size > 1:

@@ -125,3 +125,39 @@ def test_tf_tensors_eager(tf, scalar_dataset):
         next_fn = tf_tensors(reader)
         batch = next_fn()
     assert "id" in batch
+
+
+def test_adapters_reject_device_decode_readers(tmp_path):
+    """A decode_on_device reader yields staging payloads only the JAX loader can
+    finish — the torch/tf adapters must reject it with a pointed error instead of
+    silently handing object payloads to collate."""
+    import cv2
+
+    from petastorm_tpu import types as ptypes
+    from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+    from petastorm_tpu.metadata import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    rng = np.random.RandomState(0)
+    schema = Unischema("S", [
+        UnischemaField("id", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+        UnischemaField("image", np.uint8, (16, 16, 3), CompressedImageCodec("jpeg"),
+                       False),
+    ])
+    url = "file://" + str(tmp_path / "ds")
+    write_dataset(url, schema, ({"id": i, "image": rng.randint(0, 256, (16, 16, 3),
+                                                               dtype=np.uint8)}
+                                for i in range(4)))
+    from petastorm_tpu.adapters.pytorch import DataLoader as TorchDataLoader
+    from petastorm_tpu.adapters.tf import make_petastorm_dataset
+    from petastorm_tpu.reader import make_batch_reader
+
+    reader = make_batch_reader(url, decode_on_device=True, num_epochs=1)
+    try:
+        with pytest.raises(ValueError, match="decode_on_device"):
+            TorchDataLoader(reader)
+        with pytest.raises(ValueError, match="decode_on_device"):
+            make_petastorm_dataset(reader)
+    finally:
+        reader.stop()
+        reader.join()
