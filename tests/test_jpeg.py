@@ -440,3 +440,47 @@ def test_second_sof_rejected_not_crash():
     evil = data[:-2] + bytes(big_sof) + data[sof:len(data)]  # 2nd SOF + scans + EOI
     with pytest.raises(ValueError):
         native.jpeg_decode_coeffs_native(evil)
+
+
+def test_progressive_coefficients_bit_exact_vs_baseline():
+    """Encoding the same pixels at the same quality baseline vs progressive transmits
+    the SAME quantized coefficients (progressive only reorders them) — so native
+    progressive decode must be bit-exact against native baseline decode."""
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    from petastorm_tpu.ops.jpeg import entropy_decode_jpeg_fast
+
+    rng = np.random.RandomState(41)
+    for shape in ((64, 80, 3), (17, 19, 3), (48, 48)):
+        img = rng.randint(0, 256, shape, dtype=np.uint8)
+        ok, b = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 88])
+        ok, p = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 88,
+                                           cv2.IMWRITE_JPEG_PROGRESSIVE, 1])
+        base = entropy_decode_jpeg_fast(b.tobytes())
+        prog = entropy_decode_jpeg_fast(p.tobytes())
+        assert len(base.components) == len(prog.components)
+        for bc, pc in zip(base.components, prog.components):
+            np.testing.assert_array_equal(bc.blocks, pc.blocks)
+            np.testing.assert_array_equal(bc.qtable, pc.qtable)
+
+
+def test_truncated_streams_never_crash():
+    """Every truncation of baseline and progressive streams must either decode or
+    raise ValueError — never crash the worker process."""
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    rng = np.random.RandomState(42)
+    img = rng.randint(0, 256, (32, 32, 3), dtype=np.uint8)
+    for opts in ([cv2.IMWRITE_JPEG_QUALITY, 90],
+                 [cv2.IMWRITE_JPEG_QUALITY, 90, cv2.IMWRITE_JPEG_PROGRESSIVE, 1]):
+        ok, enc = cv2.imencode(".jpg", img, opts)
+        data = enc.tobytes()
+        for cut in range(2, len(data), 23):
+            try:
+                native.jpeg_decode_coeffs_native(data[:cut])
+            except (ValueError, RuntimeError):
+                pass
