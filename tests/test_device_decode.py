@@ -281,3 +281,42 @@ def test_per_row_path_mixed_staged_and_fallback_rows(jpeg_dataset):
         assert imgs.shape == (4, 32, 48, 3)
         for row in imgs:
             assert np.abs(row.astype(int) - ref.astype(int)).mean() < 3.0
+
+
+def test_process_pool_device_decode_wire(tmp_path):
+    """decode_on_device over the process pool: staged payloads cross the IPC wire
+    (JpegPlanes.__reduce__ ships one detached row, not its row group's buffers) and
+    the finished images match the host-decode path."""
+    from petastorm_tpu import types as ptypes
+    from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.metadata import write_dataset
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    rng = np.random.RandomState(0)
+    schema = Unischema("S", [
+        UnischemaField("id", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+        UnischemaField("image", np.uint8, (24, 24, 3),
+                       CompressedImageCodec("jpeg", 92), False),
+    ])
+    url = "file://" + str(tmp_path / "ds")
+    write_dataset(url, schema,
+                  ({"id": i, "image": rng.randint(0, 256, (24, 24, 3), dtype=np.uint8)}
+                   for i in range(12)), rows_per_file=12)
+
+    def collect(**kwargs):
+        reader = make_batch_reader(url, num_epochs=1, **kwargs)
+        out = {}
+        with DataLoader(reader, 4, to_device=False, last_batch="partial") as loader:
+            for b in loader:
+                for j, i in enumerate(np.asarray(b["id"])):
+                    out[int(i)] = np.asarray(b["image"])[j]
+        return out
+
+    got = collect(reader_pool_type="process", workers_count=2, decode_on_device=True)
+    ref = collect()
+    assert len(got) == 12
+    worst = max(np.abs(got[i].astype(int) - ref[i].astype(int)).mean()
+                for i in range(12))
+    assert worst < 2.5, worst
