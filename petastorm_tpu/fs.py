@@ -54,6 +54,35 @@ def get_dataset_path(parsed_url):
     return _strip_scheme(parsed_url)
 
 
+class FilesystemResolver:
+    """Compat shim for the reference resolver CLASS (petastorm/fs_utils.py ~L40) —
+    user code calls it directly (``FilesystemResolver(url).filesystem()``). New code
+    should prefer :func:`get_filesystem_and_path_or_paths`.
+
+    ``hdfs_driver`` and ``user`` are accepted for signature compatibility; driver
+    selection is libhdfs-only here (see the module docstring's HA compat decision).
+    """
+
+    def __init__(self, dataset_url, storage_options=None, filesystem=None,
+                 hdfs_driver=None, user=None):  # noqa: ARG002 — reference signature
+        self._dataset_url = str(dataset_url)
+        self._parsed = urlparse(self._dataset_url)
+        self._filesystem, self._path = get_filesystem_and_path_or_paths(
+            self._dataset_url, storage_options=storage_options, filesystem=filesystem)
+
+    def filesystem(self):
+        """The resolved ``pyarrow.fs`` filesystem."""
+        return self._filesystem
+
+    def get_dataset_path(self):
+        """Filesystem-relative dataset path."""
+        return self._path
+
+    def parsed_dataset_url(self):
+        """The ``urllib.parse`` result for the original URL."""
+        return self._parsed
+
+
 def _strip_scheme(parsed):
     if parsed.scheme in ("", "file"):
         return parsed.path

@@ -79,3 +79,16 @@ def test_get_dataset_path():
     assert get_dataset_path(urlparse("file:///a/b")) == "/a/b"
     assert get_dataset_path(urlparse("s3://bucket/a/b")) == "bucket/a/b"
     assert get_dataset_path(urlparse("hdfs://nn/a/b")) == "/a/b"
+
+
+def test_filesystem_resolver_class_compat(tmp_path):
+    """Reference public class surface: FilesystemResolver(url).filesystem() /
+    get_dataset_path() / parsed_dataset_url()."""
+    from petastorm_tpu.fs import FilesystemResolver
+
+    r = FilesystemResolver("file://" + str(tmp_path))
+    assert r.get_dataset_path() == str(tmp_path)
+    assert r.parsed_dataset_url().scheme == "file"
+    import pyarrow.fs as pafs
+    info = r.filesystem().get_file_info(str(tmp_path))
+    assert info.type == pafs.FileType.Directory
