@@ -709,9 +709,12 @@ class InMemDataLoader:
         self._jitted_transform = None
         # fill: reuse the streaming DataLoader (handles staged on-device decode and the
         # sharding layout), then concatenate the chunks on device
+        self._sharding = sharding
         chunks = []
         dropped = set()
-        with DataLoader(reader, self.batch_size, sharding=sharding,
+        # fill UNSHARDED: chunk/partial-batch row counts rarely divide the batch axis;
+        # the resident store and gathered batches are laid out below instead
+        with DataLoader(reader, self.batch_size, sharding=None,
                         last_batch="partial", prefetch=2) as fill:
             for batch in fill:
                 kept = {}
@@ -732,6 +735,19 @@ class InMemDataLoader:
             for k in chunks[0]
         }
         self.rows = int(next(iter(self._store.values())).shape[0])
+        if sharding is not None:
+            # shard the resident store along the batch axis when the row count
+            # divides; otherwise it stays on the default device and only the
+            # gathered batches are laid out per the sharding
+            try:
+                self._store = {
+                    k: jax.device_put(v, _matching_sharding(sharding, v))
+                    for k, v in self._store.items()
+                }
+            except ValueError:
+                logger.warning(
+                    "InMemDataLoader store (%d rows) does not divide over the "
+                    "sharding's batch axis; store kept unsharded", self.rows)
 
         def _gather(store, idx):
             return {k: v[idx] for k, v in store.items()}
@@ -768,6 +784,9 @@ class InMemDataLoader:
                 if len(idx) < self.batch_size and self.last_batch == "drop":
                     break
                 batch = self._gather(self._store, idx)
+                if self._sharding is not None and len(idx) == self.batch_size:
+                    batch = {k: jax.device_put(v, _matching_sharding(self._sharding, v))
+                             for k, v in batch.items()}
                 if self._device_transform is not None:
                     if self._jitted_transform is None:
                         self._jitted_transform = jax.jit(self._device_transform)

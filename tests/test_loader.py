@@ -335,3 +335,22 @@ def test_sequence_sharded_batch_delivery(tmp_path):
     shard = arr.addressable_shards[0]
     assert shard.data.shape == (8 // 2, seq // 4)  # batch over dp, sequence over sp
     np.testing.assert_array_equal(np.asarray(arr), tokens[:8])
+
+
+def test_inmem_loader_sharded_store_and_batches(scalar_dataset):
+    """InMemDataLoader keeps the resident store AND the gathered batches laid out per
+    the given sharding (batch axis over dp)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from petastorm_tpu.loader import InMemDataLoader
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    reader = make_batch_reader(scalar_dataset.url, num_epochs=1)
+    with InMemDataLoader(reader, batch_size=16, num_epochs=1, seed=0,
+                         sharding=sharding) as loader:
+        batch = next(iter(loader))
+    arr = batch["float_col"]
+    assert arr.shape[0] == 16
+    assert len(arr.sharding.device_set) == 8
+    assert arr.addressable_shards[0].data.shape[0] == 2
