@@ -709,6 +709,13 @@ class InMemDataLoader:
         self._jitted_transform = None
         # fill: reuse the streaming DataLoader (handles staged on-device decode and the
         # sharding layout), then concatenate the chunks on device
+        plan = getattr(reader, "_plan", None)
+        if plan is not None and getattr(plan, "_num_epochs", 1) is None:
+            raise ValueError(
+                "InMemDataLoader consumes the reader ONCE to fill device memory; an "
+                "infinite reader (num_epochs=None) would never finish the fill. Build "
+                "the reader with num_epochs=1 and set epochs here."
+            )
         self._sharding = sharding
         chunks = []
         dropped = set()

@@ -77,6 +77,31 @@ def normalize_and_augment(images, mean, std, key, flip=True, out_dtype=jnp.bfloa
     return out
 
 
+@functools.partial(jax.jit, static_argnames=("brightness", "contrast", "saturation"))
+def color_jitter(images, key, brightness=0.4, contrast=0.4, saturation=0.4):
+    """Per-image random brightness/contrast/saturation (torchvision-style ranges:
+    factor ~ U[1-x, 1+x]); float images in, same dtype out. All elementwise — XLA
+    fuses the three adjustments into one HBM pass alongside whatever follows."""
+    dtype = images.dtype
+    x = images.astype(jnp.float32)
+    kb, kc, ks = jax.random.split(key, 3)
+    n = x.shape[0]
+
+    def factors(k, span):
+        return jax.random.uniform(k, (n, 1, 1, 1), minval=1.0 - span,
+                                  maxval=1.0 + span)
+
+    if brightness:
+        x = x * factors(kb, brightness)
+    if contrast:
+        mean = jnp.mean(x, axis=(1, 2, 3), keepdims=True)
+        x = (x - mean) * factors(kc, contrast) + mean
+    if saturation:
+        gray = jnp.mean(x, axis=-1, keepdims=True)
+        x = (x - gray) * factors(ks, saturation) + gray
+    return jnp.clip(x, 0.0, 255.0).astype(dtype)
+
+
 def random_crop(images, key, crop_h, crop_w):
     """Per-image random crop via a single dynamic gather (static output shape)."""
     n, h, w, c = images.shape

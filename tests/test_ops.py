@@ -107,3 +107,39 @@ def test_empty_sample_raises():
     buf = DeviceShuffleBuffer(8, batch, jax.random.PRNGKey(0))
     with pytest.raises(ValueError):
         buf.sample(2)
+
+
+def test_color_jitter_matches_numpy_reference():
+    import jax
+    from petastorm_tpu.ops.image import color_jitter
+
+    rng = np.random.RandomState(50)
+    imgs = rng.randint(0, 256, (4, 8, 8, 3)).astype(np.float32)
+    key = jax.random.PRNGKey(3)
+    out = np.asarray(color_jitter(imgs, key, brightness=0.3, contrast=0.3,
+                                  saturation=0.3))
+    assert out.shape == imgs.shape and out.dtype == np.float32
+    assert 0.0 <= out.min() and out.max() <= 255.0
+    # determinism in the key
+    again = np.asarray(color_jitter(imgs, key, brightness=0.3, contrast=0.3,
+                                    saturation=0.3))
+    np.testing.assert_array_equal(out, again)
+    # a different key jitters differently; zero spans are identity
+    other = np.asarray(color_jitter(imgs, jax.random.PRNGKey(4), brightness=0.3,
+                                    contrast=0.3, saturation=0.3))
+    assert not np.array_equal(out, other)
+    ident = np.asarray(color_jitter(imgs, key, brightness=0, contrast=0, saturation=0))
+    np.testing.assert_allclose(ident, imgs, atol=1e-4)
+
+
+def test_inmem_loader_rejects_infinite_reader(scalar_dataset):
+    from petastorm_tpu.loader import InMemDataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    reader = make_batch_reader(scalar_dataset.url, num_epochs=None)
+    try:
+        with pytest.raises(ValueError, match="num_epochs"):
+            InMemDataLoader(reader, batch_size=8)
+    finally:
+        reader.stop()
+        reader.join()
