@@ -92,3 +92,32 @@ def test_filesystem_resolver_class_compat(tmp_path):
     import pyarrow.fs as pafs
     info = r.filesystem().get_file_info(str(tmp_path))
     assert info.type == pafs.FileType.Directory
+
+
+def test_fsspec_bridge_reads_memory_filesystem():
+    """The fsspec fallback (the GCS/anything-else bridge) exercised END TO END against
+    a real fsspec filesystem — fsspec's built-in memory:// — not just URL dispatch:
+    write parquet through fsspec, read it back through make_batch_reader."""
+    import fsspec
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.reader import make_batch_reader
+
+    fs = fsspec.filesystem("memory")
+    fs.makedirs("/bridge_ds", exist_ok=True)
+    t = pa.table({"id": np.arange(20, dtype=np.int64),
+                  "v": np.arange(20).astype(np.float32)})
+    with fs.open("/bridge_ds/part-0.parquet", "wb") as f:
+        pq.write_table(t, f, row_group_size=8)
+
+    reader = make_batch_reader("memory:///bridge_ds", num_epochs=1, workers_count=1)
+    try:
+        rows = []
+        for b in reader:
+            rows.extend(np.asarray(b.id).tolist())
+    finally:
+        reader.stop()
+        reader.join()
+    assert sorted(rows) == list(range(20))
