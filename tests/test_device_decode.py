@@ -320,3 +320,24 @@ def test_process_pool_device_decode_wire(tmp_path):
     worst = max(np.abs(got[i].astype(int) - ref[i].astype(int)).mean()
                 for i in range(12))
     assert worst < 2.5, worst
+
+
+def test_inmem_loader_over_device_decode_reader(jpeg_dataset):
+    """InMemDataLoader fills through the staged decode path: the resident store holds
+    DECODED images and epochs serve them without re-decoding."""
+    from petastorm_tpu.loader import InMemDataLoader
+
+    expected = _host_decoded(jpeg_dataset)
+    reader = make_batch_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    with InMemDataLoader(reader, batch_size=8, num_epochs=2, seed=5) as loader:
+        seen = 0
+        for batch in loader:
+            imgs = np.asarray(batch["image_jpeg"])
+            ids = np.asarray(batch["id"])
+            assert imgs.dtype == np.uint8 and imgs.shape[1:] == (32, 48, 3)
+            for i, rid in enumerate(ids):
+                ref = expected[int(rid)]
+                assert np.abs(imgs[i].astype(int) - ref.astype(int)).mean() < 2.0
+                seen += 1
+        assert seen == 48  # 24 rows x 2 epochs (drop policy, 24 % 8 == 0)
