@@ -68,3 +68,21 @@ def test_pseudorandom_split_validation():
         in_pseudorandom_split([0.5, 0.6], 0, "k")
     with pytest.raises(ValueError):
         in_pseudorandom_split([0.5], 1, "k")
+
+
+def test_in_intersection_vectorized():
+    p = in_intersection({1, 5}, "tags")
+    col = np.empty(3, dtype=object)
+    col[0], col[1], col[2] = [5, 9], [2, 3], [1]
+    np.testing.assert_array_equal(p.do_include_vectorized({"tags": col}),
+                                  [True, False, True])
+
+
+def test_pseudorandom_split_vectorized_matches_scalar():
+    """The vectorized path (distinct-value md5 cache) must agree element-wise with
+    do_include, including repeated keys."""
+    p = in_pseudorandom_split([0.4, 0.6], 0, "k")
+    keys = np.array(["a", "b", "c", "a", "b", "z"], dtype=object)
+    vec = p.do_include_vectorized({"k": keys})
+    scalar = [p.do_include({"k": k}) for k in keys]
+    np.testing.assert_array_equal(vec, scalar)
