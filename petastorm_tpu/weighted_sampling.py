@@ -18,6 +18,24 @@ class WeightedSamplingReader:
         self._rng = np.random.Generator(np.random.PCG64(seed))
         # mixing readers must agree on ngram-ness (reference behavior)
         self.ngram = readers[0].ngram if hasattr(readers[0], "ngram") else None
+        # downstream consumers (adapters, the JAX loader) read these off the reader;
+        # expose the first reader's and require the others to agree where it matters
+        self.schema = getattr(readers[0], "schema", None)
+        self.transform_spec = getattr(readers[0], "transform_spec", None)
+        self.is_batched_reader = getattr(readers[0], "is_batched_reader", False)
+        for r in readers[1:]:
+            if getattr(r, "is_batched_reader", False) != self.is_batched_reader:
+                raise ValueError(
+                    "Cannot mix per-row and batched readers in WeightedSamplingReader"
+                )
+        fields = getattr(readers[0], "device_decode_fields", frozenset())
+        for r in readers[1:]:
+            if getattr(r, "device_decode_fields", frozenset()) != fields:
+                raise ValueError(
+                    "All mixed readers must stage the same device-decode fields; got "
+                    "%r vs %r" % (sorted(fields),
+                                  sorted(getattr(r, "device_decode_fields", ()))))
+        self.device_decode_fields = fields
 
     def __iter__(self):
         return self

@@ -338,3 +338,33 @@ def test_weighted_sampling_reader(synthetic_dataset):
         ids = [int(r.id) for r in mixed]
     assert len(ids) == 60  # drains both readers
     assert sorted(set(ids)) == list(range(30))
+
+
+def test_weighted_sampling_through_dataloader(scalar_dataset):
+    """Mixed readers feed the JAX DataLoader: the wrapper passes schema /
+    is_batched_reader / device_decode_fields through, and mixing rejects
+    per-row + batched combinations."""
+    from petastorm_tpu import WeightedSamplingReader
+    from petastorm_tpu.loader import DataLoader
+
+    r1 = make_batch_reader(scalar_dataset.url, num_epochs=1)
+    r2 = make_batch_reader(scalar_dataset.url, num_epochs=1)
+    mixed = WeightedSamplingReader([r1, r2], [0.7, 0.3], seed=11)
+    assert mixed.is_batched_reader and mixed.schema is not None
+    total = 0
+    with DataLoader(mixed, batch_size=8, to_device=False, last_batch="partial") as loader:
+        for b in loader:
+            total += len(b["id"])
+    assert total == 2 * len(scalar_dataset.data)
+
+    # per-row + batched mix must be rejected
+    r3 = make_batch_reader(scalar_dataset.url, num_epochs=1)
+    r5 = make_batch_reader(scalar_dataset.url, num_epochs=1)
+    r5.is_batched_reader = False  # simulate a per-row reader cheaply
+    try:
+        with pytest.raises(ValueError, match="mix"):
+            WeightedSamplingReader([r3, r5], [0.5, 0.5])
+    finally:
+        for r in (r3, r5):
+            r.stop()
+            r.join()
