@@ -8,7 +8,7 @@ implementation is TPU-first: deterministic multi-host planning over ``jax.proces
 Arrow record-batch streaming, async ``device_put`` prefetch, Pallas decode kernels.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from petastorm_tpu.errors import (  # noqa: F401
     DecodeFieldError,
