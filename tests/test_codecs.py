@@ -128,3 +128,45 @@ def test_scalar_codec_from_spark_style_tag():
     # our type tags stand in for pyspark.sql.types
     codec = ScalarCodec(ptypes.IntegerType())
     assert codec.arrow_dtype() == __import__("pyarrow").int32()
+
+
+def test_randomized_codec_roundtrips():
+    """Property-style sweep: random shapes/dtypes round-trip bit-exact through
+    Ndarray/CompressedNdarray codecs, and scalar codecs preserve value/dtype —
+    a broad net under the per-codec unit tests."""
+    from petastorm_tpu import types as ptypes
+    from petastorm_tpu.codecs import (CompressedNdarrayCodec, NdarrayCodec,
+                                      ScalarCodec)
+    from petastorm_tpu.unischema import UnischemaField
+
+    rng = np.random.RandomState(77)
+    dtypes = [np.uint8, np.int16, np.int32, np.int64, np.float32, np.float64, np.bool_]
+    for trial in range(30):
+        dt = dtypes[trial % len(dtypes)]
+        ndim = rng.randint(1, 4)
+        shape = tuple(int(s) for s in rng.randint(1, 9, ndim))
+        if dt is np.bool_:
+            value = rng.rand(*shape) > 0.5
+        elif np.issubdtype(dt, np.floating):
+            value = rng.standard_normal(shape).astype(dt)
+        else:
+            value = rng.randint(0, 100, shape).astype(dt)
+        for codec in (NdarrayCodec(), CompressedNdarrayCodec()):
+            field = UnischemaField("f", dt, shape, codec, False)
+            out = codec.decode(field, bytes(codec.encode(field, value)))
+            assert out.dtype == value.dtype
+            np.testing.assert_array_equal(out, value)
+
+    scalar_cases = [
+        (np.int32, ptypes.IntegerType(), 42),
+        (np.int64, ptypes.LongType(), -7),
+        (np.float32, ptypes.FloatType(), 1.5),
+        (np.float64, ptypes.DoubleType(), -2.25),
+        (np.bool_, ptypes.BooleanType(), True),
+    ]
+    for np_dtype, tag, v in scalar_cases:
+        codec = ScalarCodec(tag)
+        field = UnischemaField("s", np_dtype, (), codec, False)
+        out = codec.decode(field, codec.encode(field, np_dtype(v)))
+        assert out == np_dtype(v)
+        assert np.dtype(type(out)) == np.dtype(np_dtype) or out.dtype == np_dtype
