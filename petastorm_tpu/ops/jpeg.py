@@ -51,6 +51,9 @@ class JpegPlanes:
     #: Lets :func:`stack_jpeg_coefficients` re-assemble batches by slicing/gathering the
     #: parent buffers instead of np.stack over per-row objects.
     batch_ref: tuple | None = None
+    #: per component, the max ZIGZAG index with any nonzero coefficient (from the
+    #: native batch decode) — lets the device transfer ship only the zigzag prefix.
+    kmax: tuple | None = None
 
     def detach(self):
         """Return an equivalent ``JpegPlanes`` that owns its own coefficient copies.
@@ -65,13 +68,14 @@ class JpegPlanes:
             JpegComponent(c.blocks.copy(), c.qtable.copy(), c.h_samp, c.v_samp)
             for c in self.components
         ]
-        return JpegPlanes(self.height, self.width, comps, batch_ref=None)
+        return JpegPlanes(self.height, self.width, comps, batch_ref=None,
+                          kmax=self.kmax)
 
     def __reduce__(self):
         # pickle (process-pool IPC, disk cache) must ship ONLY this row: the default
         # reduce would serialize batch_ref's entire row-group buffers per row
         d = self.detach()
-        return (JpegPlanes, (d.height, d.width, d.components, None))
+        return (JpegPlanes, (d.height, d.width, d.components, None, d.kmax))
 
 
 class _HuffTable:
@@ -434,7 +438,7 @@ def entropy_decode_jpeg_batch(blobs):
 
     if not native.native_available():
         raise RuntimeError("native jpeg decoder unavailable: %s" % native.native_error())
-    layout, coeffs, qtabs, status = native.jpeg_decode_coeffs_batch_native(blobs)
+    layout, coeffs, qtabs, kmax, status = native.jpeg_decode_coeffs_batch_native(blobs)
     height, width, comps_layout = layout
     if len(comps_layout) not in (1, 3):
         raise ValueError(
@@ -450,7 +454,8 @@ def entropy_decode_jpeg_batch(blobs):
             JpegComponent(coeffs[c][i].reshape(by, bx, 64), qtabs[i, c], h, v)
             for c, (h, v, by, bx) in enumerate(comps_layout)
         ]
-        out.append(JpegPlanes(height, width, comps, batch_ref=(coeffs, qtabs, i)))
+        out.append(JpegPlanes(height, width, comps, batch_ref=(coeffs, qtabs, i),
+                              kmax=kmax))
     return out
 
 
@@ -496,23 +501,36 @@ def _idct_scaled(scaled):
 
 
 @functools.lru_cache(maxsize=32)
-def _batched_stage2(layout):
+def _batched_stage2(layout, ks=None):
     """Layout-specialized jitted decoder: stacked coefficient arrays → (n, h, w, 3)
     uint8 RGB. One Pallas IDCT dispatch per component for the WHOLE batch (vs one jit
     per image — VERDICT r1 #1). The batch size is taken from the input shapes, so jit's
-    own shape specialization handles varying group sizes."""
+    own shape specialization handles varying group sizes.
+
+    ``ks`` (per component, multiples of 8) selects the zigzag-truncated transfer
+    variant: inputs arrive as ``(n, blocks, k)`` zigzag-prefix packs (all dropped
+    coefficients are zero — ``kmax`` contract) and are zero-padded + inverse-permuted
+    back to natural order on device, fused into the same program. Bit-identical
+    output; ~k/64 of the H2D bytes."""
     import jax
     import jax.numpy as jnp
 
     height, width, comp_layout = layout
     hmax = max(h for h, _v, _by, _bx in comp_layout)
     vmax = max(v for _h, v, _by, _bx in comp_layout)
+    unzig = jnp.asarray(UNZIGZAG)
 
     def fn(coeffs, qtabs):
         n = coeffs[0].shape[0]
         planes = []
-        for (h_samp, v_samp, by, bx), coef, qtab in zip(comp_layout, coeffs, qtabs):
-            # coef: (n, by*bx, 64) int16; qtab: (n, 64) int32 (per-image: quality may vary)
+        for ci, ((h_samp, v_samp, by, bx), coef, qtab) in enumerate(
+                zip(comp_layout, coeffs, qtabs)):
+            # coef: (n, by*bx, 64) int16 natural order — or (n, by*bx, ks[ci])
+            # zigzag prefix when this component was packed; qtab: (n, 64) int32
+            # (per-image: quality may vary)
+            if ks is not None and ks[ci] < 64:
+                coef = jnp.pad(coef, ((0, 0), (0, 0), (0, 64 - ks[ci])))
+                coef = jnp.take(coef, unzig, axis=-1)
             scaled = coef.astype(jnp.float32) * qtab.astype(jnp.float32)[:, None, :]
             pix = _idct_scaled(scaled.reshape(n * by * bx, 64))
             pix = jnp.clip(jnp.round(pix), 0.0, 255.0)  # libjpeg range-limits at IDCT out
@@ -605,15 +623,68 @@ def decode_jpeg_batch(planes_list):
         groups.setdefault(_layout_key(p), []).append(i)
     if len(groups) == 1:
         layout, = groups
-        coeffs, qtabs = stack_jpeg_coefficients(planes_list)
-        return _batched_stage2(layout)(coeffs, qtabs)
+        return _decode_group(layout, planes_list)
     parts = []
     order = []
     for layout, indices in groups.items():
         group = [planes_list[i] for i in indices]
-        coeffs, qtabs = stack_jpeg_coefficients(group)
-        parts.append(_batched_stage2(layout)(coeffs, qtabs))
+        parts.append(_decode_group(layout, group))
         order.extend(indices)
     stacked = jnp.concatenate(parts, axis=0)
     inverse = np.argsort(np.asarray(order))
     return stacked[jnp.asarray(inverse)]
+
+
+#: Coarse zigzag-prefix buckets: few distinct compiled variants (compile churn is the
+#: real cost — each (layout, ks) is a full XLA program), still 75%/50% H2D savings.
+#: 64 means "ship the full spectrum for this component" (no pack, no device permute).
+_K_BUCKETS = (16, 32, 64)
+
+#: Per-layout sticky buckets: ks only ever GROWS, so content variation across row
+#: groups costs at most len(_K_BUCKETS)-1 recompiles per component over the process
+#: lifetime instead of one per distinct kmax.
+_STICKY_KS: dict = {}
+
+
+def _truncation_ks(group, layout=None):
+    """Per-component zigzag-prefix buckets for a same-layout group, or None when
+    truncation is unavailable (a row without kmax) or useless (every component at
+    full width)."""
+    kms = [p.kmax for p in group]
+    if any(km is None for km in kms):
+        return None
+    ncomp = len(group[0].components)
+
+    def bucket(kcount):
+        for b in _K_BUCKETS:
+            if kcount <= b:
+                return b
+        return 64
+
+    ks = [bucket(max(km[c] for km in kms) + 1) for c in range(ncomp)]
+    if layout is not None:
+        prev = _STICKY_KS.get(layout)
+        if prev is not None:
+            ks = [max(a, b) for a, b in zip(ks, prev)]
+        _STICKY_KS[layout] = ks
+    if all(k >= 64 for k in ks):
+        return None
+    return tuple(ks)
+
+
+def _decode_group(layout, group):
+    """One same-layout group → device decode, shipping the zigzag prefix when the
+    batch's kmax says most of the spectrum is zero. Components at full width pass
+    through unpacked (no host copy, no device permute)."""
+    coeffs, qtabs = stack_jpeg_coefficients(group)
+    ks = _truncation_ks(group, layout)
+    if ks is not None:
+        from petastorm_tpu.ops import native
+
+        if native.native_available():
+            coeffs = tuple(
+                native.jpeg_zigzag_truncate_native(c, k) if k < 64 else c
+                for c, k in zip(coeffs, ks)
+            )
+            return _batched_stage2(layout, ks)(coeffs, qtabs)
+    return _batched_stage2(layout)(coeffs, qtabs)

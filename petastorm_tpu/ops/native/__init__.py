@@ -118,8 +118,13 @@ def _load():
                 ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
                 ctypes.c_int32, ctypes.POINTER(_JpegLayout),
                 ctypes.POINTER(ctypes.POINTER(ctypes.c_int16)),
-                ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int32)]
+                ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32)]
             lib.ptpu_jpeg_decode_batch.restype = ctypes.c_int32
+            lib.ptpu_jpeg_zigzag_truncate.argtypes = [
+                ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int16),
+                ctypes.c_int64, ctypes.c_int32]
+            lib.ptpu_jpeg_zigzag_truncate.restype = None
             _LIB = lib
         except Exception as e:  # noqa: BLE001 — degrade to Python fallback
             _LIB_ERR = str(e)
@@ -170,12 +175,15 @@ def jpeg_decode_coeffs_batch_native(blobs):
     buffer copies, GIL released for the entire batch (the per-image path spends ~2/3 of
     its wall in Python wrapper overhead + ctypes→numpy copies on 1-core hosts).
 
-    Returns ``(layout, coeffs, qtabs, status)``:
+    Returns ``(layout, coeffs, qtabs, kmax, status)``:
 
     - ``layout``: ``(height, width, ((h_samp, v_samp, blocks_y, blocks_x), ...))``
       parsed from the first stream
     - ``coeffs``: tuple of ``(n, blocks_y*blocks_x, 64)`` int16 arrays, one per component
     - ``qtabs``: ``(n, ncomp, 64)`` uint16 natural-order quantization tables
+    - ``kmax``: per component, the max ZIGZAG index any stream wrote — every
+      coefficient beyond it is zero, so transfers may ship only the prefix
+      (:func:`jpeg_zigzag_truncate_native`)
     - ``status``: ``(n,)`` int32 — 0 decoded; nonzero = that stream failed
       (lossless/arithmetic mode / corrupt / different layout; its slice is zeroed) and
       the caller must re-decode it individually (e.g. cv2 host fallback). Baseline and
@@ -210,10 +218,12 @@ def jpeg_decode_coeffs_batch_native(blobs):
         coeffs.append(arr)
         block_ptrs[c] = arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int16))
     qtabs = np.empty((n, ncomp, 64), dtype=np.uint16)
+    kmax = np.zeros(4, dtype=np.int32)
     status = np.empty(n, dtype=np.int32)
     lib.ptpu_jpeg_decode_batch(
         datas, lens, n, ctypes.byref(layout), block_ptrs,
         qtabs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        kmax.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         status.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     layout_key = (
@@ -222,7 +232,32 @@ def jpeg_decode_coeffs_batch_native(blobs):
         tuple((layout.h_samp[c], layout.v_samp[c], layout.blocks_y[c], layout.blocks_x[c])
               for c in range(ncomp)),
     )
-    return layout_key, tuple(coeffs), qtabs, status
+    return layout_key, tuple(coeffs), qtabs, tuple(int(k) for k in kmax[:ncomp]), status
+
+
+def jpeg_zigzag_truncate_native(src, k):
+    """(n, nblocks, 64) int16 natural-order coefficients → (n, nblocks, k) int16
+    zigzag-prefix pack (``dst[..., j] = src[..., zigzag_to_natural(j)]``). The caller
+    guarantees all coefficients beyond zigzag index k-1 are zero (``kmax`` from the
+    batch decode)."""
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native jpeg decoder unavailable: %s" % _LIB_ERR)
+    src = np.ascontiguousarray(src, dtype=np.int16)
+    n, nb, last = src.shape
+    if last != 64:
+        raise ValueError("expected trailing dim 64, got %d" % last)
+    if not 1 <= int(k) <= 64:  # k > 64 would read past the zigzag table in C
+        raise ValueError("k must be in [1, 64], got %r" % (k,))
+    dst = np.empty((n, nb, int(k)), dtype=np.int16)
+    lib.ptpu_jpeg_zigzag_truncate(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+        n * nb, int(k),
+    )
+    return dst
 
 
 def jpeg_decode_coeffs_native(data):

@@ -219,6 +219,7 @@ struct ProgScanArgs {
   int width, height, hmax, vmax, mcus_x, mcus_y;
   int16_t* const* blocks;   // per frame component c: padded grid
   const int* out_bx;        // per frame component: padded blocks_x (row stride)
+  int32_t* kmax;            // nullable: per frame component, max zigzag index written
 };
 
 inline int prog_find_next_marker(const uint8_t* data, int64_t len, int64_t from,
@@ -354,6 +355,7 @@ int decode_progressive_scan(const ProgScanArgs& a, int64_t* end_pos) {
                 k += r;
                 if (k > a.Se) return PTPU_JPEG_CORRUPT;
                 blk[kZigzagToNatural[k]] = (int16_t)(extend(br.take(s), s) * p1);
+                if (a.kmax && k > a.kmax[c]) a.kmax[c] = k;
                 k++;
               }
             }
@@ -402,6 +404,7 @@ int decode_progressive_scan(const ProgScanArgs& a, int64_t* end_pos) {
               }
               if (s && k <= a.Se) {
                 blk[kZigzagToNatural[k]] = (int16_t)newval;
+                if (a.kmax && k > a.kmax[c]) a.kmax[c] = k;
               }
               k++;
             }
@@ -485,10 +488,13 @@ const char* ptpu_jpeg_error_string(int code) {
 // written into the caller's buffers (dst[c]: blocks_y*blocks_x*64 int16 each, qdst:
 // ncomp*64 uint16 natural order) after verifying the stream's layout equals ``expect``;
 // nothing is allocated and nothing must be freed. Otherwise blocks are malloc'ed into
-// ``out`` (ptpu_jpeg_free_coeffs frees them).
+// ``out`` (ptpu_jpeg_free_coeffs frees them). ``kmax`` (nullable, per component) is
+// raised to the largest ZIGZAG index this stream writes a coefficient at — free to
+// track during entropy decode, and it lets the caller ship only the nonzero zigzag
+// prefix to the device.
 static int decode_impl(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out,
                        const PtpuJpegLayout* expect, int16_t* const* dst,
-                       uint16_t* qdst) {
+                       uint16_t* qdst, int32_t* kmax) {
   memset(out, 0, sizeof(*out));
   if (len < 4 || data[0] != 0xFF || data[1] != 0xD8) return PTPU_JPEG_NOT_JPEG;
 
@@ -747,6 +753,7 @@ static int decode_impl(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out,
         pargs.mcus_y = mcus_y;
         pargs.blocks = out->blocks;
         pargs.out_bx = out->blocks_x;
+        pargs.kmax = kmax;
         int64_t next_pos = 0;
         rc = decode_progressive_scan(pargs, &next_pos);
         if (rc != PTPU_JPEG_OK) goto done;
@@ -829,6 +836,7 @@ static int decode_impl(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out,
                   k += r;
                   if (k > 63) break;
                   blk[kZigzagToNatural[k]] = (int16_t)extend(br.take(s), s);
+                  if (kmax && k > kmax[c]) kmax[c] = k;
                   k++;
                 }
               }
@@ -851,7 +859,7 @@ done:
 }
 
 int ptpu_jpeg_decode_coeffs(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out) {
-  return decode_impl(data, len, out, nullptr, nullptr, nullptr);
+  return decode_impl(data, len, out, nullptr, nullptr, nullptr, nullptr);
 }
 
 // Parse only as far as the frame header; fills the decode layout without touching the
@@ -914,31 +922,54 @@ int ptpu_jpeg_parse_layout(const uint8_t* data, int64_t len, PtpuJpegLayout* out
 // ``expect``'s layout, written into caller-allocated stacked buffers:
 //   out_blocks[c] : (n, blocks_y[c]*blocks_x[c], 64) int16, C-contiguous
 //   out_qtabs     : (n, ncomp, 64) uint16, natural order
+//   out_kmax      : per component (size 4), max ZIGZAG index any stream wrote —
+//                   coefficients at zigzag positions > out_kmax[c] are all zero, so
+//                   the caller may ship only the prefix (ptpu_jpeg_zigzag_truncate)
 // status[i] = PTPU_JPEG_OK or the stream's error code (its slice is left zeroed; the
 // caller re-decodes failed rows individually). Returns the number of failed streams.
 // One call decodes a whole row group with the GIL released.
 int ptpu_jpeg_decode_batch(const uint8_t* const* datas, const int64_t* lens, int32_t n,
                            const PtpuJpegLayout* expect, int16_t* const* out_blocks,
-                           uint16_t* out_qtabs, int32_t* status) {
+                           uint16_t* out_qtabs, int32_t* out_kmax, int32_t* status) {
   size_t stride[4];
   for (int c = 0; c < expect->ncomp && c < 4; c++)
     stride[c] = (size_t)expect->blocks_y[c] * expect->blocks_x[c] * 64;
+  for (int c = 0; c < 4; c++) out_kmax[c] = 0;
   int failures = 0;
   for (int32_t i = 0; i < n; i++) {
     int16_t* dst[4] = {nullptr, nullptr, nullptr, nullptr};
     for (int c = 0; c < expect->ncomp && c < 4; c++)
       dst[c] = out_blocks[c] + (size_t)i * stride[c];
     PtpuJpegCoeffs tmp;
+    int32_t kmax_local[4] = {0, 0, 0, 0};
     int rc = decode_impl(datas[i], lens[i], &tmp, expect, dst,
-                         out_qtabs + (size_t)i * expect->ncomp * 64);
+                         out_qtabs + (size_t)i * expect->ncomp * 64, kmax_local);
     status[i] = rc;
     if (rc != PTPU_JPEG_OK) {
       failures++;
       for (int c = 0; c < expect->ncomp && c < 4; c++)
         memset(dst[c], 0, stride[c] * sizeof(int16_t));
+    } else {
+      // merge only successful streams: a corrupt stream's partial garbage writes are
+      // zeroed above and must not inflate the row group's kmax
+      for (int c = 0; c < expect->ncomp && c < 4; c++)
+        if (kmax_local[c] > out_kmax[c]) out_kmax[c] = kmax_local[c];
     }
   }
   return failures;
+}
+
+// Pack the zigzag prefix: src (nblocks, 64) int16 natural order → dst (nblocks, k)
+// int16 where dst[b, j] = src[b, zigzag_to_natural(j)]. Coefficients beyond zigzag
+// index k-1 are dropped (the caller guarantees they are zero via out_kmax). Reads only
+// the needed elements — ~k/64 of the bytes a numpy fancy-gather touches.
+void ptpu_jpeg_zigzag_truncate(const int16_t* src, int16_t* dst, int64_t nblocks,
+                               int32_t k) {
+  for (int64_t b = 0; b < nblocks; b++) {
+    const int16_t* s = src + b * 64;
+    int16_t* d = dst + b * k;
+    for (int32_t j = 0; j < k; j++) d[j] = s[kZigzagToNatural[j]];
+  }
 }
 
 }  // extern "C"

@@ -484,3 +484,61 @@ def test_truncated_streams_never_crash():
                 native.jpeg_decode_coeffs_native(data[:cut])
             except (ValueError, RuntimeError):
                 pass
+
+
+def test_kmax_bound_and_truncated_decode_bit_equal():
+    """The batch decoder's kmax must bound every nonzero zigzag index, the native
+    zigzag pack must equal the numpy gather, and the truncated device decode must be
+    BIT-equal to the per-image path (truncation only drops guaranteed zeros)."""
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    from petastorm_tpu.ops.jpeg import (ZIGZAG, decode_jpeg_batch,
+                                        entropy_decode_jpeg_batch,
+                                        entropy_decode_jpeg_fast,
+                                        stack_jpeg_coefficients, _truncation_ks)
+
+    rng = np.random.RandomState(61)
+    # smooth images -> sparse spectra -> truncation path taken
+    blobs = []
+    for _ in range(6):
+        img = cv2.GaussianBlur(rng.randint(0, 256, (48, 64, 3)).astype(np.float32),
+                               (7, 7), 2.0).clip(0, 255).astype(np.uint8)
+        ok, enc = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 85])
+        blobs.append(enc.tobytes())
+    batch = entropy_decode_jpeg_batch(blobs)
+    assert batch[0].kmax is not None
+    coeffs, _ = stack_jpeg_coefficients(batch)
+    for c, arr in enumerate(coeffs):
+        nz = np.where((arr != 0).any(axis=(0, 1))[ZIGZAG])[0]
+        true_kmax = int(nz[-1]) if len(nz) else 0
+        assert batch[0].kmax[c] >= true_kmax
+
+    ks = _truncation_ks(batch)
+    assert ks is not None  # smooth data must actually exercise the packed path
+    packed = native.jpeg_zigzag_truncate_native(coeffs[0], ks[0])
+    np.testing.assert_array_equal(packed, coeffs[0][:, :, ZIGZAG[:ks[0]]])
+
+    out = np.asarray(decode_jpeg_batch(batch))
+    for i, blob in enumerate(blobs):
+        ref = np.asarray(decode_jpeg_device_stage(entropy_decode_jpeg_fast(blob)))
+        np.testing.assert_array_equal(out[i], ref)
+
+
+def test_kmax_survives_detach_and_pickle():
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    import pickle
+
+    from petastorm_tpu.ops.jpeg import entropy_decode_jpeg_batch
+
+    rng = np.random.RandomState(62)
+    ok, enc = cv2.imencode(".jpg", rng.randint(0, 256, (32, 32, 3), dtype=np.uint8),
+                           [cv2.IMWRITE_JPEG_QUALITY, 90])
+    p = entropy_decode_jpeg_batch([enc.tobytes()])[0]
+    assert p.kmax is not None
+    assert p.detach().kmax == p.kmax
+    assert pickle.loads(pickle.dumps(p)).kmax == p.kmax
