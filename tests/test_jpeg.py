@@ -542,3 +542,30 @@ def test_kmax_survives_detach_and_pickle():
     assert p.kmax is not None
     assert p.detach().kmax == p.kmax
     assert pickle.loads(pickle.dumps(p)).kmax == p.kmax
+
+
+def test_truncated_decode_with_progressive_streams():
+    """kmax tracking covers progressive scans too: smooth progressive JPEGs take the
+    zigzag-prefix path and the output stays bit-equal to the per-image decode."""
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    from petastorm_tpu.ops.jpeg import (decode_jpeg_batch, entropy_decode_jpeg_batch,
+                                        entropy_decode_jpeg_fast, _truncation_ks)
+
+    rng = np.random.RandomState(63)
+    blobs = []
+    for _ in range(4):
+        img = cv2.GaussianBlur(rng.randint(0, 256, (48, 48, 3)).astype(np.float32),
+                               (9, 9), 3.0).clip(0, 255).astype(np.uint8)
+        ok, enc = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 80,
+                                             cv2.IMWRITE_JPEG_PROGRESSIVE, 1])
+        blobs.append(enc.tobytes())
+    batch = entropy_decode_jpeg_batch(blobs)
+    assert all(p is not None and p.kmax is not None for p in batch)
+    assert _truncation_ks(batch) is not None
+    out = np.asarray(decode_jpeg_batch(batch))
+    for i, blob in enumerate(blobs):
+        ref = np.asarray(decode_jpeg_device_stage(entropy_decode_jpeg_fast(blob)))
+        np.testing.assert_array_equal(out[i], ref)
