@@ -716,6 +716,12 @@ class InMemDataLoader:
                 "infinite reader (num_epochs=None) would never finish the fill. Build "
                 "the reader with num_epochs=1 and set epochs here."
             )
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "InMemDataLoader is single-process: the resident store and gathers "
+                "are addressable-device only. Under multi-process JAX use the "
+                "streaming DataLoader (global-array assembly) instead."
+            )
         self._sharding = sharding
         chunks = []
         dropped = set()
