@@ -1,0 +1,32 @@
+"""Examples must at least parse and import-resolve against the package (guards
+against API drift rotting the acceptance-config scripts without anyone noticing;
+full runs are exercised manually/by the driver, not in the unit suite)."""
+import ast
+import os
+import py_compile
+
+import pytest
+
+EXAMPLES_ROOT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                             "examples")
+SCRIPTS = sorted(
+    os.path.join(root, f)
+    for root, _dirs, files in os.walk(EXAMPLES_ROOT)
+    for f in files if f.endswith(".py")
+)
+
+
+@pytest.mark.parametrize("script", SCRIPTS, ids=[os.path.relpath(s, EXAMPLES_ROOT)
+                                                 for s in SCRIPTS])
+def test_example_compiles_and_imports_resolve(script, tmp_path):
+    py_compile.compile(script, cfile=str(tmp_path / "out.pyc"), doraise=True)
+    # every `petastorm_tpu...` import named at module level must resolve
+    tree = ast.parse(open(script).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.startswith("petastorm_tpu"):
+            mod = __import__(node.module, fromlist=[a.name for a in node.names])
+            for alias in node.names:
+                assert hasattr(mod, alias.name), (
+                    "%s imports %s from %s which does not exist"
+                    % (script, alias.name, node.module))
