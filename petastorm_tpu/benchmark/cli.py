@@ -23,6 +23,10 @@ def main(argv=None):
                         help="two-stage JPEG decode (requires --loader for the device half)")
     parser.add_argument("--loader-batch-size", type=int, default=256)
     args = parser.parse_args(argv)
+    if args.decode_on_device and not args.loader:
+        parser.error("--decode-on-device requires --loader: without the loader's device "
+                     "half the reader yields stage-1 staging payloads, not images, and "
+                     "the throughput number would be meaningless")
 
     from petastorm_tpu.benchmark.throughput import reader_throughput
     from petastorm_tpu.reader import make_batch_reader, make_reader

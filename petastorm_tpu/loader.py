@@ -282,11 +282,19 @@ class DataLoader:
         self._transfer_thread = None
         self._stop = threading.Event()
         self._producer_error = None
+        #: bumped by every _start_producer(); a superseded iterator's finalizer
+        #: compares its captured generation before calling stop() so closing/GC-ing
+        #: an old iterator cannot kill the pipeline a newer __iter__ armed
+        self._generation = 0
         self.stats = PipelineStats()
 
     # -- producer (background thread: reader → host batches) ---------------------------
+    #
+    # The host-batch queue is passed IN (not read off self) so a thread from a
+    # superseded iteration that outlives join()'s timeout keeps draining/feeding its
+    # OWN queue and can never steal batches from the queue a newer __iter__ installed.
 
-    def _produce(self):
+    def _produce(self, q):
         batcher = _HostBatcher(self.local_batch_size, self._shuffling_queue_capacity,
                                self._seed)
         stats = self.stats
@@ -333,8 +341,14 @@ class DataLoader:
                         return
                     if self.last_batch == "pad":
                         batch = self._pad(batch)
-                    self._queue.put(batch)
+                    if not _put_with_stop(q, batch, self._stop):
+                        return
+            # tail flush: the same per-batch stop check as the main loop — a stop()
+            # during the flush must not leave the producer blocked on an untimed put
+            # after the consumer already exited on the re-injected sentinel
             for batch in batcher.finish():
+                if self._stop.is_set():
+                    return
                 n = len(next(iter(batch.values()))) if batch else 0
                 if self.last_batch == "drop":
                     # the shuffling buffer can still hold whole batches at reader
@@ -343,11 +357,12 @@ class DataLoader:
                         continue
                 elif self.last_batch == "pad":
                     batch = self._pad(batch)
-                self._queue.put(batch)
+                if not _put_with_stop(q, batch, self._stop):
+                    return
         except Exception as e:  # noqa: BLE001 — surfaced to consumer thread
             self._producer_error = e
         finally:
-            _put_sentinel(self._queue, self._stop)
+            _put_sentinel(q, self._stop)
 
     def _pad(self, batch):
         n = len(next(iter(batch.values()))) if batch else 0
@@ -368,17 +383,41 @@ class DataLoader:
 
     # -- consumer side ------------------------------------------------------------------
 
-    def _host_batches(self):
+    def _start_producer(self):
+        """Arm the pipeline for a fresh iteration. MUST run on the consumer thread
+        (ADVICE r2: ``_stop.clear()`` used to run on the transfer thread at first
+        advance, so a ``stop()`` issued around iteration start could be silently
+        undone, and a second ``__iter__`` could race a still-live previous set of
+        threads). A new ``__iter__`` supersedes any previous one: the old pipeline
+        is stopped and joined before state is reset."""
+        if (self._producer is not None and self._producer.is_alive()) or (
+                self._transfer_thread is not None and self._transfer_thread.is_alive()):
+            self.stop()
+            self.join()
+            if (self._producer is not None and self._producer.is_alive()) or (
+                    self._transfer_thread is not None
+                    and self._transfer_thread.is_alive()):
+                # join() timed out: resetting _stop under a live thread would let a
+                # zombie keep running into the new iteration — refuse instead
+                raise RuntimeError(
+                    "previous DataLoader iteration did not shut down within the join "
+                    "timeout (a pipeline thread is still alive — likely stuck in a "
+                    "long device dispatch); cannot safely start a new iteration")
+        self._generation += 1
         self._stop.clear()
         self._producer_error = None
         self.stats.reset()
         self._queue = queue.Queue(maxsize=max(2, self._host_queue_size))
-        self._producer = threading.Thread(target=self._produce, name="ptpu-loader", daemon=True)
+        self._dev_queue = None
+        self._producer = threading.Thread(target=self._produce, args=(self._queue,),
+                                          name="ptpu-loader", daemon=True)
         self._producer.start()
+
+    def _host_batches(self, q):
         stats = self.stats
         while True:
             t0 = time.perf_counter()
-            item = self._queue.get()
+            item = q.get()
             stats.queue_wait_s += time.perf_counter() - t0
             if item is _SENTINEL:
                 if self._producer_error is not None:
@@ -484,19 +523,22 @@ class DataLoader:
         return arrays
 
     def __iter__(self):
+        self._start_producer()
+        gen = self._generation  # superseded iterators must not stop a newer pipeline
+        host_q = self._queue
         if not self.to_device:
             # staged decode still has to finish (decode runs on device, delivery is
             # host numpy) so CPU-only consumers see images, not coefficient payloads
             if getattr(self.reader, "device_decode_fields", None):
-                for batch in self._host_batches():
+                for batch in self._host_batches(host_q):
                     rest, staged = self._decode_staged(batch)
                     rest.update({k: np.asarray(v) for k, v in staged.items()})
                     yield rest
             else:
-                yield from self._host_batches()
+                yield from self._host_batches(host_q)
             return
         if self.prefetch <= 0:  # synchronous transfer (debug)
-            for batch in self._host_batches():
+            for batch in self._host_batches(host_q):
                 yield self._to_device(batch)
             return
         # Async transfer thread: host batches → decode dispatch + device_put → a small
@@ -509,10 +551,11 @@ class DataLoader:
 
         def _transfer():
             try:
-                for batch in self._host_batches():
+                for batch in self._host_batches(host_q):
                     if self._stop.is_set():
                         return
-                    dev_q.put(self._to_device(batch))
+                    if not _put_with_stop(dev_q, self._to_device(batch), self._stop):
+                        return
             except Exception as e:  # noqa: BLE001 — surfaced to consumer thread
                 transfer_error.append(e)
             finally:
@@ -535,9 +578,11 @@ class DataLoader:
                     return
                 yield item
         finally:
-            if not finished:
+            if not finished and gen == self._generation:
                 # iterator abandoned mid-epoch (break / del): stop the pipeline so the
-                # transfer thread does not keep pinning prefetched device batches
+                # transfer thread does not keep pinning prefetched device batches.
+                # Guarded by generation: closing a SUPERSEDED iterator (a newer
+                # __iter__ already re-armed the loader) must not kill the new one.
                 self.stop()
 
     # -- lifecycle ----------------------------------------------------------------------
@@ -555,6 +600,16 @@ class DataLoader:
                         q.get_nowait()
                 except Exception:  # noqa: BLE001
                     pass
+                # the drain may have consumed the producer's end-of-stream sentinel
+                # while the downstream thread is blocked in an untimed get() with the
+                # producer already exited (ADVICE r2 teardown race) — re-put it so the
+                # blocked get always wakes. The queue was just drained, so put_nowait
+                # cannot be full except under a concurrent producer put, in which case
+                # that put itself unblocks the get.
+                try:
+                    q.put_nowait(_SENTINEL)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def join(self):
         if self._producer is not None:
@@ -570,6 +625,20 @@ class DataLoader:
         self.join()
         self.reader.stop()
         self.reader.join()
+
+
+def _put_with_stop(q, item, stop_event):
+    """Bounded-queue put that gives up once the loader is stopped: an untimed put can
+    block forever when stop() wins the race for the slot freed by its own drain (the
+    consumer is gone, nothing ever drains again). Returns False when stopped."""
+    full = queue.Full  # bound early: may run during interpreter teardown
+    while True:
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except full:
+            if stop_event.is_set():
+                return False
 
 
 def _put_sentinel(q, stop_event):
@@ -650,6 +719,20 @@ def _resolve_local_batch(batch_size, sharding):
     return local_batch_size(batch_size, sharding.mesh, batch_axes=axes)
 
 
+def _batch_shard_count(sharding):
+    """How many ways the sharding splits the batch (leading) axis; 1 when replicated
+    or not a NamedSharding (single-device placements always lay out any row count)."""
+    import jax.sharding as jsh
+
+    if isinstance(sharding, jsh.NamedSharding):
+        spec0 = sharding.spec[0] if len(sharding.spec) else None
+        if spec0 is None:
+            return 1
+        axes = (spec0,) if isinstance(spec0, str) else tuple(spec0)
+        return int(np.prod([sharding.mesh.shape[a] for a in axes]))
+    return 1
+
+
 def _matching_sharding(sharding, arr):
     """Adapt a batch-axis sharding to an array's rank (replicate the trailing axes)."""
     import jax.sharding as jsh
@@ -691,6 +774,9 @@ class InMemDataLoader:
         ``dp`` mesh axis).
     last_batch : {"drop", "partial"}
         Remainder policy per epoch (``pad`` is pointless here — resize the store).
+        With ``sharding``, a ``partial`` tail batch is laid out per the sharding when
+        its row count divides the batch axis, and yielded unsharded (default layout)
+        otherwise — a pjit'd step with fixed ``in_shardings`` should use ``drop``.
     """
 
     def __init__(self, reader, batch_size, num_epochs=1, shuffle=True, seed=0,
@@ -797,9 +883,22 @@ class InMemDataLoader:
                 if len(idx) < self.batch_size and self.last_batch == "drop":
                     break
                 batch = self._gather(self._store, idx)
-                if self._sharding is not None and len(idx) == self.batch_size:
-                    batch = {k: jax.device_put(v, _matching_sharding(self._sharding, v))
-                             for k, v in batch.items()}
+                if self._sharding is not None:
+                    # shard the short final batch too when its row count divides the
+                    # sharding's batch axis; otherwise it stays on the gather's layout
+                    # (documented: a pjit'd step with fixed in_shardings will see one
+                    # differently-laid-out tail batch — use last_batch='drop' there).
+                    # Divisibility is checked explicitly — a blanket except would
+                    # misreport genuine sharding bugs (rank/spec mismatch) as a
+                    # tail-batch artifact and transfer columns only to discard them.
+                    if len(idx) % _batch_shard_count(self._sharding) == 0:
+                        batch = {k: jax.device_put(v, _matching_sharding(self._sharding, v))
+                                 for k, v in batch.items()}
+                    else:
+                        logger.warning(
+                            "InMemDataLoader: final partial batch (%d rows) does not "
+                            "divide the sharding's batch axis; yielded unsharded",
+                            len(idx))
                 if self._device_transform is not None:
                     if self._jitted_transform is None:
                         self._jitted_transform = jax.jit(self._device_transform)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import struct
+import threading
 
 import numpy as np
 
@@ -642,8 +643,12 @@ _K_BUCKETS = (16, 32, 64)
 
 #: Per-layout sticky buckets: ks only ever GROWS, so content variation across row
 #: groups costs at most len(_K_BUCKETS)-1 recompiles per component over the process
-#: lifetime instead of one per distinct kmax.
+#: lifetime instead of one per distinct kmax. Updated from loader transfer threads —
+#: the compare-and-grow must be atomic or two concurrent loaders can interleave
+#: read-modify-write and transiently shrink a layout's ks (ADVICE r2: extra XLA
+#: recompiles, though never wrong output).
 _STICKY_KS: dict = {}
+_STICKY_KS_LOCK = threading.Lock()
 
 
 def _truncation_ks(group, layout=None):
@@ -663,10 +668,11 @@ def _truncation_ks(group, layout=None):
 
     ks = [bucket(max(km[c] for km in kms) + 1) for c in range(ncomp)]
     if layout is not None:
-        prev = _STICKY_KS.get(layout)
-        if prev is not None:
-            ks = [max(a, b) for a, b in zip(ks, prev)]
-        _STICKY_KS[layout] = ks
+        with _STICKY_KS_LOCK:
+            prev = _STICKY_KS.get(layout)
+            if prev is not None:
+                ks = [max(a, b) for a, b in zip(ks, prev)]
+            _STICKY_KS[layout] = ks
     if all(k >= 64 for k in ks):
         return None
     return tuple(ks)

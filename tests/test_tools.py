@@ -117,3 +117,14 @@ def test_copy_dataset_cli_main(tmp_path, synthetic_dataset):
     main([synthetic_dataset.url, target])
     with make_reader(target, shuffle_row_groups=False) as reader:
         assert len(list(reader)) == len(synthetic_dataset.data)
+
+
+def test_benchmark_cli_decode_on_device_requires_loader(scalar_dataset):
+    """ADVICE r2: --decode-on-device without --loader would silently benchmark
+    stage-1 staging payloads; the CLI must refuse."""
+    import pytest
+
+    from petastorm_tpu.benchmark.cli import main
+
+    with pytest.raises(SystemExit):
+        main([scalar_dataset.url, "--batch", "--decode-on-device"])
