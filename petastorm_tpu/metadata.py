@@ -33,7 +33,10 @@ REFERENCE_ROW_GROUPS_KEY = b"dataset-toolkit.num_row_groups_per_file.v1"
 _METADATA_FILES = ("_common_metadata", "_metadata")
 
 #: One unit of scheduled work: a single row group of a single file.
-RowGroupPiece = namedtuple("RowGroupPiece", ["path", "row_group", "num_rows"])
+#: ``partition_values``: raw ``{key: str}`` parsed from hive ``key=value`` path segments
+#: (None for flat layouts) — typed/pruned by :mod:`petastorm_tpu.partitions`.
+RowGroupPiece = namedtuple("RowGroupPiece", ["path", "row_group", "num_rows",
+                                             "partition_values"], defaults=(None,))
 
 
 # --------------------------------------------------------------------------------------
@@ -298,12 +301,15 @@ def load_row_groups(fs, path, validate=False):
             from petastorm_tpu.compat.reference import loads_reference_pickle
 
             counts = loads_reference_pickle(kv[REFERENCE_ROW_GROUPS_KEY])
+    from petastorm_tpu.partitions import partition_values_for_path
+
     pieces = []
     if counts is not None and not validate:
         for fname in sorted(counts):
             full = fname if posixpath.isabs(fname) else posixpath.join(path, fname)
+            pv = partition_values_for_path(full, path) or None
             for rg in range(int(counts[fname])):
-                pieces.append(RowGroupPiece(full, rg, -1))
+                pieces.append(RowGroupPiece(full, rg, -1, pv))
         return pieces
     # footer scan fallback (vanilla parquet stores)
     import pyarrow.parquet as pq
@@ -311,8 +317,9 @@ def load_row_groups(fs, path, validate=False):
     for full in _list_parquet_files(fs, path):
         with fs.open_input_file(full) as f:
             md = pq.ParquetFile(f).metadata
+        pv = partition_values_for_path(full, path) or None
         for rg in range(md.num_row_groups):
-            pieces.append(RowGroupPiece(full, rg, md.row_group(rg).num_rows))
+            pieces.append(RowGroupPiece(full, rg, md.row_group(rg).num_rows, pv))
     return pieces
 
 

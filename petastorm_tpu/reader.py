@@ -12,8 +12,11 @@ petastorm/arrow_reader_worker.py ~L60 ``ArrowReaderWorker``), redesigned per SUR
 - The batch path keeps data columnar end-to-end (Arrow → numpy dict) — the layout
   ``petastorm_tpu.loader.DataLoader`` assembles into globally-sharded ``jax.Array`` batches.
 
-``filters`` are applied as vectorized row-level masks (DNF tuples like pyarrow's) — note:
-hive-partitioned directory pruning is not yet wired into piece enumeration.
+``filters`` are applied at two levels (reference ``pq.ParquetDataset`` + ``filters``
+semantics, petastorm/reader.py ~L330): hive ``key=value`` partition directories are pruned
+from scheduling BEFORE any file is opened (:mod:`petastorm_tpu.partitions`), and the
+remaining clauses run as vectorized row-level masks (DNF tuples like pyarrow's) in the
+workers. Partition columns materialize as ordinary row/batch values.
 """
 from __future__ import annotations
 
@@ -66,7 +69,7 @@ class _WorkerBase:
 
     def __init__(self, filesystem, read_schema, stored_schema, predicate, transform_spec,
                  cache, shuffle_row_drop_partitions, filters, seed,
-                 device_fields=frozenset()):
+                 device_fields=frozenset(), partition_info=None):
         self._fs = filesystem
         self._read_schema = read_schema  # fields to deliver (pre-transform view)
         self._stored_schema = stored_schema  # full stored schema (decode source of truth)
@@ -77,6 +80,7 @@ class _WorkerBase:
         self._filters = filters
         self._seed = seed
         self._device_fields = frozenset(device_fields)  # host-stage-only decode columns
+        self._partition_info = partition_info  # hive key=value layout (or None)
         self._local = None  # threading.local built lazily (not picklable)
 
     def __getstate__(self):
@@ -108,12 +112,21 @@ class _WorkerBase:
         return pf
 
     def _read_columns(self, piece, columns):
-        """Read a row group restricted to ``columns`` (None = all)."""
+        """Read a row group restricted to ``columns`` (None = all). Hive partition
+        columns (directory values, not in the file) are appended as constants."""
         pf = self._parquet_file(piece.path)
         available = set(pf.schema_arrow.names)
+        file_columns = columns
         if columns is not None:
-            columns = [c for c in columns if c in available]
-        return pf.read_row_group(piece.row_group, columns=columns)
+            file_columns = [c for c in columns if c in available]
+        table = pf.read_row_group(piece.row_group, columns=file_columns)
+        if self._partition_info:
+            from petastorm_tpu.partitions import attach_partition_columns
+
+            table = attach_partition_columns(
+                table, piece, self._partition_info,
+                wanted=None if columns is None else set(columns))
+        return table
 
     def _row_mask(self, table):
         """Boolean keep-mask from filters + predicate over a row-group table (or None)."""
@@ -851,6 +864,12 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     fs, path = get_filesystem_and_path_or_paths(dataset_url, storage_options, filesystem)
     stored_schema = get_schema(fs, path)
 
+    pieces = load_row_groups(fs, path)
+    pieces = _apply_rowgroup_selector(fs, path, pieces, rowgroup_selector)
+    pieces, partition_info, filters = _resolve_partitions(pieces, filters)
+    if partition_info:
+        stored_schema = _schema_with_partitions(stored_schema, partition_info)
+
     ngram = None
     if isinstance(schema_fields, NGram):
         if predicate is not None:
@@ -867,9 +886,6 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     if transform_spec is not None and not transform_spec.device:
         final_schema = transform_schema(read_schema, transform_spec)
 
-    pieces = load_row_groups(fs, path)
-    pieces = _apply_rowgroup_selector(fs, path, pieces, rowgroup_selector)
-
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
     device_fields = _resolve_device_fields(read_schema, decode_on_device, ngram,
@@ -877,7 +893,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     worker = PyDictWorker(
         fs, read_schema, stored_schema, predicate, transform_spec, cache,
         shuffle_row_drop_partitions, filters, seed if seed is not None else shard_seed,
-        device_fields=device_fields,
+        device_fields=device_fields, partition_info=partition_info,
         ngram=ngram, ngram_schema=final_schema if ngram is not None else None,
     )
     r = Reader(
@@ -920,17 +936,21 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     stored_schema = infer_or_load_unischema(fs, path if not isinstance(path, list) else path[0])
     if isinstance(schema_fields, NGram):
         raise ValueError("make_batch_reader does not support NGram; use make_reader")
+
+    paths = path if isinstance(path, list) else [path]
+    pieces = []
+    for p in paths:
+        pieces.extend(load_row_groups(fs, p))
+    pieces, partition_info, filters = _resolve_partitions(pieces, filters)
+    if partition_info:
+        stored_schema = _schema_with_partitions(stored_schema, partition_info)
+
     read_schema = (
         stored_schema.create_schema_view(schema_fields) if schema_fields else stored_schema
     )
     final_schema = read_schema
     if transform_spec is not None and not transform_spec.device:
         final_schema = transform_schema(read_schema, transform_spec)
-
-    paths = path if isinstance(path, list) else [path]
-    pieces = []
-    for p in paths:
-        pieces.extend(load_row_groups(fs, p))
 
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
@@ -939,7 +959,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     worker = ArrowWorker(
         fs, read_schema, stored_schema, predicate, transform_spec, cache,
         shuffle_row_drop_partitions, filters, seed if seed is not None else shard_seed,
-        device_fields=device_fields,
+        device_fields=device_fields, partition_info=partition_info,
     )
     r = Reader(
         fs, path, final_schema, stored_schema, worker, pieces,
@@ -954,6 +974,43 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields
     return r
+
+
+def _resolve_partitions(pieces, filters):
+    """Hive partitioning at plan time: typed :class:`~petastorm_tpu.partitions.PartitionInfo`
+    from the piece paths + directory-level pruning of ``filters`` (reference
+    ``pq.ParquetDataset(..., filters=)`` petastorm/reader.py ~L330). Returns
+    ``(pieces, info-or-None, filters)`` where filter values on partition columns are
+    coerced to the inferred column types (a string-valued filter against an int-typed
+    partition must match, both here and in the workers' row-level mask); flat layouts
+    pass through untouched."""
+    from petastorm_tpu.partitions import (
+        build_partition_info,
+        normalize_filters,
+        prune_pieces,
+    )
+
+    info = build_partition_info([p.partition_values or {} for p in pieces])
+    if not info:
+        return pieces, None, filters
+    filters = normalize_filters(filters, info)
+    pruned = prune_pieces(pieces, info, filters)
+    if len(pruned) < len(pieces):
+        logger.info("Hive partition pruning: %d of %d row groups scheduled",
+                    len(pruned), len(pieces))
+    return pruned, info, filters
+
+
+def _schema_with_partitions(schema, info):
+    """Extend a stored/inferred schema with the partition-directory columns (they are
+    not in any file's arrow schema but materialize as row values on read)."""
+    from petastorm_tpu.partitions import partition_fields
+
+    extra = [f for f in partition_fields(info, nullable=True)
+             if f.name not in schema.fields]  # nullable: __HIVE_DEFAULT_PARTITION__ dirs
+    if not extra:
+        return schema
+    return Unischema(schema._name, list(schema.fields.values()) + extra)
 
 
 def _apply_rowgroup_selector(fs, path, pieces, rowgroup_selector):

@@ -1,0 +1,331 @@
+"""Hive-partitioned dataset tests (VERDICT r2 #2; reference petastorm/reader.py ~L330:
+``pq.ParquetDataset`` materializes partition columns and ``filters=`` prunes directories).
+
+Covers: partition-value parsing, type inference, directory pruning provably skipping
+file opens, partition columns materializing in both read paths, sharding composition,
+and a petastorm(-tpu) dataset whose declared schema includes the partition column.
+"""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.partitions import (
+    HIVE_NULL,
+    build_partition_info,
+    partition_values_for_path,
+    piece_matches_filters,
+)
+from petastorm_tpu.reader import make_batch_reader, make_reader
+
+
+# -- unit: parsing + inference -----------------------------------------------------------
+
+
+def test_partition_values_for_path():
+    root = "/data/ds"
+    assert partition_values_for_path("/data/ds/date=2020-01-01/part-0.parquet", root) == \
+        {"date": "2020-01-01"}
+    assert partition_values_for_path("/data/ds/a=1/b=x%20y/f.parquet", root) == \
+        {"a": "1", "b": "x y"}  # hive percent-encoding decoded
+    assert partition_values_for_path("/data/ds/part-0.parquet", root) == {}
+    assert partition_values_for_path("/data/ds/k=%s/f.parquet" % HIVE_NULL, root) == \
+        {"k": None}
+    # non key=value directories are not partition segments
+    assert partition_values_for_path("/data/ds/sub/part-0.parquet", root) == {}
+
+
+def test_build_partition_info_type_inference():
+    info = build_partition_info([{"a": "1", "b": "1.5", "c": "x"},
+                                 {"a": "2", "b": "2", "c": "y"}])
+    assert info.keys == ("a", "b", "c")
+    assert info.converters["a"]("7") == 7
+    assert info.converters["b"]("2") == 2.0
+    assert info.numpy_dtypes["a"] == np.dtype(np.int64)
+    assert info.numpy_dtypes["b"] == np.dtype(np.float64)
+    assert info.numpy_dtypes["c"] == np.dtype("O")
+    assert info.typed_values({"a": "3", "b": "4", "c": "z"}) == {"a": 3, "b": 4.0, "c": "z"}
+
+
+def test_build_partition_info_flat_and_inconsistent():
+    assert not build_partition_info([{}, {}])
+    assert not build_partition_info([])
+    with pytest.raises(ValueError, match="Inconsistent"):
+        build_partition_info([{"a": "1"}, {}])
+
+
+def test_piece_matches_filters_ops():
+    keys = ("date", "n")
+    v = {"date": "2020", "n": 3}
+    assert piece_matches_filters(v, [("date", "=", "2020")], keys)
+    assert not piece_matches_filters(v, [("date", "=", "2021")], keys)
+    assert piece_matches_filters(v, [("n", ">", 2), ("n", "<=", 3)], keys)
+    assert piece_matches_filters(v, [("n", "in", [1, 3])], keys)
+    assert not piece_matches_filters(v, [("n", "not in", [3])], keys)
+    # OR of ANDs: second clause matches
+    assert piece_matches_filters(v, [[("date", "=", "2021")], [("n", "!=", 4)]], keys)
+    # terms over non-partition columns are satisfiable at the directory level
+    assert piece_matches_filters(v, [("other_col", "=", 99)], keys)
+    # ...but a failing partition term in the same clause still prunes
+    assert not piece_matches_filters(v, [("other_col", "=", 99), ("n", "=", 7)], keys)
+
+
+# -- fixtures ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def hive_dataset(tmp_path_factory):
+    """Two-level hive store: date (string) × chunk (int), 3 dates × 2 chunks × 8 rows."""
+    root = tmp_path_factory.mktemp("hive_ds")
+    rows = []
+    rid = 0
+    for date in ("2020-01-01", "2020-01-02", "2020-01-03"):
+        for chunk in (0, 1):
+            d = root / ("date=%s" % date) / ("chunk=%d" % chunk)
+            os.makedirs(d, exist_ok=True)
+            n = 8
+            ids = np.arange(rid, rid + n, dtype=np.int64)
+            vals = ids.astype(np.float64) * 0.5
+            pq.write_table(pa.table({"id": ids, "value": vals}),
+                           str(d / "part-0.parquet"), row_group_size=4)
+            for i, v in zip(ids, vals):
+                rows.append({"id": int(i), "value": float(v), "date": date, "chunk": chunk})
+            rid += n
+    return {"url": "file://" + str(root), "rows": rows}
+
+
+# -- batch reader -----------------------------------------------------------------------
+
+
+def test_batch_reader_materializes_partition_columns(hive_dataset):
+    with make_batch_reader(hive_dataset["url"], shuffle_row_groups=False,
+                           reader_pool_type="dummy") as reader:
+        got = {}
+        for batch in reader:
+            for i, rid in enumerate(np.asarray(batch.id)):
+                got[int(rid)] = (batch.date[i], int(np.asarray(batch.chunk)[i]))
+    assert len(got) == len(hive_dataset["rows"])
+    for r in hive_dataset["rows"]:
+        assert got[r["id"]] == (r["date"], r["chunk"]), r
+    # chunk inferred as int64 (numeric directory values become numeric columns)
+    with make_batch_reader(hive_dataset["url"], reader_pool_type="dummy") as reader:
+        b = next(iter(reader))
+        assert np.asarray(b.chunk).dtype == np.int64
+
+
+def test_batch_reader_partition_filter_prunes_file_opens(hive_dataset, monkeypatch):
+    """filters on partition columns must prune whole directories BEFORE scheduling:
+    only matching files are ever opened (VERDICT r2 #2 'assert on opened-file count')."""
+    from petastorm_tpu import reader as reader_mod
+
+    opened = set()
+    orig = reader_mod._WorkerBase._parquet_file
+
+    def counting(self, path):
+        opened.add(path)
+        return orig(self, path)
+
+    monkeypatch.setattr(reader_mod._WorkerBase, "_parquet_file", counting)
+    with make_batch_reader(hive_dataset["url"],
+                           filters=[("date", "=", "2020-01-02")],
+                           reader_pool_type="thread") as reader:
+        ids = np.concatenate([np.asarray(b.id) for b in reader])
+        assert reader._num_items == 4  # 2 chunks × 2 row groups — 1/3 of the 12
+    expected = sorted(r["id"] for r in hive_dataset["rows"] if r["date"] == "2020-01-02")
+    assert sorted(ids.tolist()) == expected
+    assert len(opened) == 2  # exactly the two chunk files under date=2020-01-02
+    assert all("date=2020-01-02" in p for p in opened)
+
+
+def test_batch_reader_mixed_partition_and_row_filters(hive_dataset):
+    """DNF mixing a partition clause with a row-level clause: directory pruning is
+    conservative, row mask finishes the job."""
+    with make_batch_reader(hive_dataset["url"],
+                           filters=[("date", "=", "2020-01-01"), ("id", ">=", 4)],
+                           reader_pool_type="dummy") as reader:
+        ids = np.concatenate([np.asarray(b.id) for b in reader])
+    expected = sorted(r["id"] for r in hive_dataset["rows"]
+                      if r["date"] == "2020-01-01" and r["id"] >= 4)
+    assert sorted(ids.tolist()) == expected
+
+
+def test_batch_reader_partition_in_filter_or_clauses(hive_dataset):
+    with make_batch_reader(
+            hive_dataset["url"],
+            filters=[[("date", "=", "2020-01-01"), ("chunk", "=", 1)],
+                     [("date", "=", "2020-01-03")]],
+            reader_pool_type="dummy") as reader:
+        assert reader._num_items == 6  # (1 file + 2 files) × 2 row groups
+        ids = np.concatenate([np.asarray(b.id) for b in reader])
+    expected = sorted(r["id"] for r in hive_dataset["rows"]
+                      if (r["date"] == "2020-01-01" and r["chunk"] == 1)
+                      or r["date"] == "2020-01-03")
+    assert sorted(ids.tolist()) == expected
+
+
+def test_batch_reader_schema_fields_selects_partition_column(hive_dataset):
+    with make_batch_reader(hive_dataset["url"], schema_fields=["id", "date"],
+                           reader_pool_type="dummy") as reader:
+        b = next(iter(reader))
+        assert set(b._fields) == {"id", "date"}
+        assert all(str(d).startswith("2020-") for d in b.date)
+
+
+def test_batch_reader_sharding_composes_with_pruning(hive_dataset):
+    """Shards partition the PRUNED piece set disjointly and cover it."""
+    flt = [("date", "!=", "2020-01-02")]
+    all_ids = []
+    for shard in range(2):
+        with make_batch_reader(hive_dataset["url"], filters=flt, cur_shard=shard,
+                               shard_count=2, shard_seed=5, shuffle_row_groups=False,
+                               reader_pool_type="dummy") as reader:
+            all_ids.append(np.concatenate([np.asarray(b.id) for b in reader]).tolist())
+    expected = sorted(r["id"] for r in hive_dataset["rows"] if r["date"] != "2020-01-02")
+    assert not (set(all_ids[0]) & set(all_ids[1]))
+    assert sorted(all_ids[0] + all_ids[1]) == expected
+
+
+def test_partition_pruning_to_empty_raises(hive_dataset):
+    from petastorm_tpu.errors import NoDataAvailableError
+
+    with pytest.raises(NoDataAvailableError):
+        make_batch_reader(hive_dataset["url"], filters=[("date", "=", "1999-01-01")])
+
+
+# -- per-row reader over a hive-partitioned petastorm(-tpu) dataset ---------------------
+
+
+@pytest.fixture(scope="module")
+def hive_petastorm_dataset(tmp_path_factory):
+    """Petastorm-tpu dataset (unischema in _common_metadata) whose ``label`` column lives
+    ONLY in the hive path — the Spark ``partitionBy`` layout (SURVEY §5 TestSchema
+    partition-by column)."""
+    import pyarrow.fs as pafs
+
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.metadata import write_petastorm_tpu_metadata
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    from petastorm_tpu import types as ptypes
+
+    schema = Unischema("HivePart", [
+        UnischemaField("id", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+        UnischemaField("value", np.float64, (), ScalarCodec(ptypes.DoubleType()), False),
+        UnischemaField("label", np.int32, (), ScalarCodec(ptypes.IntegerType()), False),
+    ])
+    root = tmp_path_factory.mktemp("hive_ps")
+    rows = []
+    rid = 0
+    counts = {}
+    for label in (0, 1, 2):
+        d = root / ("label=%d" % label)
+        os.makedirs(d, exist_ok=True)
+        n = 6
+        ids = np.arange(rid, rid + n, dtype=np.int64)
+        vals = ids.astype(np.float64) + 0.25
+        pq.write_table(pa.table({"id": ids, "value": vals}),
+                       str(d / "part-0.parquet"), row_group_size=3)
+        counts["label=%d/part-0.parquet" % label] = 2
+        for i, v in zip(ids, vals):
+            rows.append({"id": int(i), "value": float(v), "label": label})
+        rid += n
+    fs = pafs.LocalFileSystem()
+    write_petastorm_tpu_metadata(fs, str(root), schema, counts)
+    return {"url": "file://" + str(root), "rows": rows}
+
+
+def test_make_reader_hive_partitioned_petastorm(hive_petastorm_dataset):
+    """Per-row path: the declared-in-schema partition column decodes from the directory
+    value through its ScalarCodec (np.int32), rows complete."""
+    with make_reader(hive_petastorm_dataset["url"], shuffle_row_groups=False,
+                     reader_pool_type="dummy") as reader:
+        got = {int(r.id): r for r in reader}
+    assert len(got) == len(hive_petastorm_dataset["rows"])
+    for exp in hive_petastorm_dataset["rows"]:
+        r = got[exp["id"]]
+        assert r.label == exp["label"]
+        assert np.asarray(r.label).dtype == np.int32  # declared codec dtype wins
+        assert r.value == exp["value"]
+
+
+def test_make_reader_hive_filter_prunes(hive_petastorm_dataset, monkeypatch):
+    from petastorm_tpu import reader as reader_mod
+
+    opened = set()
+    orig = reader_mod._WorkerBase._parquet_file
+
+    def counting(self, path):
+        opened.add(path)
+        return orig(self, path)
+
+    monkeypatch.setattr(reader_mod._WorkerBase, "_parquet_file", counting)
+    with make_reader(hive_petastorm_dataset["url"], filters=[("label", "in", [0, 2])],
+                     reader_pool_type="thread") as reader:
+        assert reader._num_items == 4  # 2 files × 2 row groups
+        ids = sorted(int(r.id) for r in reader)
+    expected = sorted(r["id"] for r in hive_petastorm_dataset["rows"]
+                      if r["label"] in (0, 2))
+    assert ids == expected
+    assert len(opened) == 2
+    assert not any("label=1" in p for p in opened)
+
+
+def test_hive_through_dataloader(hive_dataset):
+    """Partition columns ride the DataLoader like any column: numeric ones reach the
+    device, string ones stay host-side."""
+    from petastorm_tpu.loader import DataLoader
+
+    reader = make_batch_reader(hive_dataset["url"], shuffle_row_groups=False,
+                               reader_pool_type="dummy")
+    with DataLoader(reader, batch_size=8) as loader:
+        batch = next(iter(loader))
+    import jax
+
+    assert isinstance(batch["chunk"], jax.Array)
+    assert batch["chunk"].shape == (8,)
+    assert not isinstance(batch["date"], jax.Array)  # strings stay host
+    assert len(batch["date"]) == 8
+
+
+def test_string_filter_value_coerces_to_partition_type(hive_dataset):
+    """Legacy pyarrow/petastorm convention: filter values written as strings must match
+    int-typed partition columns — at prune time AND in the row-level mask."""
+    with make_batch_reader(hive_dataset["url"], filters=[("chunk", "=", "1")],
+                           reader_pool_type="dummy") as reader:
+        ids = sorted(int(x) for b in reader for x in np.asarray(b.id))
+    expected = sorted(r["id"] for r in hive_dataset["rows"] if r["chunk"] == 1)
+    assert ids == expected
+    # ordering op with a string value against an int partition: no TypeError
+    with make_batch_reader(hive_dataset["url"], filters=[("chunk", "<", "1")],
+                           reader_pool_type="dummy") as reader:
+        ids = sorted(int(x) for b in reader for x in np.asarray(b.id))
+    assert ids == sorted(r["id"] for r in hive_dataset["rows"] if r["chunk"] < 1)
+
+
+def test_null_partition_directory(tmp_path):
+    """__HIVE_DEFAULT_PARTITION__ directories deliver None/null partition values
+    instead of crashing the non-nullable decode path."""
+    from petastorm_tpu.partitions import HIVE_NULL
+
+    rid = 0
+    for seg in ("k=a", "k=" + HIVE_NULL):
+        d = tmp_path / seg
+        os.makedirs(d, exist_ok=True)
+        ids = np.arange(rid, rid + 4, dtype=np.int64)
+        pq.write_table(pa.table({"id": ids}), str(d / "f.parquet"))
+        rid += 4
+    with make_batch_reader("file://" + str(tmp_path), shuffle_row_groups=False,
+                           reader_pool_type="dummy") as reader:
+        got = {}
+        for b in reader:
+            for i, x in enumerate(np.asarray(b.id)):
+                got[int(x)] = b.k[i]
+    assert all(got[i] == "a" for i in range(4))
+    assert all(got[i] is None or (isinstance(got[i], float) and np.isnan(got[i]))
+               for i in range(4, 8))
+    # null partitions are never matched by equality filters (hive semantics)
+    with make_batch_reader("file://" + str(tmp_path), filters=[("k", "=", "a")],
+                           reader_pool_type="dummy") as reader:
+        ids = sorted(int(x) for b in reader for x in np.asarray(b.id))
+    assert ids == [0, 1, 2, 3]
