@@ -240,6 +240,15 @@ class DataLoader:
         Device batches kept in flight (double/triple buffering). 0 disables (debug).
     to_device : bool
         False yields host numpy dicts (CPU-only consumers, tests, torch adapter).
+    device_shuffle_capacity : int
+        >0 enables the HBM-resident exchange shuffle
+        (:class:`petastorm_tpu.ops.device_shuffle.DeviceShuffleBuffer`): after
+        transfer, each batch swaps into a device ring of ~this many rows and the
+        displaced rows are delivered (exactly-once, ~capacity decorrelation window,
+        one fused gather+scatter per batch — zero host work). Requires every
+        delivered column to be device-resident (no strings); composes with
+        ``shuffling_queue_capacity`` (host pre-shuffle) and ``device_transform``
+        (applied to the shuffled output). Capacity is rounded up to a batch multiple.
     pad_shapes : dict, optional
         Ragged-field policy (SURVEY.md §8 hard part #2): ``{field: max_shape}`` pads
         every row of a ragged tensor field up to ``max_shape`` (zeros) and adds a
@@ -250,11 +259,15 @@ class DataLoader:
 
     def __init__(self, reader, batch_size, sharding=None, shuffling_queue_capacity=0,
                  seed=None, last_batch="drop", device_transform=None, prefetch=2,
-                 to_device=True, host_queue_size=8, pad_shapes=None):
+                 to_device=True, host_queue_size=8, pad_shapes=None,
+                 device_shuffle_capacity=0):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if last_batch not in ("drop", "pad", "partial"):
             raise ValueError("last_batch must be drop|pad|partial, got %r" % last_batch)
+        if device_shuffle_capacity and not to_device:
+            raise ValueError("device_shuffle_capacity requires to_device=True "
+                             "(the ring lives in device memory)")
         self.reader = reader
         self.batch_size = int(batch_size)
         #: rows THIS process cuts per batch (== batch_size unless the sharding's batch
@@ -268,6 +281,7 @@ class DataLoader:
         self._shuffling_queue_capacity = shuffling_queue_capacity
         self._host_queue_size = host_queue_size
         self._pad_shapes = dict(pad_shapes) if pad_shapes else {}
+        self._device_shuffle_capacity = int(device_shuffle_capacity or 0)
         self._device_transform = device_transform
         if device_transform is None:
             spec = getattr(reader, "transform_spec", None)
@@ -455,13 +469,27 @@ class DataLoader:
                     else _matching_sharding(self.sharding, out)
                 if s is not None:
                     if jax.process_count() > 1:
-                        out = jax.make_array_from_process_local_data(s, np.asarray(out))
+                        # `out` is already device-resident (the decode just ran on
+                        # device). jax's process-local assembly slices it lazily and
+                        # places shards device-to-device, so passing the jax.Array
+                        # straight through keeps the decoded pixels on device —
+                        # np.asarray here would re-pay the full decoded-bytes D2H+H2D
+                        # the two-stage split exists to avoid (VERDICT r2 #3).
+                        out = jax.make_array_from_process_local_data(s, out)
                     else:
                         out = jax.device_put(out, s)
             decoded[name] = out
         return batch, decoded
 
     def _to_device(self, batch):
+        arrays, host = self._transfer_batch(batch)
+        arrays = self._apply_device_transform(arrays)
+        arrays.update(host)
+        return arrays
+
+    def _transfer_batch(self, batch):
+        """Staged decode + device_put with the configured sharding. Returns the device
+        arrays and the host-only (string/object) columns separately."""
         import jax
 
         t0 = time.perf_counter()
@@ -499,28 +527,72 @@ class DataLoader:
                     arrays[name] = jax.device_put(arr, s)
         arrays.update(staged)
         self.stats.h2d_s += time.perf_counter() - t0
-        if self._device_transform is not None:
-            if self._jitted_transform is None:
-                import inspect
+        return arrays, host
 
-                import jax as _jax
+    def _apply_device_transform(self, arrays):
+        if self._device_transform is None:
+            return arrays
+        import jax
 
-                try:
-                    n_params = len(inspect.signature(
-                        self._device_transform).parameters)
-                except (TypeError, ValueError):
-                    n_params = 1
-                self._transform_takes_key = n_params >= 2
-                self._jitted_transform = _jax.jit(self._device_transform)
-            if self._transform_takes_key:
-                key = jax.random.fold_in(
-                    jax.random.PRNGKey(self._seed or 0), self._transform_step)
-                self._transform_step += 1
-                arrays = self._jitted_transform(arrays, key)
-            else:
-                arrays = self._jitted_transform(arrays)
-        arrays.update(host)
-        return arrays
+        if self._jitted_transform is None:
+            import inspect
+
+            try:
+                n_params = len(inspect.signature(
+                    self._device_transform).parameters)
+            except (TypeError, ValueError):
+                n_params = 1
+            self._transform_takes_key = n_params >= 2
+            self._jitted_transform = jax.jit(self._device_transform)
+        if self._transform_takes_key:
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(self._seed or 0), self._transform_step)
+            self._transform_step += 1
+            return self._jitted_transform(arrays, key)
+        return self._jitted_transform(arrays)
+
+    def _device_batches(self, host_q):
+        """host batches → device batches, with the optional HBM exchange shuffle
+        between transfer and transform (rows are decorrelated over a ~capacity
+        window by one fused gather+scatter per batch — zero host work)."""
+        if not self._device_shuffle_capacity:
+            for batch in self._host_batches(host_q):
+                if self._stop.is_set():
+                    return
+                yield self._to_device(batch)
+            return
+        from petastorm_tpu.ops.device_shuffle import DeviceShuffleBuffer
+
+        def _ring_sharding(name, arr):
+            # lay the ring out like the batches (capacity axis where the batch axis
+            # is), so the resident rows split across devices instead of replicating
+            if self.sharding is None:
+                return None
+            s = self.sharding.get(name) if isinstance(self.sharding, dict) \
+                else _matching_sharding(self.sharding, arr)
+            return s
+
+        shuffler = DeviceShuffleBuffer(self._device_shuffle_capacity,
+                                       seed=self._seed or 0,
+                                       shardings=_ring_sharding)
+        for batch in self._host_batches(host_q):
+            if self._stop.is_set():
+                return
+            arrays, host = self._transfer_batch(batch)
+            if host:
+                raise ValueError(
+                    "device_shuffle_capacity requires every delivered column to be "
+                    "device-resident, but %s are host-only (strings/objects cannot "
+                    "live in the HBM ring). Narrow schema_fields or drop the device "
+                    "shuffle." % sorted(host)
+                )
+            out = shuffler.push(arrays)
+            if out is not None:
+                yield self._apply_device_transform(out)
+        for out in shuffler.drain():
+            if self._stop.is_set():
+                return
+            yield self._apply_device_transform(out)
 
     def __iter__(self):
         self._start_producer()
@@ -538,8 +610,7 @@ class DataLoader:
                 yield from self._host_batches(host_q)
             return
         if self.prefetch <= 0:  # synchronous transfer (debug)
-            for batch in self._host_batches(host_q):
-                yield self._to_device(batch)
+            yield from self._device_batches(host_q)
             return
         # Async transfer thread: host batches → decode dispatch + device_put → a small
         # device-batch queue. Keeping dispatch OFF the consumer thread both overlaps
@@ -551,10 +622,10 @@ class DataLoader:
 
         def _transfer():
             try:
-                for batch in self._host_batches(host_q):
+                for batch in self._device_batches(host_q):
                     if self._stop.is_set():
                         return
-                    if not _put_with_stop(dev_q, self._to_device(batch), self._stop):
+                    if not _put_with_stop(dev_q, batch, self._stop):
                         return
             except Exception as e:  # noqa: BLE001 — surfaced to consumer thread
                 transfer_error.append(e)
@@ -697,7 +768,11 @@ def _resolve_local_batch(batch_size, sharding):
     """Rows this process feeds per global batch of ``batch_size`` (1 process → all).
 
     A global batch that does not divide over the sharding's batch axis raises
-    (misconfiguration must not silently feed P×-larger batches)."""
+    (misconfiguration must not silently feed P×-larger batches). Under multi-process
+    JAX, only a ``NamedSharding`` (or a sharding whose devices are all local) can be
+    decomposed into per-process shares — a ``PositionalSharding``/GSPMD sharding
+    spanning processes raises instead of silently feeding every process the GLOBAL
+    batch and assembling wrong data (VERDICT r2 #5)."""
     try:
         import jax
         import jax.sharding as jsh
@@ -705,11 +780,38 @@ def _resolve_local_batch(batch_size, sharding):
         return batch_size
     if sharding is None or jax.process_count() == 1:
         return batch_size
+
+    def _all_local(s):
+        try:
+            pi = jax.process_index()
+            return all(d.process_index == pi for d in s.device_set)
+        except Exception:  # noqa: BLE001 — unknown sharding type: treat as non-local
+            return False
+
+    def _reject(s):
+        raise ValueError(
+            "DataLoader cannot decompose the global batch across processes for %s: "
+            "only NamedSharding exposes the mesh/axis structure needed to compute "
+            "each process's local share. Use a NamedSharding over a Mesh (batch axis "
+            "in PartitionSpec position 0), or shard the reader per process and pass "
+            "a process-local sharding." % type(s).__name__
+        )
+
     if isinstance(sharding, dict):  # per-field dict: use the first named sharding
+        # EVERY non-named entry must be process-local — one decomposable field must
+        # not grandfather in an undecomposable one beside it
+        for s in sharding.values():
+            if s is not None and not isinstance(s, jsh.NamedSharding) \
+                    and not _all_local(s):
+                _reject(s)
         named = [s for s in sharding.values() if isinstance(s, jsh.NamedSharding)]
-        sharding = named[0] if named else None
+        if not named:
+            return batch_size  # every field placement is process-local
+        sharding = named[0]
     if not isinstance(sharding, jsh.NamedSharding):
-        return batch_size
+        if _all_local(sharding):
+            return batch_size  # single-device/local placement: no decomposition needed
+        _reject(sharding)
     from petastorm_tpu.parallel.mesh import local_batch_size
 
     spec0 = sharding.spec[0] if len(sharding.spec) else None

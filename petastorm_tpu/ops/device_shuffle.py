@@ -1,13 +1,22 @@
 """HBM-resident shuffle buffer: on-device sample decorrelation (SURVEY.md §8 L6).
 
-The reference shuffles rows in host python (``RandomShufflingBuffer``); at TPU batch rates
-that costs host CPU and H2D bandwidth. This buffer keeps a fixed-size ring of rows in device
-HBM and serves random batches by a single fused gather (one XLA ``take`` per column), with
-deterministic multi-host semantics: every process uses the same PRNG key stream, so sampling
-indices agree across hosts even though each host holds different shard data.
+The reference shuffles rows in host python with a retrieve-and-remove buffer
+(petastorm/reader_impl/shuffling_buffer.py ~L80); at TPU batch rates that costs host CPU
+and re-pays H2D bandwidth. This buffer keeps a fixed-size ring of rows in device HBM and
+runs a **streaming exchange**: each incoming (already-transferred) batch picks ``b``
+DISTINCT random slots of the full ring, emits the rows currently in those slots, and
+writes the incoming rows into them — one fused gather + one fused scatter per batch,
+``O(batch)`` HBM traffic, no host involvement.
 
-All state transitions are pure jitted functions (donate-friendly); the class is a thin
-host-side cursor wrapper.
+Semantics are epoch-honest (the reference's retrieve-and-remove contract, not sampling
+with replacement): every inserted row is emitted exactly once — displaced rows ARE the
+output, and ``drain()`` flushes the residue as a permutation. A row lingers in the ring
+for a geometric number of exchanges (mean ≈ capacity/batch), giving a decorrelation
+window of ~``capacity`` rows.
+
+Multi-host: every process folds the same seed, so slot indices agree across hosts; with
+globally-sharded stores the gather/scatter run SPMD and decorrelate rows ACROSS shards
+(host-side buffers cannot do that at all — shard mixing would need a network hop).
 """
 from __future__ import annotations
 
@@ -18,59 +27,127 @@ import jax.numpy as jnp
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
-def _insert(store, batch, cursor):
-    """Overwrite ring rows [cursor, cursor+b) (wrapping) with the batch."""
+def _exchange(store, batch, key):
+    """Pick ``b`` distinct slots; emit their rows; overwrite them with ``batch``."""
     cap = next(iter(store.values())).shape[0]
     b = next(iter(batch.values())).shape[0]
-    idx = (cursor + jnp.arange(b)) % cap
+    slots = jax.random.permutation(key, cap)[:b]
+    out = {k: store[k][slots] for k in store}
+    new_store = {k: store[k].at[slots].set(batch[k].astype(store[k].dtype))
+                 for k in store}
+    return new_store, out
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _fill(store, batch, cursor):
+    """Warmup: write the batch at [cursor, cursor+b) (no wrap — warmup never overflows
+    because capacity is a multiple of the batch size)."""
+    b = next(iter(batch.values())).shape[0]
+    idx = cursor + jnp.arange(b)
     return {k: store[k].at[idx].set(batch[k].astype(store[k].dtype)) for k in store}
 
 
-@functools.partial(jax.jit, static_argnames=("batch_size",))
-def _sample(store, key, filled, batch_size):
-    cap = next(iter(store.values())).shape[0]
-    idx = jax.random.randint(key, (batch_size,), 0, jnp.maximum(filled, 1))
-    idx = idx % cap
-    return {k: v[idx] for k, v in store.items()}
-
-
 class DeviceShuffleBuffer:
-    """Fixed-capacity device ring + random-gather sampling.
+    """Fixed-capacity HBM ring with exact, without-replacement streaming shuffle.
 
-    >>> buf = DeviceShuffleBuffer(capacity=4096, example_batch=batch, key=key)
-    >>> buf.insert(batch)          # O(b) scatter in HBM
-    >>> out = buf.sample(256)      # O(b) gather, decorrelated rows
+    >>> buf = DeviceShuffleBuffer(capacity=4096, seed=0)
+    >>> for batch in device_batches:          # {name: jax.Array}, equal leading dim
+    ...     out = buf.push(batch)             # None during warmup, else a shuffled batch
+    ...     if out is not None: consume(out)
+    >>> for out in buf.drain():               # flush the residue, permuted
+    ...     consume(out)
+
+    ``capacity`` is rounded up to a multiple of the first batch's row count so warmup
+    fills exactly. All rows pushed are eventually emitted exactly once (union of push
+    outputs + drain == union of inputs). A batch SHORTER than the first batch is only
+    legal as the final push of a stream (the loader's ``last_batch='partial'`` tail);
+    pushing again after a short warmup batch raises — silently continuing would
+    scatter past the ring and lose rows.
+
+    ``shardings``: optional ``callable(name, zeros) -> Sharding | None`` laying out
+    each ring column (the loader passes its batch sharding adapted per column), so the
+    ring splits across devices like the batches do instead of replicating a full copy
+    per device; the store is then created directly in that layout (no transient
+    single-device allocation).
     """
 
-    def __init__(self, capacity, example_batch, key, sharding=None):
-        self.capacity = int(capacity)
-        self._key = key
-        self._cursor = 0
-        self._filled = 0
-        store = {}
-        for name, arr in example_batch.items():
-            shape = (self.capacity,) + tuple(arr.shape[1:])
-            z = jnp.zeros(shape, arr.dtype)
-            if sharding is not None:
-                z = jax.device_put(z, sharding)
-            store[name] = z
-        self._store = store
+    def __init__(self, capacity, seed=0, shardings=None):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self._requested_capacity = int(capacity)
+        self.capacity = None  # fixed at first push (rounded up to batch multiple)
+        self._key = jax.random.PRNGKey(int(seed))
+        self._fill_rows = 0
+        self._store = None
+        self._batch_rows = None
+        self._shardings = shardings
+        self._short_warmup = False
 
     @property
     def filled(self):
-        return self._filled
+        return self._fill_rows
 
-    def insert(self, batch):
-        b = len(next(iter(batch.values())))
-        if b > self.capacity:
-            raise ValueError("batch of %d exceeds capacity %d" % (b, self.capacity))
-        self._store = _insert(self._store, batch, jnp.int32(self._cursor))
-        self._cursor = (self._cursor + b) % self.capacity
-        self._filled = min(self.capacity, self._filled + b)
-        return self
+    def _init_store(self, batch):
+        b = next(iter(batch.values())).shape[0]
+        self._batch_rows = b
+        self._short_warmup = False  # buffer may be re-filled after a drain()
+        self.capacity = -(-self._requested_capacity // b) * b
+        store = {}
+        for name, arr in batch.items():
+            shape = (self.capacity,) + tuple(arr.shape[1:])
+            s = self._shardings(name, arr) if self._shardings is not None else None
+            if s is not None:
+                # allocate straight into the target layout — jnp.zeros-then-device_put
+                # would transiently materialize the full ring on one device
+                store[name] = jax.jit(
+                    functools.partial(jnp.zeros, shape, arr.dtype),
+                    out_shardings=s)()
+            else:
+                store[name] = jnp.zeros(shape, arr.dtype)
+        self._store = store
 
-    def sample(self, batch_size):
-        if self._filled == 0:
-            raise ValueError("sampling from an empty shuffle buffer")
+    def push(self, batch):
+        """Insert a device batch; returns the displaced batch once warm, else None."""
+        if self._store is None:
+            self._init_store(batch)
+        b = next(iter(batch.values())).shape[0]
+        if set(batch) != set(self._store):
+            raise ValueError(
+                "batch columns %s do not match buffer columns %s"
+                % (sorted(batch), sorted(self._store)))
+        if self._fill_rows < self.capacity:
+            if b > self._batch_rows:
+                raise ValueError(
+                    "warmup batches must not exceed the first batch's row count (%d), "
+                    "got %d" % (self._batch_rows, b))
+            if self._short_warmup:
+                raise ValueError(
+                    "a batch shorter than the first batch's row count is only legal "
+                    "as the FINAL push of a stream (warmup scatters would overrun "
+                    "the ring and lose rows); drain() after the short batch")
+            if b < self._batch_rows:
+                self._short_warmup = True
+            self._store = _fill(self._store, batch, jnp.int32(self._fill_rows))
+            self._fill_rows += b
+            return None
         self._key, sub = jax.random.split(self._key)
-        return _sample(self._store, sub, jnp.int32(self._filled), batch_size)
+        self._store, out = _exchange(self._store, batch, sub)
+        return out
+
+    def drain(self, batch_rows=None):
+        """Emit the resident rows as a fresh permutation, in batches of ``batch_rows``
+        (default: the push batch size; the final batch may be short). The buffer is
+        empty afterwards."""
+        if self._store is None or self._fill_rows == 0:
+            return
+        b = batch_rows or self._batch_rows
+        self._key, sub = jax.random.split(self._key)
+        # one permutation over the filled prefix (host-static size: one compile per
+        # distinct drain fill — happens once per stream end)
+        perm = jax.random.permutation(sub, self._fill_rows)
+        shuffled = {k: v[perm] for k, v in self._store.items()}
+        filled = self._fill_rows
+        self._store = None
+        self._fill_rows = 0
+        for start in range(0, filled, b):
+            yield {k: v[start:start + b] for k, v in shuffled.items()}

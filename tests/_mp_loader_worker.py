@@ -58,8 +58,60 @@ def main():
         "local_ids": sorted(set(local_ids)),
         "global_ids": sorted(global_ids),
     }
+    out.update(device_decode_phase())
     with open(os.environ["PTPU_MP_OUT"], "w") as f:
         json.dump(out, f)
+
+
+def device_decode_phase():
+    """Two-stage device decode under multi-process: the decoded global image batch must
+    be assembled from the ALREADY-DEVICE-RESIDENT local decode output (VERDICT r2 #3 —
+    no host materialization of pixels on the assembly path)."""
+    url = os.environ.get("PTPU_MP_JPEG_URL")
+    if not url:
+        return {}
+    from petastorm_tpu.reader import make_reader
+
+    assembly_input_types = []  # type name of local_data per 4-d (pixel) assembly call
+    orig = jax.make_array_from_process_local_data
+
+    def spy(s, data, *a, **k):
+        if getattr(data, "ndim", 0) == 4:
+            assembly_input_types.append(type(data).__name__)
+        return orig(s, data, *a, **k)
+
+    jax.make_array_from_process_local_data = spy
+    try:
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+        sharding = NamedSharding(mesh, PartitionSpec("dp"))
+        reader = make_reader(
+            url, decode_on_device=True, cur_shard=jax.process_index(),
+            shard_count=jax.process_count(), shard_seed=0,
+            shuffle_row_groups=False, num_epochs=1, workers_count=1,
+        )
+        image_shape = None
+        image_device_count = 0
+        local_pixel_checksums = []
+        ids = []
+        with DataLoader(reader, batch_size=8, sharding=sharding) as dl:
+            for batch in dl:
+                img = batch["image_jpeg"]
+                image_shape = list(img.shape)
+                image_device_count = len(img.sharding.device_set)
+                for shard in img.addressable_shards:
+                    local_pixel_checksums.append(int(np.asarray(shard.data,
+                                                                dtype=np.int64).sum()))
+                for shard in batch["id"].addressable_shards:
+                    ids.extend(np.asarray(shard.data).ravel().tolist())
+    finally:
+        jax.make_array_from_process_local_data = orig
+    return {
+        "decode_assembly_input_types": sorted(set(assembly_input_types)),
+        "decode_image_shape": image_shape,
+        "decode_image_device_count": image_device_count,
+        "decode_local_ids": sorted(ids),
+        "decode_pixel_sum": int(sum(local_pixel_checksums)),
+    }
 
 
 if __name__ == "__main__":

@@ -32,10 +32,12 @@ def _free_port():
 
 
 def test_two_process_global_array_assembly(tmp_path):
-    from test_common import create_test_scalar_dataset
+    from test_common import create_test_jpeg_dataset, create_test_scalar_dataset
 
     url = "file://" + str(tmp_path / "ds")
     create_test_scalar_dataset(url, num_rows=64, num_files=4)
+    jpeg_url = "file://" + str(tmp_path / "jpeg_ds")
+    create_test_jpeg_dataset(jpeg_url, num_rows=32)
 
     port = _free_port()
     procs = []
@@ -52,6 +54,7 @@ def test_two_process_global_array_assembly(tmp_path):
             "PTPU_MP_PID": str(pid),
             "PTPU_MP_NPROC": "2",
             "PTPU_MP_URL": url,
+            "PTPU_MP_JPEG_URL": jpeg_url,
             "PTPU_MP_OUT": str(out_file),
             "PYTHONPATH": _REPO + os.pathsep + _HERE,
         })
@@ -75,6 +78,18 @@ def test_two_process_global_array_assembly(tmp_path):
     # both processes observed the SAME global array content (allgather comparison)
     assert results[0]["global_ids"] == results[1]["global_ids"]
     assert set(results[0]["global_ids"]) == ids0 | ids1
+
+    # device-decode phase (VERDICT r2 #3): decoded global batches were assembled from
+    # DEVICE-RESIDENT local decode output — never a host numpy round-trip of pixels
+    for r in results:
+        assert r["decode_image_shape"] == [8, 32, 48, 3]
+        assert r["decode_image_device_count"] == 8  # global assembly across the mesh
+        assert r["decode_assembly_input_types"] == ["ArrayImpl"], \
+            "pixel assembly saw host arrays: %s" % r["decode_assembly_input_types"]
+        assert r["decode_pixel_sum"] > 0
+    d0 = set(results[0]["decode_local_ids"])
+    d1 = set(results[1]["decode_local_ids"])
+    assert not d0 & d1  # disjoint shards in the decode path too
 
 
 def test_local_batch_size_uneven_mesh_math():

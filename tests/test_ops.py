@@ -63,50 +63,75 @@ def test_random_crop_shapes_and_content():
         assert found
 
 
-def test_device_shuffle_buffer_roundtrip():
-    batch = {"x": jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
-             "y": jnp.arange(8, dtype=jnp.int32)}
-    buf = DeviceShuffleBuffer(16, batch, jax.random.PRNGKey(0))
-    buf.insert(batch)
-    out = buf.sample(4)
-    assert out["x"].shape == (4, 4)
-    # sampled rows must be rows of the inserted batch
-    xs = np.asarray(batch["x"])
-    for row in np.asarray(out["x"]):
-        assert any(np.array_equal(row, r) for r in xs)
+def _push_stream(buf, n_batches, b=8, start=0):
+    """Push batches of consecutive ids; returns everything the buffer emitted."""
+    out = []
+    for i in range(n_batches):
+        ids = jnp.arange(start + i * b, start + (i + 1) * b, dtype=jnp.int32)
+        got = buf.push({"y": ids, "x": ids.astype(jnp.float32).reshape(b, 1) * 2})
+        if got is not None:
+            out.append(got)
+    return out
 
 
-def test_device_shuffle_buffer_wraps_and_mixes():
-    buf = None
-    seen = set()
-    for i in range(6):
-        batch = {"y": jnp.full((8,), i, jnp.int32)}
-        if buf is None:
-            buf = DeviceShuffleBuffer(16, batch, jax.random.PRNGKey(1))
-        buf.insert(batch)
-    # capacity 16 holds only the last two batches
-    for _ in range(8):
-        seen.update(np.asarray(buf.sample(8)["y"]).tolist())
-    assert seen <= {4, 5}
-    assert len(seen) == 2
+def test_device_shuffle_exactly_once():
+    """Retrieve-and-remove contract (VERDICT r2 #3): the union of emitted rows over
+    push+drain equals the multiset of inserted rows — nothing repeats, nothing lost."""
+    buf = DeviceShuffleBuffer(24, seed=0)
+    emitted = _push_stream(buf, 10, b=8)  # 80 rows through a 24-row ring
+    emitted += list(buf.drain())
+    ids = np.concatenate([np.asarray(o["y"]) for o in emitted])
+    assert sorted(ids.tolist()) == list(range(80))
+    # row payloads stay aligned across columns through the exchange
+    for o in emitted:
+        np.testing.assert_array_equal(np.asarray(o["x"]).ravel(),
+                                      np.asarray(o["y"]) * 2)
+
+
+def test_device_shuffle_decorrelates_beyond_batch():
+    buf = DeviceShuffleBuffer(64, seed=3)
+    emitted = _push_stream(buf, 40, b=8)
+    emitted += list(buf.drain())
+    ids = np.concatenate([np.asarray(o["y"]) for o in emitted])
+    assert sorted(ids.tolist()) == list(range(320))
+    assert ids.tolist() != list(range(320))  # actually shuffled
+    displacement = np.abs(ids - np.arange(len(ids)))
+    assert displacement.mean() > 8  # mixing beyond batch granularity (~capacity window)
+
+
+def test_device_shuffle_warmup_and_short_tail():
+    """Dataset smaller than capacity: warmup never completes, drain emits an exact
+    permutation (incl. a short tail batch)."""
+    buf = DeviceShuffleBuffer(64, seed=1)
+    assert _push_stream(buf, 3, b=8) == []  # warming
+    tail = buf.push({"y": jnp.arange(24, 30, dtype=jnp.int32),
+                     "x": jnp.arange(24, 30, dtype=jnp.float32).reshape(6, 1) * 2})
+    assert tail is None
+    out = list(buf.drain())
+    ids = np.concatenate([np.asarray(o["y"]) for o in out])
+    assert sorted(ids.tolist()) == list(range(30))
+    assert [len(np.asarray(o["y"])) for o in out] == [8, 8, 8, 6]
+    assert buf.filled == 0  # empty after drain
 
 
 def test_device_shuffle_multihost_determinism():
-    """Same key stream -> same sampling indices regardless of resident data."""
-    b1 = {"y": jnp.arange(8, dtype=jnp.int32)}
-    b2 = {"y": jnp.arange(100, 108, dtype=jnp.int32)}
-    buf1 = DeviceShuffleBuffer(8, b1, jax.random.PRNGKey(7)).insert(b1)
-    buf2 = DeviceShuffleBuffer(8, b2, jax.random.PRNGKey(7)).insert(b2)
-    s1 = np.asarray(buf1.sample(16)["y"])
-    s2 = np.asarray(buf2.sample(16)["y"])
-    np.testing.assert_array_equal(s1 + 100, s2)
+    """Same seed -> same slot stream regardless of resident data: two hosts holding
+    different shards exchange the same positions."""
+    def run(offset):
+        buf = DeviceShuffleBuffer(16, seed=7)
+        emitted = _push_stream(buf, 6, b=8, start=offset)
+        emitted += list(buf.drain())
+        return np.concatenate([np.asarray(o["y"]) for o in emitted])
+
+    a, b = run(0), run(1000)
+    np.testing.assert_array_equal(a + 1000, b)
 
 
-def test_empty_sample_raises():
-    batch = {"y": jnp.arange(4)}
-    buf = DeviceShuffleBuffer(8, batch, jax.random.PRNGKey(0))
-    with pytest.raises(ValueError):
-        buf.sample(2)
+def test_device_shuffle_mismatched_columns_raise():
+    buf = DeviceShuffleBuffer(8, seed=0)
+    buf.push({"y": jnp.arange(8)})
+    with pytest.raises(ValueError, match="columns"):
+        buf.push({"z": jnp.arange(8)})
 
 
 def test_color_jitter_matches_numpy_reference():
@@ -143,3 +168,41 @@ def test_inmem_loader_rejects_infinite_reader(scalar_dataset):
     finally:
         reader.stop()
         reader.join()
+
+
+def test_device_shuffle_sharded_ring():
+    """The ring must split across devices like the batches do (review r3: an
+    unsharded store replicates capacity rows on every device — 8x HBM)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+    s = NamedSharding(mesh, P("dp"))
+    buf = DeviceShuffleBuffer(16, seed=0, shardings=lambda name, arr: s)
+    out = _push_stream(buf, 6, b=8)
+    out += list(buf.drain())
+    ids = np.concatenate([np.asarray(o["y"]) for o in out])
+    assert sorted(ids.tolist()) == list(range(48))
+    # the resident store itself is laid out over the 4 devices, not replicated
+    store_col = buf._store  # drained -> None; re-fill to inspect
+    buf2 = DeviceShuffleBuffer(16, seed=0, shardings=lambda name, arr: s)
+    buf2.push({"y": jnp.arange(8, dtype=jnp.int32),
+               "x": jnp.arange(8, dtype=jnp.float32).reshape(8, 1)})
+    col = buf2._store["y"]
+    assert len(col.sharding.device_set) == 4
+    assert col.addressable_shards[0].data.shape[0] == 4  # 16 / 4 per device
+
+
+def test_device_shuffle_short_batch_mid_warmup_raises():
+    """Review r3: a short batch mid-warmup would scatter past the ring (XLA clamps,
+    rows silently lost). Only legal as the FINAL push."""
+    buf = DeviceShuffleBuffer(16, seed=0)
+    buf.push({"y": jnp.arange(8, dtype=jnp.int32)})
+    assert buf.push({"y": jnp.arange(8, 12, dtype=jnp.int32)}) is None  # short: ok...
+    with pytest.raises(ValueError, match="FINAL push"):
+        buf.push({"y": jnp.arange(12, 20, dtype=jnp.int32)})  # ...but nothing after
+    # drain after the short tail is the legal continuation and stays exact
+    buf2 = DeviceShuffleBuffer(16, seed=0)
+    buf2.push({"y": jnp.arange(8, dtype=jnp.int32)})
+    buf2.push({"y": jnp.arange(8, 12, dtype=jnp.int32)})
+    ids = np.concatenate([np.asarray(o["y"]) for o in buf2.drain()])
+    assert sorted(ids.tolist()) == list(range(12))
