@@ -30,3 +30,20 @@ def test_example_compiles_and_imports_resolve(script, tmp_path):
                 assert hasattr(mod, alias.name), (
                     "%s imports %s from %s which does not exist"
                     % (script, alias.name, node.module))
+
+
+def test_dryrun_multichip_dp2_loader_fed(capsys):
+    """The driver's multichip artifact must exercise dp>=2 and feed the step through
+    the real DataLoader (VERDICT r2 #6). Runs the actual entry point on the 8-virtual-
+    device CPU topology."""
+    import os
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
+    out = capsys.readouterr().out
+    assert "'dp': 2" in out
+    assert "loader_fed_steps=4" in out
