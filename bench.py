@@ -29,9 +29,41 @@ BATCH = 128
 QUALITY = 85
 
 
+def _source_images():
+    """Photographic source frames for the bench dataset, in preference order:
+    1. ``PTPU_BENCH_IMAGE_DIR`` — user-supplied photos (jpg/jpeg/png), center-covered
+       to 224×224 (VERDICT r2 #8: bench against a real corpus when one is available);
+    2. sklearn's two genuine photographs (sharp architecture + macro) — real spectra
+       by default;
+    3. blurred-noise synthetic (round-2 behavior) when neither exists."""
+    import cv2
+
+    if os.environ.get("PTPU_BENCH_CONTENT") == "synthetic":
+        return [], "synthetic (forced)"  # r1/r2-comparable smooth content
+    user_dir = os.environ.get("PTPU_BENCH_IMAGE_DIR")
+    frames = []
+    if user_dir and os.path.isdir(user_dir):
+        for name in sorted(os.listdir(user_dir)):
+            if name.lower().endswith((".jpg", ".jpeg", ".png")):
+                img = cv2.imread(os.path.join(user_dir, name), cv2.IMREAD_COLOR)
+                if img is not None:
+                    frames.append(img)
+        if frames:
+            return frames, "user_dir:%s(%d)" % (user_dir, len(frames))
+    try:
+        from sklearn.datasets import load_sample_images
+
+        frames = [f[:, :, ::-1] for f in load_sample_images().images]  # RGB → BGR
+        return frames, "sklearn_photos"
+    except Exception:  # noqa: BLE001 — fall back to synthetic
+        return [], "synthetic"
+
+
 def make_dataset(root):
-    """ImageNet-shaped JPEG dataset via the real codec write path (photo-like content:
-    blurred noise + gradient, so entropy statistics resemble natural images)."""
+    """ImageNet-shaped JPEG dataset via the real codec write path. Content is real
+    photographic crops by default (see :func:`_source_images`); each row is a randomly
+    placed, randomly flipped, brightness-jittered 224×224 crop, so the corpus has
+    photographic spectra with per-row variety."""
     import cv2
     import pyarrow as pa
     import pyarrow.parquet as pq
@@ -47,22 +79,41 @@ def make_dataset(root):
         UnischemaField("label", np.int32, (), ScalarCodec(ptypes.IntegerType()), False),
     ])
     rng = np.random.RandomState(0)
+    frames, source = _source_images()
+    sys.stderr.write("bench dataset content source: %s\n" % source)
     x = np.linspace(0, 255, IMG[0], dtype=np.float32)
     grad = np.add.outer(x, x) * 0.5
 
+    def one_image(i):
+        if frames:
+            f = frames[i % len(frames)]
+            h, w = f.shape[:2]
+            if h < IMG[0] or w < IMG[1]:
+                f = cv2.resize(f, (max(w, IMG[1]), max(h, IMG[0])))
+                h, w = f.shape[:2]
+            y0 = rng.randint(0, h - IMG[0] + 1)
+            x0 = rng.randint(0, w - IMG[1] + 1)
+            crop = f[y0:y0 + IMG[0], x0:x0 + IMG[1]].astype(np.float32)
+            if rng.rand() < 0.5:
+                crop = crop[:, ::-1]
+            crop = crop * rng.uniform(0.85, 1.15)  # brightness variety
+            return crop.clip(0, 255).astype(np.uint8)
+        noise = rng.randint(0, 256, IMG).astype(np.float32)
+        img = 0.55 * cv2.GaussianBlur(noise, (7, 7), 2.0) + 0.45 * grad[..., None]
+        return img.clip(0, 255).astype(np.uint8)
+
     def rows():
         for i in range(ROWS):
-            noise = rng.randint(0, 256, IMG).astype(np.float32)
-            img = 0.55 * cv2.GaussianBlur(noise, (7, 7), 2.0) + 0.45 * grad[..., None]
             yield {
                 "id": i,
-                "image": img.clip(0, 255).astype(np.uint8),
+                "image": one_image(i),
                 "label": np.int32(i % 1000),
             }
 
-    # ~20KB/jpeg at q85 -> ~6MB row groups of ~ROW_GROUP rows
+    # ~20-35KB/jpeg at q85 -> ~6MB row groups of ~ROW_GROUP rows
     write_dataset("file://" + root, schema, rows(),
                   rows_per_file=ROWS_PER_FILE, row_group_size_mb=6)
+    return source
 
 
 def main():
@@ -73,11 +124,32 @@ def main():
     from petastorm_tpu.loader import DataLoader
     from petastorm_tpu.reader import make_batch_reader
 
-    root = os.path.join(tempfile.gettempdir(), "ptpu_bench_jpeg224")
+    # v3: real-photo content (BASELINE.md §6). The cache dir is keyed by content
+    # mode, and the _done marker records the ACTUAL source the dataset was built
+    # from — _source_images() falls back across sources (typo'd image dir, missing
+    # sklearn), so the marker, not the env, is the truth; a mismatch rebuilds.
+    mode = "synthetic" if os.environ.get("PTPU_BENCH_CONTENT") == "synthetic" else \
+        ("userdir" if os.environ.get("PTPU_BENCH_IMAGE_DIR") else "photos")
+    root = os.path.join(tempfile.gettempdir(), "ptpu_bench_jpeg224_v3_" + mode)
     marker = os.path.join(root, "_done")
-    if not os.path.exists(marker):
-        make_dataset(root)
-        open(marker, "w").close()
+    # acceptable recorded sources per mode ('photos' accepts the synthetic fallback
+    # so a sklearn-less host does not rebuild every run; 'userdir' does NOT accept
+    # fallbacks — once the user's path works, the dataset must be rebuilt from it)
+    accept = {"synthetic": ("synthetic",), "userdir": ("user_dir",),
+              "photos": ("sklearn_photos", "synthetic")}[mode]
+    content = None
+    if os.path.exists(marker):
+        with open(marker) as f:
+            recorded = f.read().strip()
+        if recorded.startswith(accept):
+            content = recorded
+    if content is None:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+        content = make_dataset(root)
+        with open(marker, "w") as f:
+            f.write(content)
 
     # ResNet-stem-shaped device step (conv 7x7/2 + 3x3/2 + 3x3/2 in bf16) so the
     # device-idle fraction is measured against real MXU work, not a bare reduction
@@ -156,8 +228,45 @@ def main():
             "stages": stages,
         }
 
+    def make_resnet_step():
+        import __graft_entry__ as g
+
+        fwd, (variables, _ex) = g.entry()
+        return jax.jit(lambda img: fwd(variables, img.astype(jnp.float32)))
+
+    def measure_overlap(jstep, decode_on_device, measure_batches):
+        """North-star idle proof (VERDICT r2 #1): overlap the pipeline with the
+        flagship model's forward (ResNet-50, ``__graft_entry__.entry``) auto-scaled
+        to ≥ the pipeline's per-batch cost, and report consumer starvation
+        (device_queue_wait / wall) as idle. Unlike the free-device windows above,
+        this directly answers "does the pipeline keep a BUSY device fed?" and is
+        insensitive to the tunnel's dispatch-latency weather.
+
+        Semantics per path: with host decode, consumer starvation IS device idle
+        (the pipeline is pure host+H2D work). With on-device decode, the chip spends
+        real execution time decoding between steps — starvation then includes
+        decode residency (device busy, not idle), so the host-decode number is the
+        keep-the-device-fed proof and the device-decode number bounds the decode's
+        on-chip share."""
+        from petastorm_tpu.benchmark.throughput import overlap_throughput
+
+        workers = max(1, min(8, (os.cpu_count() or 2) - 1))
+        reader = make_batch_reader(
+            "file://" + root, workers_count=workers, shuffle_row_groups=True, seed=0,
+            num_epochs=None, decode_on_device=decode_on_device,
+        )
+        loader = DataLoader(reader, BATCH, prefetch=3, host_queue_size=8)
+        with loader:
+            return overlap_throughput(loader, lambda b: jstep(b["image"]),
+                                      warmup_batches=3,
+                                      measure_batches=measure_batches)
+
     host = measure(decode_on_device=False)
     device = measure(decode_on_device=True)
+    jstep = make_resnet_step()
+    overlap = measure_overlap(jstep, decode_on_device=True, measure_batches=16)
+    overlap_hostdec = measure_overlap(jstep, decode_on_device=False,
+                                      measure_batches=12)
 
     vs = device["rows_per_sec"] / host["rows_per_sec"] if host["rows_per_sec"] else 1.0
     print(json.dumps({
@@ -169,6 +278,17 @@ def main():
         "step_ms": round(device["step_ms"], 2),
         "host_decode_rows_per_sec": round(host["rows_per_sec"], 1),
         "host_decode_device_idle_fraction": round(host["device_idle_fraction"], 4),
+        "overlap_device_idle_fraction": round(overlap.device_idle_fraction, 4),
+        "overlap_rows_per_sec": round(overlap.rows_per_second, 1),
+        "overlap_step_repeats": overlap.step_repeats,
+        "overlap_resnet50_step_ms": round((overlap.step_seconds or 0) * 1e3, 2),
+        "overlap_stages": overlap.stages,
+        "overlap_hostdec_device_idle_fraction":
+            round(overlap_hostdec.device_idle_fraction, 4),
+        "overlap_hostdec_rows_per_sec": round(overlap_hostdec.rows_per_second, 1),
+        "overlap_hostdec_step_repeats": overlap_hostdec.step_repeats,
+        "overlap_hostdec_stages": overlap_hostdec.stages,
+        "content": content,
         "stages": device["stages"],
         "host_stages": host["stages"],
     }))
