@@ -10,13 +10,16 @@ fsspec bridge for anything else — no hand-rolled namenode HA logic; pyarrow's 
 consumes ``core-site.xml``. GCS is the north-star source (BASELINE.json reads ImageNet-Parquet
 from GCS), so ``gs://`` resolves through pyarrow's GcsFileSystem when available, else gcsfs.
 
-HDFS HA compat decision (replaces petastorm/hdfs/namenode.py ~L40–L200 entirely): pass the
-HA *nameservice id* as the URL authority — ``hdfs://nameservice1/path`` — and libhdfs (behind
-``pyarrow.fs.HadoopFileSystem``) performs namenode resolution + failover from
-``core-site.xml``/``hdfs-site.xml`` (``dfs.nameservices``/``dfs.ha.namenodes.*``), which is the
-same config surface the reference's ``HdfsNamenodeResolver``/``HAHdfsClient`` parsed by hand.
-``hdfs:///path`` (no authority) maps to host ``"default"`` = libhdfs's fs.defaultFS.
-URL→constructor dispatch is covered by mocked tests (tests/test_fs.py) without a cluster.
+HDFS HA (petastorm/hdfs/namenode.py ~L40–L200 parity, two layers): libhdfs (behind
+``pyarrow.fs.HadoopFileSystem``) natively resolves nameservice authorities from
+``core-site.xml``/``hdfs-site.xml``; ON TOP, :mod:`petastorm_tpu.hdfs` resolves
+``dfs.nameservices``/``dfs.ha.namenodes.*``/``dfs.namenode.rpc-address.*`` itself and wraps
+multi-namenode services in ``HAHdfsClient`` — every filesystem call retries across the
+namenode list with reconnect-on-standby and raises ``MaxFailoversExceeded`` after the
+configured passes (the reference's app-level guarantee, so a namenode flip mid-epoch
+rotates instead of killing the read). ``hdfs:///path`` (no authority) maps to
+``fs.defaultFS``. URL→constructor dispatch + failover are covered by mocked tests
+(tests/test_fs.py, tests/test_hdfs_ha.py) without a cluster.
 """
 from __future__ import annotations
 
@@ -110,9 +113,13 @@ def _resolve(parsed, urls, storage_options):
             fs = pafs.PyFileSystem(pafs.FSSpecHandler(gcsfs.GCSFileSystem(**storage_options)))
         return fs, [(p.netloc + p.path).rstrip("/") for p in parsed]
     if scheme == "hdfs":
-        host = parsed[0].hostname or "default"
-        port = parsed[0].port or 0
-        fs = pafs.HadoopFileSystem(host, port, **storage_options)
+        from petastorm_tpu.hdfs import connect_hdfs
+
+        # nameservice authorities resolve through Hadoop config to an HA failover
+        # client (petastorm/hdfs/namenode.py parity); explicit host:port stays a
+        # plain libhdfs connection
+        fs = connect_hdfs(parsed[0].hostname, parsed[0].port,
+                          storage_options=storage_options)
         return fs, [p.path for p in parsed]
     # anything else: try fsspec
     try:
