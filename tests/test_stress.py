@@ -1,0 +1,127 @@
+"""Concurrency / teardown stress at the READER and LOADER level (VERDICT r2 #10):
+the executor- and cache-unit-level tests exist; these drive the same failure modes
+through the full product path — two readers sharing one disk cache, a pool child dying
+mid-epoch under load, loader abandonment during staged device decode, and reset()
+racing in-flight results.
+"""
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.loader import DataLoader
+from petastorm_tpu.reader import make_batch_reader, make_reader
+
+
+def test_concurrent_readers_share_disk_cache(scalar_dataset, tmp_path):
+    """Two readers over the same dataset share one local-disk cache directory,
+    iterating concurrently across threads: both must deliver exact data (no torn
+    cache entries, no mismatched fills)."""
+    cache_dir = str(tmp_path / "shared")
+    expected = sorted(r["id"] for r in scalar_dataset.data)
+    results = {}
+    errors = []
+
+    def run(tag, seed):
+        try:
+            reader = make_batch_reader(
+                scalar_dataset.url, cache_type="local-disk",
+                cache_location=cache_dir, shuffle_row_groups=True, seed=seed,
+                num_epochs=3, workers_count=2)
+            with reader:
+                ids = [int(x) for b in reader for x in np.asarray(b.id)]
+            results[tag] = ids
+        except Exception as e:  # noqa: BLE001
+            errors.append((tag, e))
+
+    threads = [threading.Thread(target=run, args=(i, i)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for tag, ids in results.items():
+        assert sorted(ids) == sorted(expected * 3), tag
+
+
+def test_reader_process_child_killed_mid_epoch_under_load(scalar_dataset):
+    """SIGKILL a pool child while a process-pool READER is mid-iteration: the death
+    must surface as a clean RuntimeError at the consumer (never a hang, never
+    silently-missing rows)."""
+    reader = make_batch_reader(scalar_dataset.url, reader_pool_type="process",
+                               workers_count=2, num_epochs=None,
+                               results_timeout_s=60)
+    killed = False
+    count = 0
+    with reader, pytest.raises(RuntimeError, match="worker process died"):
+        for _ in reader:
+            count += 1
+            if count == 3:
+                os.kill(reader._executor._procs[0].pid, signal.SIGKILL)
+                killed = True
+    assert killed
+
+
+def test_loader_abandoned_during_staged_decode(tmp_path):
+    """Abandon a device-decode loader while stage-2 dispatches are in flight: all
+    pipeline threads must wind down promptly (nothing left pinning staged payloads
+    or device batches)."""
+    from test_common import create_test_jpeg_dataset
+
+    url = "file://" + str(tmp_path / "jds")
+    create_test_jpeg_dataset(url, num_rows=48)
+    for iteration in range(3):
+        reader = make_reader(url, decode_on_device=True, num_epochs=None,
+                             workers_count=1, shuffle_row_groups=False)
+        loader = DataLoader(reader, batch_size=8, prefetch=3)
+        it = iter(loader)
+        next(it)  # decode compiled, pipeline saturated with staged work
+        it.close()  # abandon mid-flight
+        t0 = time.time()
+        loader.stop()
+        loader.join()
+        assert time.time() - t0 < 15
+        assert not loader._producer.is_alive()
+        if loader._transfer_thread is not None:
+            assert not loader._transfer_thread.is_alive()
+        reader.stop()
+        reader.join()
+
+
+@pytest.mark.parametrize("pool", ["thread", "process"])
+def test_reset_races_in_flight_results(scalar_dataset, pool):
+    """reset() issued while the pool still has work in flight: the restarted epoch
+    stream must be exact (every row exactly once per epoch) with no residue from the
+    aborted pass leaking across the reset."""
+    reader = make_batch_reader(scalar_dataset.url, reader_pool_type=pool,
+                               workers_count=2, num_epochs=1,
+                               shuffle_row_groups=True, seed=3,
+                               results_timeout_s=60)
+    expected = sorted(r["id"] for r in scalar_dataset.data)
+    with reader:
+        it = iter(reader)
+        next(it)  # results in flight beyond this one
+        for _ in range(5):
+            reader.reset()  # hammer the race: stop/join/restart with work pending
+        ids = [int(x) for b in reader for x in np.asarray(b.id)]
+    assert sorted(ids) == expected
+
+
+def test_reset_midstream_many_cycles(scalar_dataset):
+    """Tighter loop on the reset race: interleave consumption and reset repeatedly;
+    every post-reset pass must still deliver a complete epoch."""
+    reader = make_batch_reader(scalar_dataset.url, reader_pool_type="thread",
+                               workers_count=4, num_epochs=1,
+                               shuffle_row_groups=True, seed=1)
+    expected = sorted(r["id"] for r in scalar_dataset.data)
+    with reader:
+        for cycle in range(4):
+            it = iter(reader)
+            for _ in range(cycle % 3):  # consume 0..2 batches before resetting
+                next(it, None)
+            reader.reset()
+        ids = [int(x) for b in reader for x in np.asarray(b.id)]
+    assert sorted(ids) == expected
