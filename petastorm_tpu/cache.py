@@ -81,7 +81,13 @@ class LocalDiskCache(CacheBase):
             return pickle.load(f)
 
     def _write(self, fpath, value):
-        tmp = fpath + ".tmp.%d" % os.getpid()
+        # tmp name must be unique per WRITER, not per process: two pool threads
+        # filling the same key concurrently would interleave writes into a shared
+        # tmp file and the loser's os.replace would raise FileNotFoundError after
+        # the winner moved it (caught by tests/test_stress.py concurrent readers)
+        import uuid
+
+        tmp = "%s.tmp.%s" % (fpath, uuid.uuid4().hex)
         if self._serializer == "arrow":
             import pyarrow as pa
 
@@ -93,15 +99,30 @@ class LocalDiskCache(CacheBase):
                 pickle.dump(value, f, protocol=pickle.HIGHEST_PROTOCOL)
         os.replace(tmp, fpath)
 
+    #: tmp files older than this are considered orphans of a crashed writer and are
+    #: reclaimed by eviction; younger ones are in-flight (unlinking those would make
+    #: the writer's os.replace fail)
+    TMP_ORPHAN_GRACE_S = 300
+
     def _evict(self):
+        import time
+
         entries = []
         total = 0
+        now = time.time()
         for name in os.listdir(self._path):
             fpath = os.path.join(self._path, name)
             try:
                 st = os.stat(fpath)
             except OSError:
                 continue
+            if ".tmp." in name:
+                if now - st.st_mtime > self.TMP_ORPHAN_GRACE_S:
+                    try:  # orphan of a SIGKILLed writer: reclaim the space
+                        os.unlink(fpath)
+                    except OSError:
+                        pass
+                continue  # in-flight writer: never unlink, never count
             entries.append((st.st_mtime, st.st_size, fpath))
             total += st.st_size
         entries.sort()

@@ -199,14 +199,46 @@ def _list_parquet_files(fs, path):
     info = fs.get_file_info(path)
     if info.type == pafs.FileType.File:
         return [path]
-    selector = pafs.FileSelector(path, recursive=True)
+    if info.type == pafs.FileType.NotFound:
+        # fsspec find() on a missing prefix returns [] without raising — a typo'd
+        # path must surface as the missing directory, not as an empty dataset
+        raise FileNotFoundError("Dataset path does not exist: %r" % path)
+    names = _flat_object_listing(fs, path)
+    if names is None:
+        selector = pafs.FileSelector(path, recursive=True)
+        names = [fi.path for fi in fs.get_file_info(selector)
+                 if fi.type == pafs.FileType.File]
     files = []
-    for fi in fs.get_file_info(selector):
-        base = posixpath.basename(fi.path)
-        if fi.type == pafs.FileType.File and not base.startswith(("_", ".")):
+    for full in names:
+        base = posixpath.basename(full)
+        if not base.startswith(("_", ".")):
             if base.endswith((".parquet", ".parq")) or "." not in base:
-                files.append(fi.path)
+                files.append(full)
     return sorted(files)
+
+
+def _flat_object_listing(fs, path):
+    """One flat prefix listing for fsspec-bridged object stores, or None.
+
+    Reference parity: petastorm/gcsfs_helpers/gcsfs_fast_listing.py ~L30 — gcsfs
+    emulates directories, so a recursive ``FileSelector`` walk through the
+    ``FSSpecHandler`` costs one API round trip per directory (O(dirs), brutal on
+    hive-partitioned / many-file layouts), while object stores can enumerate any
+    prefix in a single paginated call. ``fsspec``'s ``find()`` is that call."""
+    handler = getattr(fs, "handler", None)
+    inner = getattr(handler, "fs", None)
+    if inner is None or not hasattr(inner, "find"):
+        return None
+    try:
+        found = inner.find(path)
+    except Exception as e:  # noqa: BLE001 — fall back to the selector walk
+        import logging
+
+        logging.getLogger(__name__).debug("flat listing failed (%s); selector walk", e)
+        return None
+    # fsspec returns keys in the inner fs's own convention; the FSSpecHandler maps
+    # paths 1:1, so they are valid for the bridged pyarrow fs as-is
+    return [str(p) for p in found]
 
 
 def _read_kv_metadata(fs, path):

@@ -197,3 +197,25 @@ def test_selector_predicate_and_shard_compose(synthetic_dataset):
     # manual filter as long as selected row groups cover all matches (they do: the
     # union over both shards is every selected row group)
     assert got == expected
+
+
+def test_eviction_reclaims_orphaned_tmp_files(tmp_path):
+    """Review r3: tmp files from a crashed writer are reclaimed once older than the
+    grace period; in-flight (young) tmp files are never touched."""
+    import os
+    import time
+
+    from petastorm_tpu.cache import LocalDiskCache
+
+    cache = LocalDiskCache(str(tmp_path / "c"), size_limit_bytes=10_000)
+    cache.get("k", lambda: list(range(100)))
+    orphan = str(tmp_path / "c" / "deadbeef.pkl.tmp.abc123")
+    young = str(tmp_path / "c" / "cafe.pkl.tmp.def456")
+    for p in (orphan, young):
+        with open(p, "wb") as f:
+            f.write(b"x" * 64)
+    old = time.time() - LocalDiskCache.TMP_ORPHAN_GRACE_S - 10
+    os.utime(orphan, (old, old))
+    cache.get("k2", lambda: list(range(100)))  # triggers eviction pass
+    assert not os.path.exists(orphan)
+    assert os.path.exists(young)

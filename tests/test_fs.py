@@ -121,3 +121,71 @@ def test_fsspec_bridge_reads_memory_filesystem():
         reader.stop()
         reader.join()
     assert sorted(rows) == list(range(20))
+
+
+def test_flat_object_listing_on_fsspec_bridge():
+    """Object-store listing fast path (reference gcsfs_fast_listing parity): on an
+    fsspec-bridged filesystem, piece enumeration uses ONE flat find() instead of a
+    per-directory recursive selector walk — and returns identical files."""
+    import fsspec
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu import metadata as md
+    from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+    from petastorm_tpu.reader import make_batch_reader
+
+    mfs = fsspec.filesystem("memory")
+    rid = 0
+    for date in ("d1", "d2"):
+        for part in range(3):
+            p = "/flat_ds/date=%s/part-%d.parquet" % (date, part)
+            with mfs.open(p, "wb") as f:
+                pq.write_table(
+                    pa.table({"id": np.arange(rid, rid + 4, dtype=np.int64)}), f)
+            rid += 4
+
+    fs, path = get_filesystem_and_path_or_paths("memory:///flat_ds")
+    calls = {"find": 0, "ls": 0}
+    orig_find = type(mfs).find
+    orig_ls = type(mfs).ls
+
+    def spy_find(self, *a, **k):
+        calls["find"] += 1
+        return orig_find(self, *a, **k)
+
+    def spy_ls(self, *a, **k):
+        calls["ls"] += 1
+        return orig_ls(self, *a, **k)
+
+    type(mfs).find = spy_find
+    type(mfs).ls = spy_ls
+    try:
+        files = md._list_parquet_files(fs, path)
+    finally:
+        type(mfs).find = orig_find
+        type(mfs).ls = orig_ls
+    assert len(files) == 6
+    # enumeration delegated to ONE find() call — the method gcsfs/s3fs implement as
+    # a single paginated flat listing (memory:// emulates find via walk internally,
+    # so ls-count is only meaningful for real object stores)
+    assert calls["find"] == 1
+
+    # end-to-end: the fast-listed hive store reads correctly (partition col incl.)
+    with make_batch_reader("memory:///flat_ds", num_epochs=1, workers_count=1,
+                           reader_pool_type="dummy",
+                           shuffle_row_groups=False) as reader:
+        got = sorted(int(x) for b in reader for x in np.asarray(b.id))
+    assert got == list(range(24))
+
+
+def test_missing_fsspec_path_raises_not_empty():
+    """Review r3: a typo'd path on an fsspec-bridged store must raise
+    FileNotFoundError, not read back as an empty dataset."""
+    import pytest
+
+    from petastorm_tpu.reader import make_batch_reader
+
+    with pytest.raises(FileNotFoundError, match="does not exist"):
+        make_batch_reader("memory:///no_such_dataset_anywhere")
