@@ -49,6 +49,12 @@ def __getattr__(name):
             from petastorm_tpu.loader import InMemDataLoader
 
             return InMemDataLoader
+        if name == "checkpoint":
+            import importlib
+
+            # importlib (not `from petastorm_tpu import checkpoint`): the from-import
+            # re-enters this __getattr__ before the submodule lands in sys.modules
+            return importlib.import_module("petastorm_tpu.checkpoint")
     except ImportError as e:
         raise AttributeError(
             "petastorm_tpu.%s is unavailable (%s)" % (name, e)

@@ -1,0 +1,91 @@
+"""Orbax-integrated checkpointing of the data-plane state.
+
+TPU-native upgrade over the reference (SURVEY.md §6: petastorm has no resumable
+cursor at all): ``Reader.state_dict()`` already gives exact mid-epoch resume; this
+module makes that state a first-class item in an **orbax** checkpoint next to the
+model params/optimizer — one atomic step directory, one restore call, the workflow
+preemption-prone pods actually use.
+
+    import orbax.checkpoint as ocp
+    from petastorm_tpu import checkpoint as ptck
+
+    mngr = ocp.CheckpointManager(ckpt_dir)
+    ...
+    mngr.save(step, args=ocp.args.Composite(
+        params=ocp.args.StandardSave(params),
+        reader=ptck.save_args(reader),
+    ))
+    ...
+    restored = mngr.restore(step, args=ocp.args.Composite(
+        params=ocp.args.StandardRestore(params_template),
+        reader=ptck.restore_args(),
+    ))
+    ptck.apply(reader, restored["reader"])
+
+For scripts that only need the data-plane state, :func:`save` / :func:`restore`
+write/read a standalone orbax checkpoint directory.
+
+Multi-process: ``Reader.state_dict()`` is per-process (each process owns its shard's
+plan); orbax's managers coordinate the multi-host write. Save the reader item from
+EVERY process (orbax Composite handles per-process payloads via ``JsonSave`` on
+process 0 — for per-shard exactness use :func:`save` with a per-process path, or
+embed ``state_dict()`` in your own per-host payload).
+"""
+from __future__ import annotations
+
+
+def save_args(reader):
+    """``ocp.args.JsonSave`` of the reader's exact-resume state — pass as one item of
+    an ``ocp.args.Composite`` alongside params/opt-state."""
+    import orbax.checkpoint as ocp
+
+    return ocp.args.JsonSave(reader.state_dict())
+
+
+def restore_args():
+    """``ocp.args.JsonRestore`` matching :func:`save_args`."""
+    import orbax.checkpoint as ocp
+
+    return ocp.args.JsonRestore()
+
+
+def apply(reader, restored_state):
+    """Load a restored state dict into a freshly-built reader (same dataset/config).
+
+    The reader resumes at the consumed-work watermark: fully-delivered row groups
+    are skipped; in-flight ones replay in full (at-least-once at row-group
+    granularity — ``Reader.state_dict`` docs)."""
+    reader.load_state_dict(_denormalize(restored_state))
+    return reader
+
+
+def save(path, reader):
+    """Standalone orbax checkpoint of just the data-plane state at ``path``."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.Checkpointer(ocp.JsonCheckpointHandler())
+    ckptr.save(_epath(path), args=save_args(reader))
+
+
+def restore(path, reader):
+    """Restore a standalone :func:`save` checkpoint into ``reader``."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.Checkpointer(ocp.JsonCheckpointHandler())
+    state = ckptr.restore(_epath(path))
+    return apply(reader, state)
+
+
+def _epath(path):
+    from etils import epath
+
+    return epath.Path(path)
+
+
+def _denormalize(state):
+    """JSON round trips stringify the integer epoch keys in ``consumed``; restore
+    them (load_state_dict casts defensively, but keep the contract explicit)."""
+    state = dict(state)
+    if "consumed" in state:
+        state["consumed"] = {int(k): v for k, v in state["consumed"].items()}
+    return state

@@ -860,6 +860,14 @@ class InMemDataLoader:
     costs no host CPU and no transfer — the right shape for small/medium datasets
     (MNIST-scale fine-tuning, eval sets) on big accelerators.
 
+    Under multi-process JAX each process fills its own shard (pass a sharded reader,
+    ``cur_shard=jax.process_index()``) and keeps it resident on ITS devices; every
+    batch gathers each process's local share and assembles the global ``jax.Array``
+    from the device-resident parts. ``batch_size`` stays GLOBAL; requires a
+    decomposable ``NamedSharding`` and ``last_batch='drop'``; the per-epoch batch
+    count is agreed once at fill time (allgather of local row counts — the only
+    collective).
+
     Parameters
     ----------
     reader : Reader
@@ -904,12 +912,34 @@ class InMemDataLoader:
                 "infinite reader (num_epochs=None) would never finish the fill. Build "
                 "the reader with num_epochs=1 and set epochs here."
             )
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "InMemDataLoader is single-process: the resident store and gathers "
-                "are addressable-device only. Under multi-process JAX use the "
-                "streaming DataLoader (global-array assembly) instead."
-            )
+        #: multi-process: each process keeps ITS shard HBM-resident and every batch
+        #: assembles a global jax.Array from the per-process device-resident gathers
+        #: (same contract as per-rank InMemBatchedDataLoader under DDP, but the
+        #: delivered batch is global). Requires a decomposable NamedSharding and
+        #: last_batch='drop'; per-epoch batch count is agreed once at fill time via
+        #: an allgather of local row counts.
+        self._multiprocess = jax.process_count() > 1
+        if self._multiprocess:
+            if sharding is None:
+                raise ValueError(
+                    "multi-process InMemDataLoader requires a sharding (a "
+                    "NamedSharding whose batch axis decomposes per process)")
+            if last_batch != "drop":
+                raise ValueError(
+                    "multi-process InMemDataLoader supports last_batch='drop' only "
+                    "(a ragged tail cannot assemble into a uniform global array)")
+            self.local_batch_size = _resolve_local_batch(self.batch_size, sharding)
+            if self.local_batch_size >= self.batch_size:
+                # a replicated batch axis would assemble each process's DIFFERENT
+                # shard rows as 'replicas' of one global array — silent corruption
+                # (jax requires replica data to be identical and does not verify it)
+                raise ValueError(
+                    "multi-process InMemDataLoader requires a sharding whose batch "
+                    "axis spans processes (each process contributes its shard); a "
+                    "replicated batch axis would label divergent per-process shards "
+                    "as replicas of the same array")
+        else:
+            self.local_batch_size = self.batch_size
         self._sharding = sharding
         chunks = []
         dropped = set()
@@ -936,7 +966,30 @@ class InMemDataLoader:
             for k in chunks[0]
         }
         self.rows = int(next(iter(self._store.values())).shape[0])
-        if sharding is not None:
+        if self._multiprocess:
+            from jax.experimental import multihost_utils
+
+            self._local_rows = self.rows
+            all_rows = np.asarray(multihost_utils.process_allgather(
+                np.array([self._local_rows], dtype=np.int64))).ravel()
+            self._batches_per_epoch = int(all_rows.min()) // self.local_batch_size
+            if self._batches_per_epoch == 0:
+                raise ValueError(
+                    "multi-process InMemDataLoader: some process holds only %d rows "
+                    "— fewer than its local batch share %d; no full global batch "
+                    "can be formed" % (int(all_rows.min()), self.local_batch_size))
+            served = self._batches_per_epoch * self.local_batch_size
+            if int(all_rows.max()) > served:
+                logger.warning(
+                    "InMemDataLoader shards are uneven (%d..%d rows/process): each "
+                    "epoch serves %d rows/process; with shuffle=True the excluded "
+                    "rows differ per epoch, with shuffle=False the SAME surplus "
+                    "rows are never served — rebalance shards (shard_seed) or keep "
+                    "shuffle on", int(all_rows.min()), int(all_rows.max()), served)
+            self.rows = int(all_rows.sum())
+            # the store stays PROCESS-LOCAL (addressable devices); the global layout
+            # happens per batch from the already-device-resident gathers
+        elif sharding is not None:
             # shard the resident store along the batch axis when the row count
             # divides; otherwise it stays on the default device and only the
             # gathered batches are laid out per the sharding
@@ -956,6 +1009,8 @@ class InMemDataLoader:
         self._gather = jax.jit(_gather)
 
     def __len__(self):
+        if self._multiprocess:
+            return self._batches_per_epoch
         full, rem = divmod(self.rows, self.batch_size)
         return full + (1 if rem and self.last_batch == "partial" else 0)
 
@@ -975,6 +1030,11 @@ class InMemDataLoader:
             except (TypeError, ValueError):
                 takes_key = False
         while self.num_epochs is None or epoch < self.num_epochs:
+            if self._multiprocess:
+                yield from self._multiprocess_epoch(epoch, takes_key, step)
+                epoch += 1
+                step += self._batches_per_epoch
+                continue
             if self.shuffle:
                 key = jax.random.fold_in(jax.random.PRNGKey(self._seed), epoch)
                 perm = jax.random.permutation(key, self.rows)
@@ -1001,18 +1061,51 @@ class InMemDataLoader:
                             "InMemDataLoader: final partial batch (%d rows) does not "
                             "divide the sharding's batch axis; yielded unsharded",
                             len(idx))
-                if self._device_transform is not None:
-                    if self._jitted_transform is None:
-                        self._jitted_transform = jax.jit(self._device_transform)
-                    if takes_key:
-                        tkey = jax.random.fold_in(
-                            jax.random.PRNGKey(self._seed + 1), step)
-                        batch = self._jitted_transform(batch, tkey)
-                    else:
-                        batch = self._jitted_transform(batch)
+                batch = self._apply_transform(batch, step, takes_key)
                 step += 1
                 yield batch
             epoch += 1
+
+    def _multiprocess_epoch(self, epoch, takes_key, step0):
+        """One epoch under multi-process JAX: per-process local permutation gathers,
+        each assembled into a global jax.Array from the device-resident local share
+        (no host round trip — same path the streaming loader's decode assembly uses)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.shuffle:
+            # fold the process index so shard orders decorrelate across processes
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(self._seed), epoch),
+                jax.process_index() + 1)
+            perm = jax.random.permutation(key, self._local_rows)
+        else:
+            perm = jnp.arange(self._local_rows)
+        for b in range(self._batches_per_epoch):
+            idx = perm[b * self.local_batch_size:(b + 1) * self.local_batch_size]
+            local = self._gather(self._store, idx)
+            batch = {}
+            for k, v in local.items():
+                s = self._sharding.get(k) if isinstance(self._sharding, dict) \
+                    else _matching_sharding(self._sharding, v)
+                if s is None:
+                    batch[k] = v  # field without a declared layout stays local
+                else:
+                    batch[k] = jax.make_array_from_process_local_data(s, v)
+            batch = self._apply_transform(batch, step0 + b, takes_key)
+            yield batch
+
+    def _apply_transform(self, batch, step, takes_key):
+        if self._device_transform is None:
+            return batch
+        import jax
+
+        if self._jitted_transform is None:
+            self._jitted_transform = jax.jit(self._device_transform)
+        if takes_key:
+            tkey = jax.random.fold_in(jax.random.PRNGKey(self._seed + 1), step)
+            return self._jitted_transform(batch, tkey)
+        return self._jitted_transform(batch)
 
     def __enter__(self):
         return self

@@ -59,8 +59,50 @@ def main():
         "global_ids": sorted(global_ids),
     }
     out.update(device_decode_phase())
+    out.update(inmem_phase())
     with open(os.environ["PTPU_MP_OUT"], "w") as f:
         json.dump(out, f)
+
+
+def inmem_phase():
+    """Multi-process InMemDataLoader: per-process HBM-resident shards, global batches
+    assembled from device-resident gathers, agreed batch count, exact epochs."""
+    from petastorm_tpu.loader import InMemDataLoader
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+    reader = make_batch_reader(
+        os.environ["PTPU_MP_URL"],
+        cur_shard=jax.process_index(), shard_count=jax.process_count(),
+        shard_seed=0, shuffle_row_groups=False, num_epochs=1, workers_count=1,
+    )
+    loader = InMemDataLoader(reader, batch_size=16, num_epochs=2, seed=4,
+                             sharding=sharding)
+    epochs = [[], []]
+    shapes = set()
+    device_counts = set()
+    n_batches = len(loader)
+    i = 0
+    for batch in loader:
+        arr = batch["id"]
+        shapes.add(tuple(arr.shape))
+        device_counts.add(len(arr.sharding.device_set))
+        for shard in arr.addressable_shards:
+            epochs[i // n_batches].extend(np.asarray(shard.data).ravel().tolist())
+        i += 1
+    reader.stop()
+    reader.join()
+    return {
+        "inmem_batches_per_epoch": n_batches,
+        "inmem_local_batch": loader.local_batch_size,
+        "inmem_global_rows": loader.rows,
+        "inmem_shapes": sorted(str(s) for s in shapes),
+        "inmem_device_counts": sorted(device_counts),
+        "inmem_epoch0_local_ids": sorted(epochs[0]),
+        "inmem_epoch1_local_ids": sorted(epochs[1]),
+        "inmem_epoch0_order": epochs[0],
+        "inmem_epoch1_order": epochs[1],
+    }
 
 
 def device_decode_phase():

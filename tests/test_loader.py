@@ -512,3 +512,28 @@ def test_device_shuffle_requires_to_device(scalar_dataset):
         DataLoader(reader, batch_size=5, device_shuffle_capacity=32, to_device=False)
     reader.stop()
     reader.join()
+
+
+def test_multiprocess_inmem_guards(scalar_dataset, monkeypatch):
+    """Review r3: multi-process InMemDataLoader must reject a replicated batch axis
+    (divergent per-process shards would silently assemble as 'replicas') and any
+    missing sharding/last_batch misconfig — before touching the reader."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from petastorm_tpu.loader import InMemDataLoader
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    reader = make_batch_reader(scalar_dataset.url, num_epochs=1)
+    try:
+        with pytest.raises(ValueError, match="requires a sharding"):
+            InMemDataLoader(reader, batch_size=8)  # no sharding at all
+        with pytest.raises(ValueError, match="drop"):
+            InMemDataLoader(reader, batch_size=8, last_batch="partial",
+                            sharding=NamedSharding(mesh, P("dp")))
+        with pytest.raises(ValueError, match="replicated batch axis|spans processes"):
+            InMemDataLoader(reader, batch_size=8,
+                            sharding=NamedSharding(mesh, P(None)))
+    finally:
+        reader.stop()
+        reader.join()

@@ -91,6 +91,21 @@ def test_two_process_global_array_assembly(tmp_path):
     d1 = set(results[1]["decode_local_ids"])
     assert not d0 & d1  # disjoint shards in the decode path too
 
+    # InMem phase: per-process resident shards → global batches, exact epochs
+    for r in results:
+        assert r["inmem_local_batch"] == 8  # global 16 over 2 processes
+        assert r["inmem_shapes"] == ["(16,)"]  # every batch is the GLOBAL size
+        assert r["inmem_device_counts"] == [8]  # laid out across the whole mesh
+        assert r["inmem_global_rows"] == 64
+        # each epoch delivers this process's share exactly once
+        e0, e1 = r["inmem_epoch0_local_ids"], r["inmem_epoch1_local_ids"]
+        assert e0 == e1
+        assert len(e0) == len(set(e0)) == r["inmem_batches_per_epoch"] * 8
+        assert r["inmem_epoch0_order"] != r["inmem_epoch1_order"]  # reshuffled
+    # the two processes' shares are disjoint
+    assert not set(results[0]["inmem_epoch0_local_ids"]) & \
+        set(results[1]["inmem_epoch0_local_ids"])
+
 
 def test_local_batch_size_uneven_mesh_math():
     """Pure mesh math against fake device grids — no processes needed."""
