@@ -1115,11 +1115,15 @@ class InMemDataLoader:
 
 
 def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1,
-                    shuffling_queue_capacity=0, reader_factory=None, **reader_kwargs):
+                    shuffling_queue_capacity=0, reader_factory=None,
+                    last_batch="drop", device_transform=None, prefetch=2,
+                    pad_shapes=None, device_shuffle_capacity=0, to_device=True,
+                    host_queue_size=8, **reader_kwargs):
     """One-call convenience: ``make_batch_reader`` + :class:`DataLoader`.
 
     ``reader_kwargs`` pass through to :func:`petastorm_tpu.reader.make_batch_reader`
-    (or ``reader_factory`` when given). Under multi-process JAX, ``cur_shard``/``shard_count``
+    (or ``reader_factory`` when given); the explicit loader parameters mirror
+    :class:`DataLoader`. Under multi-process JAX, ``cur_shard``/``shard_count``
     default to ``jax.process_index()``/``jax.process_count()``.
     """
     from petastorm_tpu.reader import make_batch_reader
@@ -1139,4 +1143,8 @@ def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1
     if seed is None:
         seed = reader_kwargs.get("shard_seed")
     return DataLoader(reader, batch_size, sharding=sharding,
-                      shuffling_queue_capacity=shuffling_queue_capacity, seed=seed)
+                      shuffling_queue_capacity=shuffling_queue_capacity, seed=seed,
+                      last_batch=last_batch, device_transform=device_transform,
+                      prefetch=prefetch, pad_shapes=pad_shapes,
+                      device_shuffle_capacity=device_shuffle_capacity,
+                      to_device=to_device, host_queue_size=host_queue_size)

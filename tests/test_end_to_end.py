@@ -368,3 +368,59 @@ def test_weighted_sampling_through_dataloader(scalar_dataset):
         for r in (r3, r5):
             r.stop()
             r.join()
+
+
+def test_weighted_sampling_respects_ratios(scalar_dataset, tmp_path):
+    """Statistical contract (reference weighted_sampling_reader ~L30): the draw
+    frequencies track the declared weights while both readers still have data."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu import WeightedSamplingReader
+
+    # a second, distinguishable dataset (ids offset by 1000), large enough that
+    # neither reader drains during the measurement window
+    other = tmp_path / "other"
+    other.mkdir()
+    pq.write_table(pa.table({"id": np.arange(1000, 1600, dtype=np.int64)}),
+                   str(other / "p.parquet"), row_group_size=4)
+    big = tmp_path / "big"
+    big.mkdir()
+    pq.write_table(pa.table({"id": np.arange(600, dtype=np.int64)}),
+                   str(big / "p.parquet"), row_group_size=4)
+
+    r1 = make_batch_reader("file://" + str(big), num_epochs=1,
+                           reader_pool_type="dummy", shuffle_row_groups=False)
+    r2 = make_batch_reader("file://" + str(other), num_epochs=1,
+                           reader_pool_type="dummy", shuffle_row_groups=False)
+    draws_a = 0
+    n = 0
+    with WeightedSamplingReader([r1, r2], [0.8, 0.2], seed=5) as mixed:
+        for batch in mixed:
+            first = int(np.asarray(batch.id)[0])
+            draws_a += first < 1000
+            n += 1
+            if n >= 120:
+                break
+    frac = draws_a / n
+    assert 0.65 < frac < 0.92, frac  # ~0.8 within binomial noise at n=120
+
+
+def test_make_dataloader_forwards_loader_options(scalar_dataset):
+    """make_dataloader passes the full DataLoader surface through (device shuffle,
+    last_batch, transform, prefetch)."""
+    from petastorm_tpu.loader import make_dataloader
+
+    loader = make_dataloader(
+        scalar_dataset.url, batch_size=5, shuffle_row_groups=False,
+        schema_fields=["id", "float_col"], last_batch="partial",
+        device_shuffle_capacity=16, seed=9,
+        device_transform=lambda b: {**b, "id2": b["id"] * 2})
+    with loader:
+        batches = list(loader)
+    ids = np.concatenate([np.asarray(b["id"]) for b in batches])
+    assert sorted(ids.tolist()) == sorted(r["id"] for r in scalar_dataset.data)
+    assert ids.tolist() != sorted(ids.tolist())  # device shuffle applied
+    for b in batches:
+        np.testing.assert_array_equal(np.asarray(b["id2"]),
+                                      np.asarray(b["id"]) * 2)
