@@ -1114,17 +1114,27 @@ class InMemDataLoader:
         self._store = None  # release HBM
 
 
+_UNSET = object()
+
+#: DataLoader keyword parameters make_dataloader forwards when explicitly given —
+#: defaults stay defined ONCE, on DataLoader.__init__ (they'd silently drift if
+#: re-stated here).
+_LOADER_OPTS = ("last_batch", "device_transform", "prefetch", "pad_shapes",
+                "device_shuffle_capacity", "to_device", "host_queue_size")
+
+
 def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1,
                     shuffling_queue_capacity=0, reader_factory=None,
-                    last_batch="drop", device_transform=None, prefetch=2,
-                    pad_shapes=None, device_shuffle_capacity=0, to_device=True,
-                    host_queue_size=8, **reader_kwargs):
+                    last_batch=_UNSET, device_transform=_UNSET, prefetch=_UNSET,
+                    pad_shapes=_UNSET, device_shuffle_capacity=_UNSET,
+                    to_device=_UNSET, host_queue_size=_UNSET, **reader_kwargs):
     """One-call convenience: ``make_batch_reader`` + :class:`DataLoader`.
 
     ``reader_kwargs`` pass through to :func:`petastorm_tpu.reader.make_batch_reader`
     (or ``reader_factory`` when given); the explicit loader parameters mirror
-    :class:`DataLoader`. Under multi-process JAX, ``cur_shard``/``shard_count``
-    default to ``jax.process_index()``/``jax.process_count()``.
+    :class:`DataLoader` (defaults are DataLoader's — only explicitly-passed values
+    are forwarded). Under multi-process JAX, ``cur_shard``/``shard_count`` default
+    to ``jax.process_index()``/``jax.process_count()``.
     """
     from petastorm_tpu.reader import make_batch_reader
 
@@ -1142,9 +1152,9 @@ def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1
     seed = reader_kwargs.get("seed")
     if seed is None:
         seed = reader_kwargs.get("shard_seed")
+    passed = locals()
+    loader_kwargs = {name: passed[name] for name in _LOADER_OPTS
+                     if passed[name] is not _UNSET}
     return DataLoader(reader, batch_size, sharding=sharding,
                       shuffling_queue_capacity=shuffling_queue_capacity, seed=seed,
-                      last_batch=last_batch, device_transform=device_transform,
-                      prefetch=prefetch, pad_shapes=pad_shapes,
-                      device_shuffle_capacity=device_shuffle_capacity,
-                      to_device=to_device, host_queue_size=host_queue_size)
+                      **loader_kwargs)

@@ -502,7 +502,7 @@ def _idct_scaled(scaled):
 
 
 @functools.lru_cache(maxsize=32)
-def _batched_stage2(layout, ks=None):
+def _batched_stage2(layout, ks=None, packed=None):
     """Layout-specialized jitted decoder: stacked coefficient arrays → (n, h, w, 3)
     uint8 RGB. One Pallas IDCT dispatch per component for the WHOLE batch (vs one jit
     per image — VERDICT r1 #1). The batch size is taken from the input shapes, so jit's
@@ -512,7 +512,14 @@ def _batched_stage2(layout, ks=None):
     variant: inputs arrive as ``(n, blocks, k)`` zigzag-prefix packs (all dropped
     coefficients are zero — ``kmax`` contract) and are zero-padded + inverse-permuted
     back to natural order on device, fused into the same program. Bit-identical
-    output; ~k/64 of the H2D bytes."""
+    output; ~k/64 of the H2D bytes.
+
+    ``packed`` (per component, bool) selects the 12-bit transfer variant on top:
+    inputs arrive as ``(n, blocks, k*3//2)`` uint8 (two coefficients per 3 bytes,
+    ``ptpu_jpeg_pack12`` layout) and are unpacked to int16 with fused integer ops
+    before the pad/unpermute. Exact for |coeff| ≤ 2047 (the native packer verifies
+    and falls back to int16 otherwise) — so output stays bit-identical at 75% of
+    even the truncated H2D bytes."""
     import jax
     import jax.numpy as jnp
 
@@ -527,8 +534,18 @@ def _batched_stage2(layout, ks=None):
         for ci, ((h_samp, v_samp, by, bx), coef, qtab) in enumerate(
                 zip(comp_layout, coeffs, qtabs)):
             # coef: (n, by*bx, 64) int16 natural order — or (n, by*bx, ks[ci])
-            # zigzag prefix when this component was packed; qtab: (n, 64) int32
-            # (per-image: quality may vary)
+            # zigzag prefix when this component was truncated, or the 12-bit uint8
+            # pack of either; qtab: (n, 64) int32 (per-image: quality may vary)
+            if packed is not None and packed[ci]:
+                triples = coef.reshape(coef.shape[0], coef.shape[1], -1, 3)
+                b0 = triples[..., 0].astype(jnp.int32)
+                b1 = triples[..., 1].astype(jnp.int32)
+                b2 = triples[..., 2].astype(jnp.int32)
+                lo = b0 | ((b1 & 0xF) << 8)
+                hi = (b1 >> 4) | (b2 << 4)
+                pair = jnp.stack([lo, hi], axis=-1)
+                pair = pair - ((pair & 0x800) << 1)  # sign-extend 12-bit
+                coef = pair.reshape(coef.shape[0], coef.shape[1], -1)
             if ks is not None and ks[ci] < 64:
                 coef = jnp.pad(coef, ((0, 0), (0, 0), (0, 64 - ks[ci])))
                 coef = jnp.take(coef, unzig, axis=-1)
@@ -678,19 +695,42 @@ def _truncation_ks(group, layout=None):
     return tuple(ks)
 
 
+#: Per-(layout, component) components observed to exceed the 12-bit coefficient
+#: range: packing is disabled STICKY for them (one overflow means the content class
+#: can overflow again — flip-flopping would churn XLA recompiles). Guarded by the
+#: same lock as _STICKY_KS.
+_PACK12_DISABLED: set = set()
+
+
 def _decode_group(layout, group):
-    """One same-layout group → device decode, shipping the zigzag prefix when the
-    batch's kmax says most of the spectrum is zero. Components at full width pass
-    through unpacked (no host copy, no device permute)."""
+    """One same-layout group → device decode. Transfer narrowing, both exact and
+    composable: (a) ship only the zigzag prefix when the batch's kmax says the rest
+    of the spectrum is zero; (b) 12-bit-pack whatever is shipped (native range-checked
+    pack, fused integer unpack on device). Sharp photographic content defeats (a)
+    (kmax ≈ 63) but still gets 25% H2D savings from (b); smooth content composes
+    both (k=16 packed = 18% of the full-spectrum int16 bytes)."""
     coeffs, qtabs = stack_jpeg_coefficients(group)
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        return _batched_stage2(layout)(coeffs, qtabs)
     ks = _truncation_ks(group, layout)
     if ks is not None:
-        from petastorm_tpu.ops import native
-
-        if native.native_available():
-            coeffs = tuple(
-                native.jpeg_zigzag_truncate_native(c, k) if k < 64 else c
-                for c, k in zip(coeffs, ks)
-            )
-            return _batched_stage2(layout, ks)(coeffs, qtabs)
-    return _batched_stage2(layout)(coeffs, qtabs)
+        coeffs = tuple(
+            native.jpeg_zigzag_truncate_native(c, k) if k < 64 else c
+            for c, k in zip(coeffs, ks)
+        )
+    packed = []
+    shipped = []
+    for ci, c in enumerate(coeffs):
+        p = None
+        with _STICKY_KS_LOCK:
+            enabled = (layout, ci) not in _PACK12_DISABLED
+        if enabled:
+            p = native.jpeg_pack12_native(c)
+            if p is None:  # 12-bit range exceeded: sticky int16 for this component
+                with _STICKY_KS_LOCK:
+                    _PACK12_DISABLED.add((layout, ci))
+        packed.append(p is not None)
+        shipped.append(p if p is not None else c)
+    return _batched_stage2(layout, ks, tuple(packed))(tuple(shipped), qtabs)

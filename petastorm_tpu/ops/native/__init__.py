@@ -125,6 +125,10 @@ def _load():
                 ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_int16),
                 ctypes.c_int64, ctypes.c_int32]
             lib.ptpu_jpeg_zigzag_truncate.restype = None
+            lib.ptpu_jpeg_pack12.argtypes = [
+                ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int64]
+            lib.ptpu_jpeg_pack12.restype = ctypes.c_int32
             _LIB = lib
         except Exception as e:  # noqa: BLE001 — degrade to Python fallback
             _LIB_ERR = str(e)
@@ -258,6 +262,29 @@ def jpeg_zigzag_truncate_native(src, k):
         n * nb, int(k),
     )
     return dst
+
+
+def jpeg_pack12_native(src):
+    """(n, nblocks, k) int16 coefficients → (n, nblocks, k*3//2) uint8 12-bit pack
+    (two coefficients per 3 bytes), or None when any value exceeds the 12-bit range
+    (the caller ships int16 unpacked). ``k`` must be even. The device side unpacks
+    with fused integer ops (`ops.jpeg` stage 2) — H2D ships 75% of the bytes."""
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native jpeg decoder unavailable: %s" % _LIB_ERR)
+    src = np.ascontiguousarray(src, dtype=np.int16)
+    n, nb, k = src.shape
+    if k % 2:
+        raise ValueError("pack12 needs an even trailing dim, got %d" % k)
+    dst = np.empty((n, nb, k * 3 // 2), dtype=np.uint8)
+    rc = lib.ptpu_jpeg_pack12(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n * nb * k,
+    )
+    return dst if rc == 0 else None
 
 
 def jpeg_decode_coeffs_native(data):

@@ -972,4 +972,27 @@ void ptpu_jpeg_zigzag_truncate(const int16_t* src, int16_t* dst, int64_t nblocks
   }
 }
 
+// 12-bit coefficient pack: src (nvals,) int16 → dst (nvals * 3 / 2,) uint8, two
+// values per 3 bytes, little-endian nibble layout:
+//   dst[0] = v0 & 0xFF;  dst[1] = ((v0 >> 8) & 0xF) | ((v1 & 0xF) << 4);
+//   dst[2] = (v1 >> 4) & 0xFF
+// (values stored as 12-bit two's complement). Returns 0 on success, -1 when any
+// |value| exceeds the 12-bit range (caller ships int16 instead; dst contents are
+// then unspecified). nvals must be even — the caller packs whole (block, k) rows
+// with even k. Quantized DCT coefficients exceed ±2047 only at extreme qualities
+// (quant step 1–2 with saturated content), so the fallback is rare but mandatory.
+int32_t ptpu_jpeg_pack12(const int16_t* src, uint8_t* dst, int64_t nvals) {
+  for (int64_t i = 0; i < nvals; i += 2) {
+    int16_t a = src[i], b = src[i + 1];
+    if (a < -2048 || a > 2047 || b < -2048 || b > 2047) return -1;
+    uint16_t ua = (uint16_t)a & 0xFFF;
+    uint16_t ub = (uint16_t)b & 0xFFF;
+    uint8_t* d = dst + (i / 2) * 3;
+    d[0] = (uint8_t)(ua & 0xFF);
+    d[1] = (uint8_t)(((ua >> 8) & 0xF) | ((ub & 0xF) << 4));
+    d[2] = (uint8_t)((ub >> 4) & 0xFF);
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -569,3 +569,64 @@ def test_truncated_decode_with_progressive_streams():
     for i, blob in enumerate(blobs):
         ref = np.asarray(decode_jpeg_device_stage(entropy_decode_jpeg_fast(blob)))
         np.testing.assert_array_equal(out[i], ref)
+
+
+def test_pack12_roundtrip_and_overflow():
+    """12-bit coefficient pack: exact byte layout vs a numpy reference, range check
+    returns None on overflow, odd trailing dim rejected."""
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    rng = np.random.RandomState(77)
+    src = rng.randint(-2048, 2048, (3, 5, 16)).astype(np.int16)
+    packed = native.jpeg_pack12_native(src)
+    assert packed is not None and packed.shape == (3, 5, 24)
+    flat = src.reshape(-1)
+    out = packed.reshape(-1)
+    for i in range(0, len(flat), 2):
+        a, b = int(flat[i]) & 0xFFF, int(flat[i + 1]) & 0xFFF
+        j = (i // 2) * 3
+        assert out[j] == (a & 0xFF)
+        assert out[j + 1] == (((a >> 8) & 0xF) | ((b & 0xF) << 4))
+        assert out[j + 2] == ((b >> 4) & 0xFF)
+    # overflow anywhere -> None (caller ships int16)
+    src2 = src.copy()
+    src2[1, 2, 3] = 2048
+    assert native.jpeg_pack12_native(src2) is None
+    src2[1, 2, 3] = -2049
+    assert native.jpeg_pack12_native(src2) is None
+    with pytest.raises(ValueError, match="even"):
+        native.jpeg_pack12_native(src[:, :, :15])
+
+
+def test_pack12_overflow_sticky_fallback_still_exact():
+    """A component that overflows the 12-bit range falls back to int16 transfer —
+    output still bit-equal — and the fallback is sticky per (layout, component)."""
+    from petastorm_tpu.ops import jpeg as j
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    rng = np.random.RandomState(9)
+    blobs = []
+    for _ in range(4):
+        img = cv2.GaussianBlur(rng.randint(0, 256, (32, 48, 3)).astype(np.float32),
+                               (5, 5), 1.5).clip(0, 255).astype(np.uint8)
+        ok, enc = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 85])
+        blobs.append(enc.tobytes())
+    batch = j.entropy_decode_jpeg_batch(blobs)
+    ref = np.asarray(j.decode_jpeg_batch(batch))  # packed path (normal content)
+
+    layout = j._layout_key(batch[0])
+    orig_pack = native.jpeg_pack12_native
+    try:
+        native.jpeg_pack12_native = lambda src: None  # force 'overflow' everywhere
+        out = np.asarray(j.decode_jpeg_batch(batch))
+        np.testing.assert_array_equal(out, ref)  # int16 fallback bit-equal
+        with j._STICKY_KS_LOCK:
+            assert any(key[0] == layout for key in j._PACK12_DISABLED)
+    finally:
+        native.jpeg_pack12_native = orig_pack
+        with j._STICKY_KS_LOCK:
+            j._PACK12_DISABLED.clear()  # don't leak the forced state to other tests
