@@ -35,8 +35,12 @@ _METADATA_FILES = ("_common_metadata", "_metadata")
 #: One unit of scheduled work: a single row group of a single file.
 #: ``partition_values``: raw ``{key: str}`` parsed from hive ``key=value`` path segments
 #: (None for flat layouts) — typed/pruned by :mod:`petastorm_tpu.partitions`.
+#: ``stats``: ``{column: (min, max)}`` from the parquet row-group statistics when the
+#: footer was read (None on the KV fast path) — lets ``filters`` skip whole row groups
+#: before scheduling (reference: ``pq.ParquetDataset`` statistics filtering).
 RowGroupPiece = namedtuple("RowGroupPiece", ["path", "row_group", "num_rows",
-                                             "partition_values"], defaults=(None,))
+                                             "partition_values", "stats"],
+                           defaults=(None, None))
 
 
 # --------------------------------------------------------------------------------------
@@ -351,8 +355,36 @@ def load_row_groups(fs, path, validate=False):
             md = pq.ParquetFile(f).metadata
         pv = partition_values_for_path(full, path) or None
         for rg in range(md.num_row_groups):
-            pieces.append(RowGroupPiece(full, rg, md.row_group(rg).num_rows, pv))
+            rgmd = md.row_group(rg)
+            pieces.append(RowGroupPiece(full, rg, rgmd.num_rows, pv,
+                                        _rowgroup_stats(rgmd)))
     return pieces
+
+
+def _rowgroup_stats(rgmd):
+    """``{column: (min, max, null_count)}`` from a row group's parquet statistics, or
+    None. ``null_count`` is None when the footer does not record it.
+
+    Only simple (non-nested) columns with valid min/max are recorded — the plan-time
+    statistics pruning in ``reader._prune_by_stats`` treats absent columns as
+    unconstrained, so partial stats are safe. min/max EXCLUDE nulls (parquet
+    semantics), which is why the null count must ride along: ``!=``-style pruning is
+    only sound when the group provably has no nulls."""
+    stats = {}
+    for ci in range(rgmd.num_columns):
+        col = rgmd.column(ci)
+        st = col.statistics
+        if st is None or not st.has_min_max:
+            continue
+        name = col.path_in_schema
+        if "." in name:  # nested columns: path is not a plain field name
+            continue
+        try:
+            nulls = st.null_count if st.has_null_count else None
+            stats[name] = (st.min, st.max, nulls)
+        except Exception:  # noqa: BLE001 — exotic logical types: skip, stay safe
+            continue
+    return stats or None
 
 
 def _rows_for_bytes(table, target_bytes):

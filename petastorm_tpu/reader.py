@@ -505,15 +505,22 @@ def _dnf_mask(table, filters):
             return ~np.isin(col, list(val))
         raise ValueError("Unsupported filter op %r" % op)
 
-    clauses = [filters] if isinstance(filters[0][0], str) else filters
     total = None
-    for clause in clauses:
+    for clause in _dnf_clauses(filters):
         cmask = None
         for name, op, val in clause:
             t = term_mask(name, op, val)
             cmask = t if cmask is None else (cmask & t)
         total = cmask if total is None else (total | cmask)
     return np.asarray(total, dtype=bool)
+
+
+def _dnf_clauses(filters):
+    """Normalize pyarrow-style DNF filters to a list of AND-clauses: accepts both the
+    flat ``[(col, op, val), ...]`` form and the ``[[...], [...]]`` OR-of-ANDs form.
+    Shared by the row-level mask (``_dnf_mask``) and plan-time statistics pruning
+    (``_prune_by_stats``) so their clause semantics cannot drift."""
+    return [filters] if isinstance(filters[0][0], str) else filters
 
 
 def _stable_repr(value):
@@ -867,6 +874,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     pieces = load_row_groups(fs, path)
     pieces = _apply_rowgroup_selector(fs, path, pieces, rowgroup_selector)
     pieces, partition_info, filters = _resolve_partitions(pieces, filters)
+    pieces = _prune_by_stats(pieces, filters)
     if partition_info:
         stored_schema = _schema_with_partitions(stored_schema, partition_info)
 
@@ -942,6 +950,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     for p in paths:
         pieces.extend(load_row_groups(fs, p))
     pieces, partition_info, filters = _resolve_partitions(pieces, filters)
+    pieces = _prune_by_stats(pieces, filters)
     if partition_info:
         stored_schema = _schema_with_partitions(stored_schema, partition_info)
 
@@ -999,6 +1008,59 @@ def _resolve_partitions(pieces, filters):
         logger.info("Hive partition pruning: %d of %d row groups scheduled",
                     len(pruned), len(pieces))
     return pruned, info, filters
+
+
+def _prune_by_stats(pieces, filters):
+    """Row-group statistics pruning (reference: ``pq.ParquetDataset`` consults parquet
+    min/max before reading): drop pieces that NO or-clause of the DNF ``filters`` can
+    match given their footer statistics. Conservative-correct: absent stats, unknown
+    columns, and type mismatches all count as satisfiable — a piece is only dropped on
+    a provable contradiction, and the row-level mask still runs for survivors.
+    Parquet min/max exclude nulls, so ``!=``/``not in`` prune only groups with a
+    recorded null count of zero (null rows MATCH those operators in the row mask).
+
+    Stats are plan-time-only: the returned pieces carry ``stats=None`` so work items
+    shipped to pool workers don't re-pickle per-column bounds."""
+    if not pieces:
+        return pieces
+    if not filters:
+        return [p._replace(stats=None) if p.stats else p for p in pieces]
+
+    def term_unsat(stats, name, op, val):
+        if not stats or name not in stats:
+            return False
+        mn, mx, nulls = stats[name]
+        try:
+            if op in ("=", "=="):
+                return val < mn or val > mx
+            if op == "!=":
+                return nulls == 0 and bool(mn == mx == val)
+            if op == "<":
+                return mn >= val
+            if op == "<=":
+                return mn > val
+            if op == ">":
+                return mx <= val
+            if op == ">=":
+                return mx < val
+            if op == "in":
+                return all(v < mn or v > mx for v in val)
+            if op in ("not in", "not-in"):
+                return nulls == 0 and bool(mn == mx) and mn in set(val)
+        except TypeError:  # mixed types (e.g. str filter vs bytes stats): no pruning
+            return False
+        return False
+
+    kept = [
+        p._replace(stats=None) if p.stats else p
+        for p in pieces
+        if any(not any(term_unsat(p.stats, *term) for term in clause)
+               for clause in _dnf_clauses(filters))
+    ]
+    if len(kept) < len(pieces):
+        logger.info("Row-group statistics pruning: %d of %d row groups scheduled",
+                    len(kept), len(pieces))
+    return kept
 
 
 def _schema_with_partitions(schema, info):

@@ -1,0 +1,128 @@
+"""Row-group statistics pruning (reference pq.ParquetDataset filters consult parquet
+min/max before scheduling): provably-unmatchable row groups are never read; pruning is
+conservative (absent stats / unknown columns / type mismatches never prune) and
+composes with hive partition pruning and the row-level mask."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.reader import make_batch_reader
+
+
+@pytest.fixture(scope="module")
+def ordered_dataset(tmp_path_factory):
+    """id strictly ordered across 10 row groups of 10 (id range per group is tight)."""
+    root = tmp_path_factory.mktemp("ordered")
+    pq.write_table(pa.table({
+        "id": np.arange(100, dtype=np.int64),
+        "name": np.array(["n%03d" % i for i in range(100)], dtype=object),
+    }), str(root / "p.parquet"), row_group_size=10)
+    return "file://" + str(root)
+
+
+def _ids(reader):
+    return sorted(int(x) for b in reader for x in np.asarray(b.id))
+
+
+def test_stats_prune_range(ordered_dataset):
+    with make_batch_reader(ordered_dataset, filters=[("id", "<", 25)],
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 3  # groups [0,10), [10,20), [20,30) only
+        assert _ids(reader) == list(range(25))  # row mask finishes the job
+    with make_batch_reader(ordered_dataset, filters=[("id", ">=", 71)],
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 3
+        assert _ids(reader) == list(range(71, 100))
+
+
+def test_stats_prune_point_and_in(ordered_dataset):
+    with make_batch_reader(ordered_dataset, filters=[("id", "=", 42)],
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 1
+        assert _ids(reader) == [42]
+    with make_batch_reader(ordered_dataset, filters=[("id", "in", [5, 55, 95])],
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 3
+        assert _ids(reader) == [5, 55, 95]
+
+
+def test_stats_prune_or_clauses(ordered_dataset):
+    with make_batch_reader(
+            ordered_dataset,
+            filters=[[("id", "<", 10)], [("id", ">=", 90)]],
+            reader_pool_type="dummy") as reader:
+        assert reader._num_items == 2
+        assert _ids(reader) == list(range(10)) + list(range(90, 100))
+
+
+def test_stats_prune_string_columns(ordered_dataset):
+    """String statistics prune too (parquet bounds stay valid under truncation)."""
+    with make_batch_reader(ordered_dataset, filters=[("name", "=", "n015")],
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 1
+        got = [bytes(x) if isinstance(x, bytes) else x
+               for b in reader for x in b.name]
+    assert [str(x) for x in got] == ["n015"]
+
+
+def test_stats_prune_conservative_on_unknowns(ordered_dataset):
+    # unknown column term cannot prune anything
+    with make_batch_reader(ordered_dataset, filters=[("id", "<", 10),
+                                                     ("nope", "=", 1)],
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 1
+    # mixed-type comparison: conservative (no crash, no wrong pruning)
+    with make_batch_reader(ordered_dataset, filters=[("id", "=", "42")],
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 10  # str-vs-int never prunes at plan time
+
+
+def test_stats_prune_composes_with_hive(tmp_path):
+    rid = 0
+    for date in ("a", "b"):
+        d = tmp_path / ("date=%s" % date)
+        os.makedirs(d, exist_ok=True)
+        pq.write_table(pa.table({"id": np.arange(rid, rid + 40, dtype=np.int64)}),
+                       str(d / "f.parquet"), row_group_size=10)
+        rid += 40
+    with make_batch_reader("file://" + str(tmp_path),
+                           filters=[("date", "=", "b"), ("id", "<", 50)],
+                           reader_pool_type="dummy") as reader:
+        # hive pruning keeps date=b (4 groups); stats pruning keeps ids [40,50)
+        assert reader._num_items == 1
+        assert _ids(reader) == list(range(40, 50))
+
+
+def test_stats_prune_ne_keeps_null_rows(tmp_path):
+    """Review r3: parquet min/max exclude nulls — '!=' must not prune a group whose
+    non-null values all equal the filter value but which contains nulls (those null
+    rows MATCH '!=' in the row-level mask)."""
+    pq.write_table(pa.table({"x": pa.array([5, 5, 5, None, None], pa.int64()),
+                             "id": np.arange(5, dtype=np.int64)}),
+                   str(tmp_path / "p.parquet"))
+    with make_batch_reader("file://" + str(tmp_path), filters=[("x", "!=", 5)],
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 1  # NOT pruned
+        ids = sorted(int(x) for b in reader for x in np.asarray(b.id))
+    assert ids == [3, 4]  # exactly the null rows survive the row mask
+
+
+def test_stats_prune_ne_prunes_when_no_nulls(tmp_path):
+    pq.write_table(pa.table({"x": pa.array([5] * 4, pa.int64())}),
+                   str(tmp_path / "p.parquet"))
+    from petastorm_tpu.errors import NoDataAvailableError
+
+    with pytest.raises(NoDataAvailableError):
+        make_batch_reader("file://" + str(tmp_path), filters=[("x", "!=", 5)])
+
+
+def test_stats_stripped_from_scheduled_pieces(ordered_dataset):
+    """Stats are plan-time only: scheduled work items must not carry per-column
+    bounds to pool workers."""
+    with make_batch_reader(ordered_dataset, filters=[("id", "<", 25)],
+                           reader_pool_type="dummy") as reader:
+        items = reader._plan._items
+        assert all(piece.stats is None for piece, _part in items)
