@@ -55,6 +55,10 @@ class JpegPlanes:
     #: per component, the max ZIGZAG index with any nonzero coefficient (from the
     #: native batch decode) — lets the device transfer ship only the zigzag prefix.
     kmax: tuple | None = None
+    #: ``(ncomp, 64)`` int32 per-zigzag-position max |coefficient| over the row group
+    #: (shared array across the group's rows) — drives the per-position bit-width
+    #: transfer split. None when stage 1 did not profile the spectrum.
+    specmax: object = None
 
     def detach(self):
         """Return an equivalent ``JpegPlanes`` that owns its own coefficient copies.
@@ -62,7 +66,7 @@ class JpegPlanes:
         A ``batch_ref`` row pins its ENTIRE row group's stacked buffers alive (its
         blocks are views); long-lived rows — e.g. stragglers in a shuffling buffer that
         interleaves many row groups — must be detached so host memory scales with rows
-        in flight, not row groups touched."""
+        in flight, not row groups touched. ``specmax`` stays shared (tiny, immutable)."""
         if self.batch_ref is None:
             return self
         comps = [
@@ -70,13 +74,13 @@ class JpegPlanes:
             for c in self.components
         ]
         return JpegPlanes(self.height, self.width, comps, batch_ref=None,
-                          kmax=self.kmax)
+                          kmax=self.kmax, specmax=self.specmax)
 
     def __reduce__(self):
         # pickle (process-pool IPC, disk cache) must ship ONLY this row: the default
         # reduce would serialize batch_ref's entire row-group buffers per row
         d = self.detach()
-        return (JpegPlanes, (d.height, d.width, d.components, None, d.kmax))
+        return (JpegPlanes, (d.height, d.width, d.components, None, d.kmax, d.specmax))
 
 
 class _HuffTable:
@@ -446,6 +450,10 @@ def entropy_decode_jpeg_batch(blobs):
             "Unsupported JPEG component count %d (expected 1 or 3)" % len(comps_layout)
         )
     qtabs = qtabs.astype(np.int32)  # per-image contract dtype (one cast per row group)
+    # Spectral range profile, one native pass per component over the stacked buffers
+    # (memory-bound, GIL released; failed streams' slices are zeroed so they cannot
+    # inflate it). Shared across the group's rows — drives the split-pack transfer.
+    specmax = np.stack([native.jpeg_specmax_native(c) for c in coeffs])
     out = []
     for i in range(len(blobs)):
         if status[i] != 0:
@@ -456,7 +464,7 @@ def entropy_decode_jpeg_batch(blobs):
             for c, (h, v, by, bx) in enumerate(comps_layout)
         ]
         out.append(JpegPlanes(height, width, comps, batch_ref=(coeffs, qtabs, i),
-                              kmax=kmax))
+                              kmax=kmax, specmax=specmax))
     return out
 
 
@@ -502,7 +510,7 @@ def _idct_scaled(scaled):
 
 
 @functools.lru_cache(maxsize=32)
-def _batched_stage2(layout, ks=None, packed=None):
+def _batched_stage2(layout, ks=None, packed=None, split=None):
     """Layout-specialized jitted decoder: stacked coefficient arrays → (n, h, w, 3)
     uint8 RGB. One Pallas IDCT dispatch per component for the WHOLE batch (vs one jit
     per image — VERDICT r1 #1). The batch size is taken from the input shapes, so jit's
@@ -519,7 +527,16 @@ def _batched_stage2(layout, ks=None, packed=None):
     ``ptpu_jpeg_pack12`` layout) and are unpacked to int16 with fused integer ops
     before the pad/unpermute. Exact for |coeff| ≤ 2047 (the native packer verifies
     and falls back to int16 otherwise) — so output stays bit-identical at 75% of
-    even the truncated H2D bytes."""
+    even the truncated H2D bytes.
+
+    ``split`` (per component, None or ``(k1, k2)``) selects the spectral bit-width
+    split instead: the component arrives as a tuple of slabs — 12-bit pairs for
+    zigzag positions [0, k1), int8 for [k1, k2), 4-bit nibble pairs for [k2, k) —
+    chosen from the row group's measured per-position ranges (``specmax``). Unpack is
+    fused integer ops; order is always zigzag, so the pad/unpermute applies even at
+    k = 64. Bit-identical output; sharp photographic content that defeats zigzag
+    truncation still drops to ~half the 12-bit bytes (high positions are heavily
+    quantized). A split entry overrides ``packed`` for that component."""
     import jax
     import jax.numpy as jnp
 
@@ -528,27 +545,56 @@ def _batched_stage2(layout, ks=None, packed=None):
     vmax = max(v for _h, v, _by, _bx in comp_layout)
     unzig = jnp.asarray(UNZIGZAG)
 
+    def unpack12(u8):
+        # (n, blocks, m*3) uint8 → (n, blocks, 2m) int32, 12-bit two's complement
+        triples = u8.reshape(u8.shape[0], u8.shape[1], -1, 3)
+        b0 = triples[..., 0].astype(jnp.int32)
+        b1 = triples[..., 1].astype(jnp.int32)
+        b2 = triples[..., 2].astype(jnp.int32)
+        lo = b0 | ((b1 & 0xF) << 8)
+        hi = (b1 >> 4) | (b2 << 4)
+        pair = jnp.stack([lo, hi], axis=-1)
+        pair = pair - ((pair & 0x800) << 1)  # sign-extend 12-bit
+        return pair.reshape(u8.shape[0], u8.shape[1], -1)
+
+    def unpack4(u8):
+        # (n, blocks, m) uint8 → (n, blocks, 2m) int32, 4-bit two's complement
+        b = u8.astype(jnp.int32)
+        lo = b & 0xF
+        hi = (b >> 4) & 0xF
+        pair = jnp.stack([lo, hi], axis=-1)
+        pair = pair - ((pair & 0x8) << 1)  # sign-extend 4-bit
+        return pair.reshape(u8.shape[0], u8.shape[1], -1)
+
     def fn(coeffs, qtabs):
-        n = coeffs[0].shape[0]
+        n = (coeffs[0][0] if isinstance(coeffs[0], tuple) else coeffs[0]).shape[0]
         planes = []
         for ci, ((h_samp, v_samp, by, bx), coef, qtab) in enumerate(
                 zip(comp_layout, coeffs, qtabs)):
             # coef: (n, by*bx, 64) int16 natural order — or (n, by*bx, ks[ci])
             # zigzag prefix when this component was truncated, or the 12-bit uint8
-            # pack of either; qtab: (n, 64) int32 (per-image: quality may vary)
-            if packed is not None and packed[ci]:
-                triples = coef.reshape(coef.shape[0], coef.shape[1], -1, 3)
-                b0 = triples[..., 0].astype(jnp.int32)
-                b1 = triples[..., 1].astype(jnp.int32)
-                b2 = triples[..., 2].astype(jnp.int32)
-                lo = b0 | ((b1 & 0xF) << 8)
-                hi = (b1 >> 4) | (b2 << 4)
-                pair = jnp.stack([lo, hi], axis=-1)
-                pair = pair - ((pair & 0x800) << 1)  # sign-extend 12-bit
-                coef = pair.reshape(coef.shape[0], coef.shape[1], -1)
-            if ks is not None and ks[ci] < 64:
-                coef = jnp.pad(coef, ((0, 0), (0, 0), (0, 64 - ks[ci])))
+            # pack of either, or the split-pack slab tuple; qtab: (n, 64) int32
+            # (per-image: quality may vary)
+            k_ship = ks[ci] if ks is not None else 64
+            if split is not None and split[ci] is not None:
+                head, mid, tail = coef
+                parts = []
+                if head.shape[-1]:
+                    parts.append(unpack12(head))
+                if mid.shape[-1]:
+                    parts.append(mid.astype(jnp.int32))
+                if tail.shape[-1]:
+                    parts.append(unpack4(tail))
+                coef = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=-1)
+                if k_ship < 64:
+                    coef = jnp.pad(coef, ((0, 0), (0, 0), (0, 64 - k_ship)))
                 coef = jnp.take(coef, unzig, axis=-1)
+            else:
+                if packed is not None and packed[ci]:
+                    coef = unpack12(coef)
+                if ks is not None and ks[ci] < 64:
+                    coef = jnp.pad(coef, ((0, 0), (0, 0), (0, 64 - ks[ci])))
+                    coef = jnp.take(coef, unzig, axis=-1)
             scaled = coef.astype(jnp.float32) * qtab.astype(jnp.float32)[:, None, :]
             pix = _idct_scaled(scaled.reshape(n * by * bx, 64))
             pix = jnp.clip(jnp.round(pix), 0.0, 255.0)  # libjpeg range-limits at IDCT out
@@ -701,14 +747,81 @@ def _truncation_ks(group, layout=None):
 #: same lock as _STICKY_KS.
 _PACK12_DISABLED: set = set()
 
+#: Per-layout sticky split points: layout → list of per-component ``(k1, k2)``.
+#: Like _STICKY_KS, both only ever GROW (larger = wider tiers = always safe), so
+#: content variation across row groups costs a bounded number of XLA recompiles.
+#: Guarded by _STICKY_KS_LOCK.
+_STICKY_SPLIT: dict = {}
+
+#: Per-(layout, component) split-pack disablement: a range failure here means the
+#: specmax bound was violated (mixed provenance rows) — fall back to pack12 sticky.
+_SPLIT_DISABLED: set = set()
+
+
+def _batch_specmax(group):
+    """The group's combined ``(ncomp, 64)`` spectral range profile, or None when any
+    row lacks one. Rows of one row group share the profile ARRAY, so the common case
+    is a single identity check; mixed-parent groups take the elementwise max."""
+    vecs = []
+    seen = set()
+    for p in group:
+        sm = p.specmax
+        if sm is None:
+            return None
+        if id(sm) not in seen:
+            seen.add(id(sm))
+            vecs.append(sm)
+    return vecs[0] if len(vecs) == 1 else np.maximum.reduce(vecs)
+
+
+def _round_up4(x):
+    return (x + 3) & ~3
+
+
+def _split_points(profile, ks, layout):
+    """Per-component spectral split ``(k1, k2)`` (or None = plain pack12 is as good)
+    from the measured per-position ranges. Positions ≥ k1 fit int8, positions ≥ k2
+    fit 4 bits; both bucketed to multiples of 4 (pack alignment + bounded recompiles)
+    and sticky-grown per layout."""
+    ncomp = profile.shape[0]
+    out = []
+    for ci in range(ncomp):
+        k = ks[ci] if ks is not None else 64
+        mx = profile[ci]
+
+        def low_bound(lim):
+            j = k
+            while j > 0 and mx[j - 1] <= lim:
+                j -= 1
+            return j
+
+        k1 = min(_round_up4(low_bound(127)), k)
+        k2 = min(max(_round_up4(low_bound(7)), k1), k)
+        out.append((k1, k2))
+    with _STICKY_KS_LOCK:
+        prev = _STICKY_SPLIT.get(layout)
+        if prev is not None:
+            out = [(max(a1, b1), max(max(a2, b2), max(a1, b1)))
+                   for (a1, a2), (b1, b2) in zip(out, prev)]
+        _STICKY_SPLIT[layout] = out
+    spec = []
+    for ci, (k1, k2) in enumerate(out):
+        k = ks[ci] if ks is not None else 64
+        k1, k2 = min(k1, k), min(k2, k)
+        # k1 == k means every position needs 12 bits: identical bytes to pack12,
+        # without its natural-order no-permute fast path — use pack12 instead
+        spec.append(None if k1 >= k else (k1, k2))
+    return spec
+
 
 def _decode_group(layout, group):
-    """One same-layout group → device decode. Transfer narrowing, both exact and
+    """One same-layout group → device decode. Transfer narrowing, exact and
     composable: (a) ship only the zigzag prefix when the batch's kmax says the rest
-    of the spectrum is zero; (b) 12-bit-pack whatever is shipped (native range-checked
-    pack, fused integer unpack on device). Sharp photographic content defeats (a)
-    (kmax ≈ 63) but still gets 25% H2D savings from (b); smooth content composes
-    both (k=16 packed = 18% of the full-spectrum int16 bytes)."""
+    of the spectrum is zero; (b) split what ships into per-position bit widths from
+    the row group's measured spectral ranges (12-bit head / int8 mid / 4-bit tail);
+    (c) 12-bit-pack components the split can't help. Sharp photographic content
+    defeats (a) (kmax ≈ 63) but (b) still halves the 12-bit bytes — high zigzag
+    positions are heavily quantized; smooth content composes (a)+(b)."""
     coeffs, qtabs = stack_jpeg_coefficients(group)
     from petastorm_tpu.ops import native
 
@@ -720,9 +833,30 @@ def _decode_group(layout, group):
             native.jpeg_zigzag_truncate_native(c, k) if k < 64 else c
             for c, k in zip(coeffs, ks)
         )
+    profile = _batch_specmax(group)
+    split = [None] * len(coeffs)
+    if profile is not None:
+        candidate = _split_points(profile, ks, layout)
+        with _STICKY_KS_LOCK:
+            for ci, s in enumerate(candidate):
+                if s is not None and (layout, ci) not in _SPLIT_DISABLED:
+                    split[ci] = s
     packed = []
     shipped = []
     for ci, c in enumerate(coeffs):
+        if split[ci] is not None:
+            k1, k2 = split[ci]
+            is_zig = ks is not None and ks[ci] < 64
+            slabs = native.jpeg_pack_split_native(c, k1, k2, is_zigzag=is_zig)
+            if slabs is not None:
+                packed.append(False)
+                shipped.append(slabs)
+                continue
+            # Range exceeded despite the specmax bound: provenance-mixed rows.
+            # Disable sticky for this component and fall through to pack12.
+            split[ci] = None
+            with _STICKY_KS_LOCK:
+                _SPLIT_DISABLED.add((layout, ci))
         p = None
         with _STICKY_KS_LOCK:
             enabled = (layout, ci) not in _PACK12_DISABLED
@@ -733,4 +867,5 @@ def _decode_group(layout, group):
                     _PACK12_DISABLED.add((layout, ci))
         packed.append(p is not None)
         shipped.append(p if p is not None else c)
-    return _batched_stage2(layout, ks, tuple(packed))(tuple(shipped), qtabs)
+    return _batched_stage2(layout, ks, tuple(packed), tuple(split))(
+        tuple(shipped), qtabs)

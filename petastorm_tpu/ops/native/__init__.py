@@ -129,6 +129,16 @@ def _load():
                 ctypes.POINTER(ctypes.c_int16), ctypes.POINTER(ctypes.c_uint8),
                 ctypes.c_int64]
             lib.ptpu_jpeg_pack12.restype = ctypes.c_int32
+            lib.ptpu_jpeg_specmax.argtypes = [
+                ctypes.POINTER(ctypes.c_int16), ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+            lib.ptpu_jpeg_specmax.restype = None
+            lib.ptpu_jpeg_pack_split.argtypes = [
+                ctypes.POINTER(ctypes.c_int16), ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int8),
+                ctypes.POINTER(ctypes.c_uint8)]
+            lib.ptpu_jpeg_pack_split.restype = ctypes.c_int32
             _LIB = lib
         except Exception as e:  # noqa: BLE001 — degrade to Python fallback
             _LIB_ERR = str(e)
@@ -285,6 +295,64 @@ def jpeg_pack12_native(src):
         n * nb * k,
     )
     return dst if rc == 0 else None
+
+
+def jpeg_specmax_native(src, is_zigzag=False):
+    """(n, nblocks, k) int16 coefficients → (k,) int32 per-zigzag-position max |value|.
+
+    ``is_zigzag`` says rows are zigzag-prefix packs (:func:`jpeg_zigzag_truncate_native`
+    output); otherwise rows are natural order and k must be 64. The spectral range
+    profile drives the per-position bit-width split (:func:`jpeg_pack_split_native`)."""
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native jpeg decoder unavailable: %s" % _LIB_ERR)
+    src = np.ascontiguousarray(src, dtype=np.int16)
+    n, nb, k = src.shape
+    if not is_zigzag and k != 64:
+        raise ValueError("natural-order specmax needs trailing dim 64, got %d" % k)
+    out = np.zeros(k, dtype=np.int32)
+    lib.ptpu_jpeg_specmax(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+        n * nb, k, 1 if is_zigzag else 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out
+
+
+def jpeg_pack_split_native(src, k1, k2, is_zigzag=False):
+    """Spectral-split pack: (n, nblocks, k) int16 → three uint8/int8 slabs with
+    per-zigzag-position bit widths (12-bit head [0, k1), int8 mid [k1, k2), 4-bit
+    nibble tail [k2, k)), or None when any value exceeds its tier's range (the caller
+    falls back to a wider pack). k1 and k - k2 must be even; 0 ≤ k1 ≤ k2 ≤ k.
+    Zero-width slabs come back as empty arrays. Exact by construction — the device
+    unpack (`ops.jpeg` stage 2) reproduces src bit-identically."""
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native jpeg decoder unavailable: %s" % _LIB_ERR)
+    src = np.ascontiguousarray(src, dtype=np.int16)
+    n, nb, k = src.shape
+    k1, k2 = int(k1), int(k2)
+    if not 0 <= k1 <= k2 <= k:
+        raise ValueError("need 0 <= k1 <= k2 <= k, got k1=%d k2=%d k=%d" % (k1, k2, k))
+    if k1 % 2 or (k - k2) % 2:
+        raise ValueError("k1 and k - k2 must be even, got k1=%d k2=%d k=%d" % (k1, k2, k))
+    if not is_zigzag and k != 64:
+        raise ValueError("natural-order pack_split needs trailing dim 64, got %d" % k)
+    head = np.empty((n, nb, k1 * 3 // 2), dtype=np.uint8)
+    mid = np.empty((n, nb, k2 - k1), dtype=np.int8)
+    tail = np.empty((n, nb, (k - k2) // 2), dtype=np.uint8)
+    rc = lib.ptpu_jpeg_pack_split(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+        n * nb, k, 1 if is_zigzag else 0, k1, k2,
+        head.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        mid.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        tail.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return (head, mid, tail) if rc == 0 else None
 
 
 def jpeg_decode_coeffs_native(data):

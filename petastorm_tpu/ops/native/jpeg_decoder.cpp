@@ -995,4 +995,72 @@ int32_t ptpu_jpeg_pack12(const int16_t* src, uint8_t* dst, int64_t nvals) {
   return 0;
 }
 
+// Per-zigzag-position max |coefficient| over a stack of blocks: out[j] = max_b
+// |block_b[zigzag position j]| for j in [0, k). ``is_zigzag`` says whether block rows
+// are already zigzag-prefix packs of width k (ptpu_jpeg_zigzag_truncate output) or
+// natural-order 64-wide rows (k must then be 64). The spectral range profile drives
+// the per-position bit-width transfer split (ptpu_jpeg_pack_split): high zigzag
+// positions carry heavily-quantized values that fit 8 or 4 bits even on sharp
+// photographic content that defeats zigzag truncation outright.
+void ptpu_jpeg_specmax(const int16_t* src, int64_t nblocks, int32_t k,
+                       int32_t is_zigzag, int32_t* out) {
+  for (int32_t j = 0; j < k; j++) out[j] = 0;
+  for (int64_t b = 0; b < nblocks; b++) {
+    const int16_t* s = src + b * k;
+    for (int32_t j = 0; j < k; j++) {
+      int32_t v = is_zigzag ? s[j] : s[kZigzagToNatural[j]];
+      if (v < 0) v = -v;
+      if (v > out[j]) out[j] = v;
+    }
+  }
+}
+
+// Spectral-split coefficient pack, one pass: block row (zigzag order, width k) ->
+// three slabs with per-position bit widths chosen by the caller from the specmax
+// profile:
+//   head: zigzag positions [0, k1)  -> 12-bit pairs (ptpu_jpeg_pack12 layout), k1 even
+//   mid : positions [k1, k2)        -> int8
+//   tail: positions [k2, k)         -> 4-bit two's-complement nibble pairs
+//         (low nibble = even position), k - k2 even
+// ``is_zigzag`` as in ptpu_jpeg_specmax. Returns 0 on success; -1/-2/-3 when a value
+// exceeds its tier's range (head/mid/tail respectively — caller falls back to a wider
+// pack; dst contents are then unspecified). Exact by construction: the unpacked
+// values are bit-identical to src.
+int32_t ptpu_jpeg_pack_split(const int16_t* src, int64_t nblocks, int32_t k,
+                             int32_t is_zigzag, int32_t k1, int32_t k2,
+                             uint8_t* head, int8_t* mid, uint8_t* tail) {
+  const int64_t head_stride = (int64_t)(k1 / 2) * 3;
+  const int64_t mid_stride = k2 - k1;
+  const int64_t tail_stride = (k - k2) / 2;
+  for (int64_t b = 0; b < nblocks; b++) {
+    const int16_t* s = src + b * k;
+    uint8_t* hd = head + b * head_stride;
+    int8_t* md = mid + b * mid_stride;
+    uint8_t* tl = tail + b * tail_stride;
+    for (int32_t j = 0; j < k1; j += 2) {
+      int16_t a = is_zigzag ? s[j] : s[kZigzagToNatural[j]];
+      int16_t c = is_zigzag ? s[j + 1] : s[kZigzagToNatural[j + 1]];
+      if (a < -2048 || a > 2047 || c < -2048 || c > 2047) return -1;
+      uint16_t ua = (uint16_t)a & 0xFFF;
+      uint16_t uc = (uint16_t)c & 0xFFF;
+      uint8_t* d = hd + (j / 2) * 3;
+      d[0] = (uint8_t)(ua & 0xFF);
+      d[1] = (uint8_t)(((ua >> 8) & 0xF) | ((uc & 0xF) << 4));
+      d[2] = (uint8_t)((uc >> 4) & 0xFF);
+    }
+    for (int32_t j = k1; j < k2; j++) {
+      int16_t a = is_zigzag ? s[j] : s[kZigzagToNatural[j]];
+      if (a < -128 || a > 127) return -2;
+      md[j - k1] = (int8_t)a;
+    }
+    for (int32_t j = k2; j < k; j += 2) {
+      int16_t a = is_zigzag ? s[j] : s[kZigzagToNatural[j]];
+      int16_t c = is_zigzag ? s[j + 1] : s[kZigzagToNatural[j + 1]];
+      if (a < -8 || a > 7 || c < -8 || c > 7) return -3;
+      tl[(j - k2) / 2] = (uint8_t)(((uint8_t)a & 0xF) | (((uint8_t)c & 0xF) << 4));
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"

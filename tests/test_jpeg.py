@@ -600,9 +600,178 @@ def test_pack12_roundtrip_and_overflow():
         native.jpeg_pack12_native(src[:, :, :15])
 
 
-def test_pack12_overflow_sticky_fallback_still_exact():
-    """A component that overflows the 12-bit range falls back to int16 transfer —
-    output still bit-equal — and the fallback is sticky per (layout, component)."""
+def test_specmax_native_matches_numpy():
+    """Per-zigzag-position max |coeff|: natural-order and zigzag-prefix modes vs a
+    numpy reference."""
+    from petastorm_tpu.ops import native
+    from petastorm_tpu.ops.jpeg import ZIGZAG
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    rng = np.random.RandomState(31)
+    src = rng.randint(-900, 900, (4, 7, 64)).astype(np.int16)
+    got = native.jpeg_specmax_native(src)
+    flat = src.reshape(-1, 64)
+    exp = np.abs(flat[:, np.asarray(ZIGZAG)]).max(axis=0)
+    np.testing.assert_array_equal(got, exp)
+    # zigzag-prefix mode: rows already in zigzag order, any width
+    srcz = rng.randint(-50, 50, (3, 5, 16)).astype(np.int16)
+    gotz = native.jpeg_specmax_native(srcz, is_zigzag=True)
+    np.testing.assert_array_equal(gotz, np.abs(srcz.reshape(-1, 16)).max(axis=0))
+    with pytest.raises(ValueError, match="64"):
+        native.jpeg_specmax_native(srcz)  # natural mode requires width 64
+
+
+def test_pack_split_native_roundtrip():
+    """Spectral-split pack: slab layout vs a numpy reference unpack across edge
+    splits; per-tier range failures return None; validation errors raise."""
+    from petastorm_tpu.ops import native
+    from petastorm_tpu.ops.jpeg import ZIGZAG
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    rng = np.random.RandomState(32)
+
+    def unpack(head, mid, tail, k1, k2, k):
+        n, nb = mid.shape[:2]
+        out = np.empty((n, nb, k), dtype=np.int16)
+        h = head.reshape(n, nb, -1, 3).astype(np.int32)
+        lo = h[..., 0] | ((h[..., 1] & 0xF) << 8)
+        hi = (h[..., 1] >> 4) | (h[..., 2].astype(np.int32) << 4)
+        pair = np.stack([lo, hi], axis=-1)
+        pair = pair - ((pair & 0x800) << 1)
+        out[..., :k1] = pair.reshape(n, nb, -1)
+        out[..., k1:k2] = mid
+        t = tail.astype(np.int32)
+        tl, th = t & 0xF, (t >> 4) & 0xF
+        tp = np.stack([tl, th], axis=-1)
+        tp = tp - ((tp & 0x8) << 1)
+        out[..., k2:] = tp.reshape(n, nb, -1)
+        return out
+
+    # zigzag-order input with a realistic spectral profile
+    for (k1, k2, k) in [(8, 52, 64), (0, 12, 64), (4, 4, 16), (0, 0, 16),
+                        (16, 16, 16), (0, 64, 64), (64, 64, 64)]:
+        src = np.zeros((3, 6, k), dtype=np.int16)
+        if k1:
+            src[..., :k1] = rng.randint(-2048, 2048, (3, 6, k1))
+        if k2 > k1:
+            src[..., k1:k2] = rng.randint(-128, 128, (3, 6, k2 - k1))
+        if k > k2:
+            src[..., k2:] = rng.randint(-8, 8, (3, 6, k - k2))
+        res = native.jpeg_pack_split_native(src, k1, k2, is_zigzag=True)
+        assert res is not None, (k1, k2, k)
+        head, mid, tail = res
+        assert head.shape == (3, 6, k1 * 3 // 2)
+        assert mid.shape == (3, 6, k2 - k1)
+        assert tail.shape == (3, 6, (k - k2) // 2)
+        np.testing.assert_array_equal(unpack(head, mid, tail, k1, k2, k), src)
+
+    # natural-order input: position j read through the zigzag map
+    nat = np.zeros((2, 3, 64), dtype=np.int16)
+    nat[:] = rng.randint(-8, 8, (2, 3, 64))
+    zz = np.asarray(ZIGZAG)
+    nat_view = nat[..., zz]
+    res = native.jpeg_pack_split_native(nat, 0, 0)
+    assert res is not None
+    np.testing.assert_array_equal(unpack(*res, 0, 0, 64), nat_view)
+
+    # per-tier range failures
+    base = np.zeros((1, 2, 64), dtype=np.int16)
+    bad = base.copy(); bad[0, 0, 0] = 2048  # natural position 0 = zigzag 0 (head)
+    assert native.jpeg_pack_split_native(bad, 8, 52) is None
+    src = np.zeros((1, 2, 64), dtype=np.int16); src[..., 20] = 128
+    assert native.jpeg_pack_split_native(src, 0, 64, is_zigzag=True) is None
+    src = np.zeros((1, 2, 64), dtype=np.int16); src[..., 60] = 8
+    assert native.jpeg_pack_split_native(src, 0, 0, is_zigzag=True) is None
+
+    with pytest.raises(ValueError, match="even"):
+        native.jpeg_pack_split_native(np.zeros((1, 1, 16), np.int16), 3, 8,
+                                      is_zigzag=True)
+    with pytest.raises(ValueError, match="k1"):
+        native.jpeg_pack_split_native(np.zeros((1, 1, 16), np.int16), 12, 8,
+                                      is_zigzag=True)
+
+
+def test_split_pack_device_bitexact_and_sticky_growth():
+    """End-to-end spectral split: decode through _decode_group must be bit-equal to
+    the raw (no-narrowing) device path, and the per-layout sticky split points only
+    ever grow when later batches carry wider spectra."""
+    from petastorm_tpu.ops import jpeg as j
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    rng = np.random.RandomState(33)
+    # smooth batch first: narrow ranges -> small split points
+    smooth_blobs = []
+    for _ in range(4):
+        img = cv2.GaussianBlur(rng.randint(0, 256, (40, 56, 3)).astype(np.float32),
+                               (9, 9), 3.0).clip(0, 255).astype(np.uint8)
+        ok, enc = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 85])
+        smooth_blobs.append(enc.tobytes())
+    # sharp batch second: same layout, wider spectra
+    sharp_blobs = []
+    for _ in range(4):
+        img = rng.randint(0, 256, (40, 56, 3)).astype(np.uint8)
+        ok, enc = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 95])
+        sharp_blobs.append(enc.tobytes())
+
+    smooth = j.entropy_decode_jpeg_batch(smooth_blobs)
+    sharp = j.entropy_decode_jpeg_batch(sharp_blobs)
+    assert smooth[0].specmax is not None and smooth[0].specmax.shape[1] == 64
+    assert smooth[0].specmax is smooth[2].specmax  # shared across the row group
+    layout = j._layout_key(smooth[0])
+
+    def raw(group):
+        c, q = j.stack_jpeg_coefficients(group)
+        return np.asarray(j._batched_stage2(layout)(c, q))
+
+    out_smooth = np.asarray(j._decode_group(layout, smooth))
+    np.testing.assert_array_equal(out_smooth, raw(smooth))
+    with j._STICKY_KS_LOCK:
+        first = list(j._STICKY_SPLIT[layout])
+
+    out_sharp = np.asarray(j._decode_group(layout, sharp))
+    np.testing.assert_array_equal(out_sharp, raw(sharp))
+    with j._STICKY_KS_LOCK:
+        second = list(j._STICKY_SPLIT[layout])
+    for (a1, a2), (b1, b2) in zip(first, second):
+        assert b1 >= a1 and b2 >= a2  # sticky: only ever grows
+
+    # a mixed-provenance group (rows from both parents) combines profiles and
+    # still decodes bit-equal
+    mixed = [smooth[0], sharp[1], smooth[3], sharp[2]]
+    np.testing.assert_array_equal(np.asarray(j._decode_group(layout, mixed)),
+                                  raw(mixed))
+
+
+def test_specmax_survives_detach_and_pickle():
+    """detach() and pickling keep the spectral profile, so shuffling-buffer
+    stragglers and process-pool rows still ride the split pack."""
+    import pickle
+
+    from petastorm_tpu.ops import jpeg as j
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    rng = np.random.RandomState(34)
+    ok, enc = cv2.imencode(".jpg", rng.randint(0, 256, (24, 24, 3), dtype=np.uint8),
+                           [cv2.IMWRITE_JPEG_QUALITY, 85])
+    row = j.entropy_decode_jpeg_batch([enc.tobytes()])[0]
+    assert row.specmax is not None
+    det = row.detach()
+    assert det.batch_ref is None and det.specmax is row.specmax
+    back = pickle.loads(pickle.dumps(row))
+    assert back.batch_ref is None
+    np.testing.assert_array_equal(back.specmax, row.specmax)
+
+
+def test_pack_overflow_sticky_fallback_still_exact():
+    """A component that overflows its pack tier falls down the chain (spectral split
+    → 12-bit pack → int16 transfer) — output bit-equal at every tier — and each
+    disablement is sticky per (layout, component)."""
     from petastorm_tpu.ops import jpeg as j
     from petastorm_tpu.ops import native
 
@@ -616,17 +785,27 @@ def test_pack12_overflow_sticky_fallback_still_exact():
         ok, enc = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 85])
         blobs.append(enc.tobytes())
     batch = j.entropy_decode_jpeg_batch(blobs)
-    ref = np.asarray(j.decode_jpeg_batch(batch))  # packed path (normal content)
+    ref = np.asarray(j.decode_jpeg_batch(batch))  # full-tier path (normal content)
 
     layout = j._layout_key(batch[0])
+    orig_split = native.jpeg_pack_split_native
     orig_pack = native.jpeg_pack12_native
     try:
-        native.jpeg_pack12_native = lambda src: None  # force 'overflow' everywhere
+        # force split 'overflow': must fall back to pack12 bit-equal + sticky-disable
+        native.jpeg_pack_split_native = lambda src, k1, k2, is_zigzag=False: None
         out = np.asarray(j.decode_jpeg_batch(batch))
-        np.testing.assert_array_equal(out, ref)  # int16 fallback bit-equal
+        np.testing.assert_array_equal(out, ref)
+        with j._STICKY_KS_LOCK:
+            assert any(key[0] == layout for key in j._SPLIT_DISABLED)
+        # force pack12 'overflow' too: int16 fallback bit-equal + sticky-disable
+        native.jpeg_pack12_native = lambda src: None
+        out = np.asarray(j.decode_jpeg_batch(batch))
+        np.testing.assert_array_equal(out, ref)
         with j._STICKY_KS_LOCK:
             assert any(key[0] == layout for key in j._PACK12_DISABLED)
     finally:
+        native.jpeg_pack_split_native = orig_split
         native.jpeg_pack12_native = orig_pack
         with j._STICKY_KS_LOCK:
             j._PACK12_DISABLED.clear()  # don't leak the forced state to other tests
+            j._SPLIT_DISABLED.clear()
