@@ -834,13 +834,25 @@ def _decode_group(layout, group):
             for c, k in zip(coeffs, ks)
         )
     profile = _batch_specmax(group)
+    if profile is None:
+        # Some row lacks a stage-1 profile (per-image fallback decode merged into the
+        # group): recover the split savings with one memory-bound pass over the
+        # already-stacked (possibly truncated) batch instead of forfeiting them.
+        vecs = []
+        for ci, c in enumerate(coeffs):
+            if ks is not None and ks[ci] < 64:
+                v = native.jpeg_specmax_native(c, is_zigzag=True)
+                v = np.pad(v, (0, 64 - ks[ci]))
+            else:
+                v = native.jpeg_specmax_native(c)
+            vecs.append(v)
+        profile = np.stack(vecs)
     split = [None] * len(coeffs)
-    if profile is not None:
-        candidate = _split_points(profile, ks, layout)
-        with _STICKY_KS_LOCK:
-            for ci, s in enumerate(candidate):
-                if s is not None and (layout, ci) not in _SPLIT_DISABLED:
-                    split[ci] = s
+    candidate = _split_points(profile, ks, layout)
+    with _STICKY_KS_LOCK:
+        for ci, s in enumerate(candidate):
+            if s is not None and (layout, ci) not in _SPLIT_DISABLED:
+                split[ci] = s
     packed = []
     shipped = []
     for ci, c in enumerate(coeffs):

@@ -262,11 +262,7 @@ def test_batch_reader_predicate_vectorized(scalar_dataset):
 
 
 def test_batch_reader_transform_spec(scalar_dataset):
-    def add_col(pdf):
-        pdf["doubled"] = pdf["int_col"] * 2
-        return pdf
-
-    spec = TransformSpec(add_col, edit_fields=[("doubled", np.int32, (), False)])
+    spec = TransformSpec(_double_int_col, edit_fields=[("doubled", np.int32, (), False)])
     with make_batch_reader(scalar_dataset.url, transform_spec=spec,
                            reader_pool_type="dummy") as reader:
         batch = next(reader)
@@ -404,6 +400,86 @@ def test_weighted_sampling_respects_ratios(scalar_dataset, tmp_path):
                 break
     frac = draws_a / n
     assert 0.65 < frac < 0.92, frac  # ~0.8 within binomial noise at n=120
+
+
+def _add_tag_transform(row):
+    # module-level: the process pool pickles the TransformSpec into clean children
+    row = dict(row)
+    row["tag"] = row["id"] * 10
+    return row
+
+
+def _double_int_col(pdf):
+    pdf["doubled"] = pdf["int_col"] * 2
+    return pdf
+
+
+def test_composed_features_identical_across_pools(synthetic_dataset):
+    """Reference-backbone philosophy: the SAME composed configuration (projection +
+    predicate + transform + 2 epochs) must return identical rows on every pool.
+    The dummy pool is the ground truth; thread/process must match it exactly."""
+    spec = TransformSpec(_add_tag_transform, edit_fields=[("tag", np.int64, (), False)])
+
+    def run(pool):
+        with make_reader(synthetic_dataset.url, reader_pool_type=pool,
+                         workers_count=2, num_epochs=2, shuffle_row_groups=False,
+                         schema_fields=["id", "matrix"], transform_spec=spec,
+                         predicate=in_set(set(range(0, 30, 2)), "id")) as reader:
+            rows = [(int(r.id), int(r.tag), np.asarray(r.matrix).sum()) for r in reader]
+        return sorted(rows)
+
+    truth = run("dummy")
+    assert len(truth) == 2 * 15 and all(t == i * 10 for i, t, _ in truth)
+    for pool in ("thread", "process"):
+        assert run(pool) == truth, pool
+
+
+def test_batch_composed_features_identical_across_pools(scalar_dataset):
+    """Same cross-pool identity contract on the vectorized any-Parquet path, with
+    filters + transform + 2 epochs composed."""
+    spec = TransformSpec(_double_int_col, edit_fields=[("doubled", np.int32, (), False)])
+
+    def run(pool):
+        with make_batch_reader(scalar_dataset.url, reader_pool_type=pool,
+                               workers_count=2, num_epochs=2,
+                               shuffle_row_groups=False,
+                               filters=[("id", "<", 20)],
+                               transform_spec=spec) as reader:
+            rows = []
+            for b in reader:
+                for j in range(len(b.id)):
+                    rows.append((int(b.id[j]), int(b.doubled[j])))
+        return sorted(rows)
+
+    truth = run("dummy")
+    assert len(truth) == 2 * 20
+    for pool in ("thread", "process"):
+        assert run(pool) == truth, pool
+
+
+@pytest.mark.parametrize("pool", ["thread", "process"])
+def test_shuffle_row_drop_partitions_all_pools(synthetic_dataset, pool):
+    """Row-drop partitioning (reference reader.py ~L520) must cover the dataset
+    exactly once per epoch on eager pools too, not just the sync pool."""
+    with make_reader(synthetic_dataset.url, shuffle_row_drop_partitions=2,
+                     reader_pool_type=pool, workers_count=2, num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        ids = sorted(int(r.id) for r in reader)
+    assert ids == list(range(30))
+
+
+def test_local_disk_cache_threaded_identical(synthetic_dataset, tmp_path):
+    """Disk-cache fill and hit under a concurrent pool return the same rows as the
+    uncached read (cache keyed per piece; fills race-safe across workers)."""
+    kwargs = dict(cache_type="local-disk", cache_location=str(tmp_path),
+                  cache_size_limit=10**9, cache_row_size_estimate=1000,
+                  reader_pool_type="thread", workers_count=3,
+                  shuffle_row_groups=False, num_epochs=1)
+    with make_reader(synthetic_dataset.url, **kwargs) as reader:
+        fill = sorted(int(r.id) for r in reader)
+    with make_reader(synthetic_dataset.url, **kwargs) as reader:
+        hit = sorted(int(r.id) for r in reader)
+    assert fill == hit == list(range(30))
 
 
 def test_make_dataloader_forwards_loader_options(scalar_dataset):

@@ -745,6 +745,18 @@ def test_split_pack_device_bitexact_and_sticky_growth():
     np.testing.assert_array_equal(np.asarray(j._decode_group(layout, mixed)),
                                   raw(mixed))
 
+    # a group containing a PROFILE-LESS row (per-image fallback decode) must not
+    # forfeit the split: the batch-level specmax pass recovers it, bit-equal
+    loner = j.entropy_decode_jpeg_fast(smooth_blobs[1])
+    assert loner.specmax is None
+    with_loner = [smooth[0], loner, sharp[3]]
+    with j._STICKY_KS_LOCK:
+        j._STICKY_SPLIT.pop(layout, None)  # force a fresh split decision
+    out = np.asarray(j._decode_group(layout, with_loner))
+    np.testing.assert_array_equal(out, raw(with_loner))
+    with j._STICKY_KS_LOCK:
+        assert layout in j._STICKY_SPLIT  # split engaged despite the loner
+
 
 def test_specmax_survives_detach_and_pickle():
     """detach() and pickling keep the spectral profile, so shuffling-buffer
