@@ -1,5 +1,11 @@
 """Framework exceptions (reference: petastorm/errors.py, petastorm/workers_pool/__init__.py)."""
 
+#: OSError subclasses that are REAL answers, not connection trouble — IO-retry and
+#: HDFS-failover machinery must never retry these (a missing file or bad permissions
+#: will not heal; an InterruptedError that escapes PEP-475 auto-retry is deliberate).
+PERMANENT_IO_ERRORS = (FileNotFoundError, PermissionError, IsADirectoryError,
+                       NotADirectoryError, FileExistsError, InterruptedError)
+
 
 class PetastormTpuError(Exception):
     """Base class for framework errors."""

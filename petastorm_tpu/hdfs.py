@@ -23,9 +23,8 @@ import xml.etree.ElementTree as ET
 
 logger = logging.getLogger(__name__)
 
-#: OSError subclasses that are REAL answers, not connection trouble — never failover.
-_NON_RETRYABLE = (FileNotFoundError, PermissionError, IsADirectoryError,
-                  NotADirectoryError, FileExistsError, InterruptedError)
+from petastorm_tpu.errors import PERMANENT_IO_ERRORS as _NON_RETRYABLE  # noqa: E402
+# OSError subclasses that are REAL answers, not connection trouble — never failover.
 
 
 class MaxFailoversExceeded(RuntimeError):

@@ -21,13 +21,18 @@ workers. Partition columns materialize as ordinary row/batch values.
 from __future__ import annotations
 
 import logging
+import random
 import re
 import threading
+import time
 
 import numpy as np
 
 from petastorm_tpu.cache import make_cache
-from petastorm_tpu.errors import NoDataAvailableError
+from petastorm_tpu.errors import (
+    PERMANENT_IO_ERRORS as _PERMANENT_IO_ERRORS,
+    NoDataAvailableError,
+)
 from petastorm_tpu.fs import get_filesystem_and_path_or_paths
 from petastorm_tpu.metadata import (
     get_schema,
@@ -61,6 +66,38 @@ class _Tagged:
         return (epoch, ordinal, self._worker(item))
 
 
+#: Exception-module roots of the storage client stacks fsspec-bridged filesystems
+#: raise through pyarrow (gcsfs.retry.HttpError, botocore errors, aiohttp client
+#: errors, google.api_core exceptions, ...). Most of these do NOT derive from
+#: OSError, so classification is by origin: an error born in the storage layer is
+#: worth the bounded retries — a genuinely permanent one just fails a little later.
+_TRANSIENT_ERROR_MODULES = frozenset(
+    ("gcsfs", "s3fs", "adlfs", "fsspec", "aiohttp", "aiobotocore", "botocore",
+     "urllib3", "requests", "google", "azure"))
+
+
+def _is_transient_io_error(exc):
+    """Retry-worthy? OSErrors are (minus the permanent subclasses); anything raised
+    by a storage client stack is; everything else (corrupt parquet → ArrowInvalid,
+    user code errors) fails fast."""
+    if isinstance(exc, _PERMANENT_IO_ERRORS):
+        return False
+    if isinstance(exc, OSError):
+        return True
+    mod = (type(exc).__module__ or "").split(".")[0]
+    return mod in _TRANSIENT_ERROR_MODULES
+
+
+def _close_quietly(pf):
+    """Close a cached ParquetFile for real: it wraps an already-open NativeFile
+    (``_close_source=False``), so ``close()`` without ``force`` is a no-op and would
+    leave the fd/connection to GC."""
+    try:
+        pf.close(force=True)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 class _WorkerBase:
     """Shared row-group loading: column-pruned reads, predicate masking, drop partitions."""
 
@@ -69,7 +106,8 @@ class _WorkerBase:
 
     def __init__(self, filesystem, read_schema, stored_schema, predicate, transform_spec,
                  cache, shuffle_row_drop_partitions, filters, seed,
-                 device_fields=frozenset(), partition_info=None):
+                 device_fields=frozenset(), partition_info=None,
+                 io_retries=2, io_retry_backoff_s=0.1):
         self._fs = filesystem
         self._read_schema = read_schema  # fields to deliver (pre-transform view)
         self._stored_schema = stored_schema  # full stored schema (decode source of truth)
@@ -81,6 +119,8 @@ class _WorkerBase:
         self._seed = seed
         self._device_fields = frozenset(device_fields)  # host-stage-only decode columns
         self._partition_info = partition_info  # hive key=value layout (or None)
+        self._io_retries = io_retries  # extra attempts on transient IO errors
+        self._io_retry_backoff_s = io_retry_backoff_s
         self._local = None  # threading.local built lazily (not picklable)
 
     def __getstate__(self):
@@ -103,17 +143,45 @@ class _WorkerBase:
             pf = cache[path] = pq.ParquetFile(self._fs.open_input_file(path))
             while len(cache) > self.MAX_OPEN_FILES:  # LRU-evict to bound open fds
                 _, old = cache.popitem(last=False)
-                try:
-                    old.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                _close_quietly(old)
         else:
             cache.move_to_end(path)
         return pf
 
+    def _evict_parquet_file(self, path):
+        """Drop (and close) the cached handle for ``path`` — a transient IO failure may
+        leave it holding a dead connection; the retry must reopen from scratch."""
+        cache = getattr(self._local, "files", None) if self._local is not None else None
+        if cache is not None:
+            pf = cache.pop(path, None)
+            if pf is not None:
+                _close_quietly(pf)
+
     def _read_columns(self, piece, columns):
         """Read a row group restricted to ``columns`` (None = all). Hive partition
-        columns (directory values, not in the file) are appended as constants."""
+        columns (directory values, not in the file) are appended as constants.
+
+        Transient IO errors (connection resets, timeouts — routine against object
+        stores at pod scale) are retried up to ``io_retries`` times with jittered
+        exponential backoff, reopening the file each time. The reference has no retry
+        anywhere (SURVEY.md §6: a worker exception kills the read); permanent
+        conditions (missing file, bad permissions) still fail fast."""
+        attempt = 0
+        while True:
+            try:
+                return self._read_columns_once(piece, columns)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not _is_transient_io_error(e) or attempt >= self._io_retries:
+                    raise
+                self._evict_parquet_file(piece.path)
+                delay = self._io_retry_backoff_s * (2 ** attempt) * (0.5 + random.random())
+                logger.warning(
+                    "Transient IO error reading %s row group %d (%s); retry %d/%d in %.2fs",
+                    piece.path, piece.row_group, e, attempt + 1, self._io_retries, delay)
+                time.sleep(delay)
+                attempt += 1
+
+    def _read_columns_once(self, piece, columns):
         pf = self._parquet_file(piece.path)
         available = set(pf.schema_arrow.names)
         file_columns = columns
@@ -857,7 +925,8 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
                 cache_type="null", cache_location=None, cache_size_limit=None,
                 cache_row_size_estimate=None, cache_extra_settings=None,
                 transform_spec=None, filters=None, storage_options=None, filesystem=None,
-                results_timeout_s=300.0, decode_on_device=False, wire_serializer=None):
+                results_timeout_s=300.0, decode_on_device=False, wire_serializer=None,
+                io_retries=2, io_retry_backoff_s=0.1):
     """Open a petastorm(-tpu) dataset for per-row decoded reading (reference ~L60).
 
     ``schema_fields`` may be a list of names/regexes/UnischemaFields or an :class:`NGram`.
@@ -867,6 +936,11 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     coefficient staging payloads that :class:`petastorm_tpu.loader.DataLoader` finishes
     on device in one batched Pallas dispatch per batch. Consume such readers through the
     DataLoader (or call ``ops.decode_jpeg_batch`` yourself).
+
+    ``io_retries`` / ``io_retry_backoff_s``: transient row-group read failures
+    (connection resets, timeouts against object stores) are retried that many extra
+    times with jittered exponential backoff before propagating; ``io_retries=0``
+    restores the reference's fail-fast behavior (it has no retry — SURVEY.md §6).
     """
     fs, path = get_filesystem_and_path_or_paths(dataset_url, storage_options, filesystem)
     stored_schema = get_schema(fs, path)
@@ -902,6 +976,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         fs, read_schema, stored_schema, predicate, transform_spec, cache,
         shuffle_row_drop_partitions, filters, seed if seed is not None else shard_seed,
         device_fields=device_fields, partition_info=partition_info,
+        io_retries=io_retries, io_retry_backoff_s=io_retry_backoff_s,
         ngram=ngram, ngram_schema=final_schema if ngram is not None else None,
     )
     r = Reader(
@@ -927,11 +1002,14 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       cache_row_size_estimate=None, cache_extra_settings=None,
                       transform_spec=None, filters=None, storage_options=None,
                       filesystem=None, results_timeout_s=300.0, decode_on_device=False,
-                      wire_serializer=None):
+                      wire_serializer=None, io_retries=2, io_retry_backoff_s=0.1):
     """Open ANY Parquet store for vectorized columnar batches (reference ~L200).
 
     ``decode_on_device``: see :func:`make_reader` — device-decodable codec columns come
     back as staging payloads for the DataLoader's batched on-device decode.
+
+    ``io_retries`` / ``io_retry_backoff_s``: see :func:`make_reader` (transient
+    read-failure retry with backoff; 0 = reference fail-fast behavior).
 
     ``wire_serializer``: process-pool result wire format; defaults to ``"arrow"`` here
     (columnar batches ride Arrow IPC — reference ``ArrowTableSerializer`` parity) and
@@ -969,6 +1047,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         fs, read_schema, stored_schema, predicate, transform_spec, cache,
         shuffle_row_drop_partitions, filters, seed if seed is not None else shard_seed,
         device_fields=device_fields, partition_info=partition_info,
+        io_retries=io_retries, io_retry_backoff_s=io_retry_backoff_s,
     )
     r = Reader(
         fs, path, final_schema, stored_schema, worker, pieces,

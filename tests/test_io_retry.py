@@ -1,0 +1,160 @@
+"""Transient-IO retry in the row-group read path.
+
+The reference has NO retry anywhere (SURVEY.md §6 failure detection: a worker
+exception kills the read). Against object stores at pod scale, connection resets and
+timeouts are routine — the workers retry transient OSErrors with jittered backoff,
+reopening the file handle each time, while permanent conditions still fail fast.
+"""
+import numpy as np
+import pyarrow.fs as pafs
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+
+
+class FlakyFS:
+    """Duck-typed pyarrow-filesystem proxy whose ``open_input_file`` raises a
+    transient error the first ``fail_times`` times AFTER ``arm()`` is called
+    (metadata discovery during reader construction stays clean)."""
+
+    def __init__(self, inner, exc_factory, fail_times):
+        self._inner = inner
+        self._exc_factory = exc_factory
+        self._fail_budget = 0
+        self._fail_times = fail_times
+        self.open_calls = 0
+
+    def arm(self):
+        self._fail_budget = self._fail_times
+
+    def open_input_file(self, path):
+        self.open_calls += 1
+        if self._fail_budget > 0:
+            self._fail_budget -= 1
+            raise self._exc_factory()
+        return self._inner.open_input_file(path)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+@pytest.fixture()
+def flaky_store(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    d = tmp_path / "store"
+    d.mkdir()
+    pq.write_table(pa.table({"id": np.arange(20, dtype=np.int64)}),
+                   str(d / "part-0.parquet"), row_group_size=5)
+    return str(d)
+
+
+def test_transient_error_retried_to_success(flaky_store):
+    fs = FlakyFS(pafs.LocalFileSystem(), lambda: ConnectionResetError("peer reset"),
+                 fail_times=2)
+    reader = make_batch_reader("file://" + flaky_store, filesystem=fs,
+                               reader_pool_type="dummy", shuffle_row_groups=False,
+                               num_epochs=1, io_retries=3, io_retry_backoff_s=0.01)
+    fs.arm()
+    with reader:
+        ids = np.concatenate([np.asarray(b.id) for b in reader])
+    assert sorted(ids.tolist()) == list(range(20))
+    assert fs.open_calls >= 3  # two failures + reopen(s)
+
+
+def test_retries_exhausted_propagates(flaky_store):
+    fs = FlakyFS(pafs.LocalFileSystem(), lambda: TimeoutError("read timed out"),
+                 fail_times=10)
+    reader = make_batch_reader("file://" + flaky_store, filesystem=fs,
+                               reader_pool_type="dummy", shuffle_row_groups=False,
+                               num_epochs=1, io_retries=1, io_retry_backoff_s=0.01)
+    fs.arm()
+    with reader:
+        with pytest.raises(TimeoutError):
+            list(reader)
+
+
+def test_zero_retries_is_fail_fast(flaky_store):
+    fs = FlakyFS(pafs.LocalFileSystem(), lambda: ConnectionResetError("peer reset"),
+                 fail_times=1)
+    reader = make_batch_reader("file://" + flaky_store, filesystem=fs,
+                               reader_pool_type="dummy", shuffle_row_groups=False,
+                               num_epochs=1, io_retries=0)
+    fs.arm()
+    calls_before = fs.open_calls
+    with reader:
+        with pytest.raises(ConnectionResetError):
+            list(reader)
+    assert fs.open_calls == calls_before + 1  # exactly one attempt
+
+
+def test_permanent_error_not_retried(flaky_store):
+    fs = FlakyFS(pafs.LocalFileSystem(), lambda: FileNotFoundError("gone"),
+                 fail_times=10)
+    reader = make_batch_reader("file://" + flaky_store, filesystem=fs,
+                               reader_pool_type="dummy", shuffle_row_groups=False,
+                               num_epochs=1, io_retries=5, io_retry_backoff_s=0.01)
+    fs.arm()
+    calls_before = fs.open_calls
+    with reader:
+        with pytest.raises(FileNotFoundError):
+            list(reader)
+    assert fs.open_calls == calls_before + 1  # permanent: no second attempt
+
+
+def test_storage_stack_exception_retried(flaky_store):
+    """fsspec-bridged stores raise their client stack's own exception types through
+    pyarrow (gcsfs.retry.HttpError is NOT an OSError) — classification is by origin
+    module, so those heal too."""
+    http_error = type("HttpError", (Exception,), {"__module__": "gcsfs.retry"})
+    fs = FlakyFS(pafs.LocalFileSystem(), lambda: http_error("429 rate limited"),
+                 fail_times=2)
+    reader = make_batch_reader("file://" + flaky_store, filesystem=fs,
+                               reader_pool_type="dummy", shuffle_row_groups=False,
+                               num_epochs=1, io_retries=3, io_retry_backoff_s=0.01)
+    fs.arm()
+    with reader:
+        ids = np.concatenate([np.asarray(b.id) for b in reader])
+    assert sorted(ids.tolist()) == list(range(20))
+
+
+def test_non_storage_exception_not_retried(flaky_store):
+    """Errors that are neither OSError nor storage-stack-born (corrupt data, user
+    bugs) must fail fast, not burn retries."""
+    fs = FlakyFS(pafs.LocalFileSystem(), lambda: RuntimeError("not IO at all"),
+                 fail_times=10)
+    reader = make_batch_reader("file://" + flaky_store, filesystem=fs,
+                               reader_pool_type="dummy", shuffle_row_groups=False,
+                               num_epochs=1, io_retries=5, io_retry_backoff_s=0.01)
+    fs.arm()
+    calls_before = fs.open_calls
+    with reader:
+        with pytest.raises(RuntimeError):
+            list(reader)
+    assert fs.open_calls == calls_before + 1
+
+
+def test_retry_through_threaded_per_row_reader(flaky_store, tmp_path):
+    """The per-row path (make_reader) shares the same retry machinery; a flap under a
+    concurrent pool heals without losing rows."""
+    from petastorm_tpu import types as ptypes
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.metadata import write_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema("S", [
+        UnischemaField("id", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+    ])
+    url = "file://" + str(tmp_path / "ps")
+    write_dataset(url, schema, [{"id": i} for i in range(12)], rows_per_file=4)
+
+    fs = FlakyFS(pafs.LocalFileSystem(), lambda: ConnectionResetError("peer reset"),
+                 fail_times=2)
+    reader = make_reader(url, filesystem=fs, reader_pool_type="thread",
+                         workers_count=2, shuffle_row_groups=False, num_epochs=1,
+                         io_retries=3, io_retry_backoff_s=0.01)
+    fs.arm()
+    with reader:
+        ids = sorted(int(r.id) for r in reader)
+    assert ids == list(range(12))
