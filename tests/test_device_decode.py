@@ -442,3 +442,25 @@ def test_device_decode_checkpoint_resume(jpeg_dataset):
     assert sorted(head_ids + list(seen)) == list(range(24))
     for rid, img in seen.items():
         assert np.abs(img.astype(int) - expected[rid].astype(int)).mean() < 2.0
+
+
+def test_device_decode_composes_with_device_shuffle(jpeg_dataset):
+    """decode_on_device + device_shuffle_capacity in one loader: decoded image
+    batches ride the HBM exchange ring — every row still appears exactly once per
+    epoch, images stay correct, and order decorrelates from the plan order."""
+    expected = _host_decoded(jpeg_dataset)
+    reader = make_batch_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    seen = {}
+    order = []
+    with DataLoader(reader, batch_size=4, device_shuffle_capacity=12,
+                    seed=13) as loader:
+        for batch in loader:
+            imgs = np.asarray(batch["image_jpeg"])
+            for i, rid in enumerate(np.asarray(batch["id"])):
+                seen[int(rid)] = imgs[i]
+                order.append(int(rid))
+    assert sorted(order) == list(range(24))  # exactly once through the ring
+    assert order != sorted(order)  # and not plan order
+    for rid, img in seen.items():
+        assert np.abs(img.astype(int) - expected[rid].astype(int)).mean() < 2.0

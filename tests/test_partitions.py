@@ -329,3 +329,28 @@ def test_null_partition_directory(tmp_path):
                            reader_pool_type="dummy") as reader:
         ids = sorted(int(x) for b in reader for x in np.asarray(b.id))
     assert ids == [0, 1, 2, 3]
+
+
+def test_ngram_over_hive_partitioned_dataset(hive_petastorm_dataset):
+    """NGram windowing composes with hive layouts: windows form over rows whose
+    partition column exists only in the directory path, and the directory-born field
+    is selectable per timestep."""
+    from petastorm_tpu.ngram import NGram
+
+    ngram = NGram(fields={0: ["id", "value", "label"], 1: ["id", "label"]},
+                  delta_threshold=2, timestamp_field="id")
+    with make_reader(hive_petastorm_dataset["url"], schema_fields=ngram,
+                     shuffle_row_groups=False, reader_pool_type="dummy") as reader:
+        windows = list(reader)
+    assert windows, "no NGram windows formed over the partitioned store"
+    by_label = {}
+    for w in windows:
+        t0, t1 = w[0], w[1]
+        assert t1.id == t0.id + 1  # consecutive ids within a row group
+        assert t0.label == t1.label  # a window never crosses a partition dir
+        assert t0.value == t0.id + 0.25
+        by_label.setdefault(int(t0.label), 0)
+        by_label[int(t0.label)] += 1
+    # every partition contributes windows: 6 rows per label dir, 2 row groups of 3
+    # rows each -> 2 windows per group x 2 groups = 4 per label
+    assert by_label == {0: 4, 1: 4, 2: 4}
