@@ -206,7 +206,19 @@ def piece_matches_filters(typed_values, filters, keys):
                 continue
             value = typed_values.get(name)
             try:
-                matched = value is not None and _term_matches(value, op, val)
+                if value is None:
+                    # __HIVE_DEFAULT_PARTITION__ directory: null values MATCH the
+                    # negative operators (same convention as the row-level mask and
+                    # _prune_by_stats' nulls==0 guard), and an 'in' list may name
+                    # None explicitly; ordering/equality ops never match null.
+                    if op in ("!=", "not in", "not-in"):
+                        matched = True
+                    elif op == "in":
+                        matched = None in set(val)
+                    else:
+                        matched = False
+                else:
+                    matched = _term_matches(value, op, val)
             except TypeError:  # uncoercible filter value vs typed partition value
                 matched = False
             if not matched:

@@ -107,7 +107,61 @@ class in_reduce(PredicateBase):  # noqa: N801
     def do_include_vectorized(self, columns):
         masks = [np.asarray(p.do_include_vectorized(columns), dtype=bool)
                  for p in self._predicates]
+        # The builtin all/any users pass for the per-row path are ambiguous over
+        # arrays — translate them to their elementwise equivalents
+        if self._reduce_func in (all, np.all):
+            return np.logical_and.reduce(masks)
+        if self._reduce_func in (any, np.any):
+            return np.logical_or.reduce(masks)
         return np.asarray(self._reduce_func(masks), dtype=bool)
+
+
+def implied_dnf_filters(predicate):
+    """DNF filter clauses IMPLIED by ``predicate`` (predicate ⇒ clauses), or None.
+
+    Used for plan-time pruning only: the reader conjoins these with any user
+    ``filters`` so hive-partition and row-group-statistics pruning fire for the
+    translatable predicate families too — ``in_set`` (→ ``in``), ``in_negate(in_set)``
+    (→ ``not in``), and ``in_reduce`` over ``all``/``any``. The predicate itself still
+    runs as the row-level mask, so an over-broad translation can never change
+    results — untranslatable predicates (``in_lambda``, ``in_pseudorandom_split``,
+    ``in_intersection``) just return None (no extra pruning). The reference prunes
+    row groups for predicates only through prebuilt indexes (``rowgroup_selector``,
+    petastorm/selectors.py ~L30); this derives the pruning automatically.
+
+    Returns the OR-of-ANDs form ``[[(field, op, value-list), ...], ...]``.
+    """
+    if isinstance(predicate, in_set):
+        return [[(predicate._field, "in", sorted(predicate._values, key=repr))]]
+    if isinstance(predicate, in_negate):
+        inner = predicate._predicate
+        if isinstance(inner, in_set):
+            return [[(inner._field, "not in", sorted(inner._values, key=repr))]]
+        return None
+    if isinstance(predicate, in_reduce):
+        # Pruning is optional (the row mask carries correctness), so bail out rather
+        # than let nested reduces cross-product into an exponential clause set.
+        max_clauses = 64
+        children = [implied_dnf_filters(p) for p in predicate._predicates]
+        if predicate._reduce_func in (all, np.all, np.logical_and.reduce):
+            # AND: untranslatable children drop out (a conjunct subset is still
+            # implied); cross-product the survivors' or-clauses
+            out = [[]]
+            for c in children:
+                if c is None:
+                    continue
+                out = [acc + clause for acc in out for clause in c]
+                if len(out) > max_clauses:
+                    return None
+            return out if out != [[]] else None
+        if predicate._reduce_func in (any, np.any, np.logical_or.reduce):
+            # OR: every child must translate, else rows outside the union can match
+            if any(c is None for c in children):
+                return None
+            out = [clause for c in children for clause in c]
+            return out if len(out) <= max_clauses else None
+        return None
+    return None
 
 
 class in_lambda(PredicateBase):  # noqa: N801

@@ -583,6 +583,56 @@ def _dnf_mask(table, filters):
     return np.asarray(total, dtype=bool)
 
 
+def _plan_pieces(pieces, filters, predicate, shard_count=None):
+    """Plan-time pruning pipeline: hive partition resolution + directory pruning +
+    row-group statistics pruning, with predicate-implied clauses conjoined for the
+    pruning. Returns ``(pieces, partition_info, filters)`` where ``filters`` is the
+    normalized set the workers run as the row-level mask.
+
+    The implied clauses are PLAN-TIME-ONLY: the returned ``filters`` are the user's
+    (normalized), so workers don't re-evaluate — or embed in cache keys — value
+    lists the predicate itself already enforces as the row mask.
+
+    If the predicate-implied clauses alone prove the plan empty, a minimal piece set
+    (one per shard) is retained: a predicate that matches nothing must yield an
+    EMPTY read (reference semantics — predicates never fail construction, and the
+    retained row groups mask to zero rows), while an over-filtering user
+    ``filters`` still raises ``NoDataAvailableError``."""
+    out, partition_info, norm_user = _resolve_partitions(pieces, filters)
+    out = _prune_by_stats(out, norm_user)
+    implied = None
+    if predicate is not None:
+        from petastorm_tpu.predicates import implied_dnf_filters
+
+        implied = implied_dnf_filters(predicate)
+    if implied and out:
+        # Sequential pruning passes are equivalent to conjoining the clause sets
+        # (satisfiability is checked per term), so the implied clauses prune the
+        # already-user-pruned set directly — no DNF cross product needed.
+        logger.debug("Predicate-implied pruning clauses: %s", implied)
+        kept = out
+        if partition_info:
+            from petastorm_tpu.partitions import normalize_filters, prune_pieces
+
+            implied = normalize_filters(implied, partition_info)
+            kept = prune_pieces(kept, partition_info, implied)
+        kept = _prune_by_stats(kept, implied)
+        # Never hand a shard zero pieces: round-robin assignment over fewer pieces
+        # than shards would fail construction on the starved shards, where the same
+        # predicate without pruning yields an empty read there. Pad with unpruned
+        # survivors (they mask to zero rows) up to one piece per shard — a bounded
+        # waste (one re-read row group per starved shard per epoch) accepted over
+        # teaching Reader an empty-plan mode.
+        min_pieces = max(1, int(shard_count or 1))
+        if len(kept) < min_pieces:
+            have = {(p.path, p.row_group) for p in kept}
+            extra = [p for p in out if (p.path, p.row_group) not in have]
+            kept = kept + extra[:min_pieces - len(kept)]
+        out = kept
+    return ([p._replace(stats=None) if p.stats else p for p in out],
+            partition_info, norm_user)
+
+
 def _dnf_clauses(filters):
     """Normalize pyarrow-style DNF filters to a list of AND-clauses: accepts both the
     flat ``[(col, op, val), ...]`` form and the ``[[...], [...]]`` OR-of-ANDs form.
@@ -947,8 +997,8 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
 
     pieces = load_row_groups(fs, path)
     pieces = _apply_rowgroup_selector(fs, path, pieces, rowgroup_selector)
-    pieces, partition_info, filters = _resolve_partitions(pieces, filters)
-    pieces = _prune_by_stats(pieces, filters)
+    pieces, partition_info, filters = _plan_pieces(pieces, filters, predicate,
+                                                   shard_count)
     if partition_info:
         stored_schema = _schema_with_partitions(stored_schema, partition_info)
 
@@ -1027,8 +1077,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     pieces = []
     for p in paths:
         pieces.extend(load_row_groups(fs, p))
-    pieces, partition_info, filters = _resolve_partitions(pieces, filters)
-    pieces = _prune_by_stats(pieces, filters)
+    pieces, partition_info, filters = _plan_pieces(pieces, filters, predicate,
+                                                   shard_count)
     if partition_info:
         stored_schema = _schema_with_partitions(stored_schema, partition_info)
 
@@ -1098,12 +1148,13 @@ def _prune_by_stats(pieces, filters):
     Parquet min/max exclude nulls, so ``!=``/``not in`` prune only groups with a
     recorded null count of zero (null rows MATCH those operators in the row mask).
 
-    Stats are plan-time-only: the returned pieces carry ``stats=None`` so work items
-    shipped to pool workers don't re-pickle per-column bounds."""
+    Stats survive on the returned pieces so pruning passes chain; the planner
+    (``_plan_pieces``) strips them at the end — work items shipped to pool workers
+    must not re-pickle per-column bounds."""
     if not pieces:
         return pieces
     if not filters:
-        return [p._replace(stats=None) if p.stats else p for p in pieces]
+        return pieces
 
     def term_unsat(stats, name, op, val):
         if not stats or name not in stats:
@@ -1125,13 +1176,24 @@ def _prune_by_stats(pieces, filters):
             if op == "in":
                 return all(v < mn or v > mx for v in val)
             if op in ("not in", "not-in"):
-                return nulls == 0 and bool(mn == mx) and mn in set(val)
+                if nulls != 0:
+                    return False
+                excluded = set(val)
+                if bool(mn == mx):
+                    return mn in excluded
+                if isinstance(mn, (int, np.integer)) and isinstance(mx, (int, np.integer)):
+                    # integer stats: unsatisfiable iff the excluded set covers every
+                    # possible value in [mn, mx] (span bounded by len(excluded))
+                    span = int(mx) - int(mn) + 1
+                    return span <= len(excluded) and \
+                        all((int(mn) + i) in excluded for i in range(span))
+                return False
         except TypeError:  # mixed types (e.g. str filter vs bytes stats): no pruning
             return False
         return False
 
     kept = [
-        p._replace(stats=None) if p.stats else p
+        p
         for p in pieces
         if any(not any(term_unsat(p.stats, *term) for term in clause)
                for clause in _dnf_clauses(filters))

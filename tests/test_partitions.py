@@ -329,6 +329,22 @@ def test_null_partition_directory(tmp_path):
                            reader_pool_type="dummy") as reader:
         ids = sorted(int(x) for b in reader for x in np.asarray(b.id))
     assert ids == [0, 1, 2, 3]
+    # ...but null partitions DO match the negative operators (row-mask convention:
+    # None != 'a' is True), so '!='/'not in' must NOT prune the null directory
+    for flt in ([("k", "!=", "a")], [("k", "not in", ["a"])]):
+        with make_batch_reader("file://" + str(tmp_path), filters=flt,
+                               reader_pool_type="dummy") as reader:
+            ids = sorted(int(x) for b in reader for x in np.asarray(b.id))
+        assert ids == [4, 5, 6, 7], flt
+    # same through a predicate (implied clauses are plan-time-only and must not
+    # drop rows the predicate matches)
+    from petastorm_tpu.predicates import in_negate, in_set
+
+    with make_batch_reader("file://" + str(tmp_path),
+                           predicate=in_negate(in_set({"a"}, "k")),
+                           reader_pool_type="dummy") as reader:
+        ids = sorted(int(x) for b in reader for x in np.asarray(b.id))
+    assert ids == [4, 5, 6, 7]
 
 
 def test_ngram_over_hive_partitioned_dataset(hive_petastorm_dataset):
@@ -354,3 +370,18 @@ def test_ngram_over_hive_partitioned_dataset(hive_petastorm_dataset):
     # every partition contributes windows: 6 rows per label dir, 2 row groups of 3
     # rows each -> 2 windows per group x 2 groups = 4 per label
     assert by_label == {0: 4, 1: 4, 2: 4}
+
+
+def test_predicate_on_partition_column_prunes_directories(hive_dataset):
+    """in_set over a hive partition column implies directory pruning: non-matching
+    date dirs are never scheduled (no index, no user filters needed)."""
+    from petastorm_tpu.predicates import in_set
+
+    with make_batch_reader(hive_dataset["url"],
+                           predicate=in_set({"2020-01-02"}, "date"),
+                           shuffle_row_groups=False,
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 4  # 2 files x 2 row groups for that date only
+        ids = np.concatenate([np.asarray(b.id) for b in reader])
+    expected = sorted(r["id"] for r in hive_dataset["rows"] if r["date"] == "2020-01-02")
+    assert sorted(ids.tolist()) == expected

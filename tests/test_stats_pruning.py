@@ -126,3 +126,121 @@ def test_stats_stripped_from_scheduled_pieces(ordered_dataset):
                            reader_pool_type="dummy") as reader:
         items = reader._plan._items
         assert all(piece.stats is None for piece, _part in items)
+
+
+# ------------------------------------------------------- predicate-implied pruning
+
+
+def test_predicate_in_set_prunes_row_groups(ordered_dataset):
+    """in_set predicates imply 'in' filter clauses: plan-time statistics pruning
+    fires without a prebuilt index (reference needs rowgroup_selector for this)."""
+    from petastorm_tpu.predicates import in_set
+
+    with make_batch_reader(ordered_dataset, predicate=in_set({5, 55, 95}, "id"),
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 3  # 10 groups without the implied pruning
+        assert _ids(reader) == [5, 55, 95]
+
+
+def test_predicate_negate_and_reduce_prune(ordered_dataset):
+    from petastorm_tpu.predicates import in_negate, in_reduce, in_set
+
+    # not-in over a fully-covered group: group [40,50) has ONLY excluded ids -> can
+    # be pruned when its null count is recorded as 0
+    pred = in_negate(in_set(set(range(40, 50)), "id"))
+    with make_batch_reader(ordered_dataset, predicate=pred,
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 9
+        assert _ids(reader) == [i for i in range(100) if not 40 <= i < 50]
+
+    # AND of two in_sets: intersection of implied clauses
+    pred = in_reduce([in_set(set(range(0, 30)), "id"),
+                      in_set(set(range(20, 100, 7)), "id")], all)
+    with make_batch_reader(ordered_dataset, predicate=pred,
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 1  # only [20,30) can satisfy both
+        assert _ids(reader) == [20, 27]
+
+    # OR of two in_sets: union of clauses
+    pred = in_reduce([in_set({3}, "id"), in_set({93}, "id")], any)
+    with make_batch_reader(ordered_dataset, predicate=pred,
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 2
+        assert _ids(reader) == [3, 93]
+
+
+def test_predicate_matching_nothing_yields_empty_read(ordered_dataset):
+    """Predicate semantics: matching nothing is an EMPTY read, never a construction
+    error (only over-filtering user `filters` raise NoDataAvailableError) — and the
+    provably-empty plan retains only a minimal piece set, not a full scan."""
+    from petastorm_tpu.predicates import in_set
+
+    with make_batch_reader(ordered_dataset, predicate=in_set({100000}, "id"),
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 1  # one retained group masks to zero rows
+        assert _ids(reader) == []
+    # sharded: every shard still constructs and yields empty
+    for shard in range(2):
+        with make_batch_reader(ordered_dataset, predicate=in_set({100000}, "id"),
+                               cur_shard=shard, shard_count=2, shard_seed=1,
+                               reader_pool_type="dummy") as reader:
+            assert _ids(reader) == []
+
+
+def test_predicate_pruning_never_starves_a_shard(ordered_dataset):
+    """Implied pruning that keeps fewer pieces than shard_count must pad with
+    unpruned survivors: every shard constructs, the union is exactly the matches."""
+    from petastorm_tpu.predicates import in_set
+
+    got = []
+    for shard in range(4):
+        with make_batch_reader(ordered_dataset, predicate=in_set({5}, "id"),
+                               cur_shard=shard, shard_count=4, shard_seed=2,
+                               shuffle_row_groups=False,
+                               reader_pool_type="dummy") as reader:
+            assert reader._num_items == 1  # padded to one piece per shard, not 10
+            got.extend(_ids(reader))
+    assert got == [5]
+
+
+def test_untranslatable_predicate_unchanged(ordered_dataset):
+    from petastorm_tpu.predicates import in_lambda
+
+    pred = in_lambda(["id"], lambda row: row["id"] % 50 == 0,
+                     lambda cols: cols["id"] % 50 == 0)
+    with make_batch_reader(ordered_dataset, predicate=pred,
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 10  # no pruning derived
+        assert _ids(reader) == [0, 50]
+
+
+def test_predicate_pruning_composes_with_user_filters(ordered_dataset):
+    from petastorm_tpu.predicates import in_set
+
+    with make_batch_reader(ordered_dataset, predicate=in_set({5, 55, 95}, "id"),
+                           filters=[("id", "<", 60)],
+                           reader_pool_type="dummy") as reader:
+        assert reader._num_items == 2  # {5, 55} groups; 95's group cut by filters
+        assert _ids(reader) == [5, 55]
+
+
+def test_implied_dnf_filters_unit():
+    from petastorm_tpu.predicates import (implied_dnf_filters, in_lambda, in_negate,
+                                          in_pseudorandom_split, in_reduce, in_set)
+
+    assert implied_dnf_filters(in_set({2, 1}, "f")) == [[("f", "in", [1, 2])]]
+    assert implied_dnf_filters(in_negate(in_set({1}, "f"))) == [[("f", "not in", [1])]]
+    assert implied_dnf_filters(in_negate(in_lambda(["f"], lambda r: True))) is None
+    assert implied_dnf_filters(in_lambda(["f"], lambda r: True)) is None
+    assert implied_dnf_filters(in_pseudorandom_split([0.5, 0.5], 0, "f")) is None
+    # AND: untranslatable children drop out; all untranslatable -> None
+    got = implied_dnf_filters(in_reduce(
+        [in_set({1}, "a"), in_lambda(["b"], lambda r: True)], all))
+    assert got == [[("a", "in", [1])]]
+    assert implied_dnf_filters(in_reduce(
+        [in_lambda(["b"], lambda r: True)], all)) is None
+    # OR: any untranslatable child kills the translation
+    assert implied_dnf_filters(in_reduce(
+        [in_set({1}, "a"), in_lambda(["b"], lambda r: True)], any)) is None
+    got = implied_dnf_filters(in_reduce([in_set({1}, "a"), in_set({2}, "b")], any))
+    assert got == [[("a", "in", [1])], [("b", "in", [2])]]
