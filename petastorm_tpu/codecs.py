@@ -44,9 +44,11 @@ class DataframeColumnCodec:
             "%s does not support on-device decode" % type(self).__name__
         )
 
-    def device_decode_batch(self, unischema_field, staged):
+    def device_decode_batch(self, unischema_field, staged, resize_to=None):
         """On-device decode path, device half: list of staging objects (one per row) →
-        one batched device array matching :meth:`decode`'s per-row output contract."""
+        one batched device array matching :meth:`decode`'s per-row output contract.
+        ``resize_to=(h, w)`` (image codecs) asks for an on-device resize to one
+        static shape so mixed-size stores can batch."""
         raise NotImplementedError(
             "%s does not support on-device decode" % type(self).__name__
         )
@@ -311,13 +313,17 @@ class CompressedImageCodec(DataframeColumnCodec):
                 else self.host_stage_decode(unischema_field, blobs[j])
         return out
 
-    def device_decode_batch(self, unischema_field, staged):
+    def device_decode_batch(self, unischema_field, staged, resize_to=None):
         """Coefficient planes (one per row) → (n, ...) uint8 device array, one batched
         Pallas dispatch. Matches :meth:`decode`'s per-row contract: cv2 returns images
         in stored (BGR for color) channel order and 2-D for grayscale fields, so the
         RGB device output is flipped / channel-stripped accordingly. Rows that fell
         back to host decode in :meth:`host_stage_decode` arrive as ndarrays and are
-        merged in at their original positions."""
+        merged in at their original positions.
+
+        ``resize_to=(h, w)`` enables mixed-size stores: device rows resize on device
+        after decode (:func:`petastorm_tpu.ops.jpeg.resize_image_batch`), host
+        fallbacks via ``cv2.resize`` INTER_LINEAR — the matching sampling."""
         if not self.device_decodable:
             raise NotImplementedError("on-device decode is only available for jpeg")
         import jax.numpy as jnp
@@ -333,13 +339,24 @@ class CompressedImageCodec(DataframeColumnCodec):
         parts = []
         order = []
         if plane_idx:
-            img = decode_jpeg_batch([staged[i] for i in plane_idx])
+            img = decode_jpeg_batch([staged[i] for i in plane_idx],
+                                    resize_to=resize_to)
             img = img[..., 0] if grayscale else img[..., ::-1]
             parts.append(img)
             order.extend(plane_idx)
         if host_idx:
             # host-decoded fallbacks are already in stored order; no flip
-            parts.append(jnp.asarray(np.stack([staged[i] for i in host_idx])))
+            fallbacks = [staged[i] for i in host_idx]
+            if resize_to is not None:
+                import cv2
+
+                h, w = int(resize_to[0]), int(resize_to[1])
+                fallbacks = [
+                    f if f.shape[0] == h and f.shape[1] == w
+                    else cv2.resize(f, (w, h), interpolation=cv2.INTER_LINEAR)
+                    for f in fallbacks
+                ]
+            parts.append(jnp.asarray(np.stack(fallbacks)))
             order.extend(host_idx)
         if len(parts) == 1:
             out = parts[0]

@@ -88,6 +88,36 @@ def _is_device_dtype(arr):
     return isinstance(arr, np.ndarray) and arr.dtype.kind in "biufc" and arr.dtype.hasobject is False
 
 
+def _validate_decode_resize(resize, device_fields):
+    """Normalize/validate ``device_decode_resize`` at construction: a misspelled dict
+    key or a malformed target must fail HERE, not silently no-op and resurface later
+    as a mixed-size error telling the user to pass the option they already passed."""
+    if resize is None:
+        return None
+
+    def check_target(t, label):
+        try:
+            h, w = int(t[0]), int(t[1])
+        except (TypeError, ValueError, IndexError):
+            raise ValueError(
+                "device_decode_resize%s must be an (h, w) pair, got %r" % (label, t))
+        if h <= 0 or w <= 0 or len(tuple(t)) != 2:
+            raise ValueError(
+                "device_decode_resize%s must be two positive ints, got %r" % (label, t))
+        return (h, w)
+
+    if isinstance(resize, dict):
+        known = set(device_fields or ())
+        unknown = set(resize) - known
+        if unknown:
+            raise ValueError(
+                "device_decode_resize names %s, but the reader's device-decoded "
+                "fields are %s (is decode_on_device=True set, and are the names "
+                "spelled right?)" % (sorted(unknown), sorted(known)))
+        return {k: check_target(v, "[%r]" % k) for k, v in resize.items()}
+    return check_target(resize, "")
+
+
 class _HostBatcher:
     """Accumulates columnar chunks and cuts exact fixed-size batches (static shapes)."""
 
@@ -260,7 +290,7 @@ class DataLoader:
     def __init__(self, reader, batch_size, sharding=None, shuffling_queue_capacity=0,
                  seed=None, last_batch="drop", device_transform=None, prefetch=2,
                  to_device=True, host_queue_size=8, pad_shapes=None,
-                 device_shuffle_capacity=0):
+                 device_shuffle_capacity=0, device_decode_resize=None):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if last_batch not in ("drop", "pad", "partial"):
@@ -281,6 +311,11 @@ class DataLoader:
         self._shuffling_queue_capacity = shuffling_queue_capacity
         self._host_queue_size = host_queue_size
         self._pad_shapes = dict(pad_shapes) if pad_shapes else {}
+        #: (h, w) — or {field: (h, w)} — on-device resize target for device-decoded
+        #: image columns; lets mixed-size stores (raw ImageNet-style) batch with one
+        #: static shape (petastorm_tpu.ops.jpeg.resize_image_batch)
+        self._device_decode_resize = _validate_decode_resize(
+            device_decode_resize, getattr(reader, "device_decode_fields", None))
         self._device_shuffle_capacity = int(device_shuffle_capacity or 0)
         self._device_transform = device_transform
         if device_transform is None:
@@ -463,7 +498,14 @@ class DataLoader:
                     "Field %r has null rows; nullable columns are not supported with "
                     "decode_on_device (pad or filter nulls upstream)" % name
                 )
-            out = field.codec.device_decode_batch(field, staged)
+            rt = self._device_decode_resize
+            if isinstance(rt, dict):
+                rt = rt.get(name)
+            if rt is not None:
+                out = field.codec.device_decode_batch(field, staged,
+                                                      resize_to=tuple(rt))
+            else:
+                out = field.codec.device_decode_batch(field, staged)
             if self.sharding is not None:
                 s = self.sharding.get(name) if isinstance(self.sharding, dict) \
                     else _matching_sharding(self.sharding, out)
@@ -890,7 +932,8 @@ class InMemDataLoader:
     """
 
     def __init__(self, reader, batch_size, num_epochs=1, shuffle=True, seed=0,
-                 sharding=None, last_batch="drop", device_transform=None):
+                 sharding=None, last_batch="drop", device_transform=None,
+                 device_decode_resize=None):
         if last_batch not in ("drop", "partial"):
             raise ValueError("last_batch must be drop|partial, got %r" % last_batch)
         import jax
@@ -946,7 +989,8 @@ class InMemDataLoader:
         # fill UNSHARDED: chunk/partial-batch row counts rarely divide the batch axis;
         # the resident store and gathered batches are laid out below instead
         with DataLoader(reader, self.batch_size, sharding=None,
-                        last_batch="partial", prefetch=2) as fill:
+                        last_batch="partial", prefetch=2,
+                        device_decode_resize=device_decode_resize) as fill:
             for batch in fill:
                 kept = {}
                 for k, v in batch.items():
@@ -1120,14 +1164,16 @@ _UNSET = object()
 #: defaults stay defined ONCE, on DataLoader.__init__ (they'd silently drift if
 #: re-stated here).
 _LOADER_OPTS = ("last_batch", "device_transform", "prefetch", "pad_shapes",
-                "device_shuffle_capacity", "to_device", "host_queue_size")
+                "device_shuffle_capacity", "to_device", "host_queue_size",
+                "device_decode_resize")
 
 
 def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1,
                     shuffling_queue_capacity=0, reader_factory=None,
                     last_batch=_UNSET, device_transform=_UNSET, prefetch=_UNSET,
                     pad_shapes=_UNSET, device_shuffle_capacity=_UNSET,
-                    to_device=_UNSET, host_queue_size=_UNSET, **reader_kwargs):
+                    to_device=_UNSET, host_queue_size=_UNSET,
+                    device_decode_resize=_UNSET, **reader_kwargs):
     """One-call convenience: ``make_batch_reader`` + :class:`DataLoader`.
 
     ``reader_kwargs`` pass through to :func:`petastorm_tpu.reader.make_batch_reader`

@@ -666,33 +666,61 @@ def stack_jpeg_coefficients(planes_list):
     return tuple(coeffs), tuple(qtabs)
 
 
-def decode_jpeg_batch(planes_list):
+def resize_image_batch(img, target):
+    """(n, h, w, c) uint8 device batch → (n, *target, c), bilinear, no antialiasing
+    (tracks ``cv2.resize(..., INTER_LINEAR)``, the reference host resize idiom —
+    identical sampling grid on upscale, same no-prefilter choice on downscale; values
+    differ from cv2 only by float rounding). No-op when already at ``target``."""
+    import jax
+    import jax.numpy as jnp
+
+    h, w = int(target[0]), int(target[1])
+    if img.shape[1] == h and img.shape[2] == w:
+        return img
+    out = jax.image.resize(
+        img.astype(jnp.float32), (img.shape[0], h, w, img.shape[3]),
+        method="linear", antialias=False)
+    return jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+
+
+def decode_jpeg_batch(planes_list, resize_to=None):
     """Batched stage 2: list of :class:`JpegPlanes` → (n, h, w, 3) uint8 ``jax.Array``.
 
-    All images must share height/width (resize upstream or use padded-shape fields);
-    mixed chroma samplings are grouped and decoded per-group, then re-gathered in input
-    order on device."""
+    Without ``resize_to`` all images must share height/width (resize on write, or use
+    padded-shape fields); mixed chroma samplings are grouped and decoded per-group,
+    then re-gathered in input order on device.
+
+    ``resize_to=(h, w)`` lifts the uniform-size requirement for mixed-size stores
+    (raw ImageNet-style corpora): each same-layout group decodes at its stored size
+    and is bilinearly resized ON DEVICE to the target (``resize_image_batch``), so
+    every batch leaves with one static shape regardless of composition."""
     import jax.numpy as jnp
 
     if not planes_list:
         raise ValueError("decode_jpeg_batch: empty batch")
     sizes = {(p.height, p.width) for p in planes_list}
-    if len(sizes) > 1:
+    if len(sizes) > 1 and resize_to is None:
         raise ValueError(
             "decode_jpeg_batch requires a uniform image size per batch, got %s. "
-            "Resize on write, or decode on host via CompressedImageCodec.decode." % sizes
+            "Pass resize_to=(h, w) (DataLoader(device_decode_resize=...)) to decode "
+            "mixed sizes with an on-device resize, resize on write, or decode on "
+            "host via CompressedImageCodec.decode." % sizes
         )
     groups = {}
     for i, p in enumerate(planes_list):
         groups.setdefault(_layout_key(p), []).append(i)
     if len(groups) == 1:
         layout, = groups
-        return _decode_group(layout, planes_list)
+        out = _decode_group(layout, planes_list)
+        return resize_image_batch(out, resize_to) if resize_to is not None else out
     parts = []
     order = []
     for layout, indices in groups.items():
         group = [planes_list[i] for i in indices]
-        parts.append(_decode_group(layout, group))
+        decoded = _decode_group(layout, group)
+        if resize_to is not None:
+            decoded = resize_image_batch(decoded, resize_to)
+        parts.append(decoded)
         order.extend(indices)
     stacked = jnp.concatenate(parts, axis=0)
     inverse = np.argsort(np.asarray(order))

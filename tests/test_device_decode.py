@@ -464,3 +464,150 @@ def test_device_decode_composes_with_device_shuffle(jpeg_dataset):
     assert order != sorted(order)  # and not plan order
     for rid, img in seen.items():
         assert np.abs(img.astype(int) - expected[rid].astype(int)).mean() < 2.0
+
+
+# --------------------------------------------------- mixed-size stores (device resize)
+
+
+def _mixed_size_store(tmp_path, sizes, quality=90):
+    """Vanilla-parquet-with-metadata store whose JPEG rows have DIFFERENT sizes."""
+    import pyarrow as pa
+    import pyarrow.fs as pafs
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu import types as ptypes
+    from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+    from petastorm_tpu.metadata import write_petastorm_tpu_metadata
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema("Mixed", [
+        UnischemaField("id", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+        UnischemaField("image_jpeg", np.uint8, (None, None, 3),
+                       CompressedImageCodec("jpeg", quality=quality), False),
+    ])
+    field = schema.fields["image_jpeg"]
+    rng = np.random.RandomState(11)
+    imgs = []
+    enc = []
+    for i, (h, w) in enumerate(sizes):
+        base = rng.randint(0, 256, (max(2, h // 8), max(2, w // 8))).astype(np.float32)
+        img = np.kron(base, np.ones((8, 8), np.float32))[:h, :w]
+        img = np.stack([img, np.flipud(img), np.fliplr(img)], -1)
+        img = img.clip(0, 255).astype(np.uint8)
+        imgs.append(img)
+        enc.append(bytes(field.codec.encode(field, img)))
+    pq.write_table(
+        pa.table({"id": pa.array(np.arange(len(sizes), dtype=np.int64)),
+                  "image_jpeg": pa.array(enc, pa.binary())}),
+        str(tmp_path / "part-0.parquet"), row_group_size=len(sizes))
+    write_petastorm_tpu_metadata(pafs.LocalFileSystem(), str(tmp_path), schema,
+                                 {"part-0.parquet": 1})
+    return "file://" + str(tmp_path), imgs, field
+
+
+def test_mixed_sizes_without_resize_raise(tmp_path):
+    url, _, _ = _mixed_size_store(tmp_path, [(32, 48), (64, 40), (32, 48)])
+    reader = make_batch_reader(url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    with pytest.raises(ValueError, match="device_decode_resize"):
+        with DataLoader(reader, batch_size=3, last_batch="partial") as loader:
+            list(loader)
+
+
+def test_mixed_sizes_device_resize(tmp_path):
+    """Mixed-size store rides the device path with one static output shape; values
+    track cv2 decode + cv2.resize INTER_LINEAR (the host reference idiom)."""
+    import cv2
+
+    sizes = [(32, 48), (64, 40), (48, 48), (32, 48), (80, 56), (24, 24)]
+    url, imgs, field = _mixed_size_store(tmp_path, sizes)
+    target = (32, 32)
+    reader = make_batch_reader(url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    got = {}
+    with DataLoader(reader, batch_size=3, last_batch="partial",
+                    device_decode_resize=target) as loader:
+        for batch in loader:
+            arr = np.asarray(batch["image_jpeg"])
+            assert arr.shape[1:] == (32, 32, 3) and arr.dtype == np.uint8
+            for i, rid in enumerate(np.asarray(batch["id"])):
+                got[int(rid)] = arr[i]
+    assert len(got) == len(sizes)
+    for rid, (h, w) in enumerate(sizes):
+        stored = field.codec.decode(field, field.codec.encode(field, imgs[rid]))
+        if (h, w) != target:
+            ref = cv2.resize(stored, (target[1], target[0]),
+                             interpolation=cv2.INTER_LINEAR)
+        else:
+            ref = stored
+        diff = np.abs(got[rid].astype(int) - ref.astype(int))
+        assert diff.mean() < 3.0, (rid, diff.mean())
+
+
+def test_uniform_store_resize_noop_bitexact(jpeg_dataset):
+    """resize target == stored size must not perturb output: bit-equal to the
+    no-resize device path."""
+    reader = make_batch_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    with DataLoader(reader, batch_size=8,
+                    device_decode_resize=(32, 48)) as loader:
+        with_resize = {int(r): np.asarray(b["image_jpeg"])[i]
+                       for b in loader for i, r in enumerate(np.asarray(b["id"]))}
+    reader = make_batch_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    with DataLoader(reader, batch_size=8) as loader:
+        plain = {int(r): np.asarray(b["image_jpeg"])[i]
+                 for b in loader for i, r in enumerate(np.asarray(b["id"]))}
+    assert set(with_resize) == set(plain)
+    for rid in plain:
+        np.testing.assert_array_equal(with_resize[rid], plain[rid])
+
+
+def test_mixed_sizes_host_fallback_rows_resized(tmp_path, monkeypatch):
+    """Rows the native stage rejects (host cv2 fallback) resize on host and merge at
+    their positions alongside device-resized rows."""
+    url, imgs, field = _mixed_size_store(tmp_path, [(32, 48), (64, 40), (48, 32)])
+    from petastorm_tpu.ops import jpeg as J
+
+    real = J.entropy_decode_jpeg_batch
+
+    def partial_batch(blobs):
+        out = real(blobs)
+        if len(out) > 1:
+            out[1] = None  # force one row down the host fallback path
+        return out
+
+    def refuse_fast(data):
+        raise ValueError("forced: no native per-image decode either")
+
+    # batch rejects row 1 AND the per-image native path refuses -> a genuine
+    # cv2-decoded ndarray lands in the staged column next to JpegPlanes rows
+    monkeypatch.setattr(J, "entropy_decode_jpeg_batch", partial_batch)
+    monkeypatch.setattr(J, "entropy_decode_jpeg_fast", refuse_fast)
+    reader = make_batch_reader(url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    with DataLoader(reader, batch_size=3, device_decode_resize=(32, 32)) as loader:
+        (batch,) = list(loader)
+    arr = np.asarray(batch["image_jpeg"])
+    assert arr.shape == (3, 32, 32, 3)
+    import cv2
+
+    for i in range(3):
+        stored = field.codec.decode(field, field.codec.encode(field, imgs[i]))
+        ref = cv2.resize(stored, (32, 32), interpolation=cv2.INTER_LINEAR)
+        assert np.abs(arr[i].astype(int) - ref.astype(int)).mean() < 3.0, i
+
+
+def test_device_decode_resize_validated_at_construction(jpeg_dataset):
+    reader = make_batch_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1)
+    try:
+        with pytest.raises(ValueError, match="image_jpeg"):
+            DataLoader(reader, batch_size=4,
+                       device_decode_resize={"imaeg_jpeg": (32, 32)})  # misspelled
+        with pytest.raises(ValueError, match="pair"):
+            DataLoader(reader, batch_size=4, device_decode_resize=32)
+        with pytest.raises(ValueError, match="positive"):
+            DataLoader(reader, batch_size=4, device_decode_resize=(0, 32))
+    finally:
+        reader.stop()
+        reader.join()
