@@ -40,7 +40,13 @@ def main():
     parser.add_argument("--augment", action="store_true",
                         help="on-device random crop (stored size must exceed 224) + "
                              "horizontal flip, keyed per batch by the loader")
+    parser.add_argument("--decode-resize", type=int, default=0,
+                        help="on-device resize target (pixels, square) for stores "
+                             "with MIXED image sizes; 0 = require a uniform store")
     args = parser.parse_args()
+    if args.decode_resize and args.host_decode:
+        parser.error("--decode-resize requires the on-device decode path "
+                     "(drop --host-decode, or resize the store on write)")
 
     mesh = make_mesh()  # all local devices on a 'dp' axis
     sharding = batch_sharding(mesh)
@@ -85,10 +91,16 @@ def main():
         shuffle_row_groups=True, decode_on_device=not args.host_decode,
         schema_fields=["image", "label"],
     )
+    # Stores with mixed image sizes (raw, un-resized corpora) batch at one static
+    # shape via the on-device resize; uniform pre-resized stores skip it (no-op).
+    resize = None
+    if args.decode_resize:
+        resize = (args.decode_resize, args.decode_resize)
     step = 0
     t0 = time.time()
     with DataLoader(reader, args.batch_size, sharding=sharding,
-                    device_transform=device_transform) as loader:
+                    device_transform=device_transform,
+                    device_decode_resize=resize) as loader:
         for batch in loader:
             params, batch_stats, opt_state, loss = train_step(
                 params, batch_stats, opt_state, batch["image"],

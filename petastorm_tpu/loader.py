@@ -94,6 +94,11 @@ def _validate_decode_resize(resize, device_fields):
     as a mixed-size error telling the user to pass the option they already passed."""
     if resize is None:
         return None
+    if not device_fields:
+        raise ValueError(
+            "device_decode_resize was given but the reader has no device-decoded "
+            "fields — open it with decode_on_device=True (and an image-codec "
+            "column) for the on-device resize to apply")
 
     def check_target(t, label):
         try:

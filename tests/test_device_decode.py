@@ -611,3 +611,15 @@ def test_device_decode_resize_validated_at_construction(jpeg_dataset):
     finally:
         reader.stop()
         reader.join()
+
+
+def test_device_decode_resize_requires_decode_fields(jpeg_dataset):
+    """A resize target against a reader with no device-decoded fields must fail at
+    construction, not silently no-op."""
+    reader = make_batch_reader(jpeg_dataset.url, num_epochs=1)  # host decode
+    try:
+        with pytest.raises(ValueError, match="decode_on_device"):
+            DataLoader(reader, batch_size=4, device_decode_resize=(32, 32))
+    finally:
+        reader.stop()
+        reader.join()
