@@ -623,3 +623,47 @@ def test_device_decode_resize_requires_decode_fields(jpeg_dataset):
     finally:
         reader.stop()
         reader.join()
+
+
+def test_inmem_loader_mixed_sizes_with_resize(tmp_path):
+    """InMemDataLoader fills a mixed-size store through the staged decode + resize:
+    the HBM-resident store holds one static shape, epochs serve it directly."""
+    from petastorm_tpu.loader import InMemDataLoader
+
+    sizes = [(32, 48), (64, 40), (48, 48), (24, 24)] * 2
+    url, _, _ = _mixed_size_store(tmp_path, sizes)
+    reader = make_batch_reader(url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    with InMemDataLoader(reader, batch_size=4, num_epochs=2, seed=7,
+                         device_decode_resize=(32, 32)) as loader:
+        seen = 0
+        for batch in loader:
+            arr = np.asarray(batch["image_jpeg"])
+            assert arr.shape == (4, 32, 32, 3) and arr.dtype == np.uint8
+            seen += len(arr)
+    assert seen == 2 * len(sizes)
+
+
+def test_weighted_sampling_device_decode_with_resize(tmp_path):
+    """WeightedSamplingReader over two mixed-size stores passes the staged-decode
+    fields through; the loader's resize gives the mixed stream one static shape."""
+    from petastorm_tpu import WeightedSamplingReader
+
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    url_a, _, _ = _mixed_size_store(tmp_path / "a", [(32, 48), (64, 40)] * 2)
+    url_b, _, _ = _mixed_size_store(tmp_path / "b", [(24, 24), (48, 32)] * 2)
+    r1 = make_batch_reader(url_a, decode_on_device=True, num_epochs=1,
+                           shuffle_row_groups=False)
+    r2 = make_batch_reader(url_b, decode_on_device=True, num_epochs=1,
+                           shuffle_row_groups=False)
+    mixed = WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=4)
+    assert mixed.device_decode_fields == frozenset({"image_jpeg"})
+    seen = 0
+    with DataLoader(mixed, batch_size=4, last_batch="partial",
+                    device_decode_resize=(32, 32)) as loader:
+        for batch in loader:
+            arr = np.asarray(batch["image_jpeg"])
+            assert arr.shape[1:] == (32, 32, 3)
+            seen += len(arr)
+    assert seen == 8
