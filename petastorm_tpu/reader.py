@@ -751,7 +751,7 @@ class Reader:
                  shuffle_row_drop_partitions=1,
                  reader_pool_type="thread", workers_count=4, results_queue_size=16,
                  is_batched_reader=False, ngram=None, results_timeout_s=300.0,
-                 wire_serializer="pickle"):
+                 wire_serializer="pickle", worker_respawns=2):
         self._fs = filesystem
         self._path = path
         self.schema = schema
@@ -782,7 +782,7 @@ class Reader:
                                with_epoch=True)
         self._num_items = len(items)
         self._pool_args = (reader_pool_type, workers_count, results_queue_size,
-                           results_timeout_s, wire_serializer)
+                           results_timeout_s, wire_serializer, worker_respawns)
         self._executor = None
         self._results_iter = None
         self._buffer = []
@@ -976,7 +976,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
                 cache_row_size_estimate=None, cache_extra_settings=None,
                 transform_spec=None, filters=None, storage_options=None, filesystem=None,
                 results_timeout_s=300.0, decode_on_device=False, wire_serializer=None,
-                io_retries=2, io_retry_backoff_s=0.1):
+                io_retries=2, io_retry_backoff_s=0.1, worker_respawns=2):
     """Open a petastorm(-tpu) dataset for per-row decoded reading (reference ~L60).
 
     ``schema_fields`` may be a list of names/regexes/UnischemaFields or an :class:`NGram`.
@@ -991,6 +991,10 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     (connection resets, timeouts against object stores) are retried that many extra
     times with jittered exponential backoff before propagating; ``io_retries=0``
     restores the reference's fail-fast behavior (it has no retry — SURVEY.md §6).
+
+    ``worker_respawns``: the process pool's elastic-recovery budget — a child that
+    dies mid-item is replaced and its row group re-dispatched up to this many times
+    (0 = fail fast; the reference has no recovery).
     """
     fs, path = get_filesystem_and_path_or_paths(dataset_url, storage_options, filesystem)
     stored_schema = get_schema(fs, path)
@@ -1037,7 +1041,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         reader_pool_type=reader_pool_type, workers_count=workers_count,
         results_queue_size=results_queue_size, is_batched_reader=False, ngram=ngram,
         results_timeout_s=results_timeout_s,
-        wire_serializer=wire_serializer or "pickle",
+        wire_serializer=wire_serializer or "pickle", worker_respawns=worker_respawns,
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields
@@ -1052,7 +1056,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       cache_row_size_estimate=None, cache_extra_settings=None,
                       transform_spec=None, filters=None, storage_options=None,
                       filesystem=None, results_timeout_s=300.0, decode_on_device=False,
-                      wire_serializer=None, io_retries=2, io_retry_backoff_s=0.1):
+                      wire_serializer=None, io_retries=2, io_retry_backoff_s=0.1,
+                      worker_respawns=2):
     """Open ANY Parquet store for vectorized columnar batches (reference ~L200).
 
     ``decode_on_device``: see :func:`make_reader` — device-decodable codec columns come
@@ -1107,7 +1112,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         reader_pool_type=reader_pool_type, workers_count=workers_count,
         results_queue_size=results_queue_size, is_batched_reader=True,
         results_timeout_s=results_timeout_s,
-        wire_serializer=wire_serializer or "arrow",
+        wire_serializer=wire_serializer or "arrow", worker_respawns=worker_respawns,
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields

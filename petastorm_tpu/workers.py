@@ -117,15 +117,19 @@ class ThreadExecutor(ExecutorBase):
             with self._active_lock:
                 self._active -= 1
                 if self._active == 0:
-                    self._put(_DONE, force=True)
+                    self._put(_DONE)
 
-    def _put(self, value, force=False):
+    def _put(self, value):
+        # Even the _DONE marker yields to a SET stop event: the consumer is the one
+        # who sets it, and it never reads results afterwards — spinning until the
+        # full queue drains would park the last worker for join()'s whole timeout
+        # (results_timeout_s) on every stop-mid-stream teardown.
         while True:
             try:
                 self._results.put(value, timeout=0.1)
                 return
             except queue.Full:
-                if self._stop_event.is_set() and not force:
+                if self._stop_event.is_set():
                     return
 
     def results(self):
@@ -182,7 +186,7 @@ class ProcessExecutor(ExecutorBase):
     """
 
     def __init__(self, workers_count=4, results_queue_size=16, results_timeout_s=300.0,
-                 serializer="pickle", **_ignored):
+                 serializer="pickle", worker_respawns=2, **_ignored):
         self._workers_count = workers_count
         self._queue_size = results_queue_size
         self._timeout = results_timeout_s
@@ -199,11 +203,19 @@ class ProcessExecutor(ExecutorBase):
         self._active = 0
         self._active_lock = threading.Lock()
         self._tmpdir = None
+        #: Elastic recovery (no reference analog — SURVEY §6: a worker death kills the
+        #: read there): a child that dies mid-item is replaced by a fresh clean
+        #: interpreter and the in-flight item re-dispatched, up to this many times per
+        #: pool lifetime. 0 restores fail-fast. Bounded so a poison item (one that
+        #: reliably kills children, e.g. OOM) still surfaces instead of crash-looping.
+        self._respawn_budget = int(worker_respawns)
+        self._respawn_lock = threading.Lock()
+        self._spawn_counter = 0
+        self._worker = None
+        self._child_env = None
 
     def start(self, worker, plan):
         import os
-        import subprocess
-        import sys
         import tempfile
         from multiprocessing.connection import Listener
 
@@ -219,16 +231,11 @@ class ProcessExecutor(ExecutorBase):
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         child_pp = os.environ.get("PYTHONPATH", "")
         child_pp = pkg_root + ((os.pathsep + child_pp) if child_pp else "")
+        self._worker = worker  # respawned replacements re-handshake the same worker
+        self._child_env = {**os.environ, "PYTHONPATH": child_pp,
+                           "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")}
         for _ in range(self._workers_count):
-            p = subprocess.Popen(
-                [sys.executable, "-m", "petastorm_tpu._child_worker", address],
-                stdin=subprocess.PIPE,
-                env={**os.environ, "PYTHONPATH": child_pp,
-                     "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")},
-            )
-            p.stdin.write(authkey)
-            p.stdin.close()
-            self._procs.append(p)
+            self._procs.append(self._popen_child(address, authkey))
         # accept on a helper thread + child liveness poll on this one: a child that dies
         # before connecting (import error, crash) must raise here, not hang Reader
         # construction forever. Public API only — no reaching into Listener internals
@@ -245,32 +252,10 @@ class ProcessExecutor(ExecutorBase):
 
         acceptor = threading.Thread(target=_accept_loop, name="ptpu-accept", daemon=True)
         acceptor.start()
-        deadline = 120.0
-        waited = 0.0
         try:
             while len(self._conns) < self._workers_count:
-                try:
-                    item = accepted.get(timeout=1.0)
-                except queue.Empty:
-                    waited += 1.0
-                    for p in self._procs:
-                        if p.poll() is not None:
-                            raise RuntimeError(
-                                "Pool child exited with code %s before connecting (run "
-                                "'python -m petastorm_tpu._child_worker' manually to "
-                                "debug)" % p.returncode
-                            )
-                    if waited > deadline:
-                        raise TimeoutWaitingForResultError(
-                            "Pool children did not connect within %.0fs" % deadline
-                        )
-                    continue
-                if isinstance(item, Exception):
-                    raise item
-                conn = item
-                conn.send(list(sys.path))
-                conn.send(self._serializer_name)
-                conn.send(worker)
+                conn = self._await_accept(accepted, self._procs, "Pool child")
+                self._handshake(conn)
                 self._conns.append(conn)
         finally:
             listener.close()  # also unblocks the acceptor thread if we raised
@@ -282,30 +267,165 @@ class ProcessExecutor(ExecutorBase):
             t.start()
             self._threads.append(t)
 
+    def _await_accept(self, accepted, procs, what, check_stop=False, deadline=120.0):
+        """Wait for one accepted connection (or the acceptor thread's exception),
+        polling child liveness every second — ONE copy of the accept protocol shared
+        by the initial pool spawn and elastic respawns (same tolerance both places: a
+        host slow enough to need start()'s full window must also be able to heal)."""
+        waited = 0.0
+        while True:
+            try:
+                item = accepted.get(timeout=1.0)
+                break
+            except queue.Empty:
+                waited += 1.0
+                if check_stop and self._stop_event.is_set():
+                    raise RuntimeError("pool stopping during respawn")
+                for p in procs:
+                    if p.poll() is not None:
+                        raise RuntimeError(
+                            "%s exited with code %s before connecting (run 'python "
+                            "-m petastorm_tpu._child_worker' manually to debug)"
+                            % (what, p.returncode))
+                if waited > deadline:
+                    raise TimeoutWaitingForResultError(
+                        "%s did not connect within %.0fs" % (what, deadline))
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def _popen_child(self, address, authkey):
+        """Launch one clean-interpreter child pointed at ``address`` (shared by the
+        initial pool spawn and elastic respawns — ONE copy of the protocol)."""
+        import subprocess
+        import sys
+
+        p = subprocess.Popen(
+            [sys.executable, "-m", "petastorm_tpu._child_worker", address],
+            stdin=subprocess.PIPE, env=self._child_env,
+        )
+        p.stdin.write(authkey)
+        p.stdin.close()
+        return p
+
+    def _handshake(self, conn):
+        """Bootstrap a connected child: parent sys.path, wire serializer, worker."""
+        import sys
+
+        conn.send(list(sys.path))
+        conn.send(self._serializer_name)
+        conn.send(self._worker)
+
+    def _spawn_one(self):
+        """Spawn + handshake ONE replacement child (elastic respawn). Returns its
+        connection; raises when the child cannot start/connect or the pool is
+        stopping (the replacement is then killed, never leaked)."""
+        import os
+        from multiprocessing.connection import Listener
+
+        with self._respawn_lock:
+            self._spawn_counter += 1
+            address = os.path.join(self._tmpdir, "sock-r%d" % self._spawn_counter)
+        authkey = os.urandom(32)
+        listener = Listener(address, family="AF_UNIX", authkey=authkey)
+        p = None
+        conn = None
+        try:
+            p = self._popen_child(address, authkey)
+            accepted = queue.Queue()
+
+            def _accept():
+                try:
+                    accepted.put(listener.accept())
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    accepted.put(e)
+
+            t = threading.Thread(target=_accept, daemon=True, name="ptpu-respawn-accept")
+            t.start()
+            conn = self._await_accept(accepted, [p], "respawned pool child",
+                                      check_stop=True)
+            self._handshake(conn)
+            with self._respawn_lock:
+                # join()/stop() may have begun while we were mid-handshake:
+                # registering into already-cleared lists would leak an unreaped
+                # child and an open socket (join() holds this lock to clear them)
+                if self._stop_event.is_set():
+                    raise RuntimeError("pool stopping during respawn")
+                self._procs.append(p)
+                self._conns.append(conn)
+            return conn
+        except BaseException:
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            if p is not None:
+                try:
+                    p.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+        finally:
+            listener.close()
+
+    def _respawn(self, err):
+        """A replacement connection for a dead child, or None when the budget is
+        exhausted / the pool is stopping / the spawn itself fails."""
+        with self._respawn_lock:
+            if self._respawn_budget <= 0 or self._stop_event.is_set():
+                return None
+            self._respawn_budget -= 1
+            budget_left = self._respawn_budget
+        try:
+            conn = self._spawn_one()
+        except Exception as e:  # noqa: BLE001 — degrade to the fatal path
+            logger.warning("Pool child respawn failed: %s", e)
+            return None
+        logger.warning(
+            "Pool worker died (%s); respawned a replacement and re-dispatching its "
+            "item (remaining respawn budget: %d)", err, budget_left)
+        return conn
+
     def _drive_child(self, conn, plan_iter):
         try:
-            while not self._stop_event.is_set():
+            fatal = False
+            while not fatal and not self._stop_event.is_set():
                 with self._plan_lock:
                     try:
                         item = next(plan_iter)
                     except StopIteration:
                         break
-                try:
-                    conn.send(item)
-                    header = conn.recv()
-                    if header[0] == "exc":
-                        self._put(_ExcResult(header[1]))
+                while True:  # item attempts: survives child death via respawn
+                    try:
+                        conn.send(item)
+                        header = conn.recv()
+                        if header[0] == "exc":
+                            self._put(_ExcResult(header[1]))
+                            fatal = True
+                            break
+                        _, kind, nframes = header
+                        frames = [conn.recv_bytes() for _ in range(nframes)]
+                        result = self._serializer.deserialize(kind, frames)
+                    except (EOFError, BrokenPipeError, ConnectionResetError) as e:
+                        replacement = self._respawn(e)
+                        if replacement is None:
+                            self._put(_ExcResult(
+                                RuntimeError("worker process died: %s" % e)))
+                            fatal = True
+                            break
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
+                        conn = replacement
+                        continue  # re-dispatch the SAME item on the fresh child
+                    except Exception as e:  # noqa: BLE001 — a bad frame must surface,
+                        self._put(_ExcResult(e))  # not silently truncate the dataset
+                        fatal = True
                         break
-                    _, kind, nframes = header
-                    frames = [conn.recv_bytes() for _ in range(nframes)]
-                    result = self._serializer.deserialize(kind, frames)
-                except (EOFError, BrokenPipeError, ConnectionResetError) as e:
-                    self._put(_ExcResult(RuntimeError("worker process died: %s" % e)))
+                    self._put(result)
                     break
-                except Exception as e:  # noqa: BLE001 — a bad frame must surface, not
-                    self._put(_ExcResult(e))  # silently truncate the dataset
-                    break
-                self._put(result)
             try:
                 conn.send(None)  # orderly shutdown
             except (BrokenPipeError, OSError):
@@ -314,15 +434,19 @@ class ProcessExecutor(ExecutorBase):
             with self._active_lock:
                 self._active -= 1
                 if self._active == 0:
-                    self._put(_DONE, force=True)
+                    self._put(_DONE)
 
-    def _put(self, value, force=False):
+    def _put(self, value):
+        # Even the _DONE marker yields to a SET stop event: the consumer is the one
+        # who sets it, and it never reads results afterwards — spinning until the
+        # full queue drains would park the last worker for join()'s whole timeout
+        # (results_timeout_s) on every stop-mid-stream teardown.
         while True:
             try:
                 self._results.put(value, timeout=0.1)
                 return
             except queue.Full:
-                if self._stop_event.is_set() and not force:
+                if self._stop_event.is_set():
                     return
 
     def results(self):
@@ -351,32 +475,39 @@ class ProcessExecutor(ExecutorBase):
     def join(self):
         import shutil
 
+        # join == no more results wanted: setting the stop event aborts any in-flight
+        # respawn within ~1s (otherwise a driver stuck in the 60s connect wait would
+        # outlive the 10s thread join and register a child into cleared lists)
+        self._stop_event.set()
         for t in self._threads:
             t.join(timeout=10)
         self._threads = []
-        for conn in self._conns:
+        with self._respawn_lock:  # excludes a racing _spawn_one registration
+            conns, self._conns = self._conns, []
+            procs, self._procs = self._procs, []
+        for conn in conns:
             try:
                 conn.close()
             except OSError:
                 pass
-        self._conns = []
-        for p in self._procs:
+        for p in procs:
             try:
                 p.wait(timeout=5)
             except Exception:  # noqa: BLE001
                 p.kill()
-        self._procs = []
         if self._tmpdir:
             shutil.rmtree(self._tmpdir, ignore_errors=True)
             self._tmpdir = None
 
 
 def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size=16,
-                  results_timeout_s=300.0, serializer="pickle"):
+                  results_timeout_s=300.0, serializer="pickle", worker_respawns=2):
     """Factory matching the reference's ``reader_pool_type`` kwarg ('thread'|'process'|'dummy').
 
     ``serializer`` ('pickle'|'arrow') selects the process-pool wire format (reference
     Pickle/ArrowTable serializer parity); thread/dummy pools share memory and ignore it.
+    ``worker_respawns`` bounds the process pool's elastic recovery (dead children are
+    replaced and their item re-dispatched up to this many times; 0 = fail fast).
     """
     if reader_pool_type in ("dummy", "sync"):
         return SyncExecutor()
@@ -384,7 +515,7 @@ def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size
         return ThreadExecutor(workers_count, results_queue_size, results_timeout_s)
     if reader_pool_type == "process":
         return ProcessExecutor(workers_count, results_queue_size, results_timeout_s,
-                               serializer=serializer)
+                               serializer=serializer, worker_respawns=worker_respawns)
     raise ValueError(
         "Unknown reader_pool_type %r (expected 'thread', 'process' or 'dummy')"
         % reader_pool_type

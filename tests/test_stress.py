@@ -47,10 +47,30 @@ def test_concurrent_readers_share_disk_cache(scalar_dataset, tmp_path):
         assert sorted(ids) == sorted(expected * 3), tag
 
 
-def test_reader_process_child_killed_mid_epoch_under_load(scalar_dataset):
-    """SIGKILL a pool child while a process-pool READER is mid-iteration: the death
-    must surface as a clean RuntimeError at the consumer (never a hang, never
-    silently-missing rows)."""
+def test_reader_process_child_killed_mid_epoch_heals(scalar_dataset):
+    """SIGKILL a pool child while a process-pool READER is mid-iteration: elastic
+    respawn replaces it and the read continues — batches keep flowing, no hang."""
+    reader = make_batch_reader(scalar_dataset.url, reader_pool_type="process",
+                               workers_count=2, num_epochs=None,
+                               results_timeout_s=60)
+    count = 0
+    after_kill = 0
+    with reader:
+        for _ in reader:
+            count += 1
+            if count == 3:
+                os.kill(reader._executor._procs[0].pid, signal.SIGKILL)
+            elif count > 3:
+                after_kill += 1
+                if after_kill >= 8:
+                    break
+    assert after_kill >= 8  # the stream survived the death
+
+
+def test_reader_process_child_killed_fail_fast_without_respawns(scalar_dataset):
+    """With the respawn budget zeroed, the death surfaces as a clean RuntimeError at
+    the consumer (never a hang, never silently-missing rows) — reference-style
+    fail-fast, still the behavior under a poison workload once the budget drains."""
     reader = make_batch_reader(scalar_dataset.url, reader_pool_type="process",
                                workers_count=2, num_epochs=None,
                                results_timeout_s=60)
@@ -60,6 +80,7 @@ def test_reader_process_child_killed_mid_epoch_under_load(scalar_dataset):
         for _ in reader:
             count += 1
             if count == 3:
+                reader._executor._respawn_budget = 0
                 os.kill(reader._executor._procs[0].pid, signal.SIGKILL)
                 killed = True
     assert killed

@@ -121,20 +121,63 @@ def _slow_square(x):
     return x * x
 
 
-def test_process_child_killed_mid_run_surfaces_cleanly():
-    """A worker process dying mid-task (OOM-kill, segfault) must surface as a clean
-    'worker process died' error at results(), never hang the consumer (SURVEY §6:
+def test_process_child_killed_fail_fast_when_respawns_disabled():
+    """With worker_respawns=0 a child dying mid-task (OOM-kill, segfault) surfaces as
+    a clean 'worker process died' error at results(), never a hang (SURVEY §6:
     failure detection — the reference propagates worker exceptions but a silently
     killed zmq worker hangs it until the results timeout)."""
     import os
     import signal
 
-    ex = ProcessExecutor(workers_count=2, results_queue_size=4, results_timeout_s=60)
+    ex = ProcessExecutor(workers_count=2, results_queue_size=4, results_timeout_s=60,
+                         worker_respawns=0)
     ex.start(_slow_square, EpochPlan(list(range(40)), num_epochs=1))
     time.sleep(1.0)  # children connected and mid-task
     os.kill(ex._procs[0].pid, signal.SIGKILL)
     with pytest.raises(RuntimeError, match="worker process died"):
         for _ in ex.results():
             pass
+    ex.stop()
+    ex.join()
+
+
+def test_process_child_killed_heals_by_respawn():
+    """Elastic recovery (no reference analog): the default pool replaces a killed
+    child with a fresh interpreter and re-dispatches its in-flight item — every
+    result arrives exactly once."""
+    import os
+    import signal
+
+    ex = ProcessExecutor(workers_count=2, results_queue_size=4, results_timeout_s=120)
+    ex.start(_slow_square, EpochPlan(list(range(20)), num_epochs=1))
+    time.sleep(1.0)  # children connected and mid-task
+    os.kill(ex._procs[0].pid, signal.SIGKILL)
+    got = sorted(r for r in ex.results())
+    handles = list(ex._procs)  # originals + the replacement, captured before join
+    ex.stop()
+    ex.join()
+    assert got == sorted(x * x for x in range(20))
+    assert len(handles) == 3  # two originals + one respawned replacement
+    assert all(p.poll() is not None for p in handles)  # every child reaped
+
+
+def test_process_respawn_budget_exhaustion_is_fatal():
+    """Killing children beyond the budget degrades to the fail-fast error — a poison
+    workload cannot crash-loop the pool forever."""
+    import os
+    import signal
+
+    ex = ProcessExecutor(workers_count=1, results_queue_size=4, results_timeout_s=120,
+                         worker_respawns=1)
+    ex.start(_slow_square, EpochPlan(list(range(40)), num_epochs=1))
+    with pytest.raises(RuntimeError, match="worker process died"):
+        count = 0
+        for _ in ex.results():
+            count += 1
+            if count in (2, 4):  # kill the current child twice: budget is 1
+                time.sleep(0.1)
+                for p in ex._procs:
+                    if p.poll() is None:
+                        os.kill(p.pid, signal.SIGKILL)
     ex.stop()
     ex.join()
