@@ -244,3 +244,25 @@ def test_implied_dnf_filters_unit():
         [in_set({1}, "a"), in_lambda(["b"], lambda r: True)], any)) is None
     got = implied_dnf_filters(in_reduce([in_set({1}, "a"), in_set({2}, "b")], any))
     assert got == [[("a", "in", [1])], [("b", "in", [2])]]
+
+
+def test_predicate_pruned_plan_checkpoint_resume(ordered_dataset):
+    """state_dict/load_state_dict over a predicate-PRUNED plan: the resumed reader
+    reconstructs the identical pruned item list (deterministic pruning), so the
+    cursor indexes the same schedule and no matching row is lost or replayed."""
+    from petastorm_tpu.predicates import in_set
+
+    pred = in_set({5, 15, 55, 95}, "id")
+    kwargs = dict(predicate=pred, reader_pool_type="dummy",
+                  shuffle_row_groups=False, num_epochs=1)
+    with make_batch_reader(ordered_dataset, **kwargs) as reader:
+        assert reader._num_items == 4
+        it = iter(reader)
+        first = next(it)  # one row group consumed (one matching row)
+        state = reader.state_dict()
+    head = [int(x) for x in np.asarray(first.id)]
+    with make_batch_reader(ordered_dataset, **kwargs) as reader2:
+        assert reader2._num_items == 4  # same pruned plan on reconstruction
+        reader2.load_state_dict(state)
+        rest = _ids(reader2)
+    assert sorted(head + rest) == [5, 15, 55, 95]
