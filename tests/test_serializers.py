@@ -98,3 +98,16 @@ def test_arrow_serializer_preserves_bytes_vs_str_dtypes():
     _, _, cols = s.deserialize(kind, [bytes(f) for f in frames])
     assert cols["b"].dtype.kind == "S" and cols["b"][1] == b"\xff\x01"
     assert cols["u"].dtype.kind == "U" and cols["u"][0] == "xy"
+
+
+def test_malformed_wire_frames_raise_cleanly():
+    """Garbage bytes on the wire (torn child write, memory corruption) must raise a
+    normal exception the pool converts to a consumer-visible error — never hang or
+    return truncated data silently."""
+    for s in (PickleSerializer(), ArrowTableSerializer()):
+        kind, frames = s.serialize((0, 0, {"v": np.arange(4)}))
+        bad = [b"\x00\xff garbage \x13\x37"] + [bytes(f) for f in frames[1:]]
+        with pytest.raises(Exception):
+            s.deserialize(kind, bad)
+        with pytest.raises(Exception):
+            s.deserialize(kind, [])  # missing frames entirely
