@@ -336,6 +336,11 @@ def _arrow_field_to_unischema_field(pa_field):
         shape = shape + (size,)
         typ = typ.value_type
         depth += 1
+    if pat.is_dictionary(typ):
+        # dictionary encoding (pandas categoricals) is a storage detail: the field's
+        # logical type is the dictionary's VALUE type — silently dropping the column
+        # (the old behavior via the unsupported-type omit) loses data
+        typ = typ.value_type
     if pat.is_decimal(typ):
         np_dtype = np.dtype("object")
     elif pat.is_string(typ) or pat.is_large_string(typ):

@@ -156,3 +156,79 @@ def test_multi_rowgroup_store_reads_all(tmp_path_factory, typed_table):
     url = _write(tmp_path_factory.mktemp("rg"), typed_table, row_group_size=2)
     got = _read_all(url, workers_count=2, reader_pool_type="thread")
     assert sorted(got["i64"]) == sorted(typed_table["i64"].to_numpy())
+
+
+def test_dictionary_encoded_columns_transparent(tmp_path_factory):
+    """Dictionary-encoded (categorical) columns — pyarrow's default for strings —
+    decode to plain values; the encoding is a storage detail (silently DROPPING the
+    column, the pre-fix behavior via the unsupported-type omit, loses data)."""
+    table = pa.table({
+        "id": pa.array(np.arange(12), pa.int64()),
+        "cat": pa.array(["red", "green", "blue"] * 4).dictionary_encode(),
+    })
+    url = _write(tmp_path_factory.mktemp("dict"), table)
+    got = _read_all(url)
+    order = np.argsort(got["id"])
+    assert [str(v) for v in got["cat"][order][:3]] == ["red", "green", "blue"]
+
+
+def test_timezone_aware_timestamps(tmp_path_factory):
+    """tz-aware timestamps arrive as ABSOLUTE UTC instants, not wall-clock local
+    (datetime64 is tz-naive UTC — the reference's tf_utils converts the same way)."""
+    from zoneinfo import ZoneInfo
+
+    ny = ZoneInfo("America/New_York")
+    base = datetime.datetime(2022, 6, 1, 12, 0, 0, tzinfo=ny)  # = 16:00 UTC (EDT)
+    table = pa.table({
+        "id": pa.array(np.arange(4), pa.int64()),
+        "ts": pa.array([base + datetime.timedelta(hours=i) for i in range(4)],
+                       pa.timestamp("us", tz="America/New_York")),
+    })
+    url = _write(tmp_path_factory.mktemp("tz"), table)
+    got = _read_all(url)
+    order = np.argsort(got["id"])
+    ts = got["ts"][order]
+    assert ts.dtype.kind == "M"
+    # the UTC instant, NOT the 12:00 New York wall-clock value
+    assert ts[0].astype("datetime64[s]") == np.datetime64("2022-06-01T16:00:00")
+    deltas = np.diff(ts).astype("timedelta64[s]").astype(int)
+    assert list(deltas) == [3600] * 3  # hourly spacing preserved as instants
+
+
+def test_large_binary_and_large_list(tmp_path_factory):
+    table = pa.table({
+        "id": pa.array(np.arange(5), pa.int64()),
+        "lb": pa.array([b"x" * (i + 1) for i in range(5)], pa.large_binary()),
+        "ll": pa.array([np.arange(3, dtype=np.float64) * i for i in range(5)],
+                       pa.large_list(pa.float64())),
+    })
+    url = _write(tmp_path_factory.mktemp("large"), table)
+    got = _read_all(url)
+    order = np.argsort(got["id"])
+    assert [len(bytes(v)) for v in got["lb"][order]] == [1, 2, 3, 4, 5]
+    assert got["ll"].shape == (5, 3)
+    np.testing.assert_allclose(got["ll"][order][2], [0.0, 2.0, 4.0])
+
+
+def test_zero_row_store_yields_empty_read(tmp_path_factory):
+    """A parquet file with zero rows still has a (single, empty) row group: the
+    reader constructs and delivers an empty read — it does not error."""
+    url = _write(tmp_path_factory.mktemp("empty"),
+                 pa.table({"id": pa.array([], pa.int64())}), row_group_size=1)
+    with make_batch_reader(url, reader_pool_type="dummy") as reader:
+        total = sum(len(b.id) for b in reader)
+    assert total == 0
+
+
+def test_many_tiny_files_single_row_groups(tmp_path_factory):
+    """60 one-row files: enumeration, scheduling, and delivery stay exact (the
+    object-store layout pathology the flat listing exists for)."""
+    tmp = tmp_path_factory.mktemp("tiny")
+    path = tmp / "store"
+    path.mkdir()
+    for i in range(60):
+        pq.write_table(pa.table({"id": pa.array([i], pa.int64())}),
+                       str(path / ("part-%03d.parquet" % i)))
+    got = _read_all("file://" + str(path), workers_count=4,
+                    reader_pool_type="thread")
+    assert sorted(got["id"].tolist()) == list(range(60))
