@@ -262,7 +262,11 @@ def main():
                                       measure_batches=measure_batches)
 
     host = measure(decode_on_device=False)
+    from petastorm_tpu.ops.jpeg import transfer_byte_counters
+
+    transfer_byte_counters(reset=True)
     device = measure(decode_on_device=True)
+    xfer = transfer_byte_counters()
     jstep = make_resnet_step()
     overlap = measure_overlap(jstep, decode_on_device=True, measure_batches=16)
     overlap_hostdec = measure_overlap(jstep, decode_on_device=False,
@@ -289,6 +293,10 @@ def main():
         "overlap_hostdec_step_repeats": overlap_hostdec.step_repeats,
         "overlap_hostdec_stages": overlap_hostdec.stages,
         "content": content,
+        # realized coefficient-transfer narrowing (truncation + spectral split +
+        # packs): shipped H2D bytes as a fraction of full-int16 coefficients
+        "coeff_bytes_shipped_ratio":
+            round(xfer["shipped"] / xfer["raw"], 4) if xfer["raw"] else None,
         "stages": device["stages"],
         "host_stages": host["stages"],
     }))

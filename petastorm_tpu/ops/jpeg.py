@@ -785,6 +785,23 @@ _STICKY_SPLIT: dict = {}
 #: specmax bound was violated (mixed provenance rows) — fall back to pack12 sticky.
 _SPLIT_DISABLED: set = set()
 
+#: Cumulative coefficient-transfer accounting: ``raw`` = what full int16 coefficients
+#: would ship, ``shipped`` = actual bytes after truncation/split/pack. Lets bench
+#: artifacts report the REALIZED byte reduction, not the modeled one. Guarded by
+#: _STICKY_KS_LOCK.
+_TRANSFER_BYTES = {"shipped": 0, "raw": 0}
+
+
+def transfer_byte_counters(reset=False):
+    """Snapshot (optionally reset) the cumulative coefficient-transfer accounting:
+    ``{"shipped": bytes_actually_shipped, "raw": int16_equivalent_bytes}``."""
+    with _STICKY_KS_LOCK:
+        out = dict(_TRANSFER_BYTES)
+        if reset:
+            _TRANSFER_BYTES["shipped"] = 0
+            _TRANSFER_BYTES["raw"] = 0
+    return out
+
 
 def _batch_specmax(group):
     """The group's combined ``(ncomp, 64)`` spectral range profile, or None when any
@@ -854,6 +871,11 @@ def _decode_group(layout, group):
     from petastorm_tpu.ops import native
 
     if not native.native_available():
+        # un-narrowed transfer still counts: ratio must read ~1.0 here, not "no data"
+        full = sum(c.nbytes for c in coeffs)
+        with _STICKY_KS_LOCK:
+            _TRANSFER_BYTES["raw"] += full
+            _TRANSFER_BYTES["shipped"] += full
         return _batched_stage2(layout)(coeffs, qtabs)
     ks = _truncation_ks(group, layout)
     if ks is not None:
@@ -907,5 +929,13 @@ def _decode_group(layout, group):
                     _PACK12_DISABLED.add((layout, ci))
         packed.append(p is not None)
         shipped.append(p if p is not None else c)
+    n = coeffs[0].shape[0]
+    raw_bytes = sum(n * by * bx * 64 * 2 for _h, _v, by, bx in layout[2])
+    shipped_bytes = sum(
+        sum(a.nbytes for a in s) if isinstance(s, tuple) else s.nbytes
+        for s in shipped)
+    with _STICKY_KS_LOCK:
+        _TRANSFER_BYTES["raw"] += raw_bytes
+        _TRANSFER_BYTES["shipped"] += shipped_bytes
     return _batched_stage2(layout, ks, tuple(packed), tuple(split))(
         tuple(shipped), qtabs)

@@ -821,3 +821,30 @@ def test_pack_overflow_sticky_fallback_still_exact():
         with j._STICKY_KS_LOCK:
             j._PACK12_DISABLED.clear()  # don't leak the forced state to other tests
             j._SPLIT_DISABLED.clear()
+
+
+def test_transfer_byte_counters_track_realized_narrowing():
+    """The cumulative shipped/raw accounting must grow with decode work and show a
+    genuine reduction on content the narrowing helps."""
+    from petastorm_tpu.ops import jpeg as j
+    from petastorm_tpu.ops import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable: %s" % native.native_error())
+    rng = np.random.RandomState(41)
+    blobs = []
+    for _ in range(6):
+        img = cv2.GaussianBlur(rng.randint(0, 256, (40, 56, 3)).astype(np.float32),
+                               (9, 9), 3.0).clip(0, 255).astype(np.uint8)
+        ok, enc = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 85])
+        blobs.append(enc.tobytes())
+    batch = j.entropy_decode_jpeg_batch(blobs)
+    before = j.transfer_byte_counters(reset=True)
+    assert j.transfer_byte_counters() == {"shipped": 0, "raw": 0}
+    np.asarray(j.decode_jpeg_batch(batch))
+    after = j.transfer_byte_counters()
+    assert after["raw"] > 0
+    assert 0 < after["shipped"] < after["raw"]  # narrowing engaged
+    # raw equals the full int16 coefficient footprint for the batch
+    expected_raw = sum(c.blocks.size * 2 for p in batch for c in p.components)
+    assert after["raw"] == expected_raw
