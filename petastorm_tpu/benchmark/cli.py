@@ -5,6 +5,52 @@ from __future__ import annotations
 import argparse
 
 
+def _make_synthetic_step(target_ms):
+    """A jitted device step calibrated to ~``target_ms`` per call on the CURRENT
+    backend (a bf16 matmul chain — MXU work on TPU). The step folds a tiny
+    dependency on the incoming batch so it cannot be reordered ahead of the
+    transfer; operators probe "can this pipeline feed a step of X ms?" without
+    writing model code."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+
+    @jax.jit
+    def burn(n, seed, base):
+        def body(_, a):
+            return (a @ base) * jnp.bfloat16(1.0 / 1024.0)
+
+        return jax.lax.fori_loop(0, n, body, base + seed)
+
+    burn(8, jnp.bfloat16(0), x).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    burn(8, jnp.bfloat16(0), x).block_until_ready()
+    per_iter = (time.perf_counter() - t0) / 8.0
+    n = max(1, int(round(target_ms / 1000.0 / max(per_iter, 1e-7))))
+
+    import numpy as np
+
+    def step(batch):
+        seed = jnp.bfloat16(0)
+        for v in batch.values():
+            if hasattr(v, "dtype") and getattr(v.dtype, "kind", "O") in "biuf":
+                if isinstance(v, np.ndarray):
+                    # host batch (to_device=False paths): index on the HOST — an
+                    # asarray here would ship the whole array to device per step
+                    seed = jnp.bfloat16(float(v.ravel()[0]) * 1e-6)
+                else:
+                    # device array: one-element slice, no bulk transfer — the cheap
+                    # dependency that orders the step after the batch's arrival
+                    seed = v.ravel()[0].astype(jnp.bfloat16) * jnp.bfloat16(1e-6)
+                break
+        return burn(n, seed, x)
+
+    return step
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("dataset_url")
@@ -22,11 +68,20 @@ def main(argv=None):
     parser.add_argument("--decode-on-device", action="store_true",
                         help="two-stage JPEG decode (requires --loader for the device half)")
     parser.add_argument("--loader-batch-size", type=int, default=256)
+    parser.add_argument("--overlap-step-ms", type=float, default=0.0,
+                        help="overlap mode: keep the device busy with a calibrated "
+                             "synthetic step of ~this many milliseconds per batch and "
+                             "report the consumer's starvation as device idle (the "
+                             "north-star metric) instead of drain-only rows/s; "
+                             "requires --loader")
     args = parser.parse_args(argv)
     if args.decode_on_device and not args.loader:
         parser.error("--decode-on-device requires --loader: without the loader's device "
                      "half the reader yields stage-1 staging payloads, not images, and "
                      "the throughput number would be meaningless")
+    if args.overlap_step_ms and not args.loader:
+        parser.error("--overlap-step-ms requires --loader (the overlap runs on the "
+                     "device batches the loader delivers)")
 
     from petastorm_tpu.benchmark.throughput import reader_throughput
     from petastorm_tpu.reader import make_batch_reader, make_reader
@@ -45,11 +100,21 @@ def main(argv=None):
 
             loader = DataLoader(reader, args.loader_batch_size)
             bs = args.loader_batch_size
-            result = loader_throughput(
-                loader,
-                warmup_batches=max(1, args.warmup_rows // bs),
-                measure_batches=max(1, args.measure_rows // bs),
-            )
+            if args.overlap_step_ms:
+                from petastorm_tpu.benchmark.throughput import overlap_throughput
+
+                step = _make_synthetic_step(args.overlap_step_ms)
+                result = overlap_throughput(
+                    loader, step, step_repeats=1,
+                    warmup_batches=max(1, args.warmup_rows // bs),
+                    measure_batches=max(1, args.measure_rows // bs),
+                )
+            else:
+                result = loader_throughput(
+                    loader,
+                    warmup_batches=max(1, args.warmup_rows // bs),
+                    measure_batches=max(1, args.measure_rows // bs),
+                )
         else:
             result = reader_throughput(reader, args.warmup_rows, args.measure_rows)
         print(result)

@@ -115,6 +115,7 @@ def overlap_throughput(loader, step_fn, warmup_batches=3, measure_batches=30,
     """
     import jax
 
+    fixed_repeats = step_repeats is not None
     it = iter(loader)
     last = None
     for _ in range(warmup_batches):  # compiles the step, warms pipeline + page cache
@@ -202,7 +203,10 @@ def overlap_throughput(loader, step_fn, warmup_batches=3, measure_batches=30,
     # so sizing the step from observation is the measurement, not cheating: a
     # pipeline that serializes against the step would stay starved at any repeats.
     res = window(step_repeats)
-    for _ in range(2):
+    # An EXPLICIT step_repeats pins the question ("can the pipeline feed THIS much
+    # device work per batch?") — escalating would silently answer a different one;
+    # the observed idle IS the answer then, however large.
+    for _ in range(2 if not fixed_repeats else 0):
         if res.device_idle_fraction is None or res.device_idle_fraction <= 0.1:
             break
         per_batch_wall = res.seconds / max(1, res.batches)

@@ -79,6 +79,20 @@ def test_benchmark_cli(capsys, scalar_dataset):
     assert "rows/s" in out
 
 
+def test_benchmark_cli_overlap_mode(capsys, scalar_dataset):
+    """--overlap-step-ms: a calibrated synthetic device step overlaps the pipeline
+    and the result reports consumer starvation (the operator device-idle probe)."""
+    from petastorm_tpu.benchmark.cli import main
+
+    main([scalar_dataset.url, "--batch", "--loader", "--loader-batch-size", "5",
+          "--overlap-step-ms", "1", "--warmup-rows", "10", "--measure-rows", "40"])
+    out = capsys.readouterr().out
+    assert "device_idle" in out or "idle" in out
+
+    with pytest.raises(SystemExit):  # overlap requires the loader
+        main([scalar_dataset.url, "--batch", "--overlap-step-ms", "1"])
+
+
 def test_loader_throughput_device_idle(scalar_dataset):
     from petastorm_tpu.benchmark.throughput import loader_throughput
     from petastorm_tpu.loader import DataLoader
