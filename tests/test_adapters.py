@@ -240,3 +240,22 @@ def test_tf_dataset_ngram(tf, synthetic_dataset):
             assert int(w["1"]["id"].numpy()) == int(w["0"]["id"].numpy()) + 1
             windows += 1
     assert windows > 0
+
+
+def test_reference_import_path_aliases():
+    """Migration contract (docs/compat.rst): the reference's adapter import paths
+    keep working — petastorm.pytorch / petastorm.tf_utils spellings map 1:1."""
+    from petastorm_tpu import pytorch as torch_alias
+
+    assert torch_alias.DataLoader is not None
+    assert torch_alias.BatchedDataLoader is not None
+    try:
+        import tensorflow  # noqa: F401
+    except Exception:
+        import pytest as _pytest
+
+        _pytest.skip("tensorflow unavailable")
+    from petastorm_tpu import tf_utils as tf_alias
+
+    assert callable(tf_alias.make_petastorm_dataset)
+    assert callable(tf_alias.tf_tensors)
