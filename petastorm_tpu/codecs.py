@@ -257,10 +257,14 @@ class CompressedImageCodec(DataframeColumnCodec):
     def decode(self, unischema_field, encoded):
         import cv2
 
+        from petastorm_tpu.errors import DecodeFieldError
+
         # np.frombuffer reads bytes/bytearray/memoryview alike — no intermediate copy
         img = cv2.imdecode(np.frombuffer(encoded, dtype=np.uint8), cv2.IMREAD_UNCHANGED)
         if img is None:
-            raise ValueError("cv2.imdecode failed for field %r" % unischema_field.name)
+            raise DecodeFieldError(
+                "cv2.imdecode failed for field %r (stream is corrupt or uses a JPEG "
+                "family cv2 does not support, e.g. lossless)" % unischema_field.name)
         return img.astype(np.dtype(unischema_field.numpy_dtype), copy=False)
 
     def host_stage_decode(self, unischema_field, encoded):
@@ -272,12 +276,22 @@ class CompressedImageCodec(DataframeColumnCodec):
         the device-decoded rows."""
         if not self.device_decodable:
             raise NotImplementedError("on-device decode is only available for jpeg")
+        from petastorm_tpu.errors import DecodeFieldError
         from petastorm_tpu.ops.jpeg import entropy_decode_jpeg_fast
 
         try:
             return entropy_decode_jpeg_fast(bytes(encoded))
-        except ValueError:
-            return self.decode(unischema_field, encoded)
+        except ValueError as stage_err:
+            try:
+                return self.decode(unischema_field, encoded)
+            except DecodeFieldError as host_err:
+                # neither path can decode this stream (e.g. lossless or
+                # arithmetic-coded JPEG): surface ONE error naming the field and
+                # both failures instead of an opaque cv2 message from the pool
+                raise DecodeFieldError(
+                    "Field %r: stream is decodable by neither the two-stage device "
+                    "path (%s) nor host cv2 (%s)"
+                    % (unischema_field.name, stage_err, host_err)) from host_err
 
     def host_stage_decode_batch(self, unischema_field, values):
         """Sequence of encoded blobs (``None`` entries preserved) → list of staging

@@ -4,8 +4,8 @@ dp/pp/ep/sp/tp). Lazy imports keep base import light (flax/jax only load on use)
 
 
 def __getattr__(name):
-    if name in ("ResNet", "ResNet18", "ResNet50", "ResNet101", "ResNet152",
-                "BottleneckBlock"):
+    if name in ("ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
+                "BasicBlock", "BottleneckBlock"):
         from petastorm_tpu.models import resnet
 
         return getattr(resnet, name)

@@ -17,6 +17,30 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
+class BasicBlock(nn.Module):
+    """Two 3x3 convs — the ResNet18/34 block (He et al. 2015, table 1)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 (self.strides, self.strides), name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: int
@@ -47,6 +71,7 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    block_cls: ModuleDef = BottleneckBlock
 
     @nn.compact
     def __call__(self, x, train=True):
@@ -64,14 +89,15 @@ class ResNet(nn.Module):
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = BottleneckBlock(self.num_filters * 2 ** i, strides,
-                                    conv=conv, norm=norm, act=act)(x)
+                x = self.block_cls(self.num_filters * 2 ** i, strides,
+                                   conv=conv, norm=norm, act=act)(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
         return x.astype(jnp.float32)
 
 
-ResNet18 = functools.partial(ResNet, stage_sizes=[2, 2, 2, 2])   # basic-block depth kept
-ResNet50 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3])   # bottleneck as above
+ResNet18 = functools.partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock)
+ResNet34 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BasicBlock)
+ResNet50 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3])
 ResNet101 = functools.partial(ResNet, stage_sizes=[3, 4, 23, 3])
 ResNet152 = functools.partial(ResNet, stage_sizes=[3, 8, 36, 3])

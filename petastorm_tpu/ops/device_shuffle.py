@@ -26,16 +26,39 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _exchange(store, batch, key):
+def _partial_fisher_yates(idx, key, b):
+    """First ``b`` steps of a Fisher–Yates pass over the persistent permutation ``idx``.
+
+    Returns (new_idx, slots): ``slots`` is a uniformly random ordered ``b``-subset of
+    ``[0, cap)`` — regardless of the permutation ``idx`` starts as — and ``new_idx`` is
+    again a permutation, so successive draws stay uniform. Cost is ``O(b)`` updates on
+    the donated carry (vs ``O(capacity)`` for a full ``jax.random.permutation``), which
+    keeps the per-exchange cost flat as the ring grows to HBM scale.
+    """
+    cap = idx.shape[0]
+    bits = jax.random.bits(key, (b,), jnp.uint32)
+    span = (cap - jnp.arange(b)).astype(jnp.uint32)
+    # modulo draw of j_i ∈ [i, cap); bias ≤ cap/2**32 per draw — immaterial for shuffle
+    js = jnp.arange(b, dtype=jnp.int32) + (bits % span).astype(jnp.int32)
+
+    def step(carry, args):
+        i, j = args
+        vi = carry[i]
+        vj = carry[j]
+        return carry.at[i].set(vj).at[j].set(vi), vj
+
+    return jax.lax.scan(step, idx, (jnp.arange(b, dtype=jnp.int32), js))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _exchange(store, idx, batch, key):
     """Pick ``b`` distinct slots; emit their rows; overwrite them with ``batch``."""
-    cap = next(iter(store.values())).shape[0]
     b = next(iter(batch.values())).shape[0]
-    slots = jax.random.permutation(key, cap)[:b]
+    idx, slots = _partial_fisher_yates(idx, key, b)
     out = {k: store[k][slots] for k in store}
     new_store = {k: store[k].at[slots].set(batch[k].astype(store[k].dtype))
                  for k in store}
-    return new_store, out
+    return new_store, idx, out
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -79,6 +102,7 @@ class DeviceShuffleBuffer:
         self._key = jax.random.PRNGKey(int(seed))
         self._fill_rows = 0
         self._store = None
+        self._idx = None
         self._batch_rows = None
         self._shardings = shardings
         self._short_warmup = False
@@ -105,6 +129,7 @@ class DeviceShuffleBuffer:
             else:
                 store[name] = jnp.zeros(shape, arr.dtype)
         self._store = store
+        self._idx = jnp.arange(self.capacity, dtype=jnp.int32)
 
     def push(self, batch):
         """Insert a device batch; returns the displaced batch once warm, else None."""
@@ -130,8 +155,14 @@ class DeviceShuffleBuffer:
             self._store = _fill(self._store, batch, jnp.int32(self._fill_rows))
             self._fill_rows += b
             return None
+        if b > self._batch_rows:
+            # an oversized batch would wrap the Fisher–Yates span (uint32) and the
+            # clamped scatter would silently drop rows — refuse loudly instead
+            raise ValueError(
+                "batches must not exceed the first batch's row count (%d), got %d"
+                % (self._batch_rows, b))
         self._key, sub = jax.random.split(self._key)
-        self._store, out = _exchange(self._store, batch, sub)
+        self._store, self._idx, out = _exchange(self._store, self._idx, batch, sub)
         return out
 
     def drain(self, batch_rows=None):
@@ -148,6 +179,7 @@ class DeviceShuffleBuffer:
         shuffled = {k: v[perm] for k, v in self._store.items()}
         filled = self._fill_rows
         self._store = None
+        self._idx = None
         self._fill_rows = 0
         for start in range(0, filled, b):
             yield {k: v[start:start + b] for k, v in shuffled.items()}

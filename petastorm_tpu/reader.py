@@ -31,6 +31,7 @@ import numpy as np
 from petastorm_tpu.cache import make_cache
 from petastorm_tpu.errors import (
     PERMANENT_IO_ERRORS as _PERMANENT_IO_ERRORS,
+    DecodeFieldError,
     NoDataAvailableError,
 )
 from petastorm_tpu.fs import get_filesystem_and_path_or_paths
@@ -295,19 +296,29 @@ class PyDictWorker(_WorkerBase):
         decode_view = self._stored_schema.create_schema_view(
             [c for c in table.column_names if c in self._stored_schema.fields]
         )
-        staged = {}
-        for name in self._device_fields:
-            # whole-row-group batched stage 1 (one native call), same as the batch path;
-            # decode_row then just picks up each row's pre-staged payload
-            field = decode_view.fields.get(name)
-            batch_stage = getattr(field.codec, "host_stage_decode_batch", None) \
-                if field is not None else None
-            if batch_stage is not None:
-                staged[name] = batch_stage(field, [r.get(name) for r in stored_rows])
-        rows = []
-        for i, r in enumerate(stored_rows):
-            prestaged = {name: col[i] for name, col in staged.items()}
-            rows.append(decode_row(r, decode_view, self._device_fields, prestaged))
+        try:
+            staged = {}
+            for name in self._device_fields:
+                # whole-row-group batched stage 1 (one native call), same as the batch
+                # path; decode_row then just picks up each row's pre-staged payload
+                field = decode_view.fields.get(name)
+                batch_stage = getattr(field.codec, "host_stage_decode_batch", None) \
+                    if field is not None else None
+                if batch_stage is not None:
+                    try:
+                        staged[name] = batch_stage(
+                            field, [r.get(name) for r in stored_rows])
+                    except DecodeFieldError:
+                        raise
+                    except Exception as e:  # noqa: BLE001 — decode_row contract
+                        raise DecodeFieldError(
+                            "Unable to decode field %r: %s" % (name, e)) from e
+            rows = []
+            for i, r in enumerate(stored_rows):
+                prestaged = {name: col[i] for name, col in staged.items()}
+                rows.append(decode_row(r, decode_view, self._device_fields, prestaged))
+        except DecodeFieldError as e:
+            raise _annotate_decode_error(e, piece) from e
         return rows
 
     def _form_ngram_dicts(self, rows):
@@ -369,9 +380,23 @@ class ArrowWorker(_WorkerBase):
         out = {}
         for name in wanted:
             if name in table.column_names:
-                out[name] = _column_to_numpy(table, name, self._read_schema,
-                                             self._device_fields)
+                try:
+                    out[name] = _column_to_numpy(table, name, self._read_schema,
+                                                 self._device_fields)
+                except DecodeFieldError as e:
+                    raise _annotate_decode_error(e, piece) from e
+                except Exception as e:  # noqa: BLE001 — reference decode_row contract
+                    raise _annotate_decode_error(
+                        DecodeFieldError("Unable to decode field %r: %s" % (name, e)),
+                        piece) from e
         return out
+
+
+def _annotate_decode_error(err, piece):
+    """Attach the failing row group's identity to a decode error — at pod scale 'which
+    file, which group' is the difference between a fixable corpus bug and a mystery."""
+    return DecodeFieldError(
+        "%s (while decoding %s row group %d)" % (err, piece.path, piece.row_group))
 
 
 def _merge_tables(head, tail):

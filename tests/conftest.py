@@ -20,6 +20,15 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # real-service smoke markers (tests/test_smoke_real_services.py): opt-in via env
+    # vars; unconfigured runs skip cleanly. README "Real-service smoke tests".
+    for marker, svc in [("gcs", "Google Cloud Storage"), ("s3", "Amazon S3"),
+                       ("hdfs", "an HDFS cluster"), ("spark", "a real pyspark session")]:
+        config.addinivalue_line(
+            "markers", "%s: smoke test against %s (needs credentials/env)" % (marker, svc))
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.RandomState(42)

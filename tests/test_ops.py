@@ -206,3 +206,65 @@ def test_device_shuffle_short_batch_mid_warmup_raises():
     buf2.push({"y": jnp.arange(8, 12, dtype=jnp.int32)})
     ids = np.concatenate([np.asarray(o["y"]) for o in buf2.drain()])
     assert sorted(ids.tolist()) == list(range(12))
+
+
+def test_device_shuffle_oversized_batch_raises():
+    """Review r4: a post-warmup batch larger than the first batch would wrap the
+    Fisher–Yates span and silently drop rows via clamped scatters — must refuse."""
+    buf = DeviceShuffleBuffer(8, seed=0)
+    buf.push({"y": jnp.arange(4, dtype=jnp.int32)})
+    buf.push({"y": jnp.arange(4, 8, dtype=jnp.int32)})  # warm
+    with pytest.raises(ValueError, match="must not exceed"):
+        buf.push({"y": jnp.arange(8, 24, dtype=jnp.int32)})
+
+
+def test_device_shuffle_slot_draw_uniform():
+    """The O(b) partial Fisher–Yates draw is distributionally sound: every slot of the
+    ring is displaced with roughly equal frequency over many exchanges (a biased draw —
+    e.g. one that favoured low slots — would starve rows in unfavoured slots and stretch
+    the decorrelation window)."""
+    from petastorm_tpu.ops.device_shuffle import _partial_fisher_yates
+
+    cap, b, rounds = 32, 8, 400
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    key = jax.random.PRNGKey(11)
+    counts = np.zeros(cap, dtype=np.int64)
+    draw = jax.jit(_partial_fisher_yates, static_argnums=(2,), donate_argnums=(0,))
+    for _ in range(rounds):
+        key, sub = jax.random.split(key)
+        idx, slots = draw(idx, sub, b)
+        s = np.asarray(slots)
+        assert len(set(s.tolist())) == b  # distinct within an exchange
+        counts[s] += 1
+    expected = rounds * b / cap  # 100 per slot
+    assert counts.min() > expected * 0.6 and counts.max() < expected * 1.4
+
+
+def test_device_shuffle_exchange_cost_flat_in_capacity():
+    """VERDICT r3 #6: the per-exchange slot draw must be O(batch), not O(capacity).
+    Measured as wall time of the steady-state exchange at two capacities 64x apart;
+    the old full-permutation draw scaled linearly (64x work), the partial Fisher–Yates
+    draw touches O(b) elements either way."""
+    import time
+
+    def steady_exchange_time(capacity, b=64, reps=30):
+        buf = DeviceShuffleBuffer(capacity, seed=0)
+        batch = {"y": jnp.arange(b, dtype=jnp.int32)}
+        while buf.filled < buf.capacity if buf.capacity else True:
+            if buf.push(dict(batch)) is not None:
+                break
+            if buf.capacity is not None and buf.filled >= buf.capacity:
+                break
+        out = buf.push(dict(batch))  # compile the exchange
+        jax.block_until_ready(out["y"])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = buf.push(dict(batch))
+        jax.block_until_ready(out["y"])
+        return (time.perf_counter() - t0) / reps
+
+    small = steady_exchange_time(1024)
+    large = steady_exchange_time(65536)
+    # linear-in-capacity scaling would be ~64x; require well under that with slack
+    # for timer noise on a busy CI host
+    assert large < small * 8 + 2e-3, (small, large)
