@@ -44,11 +44,13 @@ class DataframeColumnCodec:
             "%s does not support on-device decode" % type(self).__name__
         )
 
-    def device_decode_batch(self, unischema_field, staged, resize_to=None):
+    def device_decode_batch(self, unischema_field, staged, resize_to=None,
+                            sharding=None):
         """On-device decode path, device half: list of staging objects (one per row) →
         one batched device array matching :meth:`decode`'s per-row output contract.
         ``resize_to=(h, w)`` (image codecs) asks for an on-device resize to one
-        static shape so mixed-size stores can batch."""
+        static shape so mixed-size stores can batch. ``sharding`` (optional batch-axis
+        sharding) asks the decode to run SPMD — one batch shard per device."""
         raise NotImplementedError(
             "%s does not support on-device decode" % type(self).__name__
         )
@@ -327,7 +329,8 @@ class CompressedImageCodec(DataframeColumnCodec):
                 else self.host_stage_decode(unischema_field, blobs[j])
         return out
 
-    def device_decode_batch(self, unischema_field, staged, resize_to=None):
+    def device_decode_batch(self, unischema_field, staged, resize_to=None,
+                            sharding=None):
         """Coefficient planes (one per row) → (n, ...) uint8 device array, one batched
         Pallas dispatch. Matches :meth:`decode`'s per-row contract: cv2 returns images
         in stored (BGR for color) channel order and 2-D for grayscale fields, so the
@@ -337,7 +340,11 @@ class CompressedImageCodec(DataframeColumnCodec):
 
         ``resize_to=(h, w)`` enables mixed-size stores: device rows resize on device
         after decode (:func:`petastorm_tpu.ops.jpeg.resize_image_batch`), host
-        fallbacks via ``cv2.resize`` INTER_LINEAR — the matching sampling."""
+        fallbacks via ``cv2.resize`` INTER_LINEAR — the matching sampling.
+
+        ``sharding``: optional batch-axis sharding; the coefficient slabs are placed
+        across its devices before the stage-2 jit so decode runs SPMD (one batch
+        shard per device) instead of serializing on the default device."""
         if not self.device_decodable:
             raise NotImplementedError("on-device decode is only available for jpeg")
         import jax.numpy as jnp
@@ -354,7 +361,7 @@ class CompressedImageCodec(DataframeColumnCodec):
         order = []
         if plane_idx:
             img = decode_jpeg_batch([staged[i] for i in plane_idx],
-                                    resize_to=resize_to)
+                                    resize_to=resize_to, sharding=sharding)
             img = img[..., 0] if grayscale else img[..., ::-1]
             parts.append(img)
             order.extend(plane_idx)

@@ -503,14 +503,21 @@ class DataLoader:
                     "Field %r has null rows; nullable columns are not supported with "
                     "decode_on_device (pad or filter nulls upstream)" % name
                 )
+            base_s = None
+            if self.sharding is not None:
+                base_s = self.sharding.get(name) \
+                    if isinstance(self.sharding, dict) else self.sharding
+            decode_s = _decode_sharding(base_s, len(staged)) \
+                if base_s is not None else None
             rt = self._device_decode_resize
             if isinstance(rt, dict):
                 rt = rt.get(name)
+            # sharding passed only when resolved: codec subclasses predating the
+            # sharding kwarg keep working for the unsharded case
+            kwargs = {} if decode_s is None else {"sharding": decode_s}
             if rt is not None:
-                out = field.codec.device_decode_batch(field, staged,
-                                                      resize_to=tuple(rt))
-            else:
-                out = field.codec.device_decode_batch(field, staged)
+                kwargs["resize_to"] = tuple(rt)
+            out = field.codec.device_decode_batch(field, staged, **kwargs)
             if self.sharding is not None:
                 s = self.sharding.get(name) if isinstance(self.sharding, dict) \
                     else _matching_sharding(self.sharding, out)
@@ -880,6 +887,42 @@ def _batch_shard_count(sharding):
         axes = (spec0,) if isinstance(spec0, str) else tuple(spec0)
         return int(np.prod([sharding.mesh.shape[a] for a in axes]))
     return 1
+
+
+def _decode_sharding(s, local_rows):
+    """Batch-axis sharding to SPMD-decode staged payloads under (VERDICT r3 #2).
+
+    Single-process: the loader's sharding itself (stage 2 consumes its mesh + batch
+    axis; trailing axes are replicated per slab inside the decode). Multi-process: a
+    global ``NamedSharding`` cannot place host data, so derive a process-LOCAL 1-D
+    mesh whose device order mirrors ``s``'s local batch-slice order — decode output
+    shards then already sit where ``make_array_from_process_local_data`` wants them.
+    Returns None when the batch axis is unsharded or does not divide — decode then
+    runs on the default device exactly as before (correct, just unscaled)."""
+    import jax
+    import jax.sharding as jsh
+
+    if not isinstance(s, jsh.NamedSharding) or not len(s.spec) or s.spec[0] is None:
+        return None
+    if jax.process_count() == 1:
+        return s
+    axis = s.spec[0]
+    s1 = jsh.NamedSharding(s.mesh, jsh.PartitionSpec(axis))
+    global_rows = local_rows * jax.process_count()
+    try:
+        imap = s1.addressable_devices_indices_map((global_rows,))
+    except ValueError:
+        return None
+    by_start = {}
+    for dev, idx in imap.items():
+        sl = idx[0]
+        start = 0 if sl.start is None else int(sl.start)
+        by_start.setdefault(start, dev)  # one device per distinct slice (replicas skip)
+    devs = [by_start[k] for k in sorted(by_start)]
+    if len(devs) <= 1 or local_rows % len(devs) != 0:
+        return None
+    mesh = jsh.Mesh(np.asarray(devs), ("_decode_batch",))
+    return jsh.NamedSharding(mesh, jsh.PartitionSpec("_decode_batch"))
 
 
 def _matching_sharding(sharding, arr):

@@ -683,7 +683,7 @@ def resize_image_batch(img, target):
     return jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
 
 
-def decode_jpeg_batch(planes_list, resize_to=None):
+def decode_jpeg_batch(planes_list, resize_to=None, sharding=None):
     """Batched stage 2: list of :class:`JpegPlanes` → (n, h, w, 3) uint8 ``jax.Array``.
 
     Without ``resize_to`` all images must share height/width (resize on write, or use
@@ -693,7 +693,12 @@ def decode_jpeg_batch(planes_list, resize_to=None):
     ``resize_to=(h, w)`` lifts the uniform-size requirement for mixed-size stores
     (raw ImageNet-style corpora): each same-layout group decodes at its stored size
     and is bilinearly resized ON DEVICE to the target (``resize_image_batch``), so
-    every batch leaves with one static shape regardless of composition."""
+    every batch leaves with one static shape regardless of composition.
+
+    ``sharding``: optional batch-axis sharding (e.g. the loader's). Coefficient slabs
+    are placed across its devices before the stage-2 jit, so decode runs SPMD — one
+    batch shard per device — and the output is already laid out for consumption
+    (single-layout batches; mixed-layout re-gathers may reshard)."""
     import jax.numpy as jnp
 
     if not planes_list:
@@ -711,13 +716,13 @@ def decode_jpeg_batch(planes_list, resize_to=None):
         groups.setdefault(_layout_key(p), []).append(i)
     if len(groups) == 1:
         layout, = groups
-        out = _decode_group(layout, planes_list)
+        out = _decode_group(layout, planes_list, sharding=sharding)
         return resize_image_batch(out, resize_to) if resize_to is not None else out
     parts = []
     order = []
     for layout, indices in groups.items():
         group = [planes_list[i] for i in indices]
-        decoded = _decode_group(layout, group)
+        decoded = _decode_group(layout, group, sharding=sharding)
         if resize_to is not None:
             decoded = resize_image_batch(decoded, resize_to)
         parts.append(decoded)
@@ -859,14 +864,56 @@ def _split_points(profile, ks, layout):
     return spec
 
 
-def _decode_group(layout, group):
+def _batch_axis_shards(sharding):
+    """Distinct batch-axis slice count under ``sharding`` (0 = not a batch sharding)."""
+    import jax.sharding as jsh
+
+    if not isinstance(sharding, jsh.NamedSharding) or not len(sharding.spec):
+        return 0
+    axis = sharding.spec[0]
+    if axis is None:
+        return 0
+    names = axis if isinstance(axis, tuple) else (axis,)
+    size = 1
+    for nm in names:
+        size *= sharding.mesh.shape[nm]
+    return size
+
+
+def _shard_decode_inputs(tree, sharding, n):
+    """``device_put`` host staging slabs with ``sharding``'s batch axis (trailing axes
+    replicated) so the stage-2 jit runs SPMD over every device instead of serializing
+    decode on the default chip (VERDICT r3 #2: on a pod host with 4–8 local chips,
+    single-device dispatch makes one chip the decode bottleneck while its siblings
+    idle, then pays an extra D2D hop at assembly). No-op when the batch does not
+    divide the shard count — single-device decode stays correct, just unscaled."""
+    shards = _batch_axis_shards(sharding)
+    if shards <= 1 or n % shards != 0:
+        return tree
+    import jax
+    import jax.sharding as jsh
+
+    axis = sharding.spec[0]
+
+    def put(a):
+        spec = jsh.PartitionSpec(axis, *([None] * (a.ndim - 1)))
+        return jax.device_put(a, jsh.NamedSharding(sharding.mesh, spec))
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def _decode_group(layout, group, sharding=None):
     """One same-layout group → device decode. Transfer narrowing, exact and
     composable: (a) ship only the zigzag prefix when the batch's kmax says the rest
     of the spectrum is zero; (b) split what ships into per-position bit widths from
     the row group's measured spectral ranges (12-bit head / int8 mid / 4-bit tail);
     (c) 12-bit-pack components the split can't help. Sharp photographic content
     defeats (a) (kmax ≈ 63) but (b) still halves the 12-bit bytes — high zigzag
-    positions are heavily quantized; smooth content composes (a)+(b)."""
+    positions are heavily quantized; smooth content composes (a)+(b).
+
+    ``sharding``: optional batch-axis sharding; staged inputs are placed across its
+    devices so dequant+IDCT+upsample+color runs SPMD (one shard of the batch per
+    device) instead of on the default device only."""
     coeffs, qtabs = stack_jpeg_coefficients(group)
     from petastorm_tpu.ops import native
 
@@ -876,6 +923,9 @@ def _decode_group(layout, group):
         with _STICKY_KS_LOCK:
             _TRANSFER_BYTES["raw"] += full
             _TRANSFER_BYTES["shipped"] += full
+        if sharding is not None:
+            coeffs, qtabs = _shard_decode_inputs(
+                (coeffs, qtabs), sharding, coeffs[0].shape[0])
         return _batched_stage2(layout)(coeffs, qtabs)
     ks = _truncation_ks(group, layout)
     if ks is not None:
@@ -937,5 +987,8 @@ def _decode_group(layout, group):
     with _STICKY_KS_LOCK:
         _TRANSFER_BYTES["raw"] += raw_bytes
         _TRANSFER_BYTES["shipped"] += shipped_bytes
+    shipped = tuple(shipped)
+    if sharding is not None:
+        shipped, qtabs = _shard_decode_inputs((shipped, qtabs), sharding, n)
     return _batched_stage2(layout, ks, tuple(packed), tuple(split))(
-        tuple(shipped), qtabs)
+        shipped, qtabs)

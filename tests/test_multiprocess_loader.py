@@ -55,6 +55,7 @@ def test_two_process_global_array_assembly(tmp_path):
             "PTPU_MP_NPROC": "2",
             "PTPU_MP_URL": url,
             "PTPU_MP_JPEG_URL": jpeg_url,
+            "PTPU_MP_CKPT": str(tmp_path / "pod_ckpt"),
             "PTPU_MP_OUT": str(out_file),
             "PYTHONPATH": _REPO + os.pathsep + _HERE,
         })
@@ -86,6 +87,12 @@ def test_two_process_global_array_assembly(tmp_path):
         assert r["decode_image_device_count"] == 8  # global assembly across the mesh
         assert r["decode_assembly_input_types"] == ["ArrayImpl"], \
             "pixel assembly saw host arrays: %s" % r["decode_assembly_input_types"]
+        # SPMD stage 2 (VERDICT r3 #2): the local decode output handed to assembly is
+        # already sharded across ALL of this process's devices (4 of the 8-device
+        # mesh), not resident on the default chip only
+        assert r["decode_assembly_input_devices"] == [4], \
+            "decode ran on %s devices, want SPMD over local 4" % \
+            r["decode_assembly_input_devices"]
         assert r["decode_pixel_sum"] > 0
     d0 = set(results[0]["decode_local_ids"])
     d1 = set(results[1]["decode_local_ids"])
@@ -105,6 +112,19 @@ def test_two_process_global_array_assembly(tmp_path):
     # the two processes' shares are disjoint
     assert not set(results[0]["inmem_epoch0_local_ids"]) & \
         set(results[1]["inmem_epoch0_local_ids"])
+
+    # checkpoint phase (VERDICT r3 #3): one shared orbax save mid-epoch captured BOTH
+    # processes' cursors; after restore each process resumed ITS exact cursor — every
+    # shard row delivered exactly once across pre-save + post-restore, pod-wide
+    covered = []
+    for r in results:
+        rows = r["ckpt_pre"] + r["ckpt_post"]
+        assert len(rows) == len(set(rows)), "rows replayed after restore"
+        covered.append(set(rows))
+    assert not covered[0] & covered[1]  # shards stayed disjoint through the restore
+    assert covered[0] | covered[1] == set(range(64))  # nothing lost pod-wide
+    # asymmetric consumption survived the round trip: distinct per-process cursors
+    assert len(results[0]["ckpt_pre"]) != len(results[1]["ckpt_pre"])
 
 
 def test_local_batch_size_uneven_mesh_math():

@@ -26,11 +26,29 @@ import pytest
 pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
 
 
-def _remote_url(env_var):
+@pytest.fixture
+def _cleanup_urls():
+    """Best-effort teardown of datasets a smoke test wrote to the real service —
+    repeated runs must not accrete uuid-suffixed corpora in the user's bucket."""
+    urls = []
+    yield urls
+    from petastorm_tpu.fs import get_filesystem_and_path_or_paths
+
+    for url in urls:
+        try:
+            fs, path = get_filesystem_and_path_or_paths(url)
+            fs.delete_dir(path)
+        except Exception:  # noqa: BLE001 — cleanup failure must not fail the test
+            pass
+
+
+def _remote_url(env_var, cleanup):
     base = os.environ.get(env_var)
     if not base:
         pytest.skip("%s not set — real-service smoke disabled" % env_var)
-    return base.rstrip("/") + "/" + uuid.uuid4().hex
+    url = base.rstrip("/") + "/" + uuid.uuid4().hex
+    cleanup.append(url)
+    return url
 
 
 def _roundtrip_store(url):
@@ -62,22 +80,22 @@ def _flat_listing(url):
 
 
 @pytest.mark.gcs
-def test_gcs_roundtrip_and_listing():
-    url = _remote_url("PTPU_SMOKE_GCS_URL")
+def test_gcs_roundtrip_and_listing(_cleanup_urls):
+    url = _remote_url("PTPU_SMOKE_GCS_URL", _cleanup_urls)
     _roundtrip_store(url)
     _flat_listing(url)
 
 
 @pytest.mark.s3
-def test_s3_roundtrip_and_listing():
-    url = _remote_url("PTPU_SMOKE_S3_URL")
+def test_s3_roundtrip_and_listing(_cleanup_urls):
+    url = _remote_url("PTPU_SMOKE_S3_URL", _cleanup_urls)
     _roundtrip_store(url)
     _flat_listing(url)
 
 
 @pytest.mark.hdfs
-def test_hdfs_roundtrip():
-    url = _remote_url("PTPU_SMOKE_HDFS_URL")
+def test_hdfs_roundtrip(_cleanup_urls):
+    url = _remote_url("PTPU_SMOKE_HDFS_URL", _cleanup_urls)
     _roundtrip_store(url)
 
 
