@@ -96,7 +96,7 @@ def loader_throughput(loader, consume_fn=None, warmup_batches=4, measure_batches
 
 
 def overlap_throughput(loader, step_fn, warmup_batches=3, measure_batches=30,
-                       headroom=1.3, step_repeats=None):
+                       headroom=1.3, step_repeats=None, deadline=None):
     """The north-star measurement (BASELINE.md: device idle ≤ 2%): overlap the pipeline
     with device work sized ≥ the pipeline's per-batch cost and report the consumer's
     starvation — ``device_queue_wait_s / wall`` — as the device-idle fraction.
@@ -112,6 +112,10 @@ def overlap_throughput(loader, step_fn, warmup_batches=3, measure_batches=30,
     ``step_fn(batch) -> device value`` must be an async-dispatching jitted function.
     The step runs ``step_repeats`` times per batch; when None it is auto-calibrated so
     ``step_repeats × step_time ≥ headroom × pipeline-interval``.
+
+    ``deadline`` (optional ``time.perf_counter()`` value): adaptive re-measures are
+    skipped once past it, so a caller budgeting a whole bench run can bound this
+    call's worst case under degraded service weather.
     """
     import jax
 
@@ -208,6 +212,8 @@ def overlap_throughput(loader, step_fn, warmup_batches=3, measure_batches=30,
     # the observed idle IS the answer then, however large.
     for _ in range(2 if not fixed_repeats else 0):
         if res.device_idle_fraction is None or res.device_idle_fraction <= 0.1:
+            break
+        if deadline is not None and time.perf_counter() > deadline:
             break
         per_batch_wall = res.seconds / max(1, res.batches)
         step_repeats = max(step_repeats + 1,

@@ -135,16 +135,32 @@ def _allgather_states(key, state):
     buf[: len(payload)] = np.frombuffer(payload, np.uint8)
     gathered = multihost_utils.process_allgather(buf)
     gathered = np.asarray(gathered).reshape(jax.process_count(), maxlen)
+    pairs = [json.loads(bytes(gathered[i, : int(lens[i])]).decode("utf-8"))
+             for i in range(gathered.shape[0])]
+    return _merge_states(pairs)
+
+
+def _merge_states(pairs):
+    """Merge gathered ``[shard_key, state]`` pairs into ``{shard_key: state}``.
+
+    Replica groups (several processes reading the SAME shard, e.g. dp replication
+    over a 2-way-sharded store) gather duplicate keys, possibly with timing skew
+    between replicas' consumed sets: keep the LEAST-consumed state so every replica
+    resumes at-least-once (the row-group-granularity contract) instead of refusing
+    to save the whole composite."""
     out = {}
-    for i in range(gathered.shape[0]):
-        k, st = json.loads(bytes(gathered[i, : int(lens[i])]).decode("utf-8"))
-        if k in out:
-            raise ValueError(
-                "Two processes claim shard key %r — pass distinct cur_shard values "
-                "(e.g. cur_shard=jax.process_index()) so the checkpoint can route "
-                "states on restore" % k)
+    for k, st in pairs:
+        k = str(k)
+        if k in out and out[k] != st:
+            if _consumed_count(st) < _consumed_count(out[k]):
+                out[k] = st
+            continue
         out[k] = st
     return out
+
+
+def _consumed_count(state):
+    return sum(len(v) for v in state.get("consumed", {}).values())
 
 
 def _epath(path):

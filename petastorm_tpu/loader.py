@@ -512,9 +512,13 @@ class DataLoader:
             rt = self._device_decode_resize
             if isinstance(rt, dict):
                 rt = rt.get(name)
-            # sharding passed only when resolved: codec subclasses predating the
-            # sharding kwarg keep working for the unsharded case
+            # sharding passed only when resolved AND the codec's signature takes it:
+            # third-party codec subclasses predating the kwarg keep decoding
+            # single-device (their output is resharded below — the old behavior)
             kwargs = {} if decode_s is None else {"sharding": decode_s}
+            if "sharding" in kwargs and not _accepts_kwarg(
+                    field.codec.device_decode_batch, "sharding"):
+                kwargs.pop("sharding")
             if rt is not None:
                 kwargs["resize_to"] = tuple(rt)
             out = field.codec.device_decode_batch(field, staged, **kwargs)
@@ -876,17 +880,23 @@ def _resolve_local_batch(batch_size, sharding):
 
 
 def _batch_shard_count(sharding):
-    """How many ways the sharding splits the batch (leading) axis; 1 when replicated
-    or not a NamedSharding (single-device placements always lay out any row count)."""
-    import jax.sharding as jsh
+    """See :func:`petastorm_tpu.parallel.mesh.batch_axis_shard_count` (shared with
+    the decode op's SPMD input staging)."""
+    from petastorm_tpu.parallel.mesh import batch_axis_shard_count
 
-    if isinstance(sharding, jsh.NamedSharding):
-        spec0 = sharding.spec[0] if len(sharding.spec) else None
-        if spec0 is None:
-            return 1
-        axes = (spec0,) if isinstance(spec0, str) else tuple(spec0)
-        return int(np.prod([sharding.mesh.shape[a] for a in axes]))
-    return 1
+    return batch_axis_shard_count(sharding)
+
+
+def _accepts_kwarg(fn, name):
+    """True when ``fn`` can be called with keyword ``name`` (or takes **kwargs)."""
+    import inspect
+
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return True  # uninspectable callables: assume modern signature
+    return name in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values())
 
 
 def _decode_sharding(s, local_rows):

@@ -87,6 +87,25 @@ def sequence_sharding(mesh, batch_axis="dp", seq_axis="sp"):
     return NamedSharding(mesh, PartitionSpec(b, s))
 
 
+def batch_axis_shard_count(sharding):
+    """How many distinct slices a sharding cuts its batch (leading) axis into.
+
+    1 = replicated/unsharded batch axis or not a ``NamedSharding`` (single-device
+    placements lay out any row count). Shared by the loader's layout checks and the
+    decode op's SPMD input staging — one definition, so they always agree on
+    whether a batch is shardable."""
+    import jax.sharding as jsh
+    import numpy as np
+
+    if isinstance(sharding, jsh.NamedSharding):
+        spec0 = sharding.spec[0] if len(sharding.spec) else None
+        if spec0 is None:
+            return 1
+        axes = (spec0,) if isinstance(spec0, str) else tuple(spec0)
+        return int(np.prod([sharding.mesh.shape[a] for a in axes]))
+    return 1
+
+
 def local_batch_size(global_batch_size, mesh, batch_axes=("dp",)):
     """Rows this process must feed for a given global batch (multi-host loaders).
 

@@ -134,6 +134,22 @@ def test_global_payload_missing_shard_raises(scalar_dataset):
         ptck.apply(resumed, {ptck._GLOBAL_KEY: {"0": state}})
 
 
+def test_replica_group_duplicate_keys_keep_least_consumed():
+    """Replica pods (several processes reading the SAME shard) may gather duplicate
+    shard keys with timing skew: the payload keeps the least-consumed state so every
+    replica resumes at-least-once — never a refused save (review r4)."""
+    from petastorm_tpu.checkpoint import _merge_states
+
+    ahead = {"plan": {"num_items": 4}, "resume_epoch": 0, "consumed": {0: [0, 1]}}
+    behind = {"plan": {"num_items": 4}, "resume_epoch": 0, "consumed": {0: [0]}}
+    for order in ([["0", ahead], ["0", behind]], [["0", behind], ["0", ahead]]):
+        merged = _merge_states(order + [["1", ahead]])
+        assert merged["0"] == behind  # least-consumed wins, both arrival orders
+        assert merged["1"] == ahead  # distinct shards untouched
+    # identical replicas collapse to one entry without comparison churn
+    assert _merge_states([["0", ahead], ["0", ahead]]) == {"0": ahead}
+
+
 def test_cross_shard_state_raises(scalar_dataset):
     """Loading shard 0's cursor into shard 1's reader must fail loudly — silently
     resuming would replay the wrong rows."""
