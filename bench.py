@@ -161,10 +161,12 @@ def main():
     # caches repeated identical work (measured: re-dispatching one batch through
     # ResNet-50 read 0.01 ms/step; re-putting one buffer read 3 GB/s), so an
     # unvaried repeat measures the cache, not the device.
+    # HOST numpy weights, cast inside the trace: closed-over DEVICE arrays lower as
+    # compile-time constants via D2H fetches that stall behind queued transfers
     rngw = np.random.RandomState(1)
-    w1 = jnp.asarray(rngw.standard_normal((7, 7, 3, 64)) * 0.05, jnp.bfloat16)
-    w2 = jnp.asarray(rngw.standard_normal((3, 3, 64, 64)) * 0.05, jnp.bfloat16)
-    w3 = jnp.asarray(rngw.standard_normal((3, 3, 64, 128)) * 0.05, jnp.bfloat16)
+    w1 = (rngw.standard_normal((7, 7, 3, 64)) * 0.05).astype(np.float32)
+    w2 = (rngw.standard_normal((3, 3, 64, 64)) * 0.05).astype(np.float32)
+    w3 = (rngw.standard_normal((3, 3, 64, 128)) * 0.05).astype(np.float32)
 
     @jax.jit
     def _step(image, label, t):
@@ -172,7 +174,9 @@ def main():
             + t.astype(jnp.bfloat16)
         dn = jax.lax.conv_dimension_numbers(x.shape, w1.shape, ("NHWC", "HWIO", "NHWC"))
         for w in (w1, w2, w3):
-            x = jax.lax.conv_general_dilated(x, w, (2, 2), "SAME", dimension_numbers=dn)
+            wb = jnp.asarray(w, jnp.bfloat16)
+            x = jax.lax.conv_general_dilated(x, wb, (2, 2), "SAME",
+                                             dimension_numbers=dn)
             x = jnp.maximum(x, 0)
             dn = jax.lax.conv_dimension_numbers(x.shape, w2.shape, ("NHWC", "HWIO", "NHWC"))
         return jnp.sum(x.astype(jnp.float32)) + jnp.sum(label)
@@ -212,6 +216,12 @@ def main():
     for _ in range(3):
         weather["h2d_best_mb_s"] = max(weather["h2d_best_mb_s"], h2d_probe())
 
+    # NOTE: the h2d probes are DIAGNOSTICS, not health inputs. The initial
+    # calibration rides an empty dispatch queue (measured 1.6 GB/s warm-connection
+    # bursts) while per-window probes queue behind the live pipeline's own
+    # transfers (measured 3-20 MB/s) — comparing the two measures contention, not
+    # service weather. Health is judged on the standalone step floor alone.
+
     # Soft wall-clock budget: degraded-weather retries must not run the bench past
     # the driver's timeout — stop opening NEW windows when the budget thins (every
     # measurement still completes at least one window).
@@ -220,15 +230,28 @@ def main():
     def time_left():
         return _budget_s - (time.perf_counter() - _t_main)
 
-    def window_health(step_key, step_s, h2d_mb_s):
-        """Degraded iff this window's standalone step or H2D probe is far off the
-        run's best observed value for the same probe."""
+    # Physics floors (seconds): conv stem b128 ≈ 30 GFLOP, ResNet-50 fwd b128 ≈
+    # 13 GFLOP; v5e peak ~394 TFLOP/s bf16 → absolute best ~0.08 ms / ~0.03 ms,
+    # and the best REAL captures on this chip are 16 ms / 23 ms. A 1 ms floor sits
+    # 13-30x above theoretical peak yet 16x under the best real observation. A
+    # "step" measuring BELOW it is not a fast device — it is the service
+    # acknowledging work without executing it (observed: ResNet-50 b128 "steps" of
+    # 0.2-1.1 ms across a whole run), and every number in that window is
+    # untrustworthy. Floors sit above every observed fake and 6-8x under the best
+    # real captures (16 ms / 23 ms).
+    _PHYSICS_FLOOR_S = {"conv_stem": 2e-3, "resnet50": 4e-3}
+
+    def window_health(step_key, step_s):
+        """Degraded iff this window's standalone step time is far off the run's
+        floor for the same step (the step runs device-resident, so its swing is
+        pure service weather at dispatch/execute, not pipeline load) — or below
+        the physics floor (implausibly fast = fake completion)."""
+        if step_s < _PHYSICS_FLOOR_S.get(step_key, 0.0):
+            return False
         floor = weather["step_floor_s"].get(step_key)
         if floor is None or step_s < floor:
             weather["step_floor_s"][step_key] = floor = step_s
-        weather["h2d_best_mb_s"] = max(weather["h2d_best_mb_s"], h2d_mb_s)
-        return step_s <= 2.5 * floor and \
-            h2d_mb_s >= 0.4 * weather["h2d_best_mb_s"]
+        return step_s <= 2.0 * floor
 
     def measure(decode_on_device, warmup_batches=4, measure_batches=20,
                 max_windows=4, reserve_s=240.0):
@@ -248,7 +271,7 @@ def main():
         )
         loader = DataLoader(reader, BATCH, prefetch=3, host_queue_size=8)
         windows = []
-        best = None
+        cands = []
         with loader:
             it = iter(loader)
             last_batch = None
@@ -280,38 +303,59 @@ def main():
                 jax.block_until_ready(r)
                 dt = time.perf_counter() - t0
                 rows_per_sec = n / dt if dt else 0.0
-                healthy = window_health("conv_stem", step_s, h2d_mb_s)
+                healthy = window_health("conv_stem", step_s)
                 windows.append({
                     "rows_per_sec": round(rows_per_sec, 1),
                     "step_ms": round(step_s * 1e3, 2),
-                    "h2d_mb_s": round(h2d_mb_s, 1),
-                    "healthy": healthy,
+                    "h2d_probe_mb_s": round(h2d_mb_s, 1),  # diagnostic: contends with live pipeline
+                    "healthy": healthy,  # provisional; re-judged vs final floors
                 })
-                if best is None or rows_per_sec > best[0]:
-                    best = (rows_per_sec, dt, batches, loader.stats.snapshot(),
-                            step_s, healthy)
+                cands.append((rows_per_sec, step_s, loader.stats.snapshot()))
                 if (_window >= 1 and healthy) or time_left() < reserve_s:
                     break
-            rows_per_sec, dt, batches, stages, step_s, healthy = best
+        return {"windows": windows, "cands": cands, "step_key": "conv_stem"}
+
+    def finalize_measure(meas):
+        """Re-judge every window against the run's FINAL floors (an early window
+        self-floors when the service is degraded from the start — a later faster
+        window must retroactively demote it), then pick the best: healthy windows
+        outrank unhealthy ones at ANY rows/s (a fake-fast service window can post
+        arbitrary throughput with zero device backpressure and must not become the
+        artifact of record)."""
+        key = meas["step_key"]
+        floor = weather["step_floor_s"].get(key)
+        for w, (rows, step_s, _st) in zip(meas["windows"], meas["cands"]):
+            w["healthy"] = bool(floor is not None
+                                and step_s >= _PHYSICS_FLOOR_S.get(key, 0.0)
+                                and step_s <= 2.0 * floor)
+        i = max(range(len(meas["cands"])),
+                key=lambda j: (meas["windows"][j]["healthy"],
+                               meas["cands"][j][0]))
+        rows, step_s, stages = meas["cands"][i]
         return {
-            "rows_per_sec": rows_per_sec,
+            "rows_per_sec": rows,
             "step_ms": step_s * 1e3,
             "stages": stages,
-            "windows": windows,
-            "healthy_window": healthy,
+            "windows": meas["windows"],
+            "healthy_window": meas["windows"][i]["healthy"],
         }
 
     def make_resnet_step():
         import __graft_entry__ as g
 
         fwd, (variables, _ex) = g.entry()
-        inner = jax.jit(lambda img, t: fwd(variables, img.astype(jnp.float32) + t))
+        # params are an ARGUMENT, never a closure: jit lowers closed-over device
+        # arrays as compile-time constants via a D2H fetch — ~100 MB of ResNet-50
+        # params through a degraded tunnel stalls the compile for minutes (same
+        # pathology as the ops/jpeg.py unzig hang, at 6 orders more bytes)
+        inner = jax.jit(lambda v, img, t: fwd(v, img.astype(jnp.float32) + t))
 
         def jstep(img):
             # distinct jitter per dispatch — see the content-cache note above;
             # without it, overlap calibration reads ~0 ms/step and sizes the
             # "busy device" work at >10k cached no-op repeats
-            return inner(img, np.float32(next(_tick) % 997) * np.float32(1e-6))
+            return inner(variables, img,
+                         np.float32(next(_tick) % 997) * np.float32(1e-6))
 
         return jstep
 
@@ -343,9 +387,8 @@ def main():
             num_epochs=None, decode_on_device=decode_on_device,
         )
         loader = DataLoader(reader, BATCH, prefetch=3, host_queue_size=8)
-        step_key = "resnet50_hostdec" if not decode_on_device else "resnet50_devdec"
         windows = []
-        best = None
+        results = []
         with loader:
             for _window in range(max_windows):
                 res = overlap_throughput(
@@ -353,25 +396,40 @@ def main():
                     measure_batches=measure_batches,
                     deadline=time.perf_counter() + max(30.0, time_left()))
                 h2d_mb_s = h2d_probe()
-                healthy = window_health(step_key, res.step_seconds or 1e-9, h2d_mb_s)
+                # one floor across both overlap modes (same step fn)
+                healthy = window_health("resnet50", res.step_seconds or 1e-9)
                 windows.append({
                     "device_idle_fraction": round(res.device_idle_fraction, 4),
                     "rows_per_sec": round(res.rows_per_second, 1),
                     "step_repeats": res.step_repeats,
                     "step_ms": round((res.step_seconds or 0) * 1e3, 2),
-                    "h2d_mb_s": round(h2d_mb_s, 1),
-                    "healthy": healthy,
+                    "h2d_probe_mb_s": round(h2d_mb_s, 1),  # diagnostic: contends with live pipeline
+                    "healthy": healthy,  # provisional; re-judged vs final floors
                 })
-                if best is None or \
-                        res.device_idle_fraction < best[0].device_idle_fraction:
-                    best = (res, healthy)
+                results.append(res)
                 # one healthy low-idle window proves the north star; otherwise keep
                 # looking for a healthy interval up to the window/time budget
                 if (healthy and res.device_idle_fraction <= 0.05) \
                         or time_left() < reserve_s:
                     break
-        res, healthy = best
-        return res, windows, healthy
+        return {"windows": windows, "results": results, "step_key": "resnet50"}
+
+    def finalize_overlap(meas):
+        """Re-judge windows vs final floors, then pick healthy-first / lowest-idle
+        (a fake-fast window's idle is meaningless — see finalize_measure)."""
+        if meas is None:
+            return None, [], False
+        key = meas["step_key"]
+        floor = weather["step_floor_s"].get(key)
+        for w, res in zip(meas["windows"], meas["results"]):
+            s = res.step_seconds or 1e-9
+            w["healthy"] = bool(floor is not None
+                                and s >= _PHYSICS_FLOOR_S.get(key, 0.0)
+                                and s <= 2.0 * floor)
+        i = max(range(len(meas["results"])),
+                key=lambda j: (meas["windows"][j]["healthy"],
+                               -meas["results"][j].device_idle_fraction))
+        return meas["results"][i], meas["windows"], meas["windows"][i]["healthy"]
 
     host = measure(decode_on_device=False, measure_batches=14, reserve_s=270.0)
     from petastorm_tpu.ops.jpeg import transfer_byte_counters
@@ -390,6 +448,21 @@ def main():
         return None
 
     jstep = attempt(make_resnet_step, "resnet step build")
+    if jstep is not None:
+        # seed the resnet step floor BEFORE the first overlap window: without it the
+        # first window self-floors and its health flag is vacuously true even in a
+        # degraded interval (also warms the compile off the measured windows)
+        def _seed_floor():
+            img = jax.device_put(np.zeros((BATCH,) + IMG, np.uint8))
+            jax.block_until_ready(jstep(img))  # compile
+            t0 = time.perf_counter()
+            r = None
+            for _ in range(10):
+                r = jstep(img)
+            jax.block_until_ready(r)
+            window_health("resnet50", (time.perf_counter() - t0) / 10)
+
+        attempt(_seed_floor, "resnet floor seed", retries=0)
     # hostdec overlap FIRST: it is the north-star number (consumer starvation with a
     # busy device = idle), so it gets budget priority over the device-decode overlap
     hostdec_res = attempt(lambda: measure_overlap(
@@ -398,10 +471,12 @@ def main():
     devdec_res = attempt(lambda: measure_overlap(
         jstep, decode_on_device=True, measure_batches=16, max_windows=2,
         reserve_s=30.0), "devdec overlap") if jstep else None
-    overlap_hostdec, hostdec_windows, hostdec_healthy = \
-        hostdec_res if hostdec_res else (None, [], False)
-    overlap, overlap_windows, overlap_healthy = \
-        devdec_res if devdec_res else (None, [], False)
+    # all measurements done: re-judge every window against the run's final floors
+    # and select bests (finalize_* docstrings)
+    host = finalize_measure(host)
+    device = finalize_measure(device)
+    overlap_hostdec, hostdec_windows, hostdec_healthy = finalize_overlap(hostdec_res)
+    overlap, overlap_windows, overlap_healthy = finalize_overlap(devdec_res)
 
     vs = device["rows_per_sec"] / host["rows_per_sec"] if host["rows_per_sec"] else 1.0
     # NOTE key semantics (r3 judging confusion): the former free-device

@@ -145,22 +145,38 @@ def _merge_states(pairs):
 
     Replica groups (several processes reading the SAME shard, e.g. dp replication
     over a 2-way-sharded store) gather duplicate keys, possibly with timing skew
-    between replicas' consumed sets: keep the LEAST-consumed state so every replica
-    resumes at-least-once (the row-group-granularity contract) instead of refusing
-    to save the whole composite."""
+    between replicas' consumed sets. The merged entry INTERSECTS the replicas'
+    consumed sets per epoch (and takes the min resume epoch): restore then skips
+    only work EVERY replica fully delivered, so each replica resumes at-least-once
+    — a least-consumed-count pick could keep a set some replica never delivered
+    and silently lose its rows. Same-key states must share a plan (same seed/
+    shuffle/epochs): differently-configured readers are not replicas, and routing
+    one of their cursors to the other would replay the wrong rows."""
     out = {}
     for k, st in pairs:
         k = str(k)
         if k in out and out[k] != st:
-            if _consumed_count(st) < _consumed_count(out[k]):
-                out[k] = st
+            prev = out[k]
+            if prev.get("plan") != st.get("plan"):
+                raise ValueError(
+                    "Shard key %r was checkpointed by readers with different plans "
+                    "(%r vs %r) — replicas of one shard must share seed/shuffle/"
+                    "epoch config, or use distinct cur_shard values"
+                    % (k, prev.get("plan"), st.get("plan")))
+            out[k] = _intersect_states(prev, st)
             continue
         out[k] = st
     return out
 
 
-def _consumed_count(state):
-    return sum(len(v) for v in state.get("consumed", {}).values())
+def _intersect_states(a, b):
+    merged = dict(a)
+    merged["resume_epoch"] = min(int(a["resume_epoch"]), int(b["resume_epoch"]))
+    ca = {int(e): set(v) for e, v in a.get("consumed", {}).items()}
+    cb = {int(e): set(v) for e, v in b.get("consumed", {}).items()}
+    merged["consumed"] = {
+        e: sorted(ca[e] & cb[e]) for e in (set(ca) & set(cb)) if ca[e] & cb[e]}
+    return merged
 
 
 def _epath(path):

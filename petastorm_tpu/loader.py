@@ -23,6 +23,7 @@ sharded (``cur_shard=jax.process_index()``); the loader assembles the global arr
 """
 from __future__ import annotations
 
+import functools
 import logging
 import queue
 import threading
@@ -888,7 +889,14 @@ def _batch_shard_count(sharding):
 
 
 def _accepts_kwarg(fn, name):
-    """True when ``fn`` can be called with keyword ``name`` (or takes **kwargs)."""
+    """True when ``fn`` can be called with keyword ``name`` (or takes **kwargs).
+    Cached on the underlying function — this runs on the transfer thread per batch,
+    and a signature cannot change between batches."""
+    return _accepts_kwarg_cached(getattr(fn, "__func__", fn), name)
+
+
+@functools.lru_cache(maxsize=None)
+def _accepts_kwarg_cached(fn, name):
     import inspect
 
     try:

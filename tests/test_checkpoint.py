@@ -134,20 +134,35 @@ def test_global_payload_missing_shard_raises(scalar_dataset):
         ptck.apply(resumed, {ptck._GLOBAL_KEY: {"0": state}})
 
 
-def test_replica_group_duplicate_keys_keep_least_consumed():
+def test_replica_group_duplicate_keys_intersect_consumed():
     """Replica pods (several processes reading the SAME shard) may gather duplicate
-    shard keys with timing skew: the payload keeps the least-consumed state so every
-    replica resumes at-least-once — never a refused save (review r4)."""
+    shard keys with timing skew: the merged state INTERSECTS consumed sets, so
+    restore only skips work EVERY replica delivered — at-least-once for all of them,
+    never a refused save, and never a row lost to a divergent replica (review r4)."""
+    import pytest as _pytest
+
     from petastorm_tpu.checkpoint import _merge_states
 
-    ahead = {"plan": {"num_items": 4}, "resume_epoch": 0, "consumed": {0: [0, 1]}}
-    behind = {"plan": {"num_items": 4}, "resume_epoch": 0, "consumed": {0: [0]}}
+    plan = {"num_items": 8, "seed": 3, "shuffle": True, "num_epochs": 1}
+    ahead = {"plan": plan, "resume_epoch": 1, "consumed": {0: [0, 1], 1: [2]}}
+    behind = {"plan": plan, "resume_epoch": 0, "consumed": {0: [0]}}
+    divergent = {"plan": plan, "resume_epoch": 0, "consumed": {0: [4]}}
     for order in ([["0", ahead], ["0", behind]], [["0", behind], ["0", ahead]]):
         merged = _merge_states(order + [["1", ahead]])
-        assert merged["0"] == behind  # least-consumed wins, both arrival orders
+        assert merged["0"]["resume_epoch"] == 0
+        assert merged["0"]["consumed"] == {0: [0]}  # only what BOTH delivered
         assert merged["1"] == ahead  # distinct shards untouched
+    # disjoint consumed sets (divergent replicas) intersect to empty: full replay
+    merged = _merge_states([["0", behind], ["0", divergent]])
+    assert merged["0"]["consumed"] == {}
     # identical replicas collapse to one entry without comparison churn
     assert _merge_states([["0", ahead], ["0", ahead]]) == {"0": ahead}
+    # differently-configured "replicas" are a misconfiguration — refuse loudly
+    other_plan = dict(plan, seed=9)
+    with _pytest.raises(ValueError, match="different plans"):
+        _merge_states([["0", ahead],
+                       ["0", {"plan": other_plan, "resume_epoch": 0,
+                              "consumed": {0: [0]}}]])
 
 
 def test_cross_shard_state_raises(scalar_dataset):

@@ -628,6 +628,42 @@ def test_mixed_sizes_without_resize_raise(tmp_path):
             list(loader)
 
 
+def test_mixed_sizes_resize_composes_with_spmd_sharding(tmp_path):
+    """SPMD stage-2 decode × device_decode_resize × batch sharding: a mixed-size
+    store delivers one static shape sharded across the mesh, values matching the
+    unsharded path (the resize consumes already-sharded decode output)."""
+    import cv2  # noqa: F401 — store construction uses the jpeg codec
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    sizes = [(32, 48), (64, 40), (48, 48), (32, 48), (80, 56), (24, 24),
+             (40, 40), (56, 32)]
+    url, imgs, field = _mixed_size_store(tmp_path, sizes)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+
+    def collect(shard):
+        reader = make_batch_reader(url, decode_on_device=True, num_epochs=1,
+                                   shuffle_row_groups=False)
+        got = {}
+        with DataLoader(reader, batch_size=8, sharding=shard,
+                        device_decode_resize=(32, 32)) as loader:
+            for batch in loader:
+                arr = batch["image_jpeg"]
+                if shard is not None:
+                    assert len(arr.sharding.device_set) == 8
+                arr = np.asarray(arr)
+                assert arr.shape[1:] == (32, 32, 3)
+                for i, rid in enumerate(np.asarray(batch["id"])):
+                    got[int(rid)] = arr[i]
+        return got
+
+    sharded, single = collect(sharding), collect(None)
+    assert sorted(sharded) == sorted(single) == list(range(len(sizes)))
+    for rid in sharded:
+        np.testing.assert_array_equal(sharded[rid], single[rid])
+
+
 def test_mixed_sizes_device_resize(tmp_path):
     """Mixed-size store rides the device path with one static output shape; values
     track cv2 decode + cv2.resize INTER_LINEAR (the host reference idiom)."""

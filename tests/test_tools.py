@@ -202,3 +202,34 @@ def test_overlap_throughput_keeps_busy_device_fed(tmp_path):
     if (_os.cpu_count() or 1) >= 4:
         # with real spare cores the pipeline genuinely overlaps the busy device
         assert res.device_idle_fraction < 0.2, res
+
+
+def test_overlap_throughput_deadline_skips_remeasure(tmp_path, scalar_dataset):
+    """``deadline`` in the past must suppress the adaptive re-measure loop: exactly
+    one window runs even when the observed idle would normally trigger escalation
+    (the bench harness uses this to bound worst-case wall under degraded service)."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    from petastorm_tpu.benchmark.throughput import overlap_throughput
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    calls = []
+
+    def step(batch):
+        calls.append(1)
+        return jnp.asarray(batch["id"]).sum()  # near-zero step → guaranteed "idle"
+
+    reader = make_batch_reader(scalar_dataset.url, num_epochs=None,
+                               shuffle_row_groups=False, workers_count=1)
+    with DataLoader(reader, batch_size=5, prefetch=2) as loader:
+        res = overlap_throughput(loader, step, warmup_batches=1, measure_batches=3,
+                                 deadline=_time.perf_counter() - 1.0)
+    assert res.batches == 3
+    # idle is high by construction (cheap step); without the deadline the adaptive
+    # loop would re-measure further windows. Exactly one window ran:
+    # 1 warmup + 10 step-cost probes + batches × repeats window dispatches.
+    assert res.device_idle_fraction is not None
+    assert len(calls) == 11 + res.batches * res.step_repeats, len(calls)
