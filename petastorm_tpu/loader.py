@@ -291,12 +291,17 @@ class DataLoader:
         boolean ``<field>__mask`` column marking the valid region, so the column
         reaches the device with a static shape. Rows exceeding the declared max raise.
         Ragged tensor fields WITHOUT a declared max raise at transfer time.
+    trace : petastorm_tpu.trace.TraceRecorder, optional
+        Records every pipeline stage (reader fetch, batch formation, decode
+        dispatch, H2D, queue waits) as chrome-trace spans — the per-span view of
+        the totals in ``stats``; ``tracer.dump(path)`` loads in ``chrome://tracing``
+        / Perfetto. Default None = zero overhead.
     """
 
     def __init__(self, reader, batch_size, sharding=None, shuffling_queue_capacity=0,
                  seed=None, last_batch="drop", device_transform=None, prefetch=2,
                  to_device=True, host_queue_size=8, pad_shapes=None,
-                 device_shuffle_capacity=0, device_decode_resize=None):
+                 device_shuffle_capacity=0, device_decode_resize=None, trace=None):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if last_batch not in ("drop", "pad", "partial"):
@@ -323,6 +328,9 @@ class DataLoader:
         self._device_decode_resize = _validate_decode_resize(
             device_decode_resize, getattr(reader, "device_decode_fields", None))
         self._device_shuffle_capacity = int(device_shuffle_capacity or 0)
+        #: optional petastorm_tpu.trace.TraceRecorder — per-span chrome-trace view of
+        #: the same stages PipelineStats totals (None = zero overhead)
+        self._trace = trace
         self._device_transform = device_transform
         if device_transform is None:
             spec = getattr(reader, "transform_spec", None)
@@ -358,7 +366,10 @@ class DataLoader:
             while True:
                 t0 = time.perf_counter()
                 item = next(it, _SENTINEL)
-                stats.read_s += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                stats.read_s += dt
+                if self._trace is not None:
+                    self._trace.add("reader.next", t0, dt)
                 if item is _SENTINEL:
                     break
                 if self._stop.is_set():
@@ -390,7 +401,10 @@ class DataLoader:
                                 if hasattr(v, "detach"):
                                     col[i] = v.detach()
                 ready = batcher.add(columns)
-                stats.batch_s += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                stats.batch_s += dt
+                if self._trace is not None:
+                    self._trace.add("batch.form", t0, dt)
                 for batch in ready:
                     if self._stop.is_set():
                         return
@@ -473,7 +487,10 @@ class DataLoader:
         while True:
             t0 = time.perf_counter()
             item = q.get()
-            stats.queue_wait_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            stats.queue_wait_s += dt
+            if self._trace is not None:
+                self._trace.add("wait.host_queue", t0, dt)
             if item is _SENTINEL:
                 if self._producer_error is not None:
                     raise self._producer_error
@@ -553,7 +570,10 @@ class DataLoader:
 
         t0 = time.perf_counter()
         batch, staged = self._decode_staged(batch)
-        self.stats.decode_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.stats.decode_s += dt
+        if self._trace is not None:
+            self._trace.add("decode.dispatch", t0, dt)
         t0 = time.perf_counter()
         device = {k: v for k, v in batch.items() if _is_device_dtype(v)}
         host = {k: v for k, v in batch.items() if k not in device}
@@ -585,7 +605,10 @@ class DataLoader:
                 else:
                     arrays[name] = jax.device_put(arr, s)
         arrays.update(staged)
-        self.stats.h2d_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.stats.h2d_s += dt
+        if self._trace is not None:
+            self._trace.add("h2d.transfer", t0, dt)
         return arrays, host
 
     def _apply_device_transform(self, arrays):
@@ -700,7 +723,10 @@ class DataLoader:
             while True:
                 t0 = time.perf_counter()
                 item = dev_q.get()
-                stats.device_queue_wait_s += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                stats.device_queue_wait_s += dt
+                if self._trace is not None:
+                    self._trace.add("wait.device_queue", t0, dt)
                 if item is _SENTINEL:
                     finished = True
                     if transfer_error:
@@ -1231,7 +1257,7 @@ _UNSET = object()
 #: re-stated here).
 _LOADER_OPTS = ("last_batch", "device_transform", "prefetch", "pad_shapes",
                 "device_shuffle_capacity", "to_device", "host_queue_size",
-                "device_decode_resize")
+                "device_decode_resize", "trace")
 
 
 def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1,
@@ -1239,7 +1265,7 @@ def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1
                     last_batch=_UNSET, device_transform=_UNSET, prefetch=_UNSET,
                     pad_shapes=_UNSET, device_shuffle_capacity=_UNSET,
                     to_device=_UNSET, host_queue_size=_UNSET,
-                    device_decode_resize=_UNSET, **reader_kwargs):
+                    device_decode_resize=_UNSET, trace=_UNSET, **reader_kwargs):
     """One-call convenience: ``make_batch_reader`` + :class:`DataLoader`.
 
     ``reader_kwargs`` pass through to :func:`petastorm_tpu.reader.make_batch_reader`

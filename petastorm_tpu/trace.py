@@ -1,0 +1,97 @@
+"""Chrome-trace span recording for the data pipeline.
+
+The reference ships no tracing at all (SURVEY.md §6: "no spans, no per-stage timers
+in the hot path"); ``PipelineStats`` already gives cheap per-stage TOTALS, and this
+module adds the per-span view when you need to see *when* each stage ran: hand a
+:class:`TraceRecorder` to ``DataLoader(trace=...)`` and every pipeline stage (reader
+fetch, batch formation, device decode dispatch, H2D, queue waits) records one
+duration event per occurrence, tagged with its thread. Dump with :meth:`dump` and
+load the file in ``chrome://tracing`` / Perfetto to see producer, transfer, and
+consumer lanes and where the bubbles are.
+
+Overhead when enabled is one ``perf_counter`` pair (already paid for stats) plus an
+appended tuple per span — no formatting until :meth:`dump`; disabled (``trace=None``,
+the default) it costs one ``is None`` check per span site.
+
+    from petastorm_tpu.trace import TraceRecorder
+
+    tracer = TraceRecorder()
+    with DataLoader(reader, 256, trace=tracer) as loader:
+        for batch in loader:
+            with tracer.span("train.step"):
+                step(batch)
+    tracer.dump("pipeline_trace.json")
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+
+class TraceRecorder:
+    """Thread-safe duration-event recorder in Chrome trace-event format.
+
+    ``max_events`` bounds memory on long runs (a span is one small tuple, but a
+    multi-hour run at hundreds of batches/s would otherwise grow without limit):
+    once full, the OLDEST spans are dropped — the dump shows the most recent
+    window, which is the one being debugged. ``None`` disables the bound."""
+
+    def __init__(self, max_events=1_000_000):
+        from collections import deque
+
+        self._events = deque(maxlen=max_events)  # (name, (tname, tid), t0_s, dur_s)
+        self._lock = threading.Lock()
+        self._origin = time.perf_counter()
+
+    def add(self, name, t0, dur):
+        """Record one span: ``t0`` from ``time.perf_counter()``, ``dur`` seconds."""
+        t = threading.current_thread()
+        # keyed by (name, ident): two live threads may SHARE a name (e.g. a train
+        # and an eval loader both run a "ptpu-loader" producer) and collapsing them
+        # onto one trace lane would render bogus nested slices
+        with self._lock:
+            self._events.append((name, (t.name, t.ident), t0, dur))
+
+    @contextlib.contextmanager
+    def span(self, name):
+        """Context manager recording the enclosed block as one span."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, t0, time.perf_counter() - t0)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._events)
+
+    def events(self):
+        """Snapshot of recorded spans as dicts (name/thread/start_s/duration_s)."""
+        with self._lock:
+            evs = list(self._events)
+        return [{"name": n, "thread": t[0], "start_s": t0 - self._origin,
+                 "duration_s": d} for n, t, t0, d in evs]
+
+    def dump(self, path):
+        """Write ``chrome://tracing`` / Perfetto JSON (trace-event format)."""
+        with self._lock:
+            evs = list(self._events)
+        pid = os.getpid()
+        tids = {}
+        trace_events = []
+        for tkey in sorted({t for _n, t, _t0, _d in evs}, key=str):
+            tid = tids[tkey] = len(tids) + 1
+            trace_events.append({  # thread-name metadata row
+                "ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+                "args": {"name": tkey[0]}})
+        for name, tkey, t0, dur in evs:
+            trace_events.append({
+                "ph": "X", "pid": pid, "tid": tids[tkey], "name": name,
+                "ts": (t0 - self._origin) * 1e6, "dur": dur * 1e6, "cat": "pipeline"})
+        with open(path, "w") as f:
+            json.dump({"traceEvents": trace_events,
+                       "displayTimeUnit": "ms"}, f)
+        return path

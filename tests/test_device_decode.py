@@ -397,6 +397,38 @@ def test_per_row_path_mixed_staged_and_fallback_rows(jpeg_dataset):
             assert np.abs(row.astype(int) - ref.astype(int)).mean() < 3.0
 
 
+def test_process_pool_spmd_decode_sharded(jpeg_dataset):
+    """Process pool × SPMD stage-2 × batch sharding: staged payloads cross the IPC
+    wire, decode fans out across the 8-device mesh, and the delivered global batch
+    matches the sync-pool single-device path bit-for-bit."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+
+    def collect(pool, shard):
+        reader = make_batch_reader(jpeg_dataset.url, decode_on_device=True,
+                                   num_epochs=1, shuffle_row_groups=False,
+                                   reader_pool_type=pool, workers_count=2)
+        out = {}
+        with DataLoader(reader, batch_size=8, sharding=shard) as loader:
+            for batch in loader:
+                img = batch["image_jpeg"]
+                if shard is not None:
+                    assert len(img.sharding.device_set) == 8
+                arr = np.asarray(img)
+                for j, rid in enumerate(np.asarray(batch["id"])):
+                    out[int(rid)] = arr[j]
+        return out
+
+    got = collect("process", sharding)
+    ref = collect("dummy", None)
+    assert sorted(got) == sorted(ref) == list(range(24))
+    for rid in got:
+        np.testing.assert_array_equal(got[rid], ref[rid])
+
+
 def test_process_pool_device_decode_wire(tmp_path):
     """decode_on_device over the process pool: staged payloads cross the IPC wire
     (JpegPlanes.__reduce__ ships one detached row, not its row group's buffers) and

@@ -1,0 +1,101 @@
+"""Pipeline span tracing (SURVEY §6: reference has "no spans, no per-stage timers";
+this build exports chrome-trace spans for the same stages PipelineStats totals)."""
+import json
+import threading
+
+import numpy as np
+
+from petastorm_tpu.loader import DataLoader
+from petastorm_tpu.reader import make_batch_reader
+from petastorm_tpu.trace import TraceRecorder
+
+
+def test_loader_records_all_stage_spans(scalar_dataset, tmp_path):
+    tracer = TraceRecorder()
+    reader = make_batch_reader(scalar_dataset.url, num_epochs=1,
+                               shuffle_row_groups=False, workers_count=1)
+    seen_rows = 0
+    with DataLoader(reader, 10, trace=tracer) as loader:
+        for batch in loader:
+            with tracer.span("train.step"):
+                seen_rows += len(np.asarray(batch["id"]))
+    assert seen_rows > 0
+    names = {e["name"] for e in tracer.events()}
+    assert {"reader.next", "batch.form", "decode.dispatch", "h2d.transfer",
+            "wait.host_queue", "wait.device_queue", "train.step"} <= names
+    # spans come from distinct pipeline threads (producer / transfer / consumer)
+    threads = {e["thread"] for e in tracer.events()}
+    assert len(threads) >= 3, threads
+    for e in tracer.events():
+        assert e["duration_s"] >= 0 and e["start_s"] >= 0
+
+    # chrome trace-event JSON round trip
+    path = tracer.dump(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert meta and spans
+    assert {m["args"]["name"] for m in meta} == threads
+    for s in spans:
+        assert s["ts"] >= 0 and s["dur"] >= 0 and s["pid"] and s["tid"]
+
+
+def test_trace_disabled_is_default(scalar_dataset):
+    reader = make_batch_reader(scalar_dataset.url, num_epochs=1, workers_count=1)
+    with DataLoader(reader, 10) as loader:
+        assert loader._trace is None
+        next(iter(loader))
+
+
+def test_recorder_bounded_memory():
+    """max_events is a ring: long runs keep the newest window instead of growing
+    without bound (review r4)."""
+    tracer = TraceRecorder(max_events=10)
+    for i in range(25):
+        tracer.add("s%d" % i, float(i), 0.5)
+    assert len(tracer) == 10
+    assert [e["name"] for e in tracer.events()] == ["s%d" % i for i in range(15, 25)]
+
+
+def test_same_thread_name_distinct_lanes(tmp_path):
+    """Two live threads sharing a NAME (train + eval loaders both spawn
+    'ptpu-loader') must land on distinct chrome-trace tids, or their overlapping
+    spans render as bogus nested slices (review r4)."""
+    tracer = TraceRecorder()
+    barrier = threading.Barrier(2)
+
+    def worker():
+        barrier.wait()
+        for _ in range(5):
+            with tracer.span("work"):
+                pass
+
+    threads = [threading.Thread(target=worker, name="ptpu-loader") for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    path = tracer.dump(str(tmp_path / "t.json"))
+    doc = json.load(open(path))
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert len(meta) == 2  # one lane per thread IDENT
+    assert all(m["args"]["name"] == "ptpu-loader" for m in meta)
+    span_tids = {e["tid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert len(span_tids) == 2
+
+
+def test_recorder_thread_safety():
+    tracer = TraceRecorder()
+
+    def hammer():
+        for i in range(200):
+            with tracer.span("t%d" % (i % 3)):
+                pass
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(tracer) == 800
