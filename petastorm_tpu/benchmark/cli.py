@@ -68,6 +68,9 @@ def main(argv=None):
     parser.add_argument("--decode-on-device", action="store_true",
                         help="two-stage JPEG decode (requires --loader for the device half)")
     parser.add_argument("--loader-batch-size", type=int, default=256)
+    parser.add_argument("--trace", metavar="PATH", default=None,
+                        help="write a chrome://tracing / Perfetto span trace of the "
+                             "measured pipeline to PATH (requires --loader)")
     parser.add_argument("--overlap-step-ms", type=float, default=0.0,
                         help="overlap mode: keep the device busy with a calibrated "
                              "synthetic step of ~this many milliseconds per batch and "
@@ -82,6 +85,9 @@ def main(argv=None):
     if args.overlap_step_ms and not args.loader:
         parser.error("--overlap-step-ms requires --loader (the overlap runs on the "
                      "device batches the loader delivers)")
+    if args.trace and not args.loader:
+        parser.error("--trace requires --loader (the spans are the loader's "
+                     "pipeline stages)")
 
     from petastorm_tpu.benchmark.throughput import reader_throughput
     from petastorm_tpu.reader import make_batch_reader, make_reader
@@ -98,23 +104,34 @@ def main(argv=None):
             from petastorm_tpu.benchmark.throughput import loader_throughput
             from petastorm_tpu.loader import DataLoader
 
-            loader = DataLoader(reader, args.loader_batch_size)
-            bs = args.loader_batch_size
-            if args.overlap_step_ms:
-                from petastorm_tpu.benchmark.throughput import overlap_throughput
+            tracer = None
+            if args.trace:
+                from petastorm_tpu.trace import TraceRecorder
 
-                step = _make_synthetic_step(args.overlap_step_ms)
-                result = overlap_throughput(
-                    loader, step, step_repeats=1,
-                    warmup_batches=max(1, args.warmup_rows // bs),
-                    measure_batches=max(1, args.measure_rows // bs),
-                )
-            else:
-                result = loader_throughput(
-                    loader,
-                    warmup_batches=max(1, args.warmup_rows // bs),
-                    measure_batches=max(1, args.measure_rows // bs),
-                )
+                tracer = TraceRecorder()
+            loader = DataLoader(reader, args.loader_batch_size, trace=tracer)
+            bs = args.loader_batch_size
+            try:
+                if args.overlap_step_ms:
+                    from petastorm_tpu.benchmark.throughput import overlap_throughput
+
+                    step = _make_synthetic_step(args.overlap_step_ms)
+                    result = overlap_throughput(
+                        loader, step, step_repeats=1,
+                        warmup_batches=max(1, args.warmup_rows // bs),
+                        measure_batches=max(1, args.measure_rows // bs),
+                    )
+                else:
+                    result = loader_throughput(
+                        loader,
+                        warmup_batches=max(1, args.warmup_rows // bs),
+                        measure_batches=max(1, args.measure_rows // bs),
+                    )
+            finally:
+                if tracer is not None:
+                    # dump in finally: the trace matters MOST when the run dies
+                    # mid-measure (the spans up to the failure show where)
+                    tracer.dump(args.trace)
         else:
             result = reader_throughput(reader, args.warmup_rows, args.measure_rows)
         print(result)

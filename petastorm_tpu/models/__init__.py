@@ -9,6 +9,10 @@ def __getattr__(name):
         from petastorm_tpu.models import resnet
 
         return getattr(resnet, name)
+    if name in ("ViT", "ViT_S16", "ViT_B16", "ViT_L16"):
+        from petastorm_tpu.models import vit
+
+        return getattr(vit, name)
     if name == "MnistCNN":
         from petastorm_tpu.models.mnist import MnistCNN
 

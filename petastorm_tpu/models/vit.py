@@ -1,0 +1,98 @@
+"""Vision Transformer (ViT) in flax.linen — second flagship image family.
+
+The reference ships no model code; the model zoo exists to exercise the data plane
+against the acceptance configs (BASELINE.json), and ViT is the other half of the
+ImageNet story next to ResNet: patchify turns the loader's (n, h, w, 3) uint8
+batches into (n, tokens, d) sequences, so the same pipeline feeds both conv and
+attention consumers. TPU notes: bfloat16 compute with float32 layer norms and
+params, einsum attention (MXU-friendly), no data-dependent control flow.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        d = x.shape[-1]
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        x = nn.gelu(x)
+        x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+        return nn.Dense(d, dtype=self.dtype)(x)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, dtype=self.dtype,
+            dropout_rate=self.dropout_rate)(y, y, deterministic=deterministic)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        return x + MlpBlock(self.mlp_dim, self.dropout_rate,
+                            self.dtype)(y, deterministic=deterministic)
+
+
+class ViT(nn.Module):
+    """ViT classifier: patchify → [cls] + learned positions → encoder → head.
+
+    Defaults are ViT-B/16 (Dosovitskiy et al. 2020 table 1): 12 layers, width 768,
+    12 heads, MLP 3072 — 86.6M params at 224² with 1000 classes.
+    """
+
+    num_classes: int = 1000
+    patch_size: int = 16
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        n, h, w, _c = x.shape
+        p = self.patch_size
+        x = x.astype(self.dtype)
+        # patchify as one conv: MXU matmul over p*p*c per output token
+        x = nn.Conv(self.hidden_size, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, name="embedding")(x)
+        x = x.reshape(n, -1, self.hidden_size)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.hidden_size),
+                         jnp.float32)
+        x = jnp.concatenate([jnp.broadcast_to(cls.astype(self.dtype),
+                                              (n, 1, self.hidden_size)), x], axis=1)
+        pos = self.param("pos_embedding", nn.initializers.normal(stddev=0.02),
+                         (1, x.shape[1], self.hidden_size), jnp.float32)
+        x = x + pos.astype(self.dtype)
+        x = nn.Dropout(self.dropout_rate)(x, deterministic=not train)
+        for i in range(self.num_layers):
+            x = EncoderBlock(self.num_heads, self.mlp_dim, self.dropout_rate,
+                             self.dtype, name="encoderblock_%d" % i)(
+                x, deterministic=not train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="encoder_norm")(x)
+        x = x[:, 0]  # [cls] token
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+ViT_B16 = functools.partial(ViT)  # 86.6M @ 224^2 / 1000 classes
+ViT_S16 = functools.partial(ViT, hidden_size=384, num_layers=12, num_heads=6,
+                            mlp_dim=1536)  # 22.1M
+ViT_L16 = functools.partial(ViT, hidden_size=1024, num_layers=24, num_heads=16,
+                            mlp_dim=4096)  # 304M

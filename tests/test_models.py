@@ -34,6 +34,45 @@ def test_block_classes():
     assert ResNet50().block_cls is BottleneckBlock
 
 
+def _vit_param_count(model, image=224):
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, image, image, 3)), train=False))
+    return sum(int(np.prod(leaf.shape)) for leaf in jax.tree_util.tree_leaves(variables["params"]))
+
+
+def test_vit_param_counts_canonical():
+    """ViT-B/16 at 224^2/1000cls is 86.6M params (Dosovitskiy et al. table 1 /
+    timm vit_base_patch16_224: 86,567,656); S/16 is 22.1M."""
+    from petastorm_tpu.models.vit import ViT_B16, ViT_S16
+
+    assert _vit_param_count(ViT_B16(num_classes=1000)) == 86_567_656
+    assert _vit_param_count(ViT_S16(num_classes=1000)) == 22_050_664
+
+
+def test_vit_forward_and_dropout():
+    """A uint8 image batch (the loader's delivery dtype) runs straight through
+    (patchify handles the cast, logits float32), and the train flag has a real
+    effect: with dropout_rate > 0, train=True needs a dropout rng and perturbs
+    outputs, train=False is deterministic."""
+    from petastorm_tpu.models.vit import ViT
+
+    model = ViT(num_classes=10, patch_size=8, hidden_size=64, num_layers=2,
+                num_heads=4, mlp_dim=128, dropout_rate=0.5)
+    x = np.random.RandomState(0).randint(0, 255, (2, 32, 32, 3)).astype(np.uint8)
+    x = jnp.asarray(x)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    eval1 = model.apply(variables, x, train=False)
+    eval2 = model.apply(variables, x, train=False)
+    np.testing.assert_array_equal(np.asarray(eval1), np.asarray(eval2))
+    assert eval1.shape == (2, 10) and eval1.dtype == jnp.float32
+    tr1 = model.apply(variables, x, train=True,
+                      rngs={"dropout": jax.random.PRNGKey(1)})
+    tr2 = model.apply(variables, x, train=True,
+                      rngs={"dropout": jax.random.PRNGKey(2)})
+    assert not np.array_equal(np.asarray(tr1), np.asarray(tr2))
+
+
 def test_basic_block_forward_shapes():
     model = ResNet18(num_classes=10)
     x = jnp.zeros((2, 64, 64, 3))

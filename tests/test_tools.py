@@ -133,6 +133,23 @@ def test_copy_dataset_cli_main(tmp_path, synthetic_dataset):
         assert len(list(reader)) == len(synthetic_dataset.data)
 
 
+def test_benchmark_cli_trace(capsys, scalar_dataset, tmp_path):
+    """--trace writes a loadable chrome-trace of the measured pipeline."""
+    import json as _json
+
+    from petastorm_tpu.benchmark.cli import main
+
+    out = tmp_path / "cli_trace.json"
+    main([scalar_dataset.url, "--batch", "--loader", "--loader-batch-size", "5",
+          "--warmup-rows", "10", "--measure-rows", "40", "--trace", str(out)])
+    doc = _json.load(open(out))
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert "reader.next" in names and "wait.device_queue" in names
+
+    with pytest.raises(SystemExit):  # trace requires the loader's stages
+        main([scalar_dataset.url, "--batch", "--trace", str(out)])
+
+
 def test_benchmark_cli_decode_on_device_requires_loader(scalar_dataset):
     """ADVICE r2: --decode-on-device without --loader would silently benchmark
     stage-1 staging payloads; the CLI must refuse."""
