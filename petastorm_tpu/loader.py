@@ -225,6 +225,21 @@ def _concat(chunks):
     return np.concatenate(chunks, axis=0)
 
 
+def _flatten_ngram_window(window):
+    """{offset: row} NGram window → one flat {'offset/field': value} row.
+
+    The reference's torch collate nests tensors per offset; for the device path a
+    FLAT naming keeps every loader feature working unchanged — per-field shardings,
+    ``pad_shapes``, masks, and device transforms all key by ``'0/image'``-style
+    names. Consumers regroup with one dict comprehension."""
+    flat = {}
+    for off, row in window.items():
+        row = row._asdict() if hasattr(row, "_asdict") else row
+        for name, value in row.items():
+            flat["%s/%s" % (off, name)] = value
+    return flat
+
+
 def _rows_to_columns(rows, object_fields=()):
     """Row dicts/namedtuples → columnar numpy dict (per-row ``make_reader`` path).
 
@@ -335,6 +350,16 @@ class DataLoader:
         if device_transform is None:
             spec = getattr(reader, "transform_spec", None)
             if spec is not None and getattr(spec, "device", False) and spec.func is not None:
+                if getattr(reader, "ngram", None) is not None:
+                    # the spec's func is written against schema field names, but
+                    # NGram batches arrive flattened to 'offset/field' columns —
+                    # auto-wiring it would KeyError (or silently touch the wrong
+                    # columns) on the first batch
+                    raise ValueError(
+                        "a device TransformSpec cannot be auto-applied to an NGram "
+                        "reader: batches are keyed 'offset/field', not by schema "
+                        "field names. Pass DataLoader(device_transform=...) written "
+                        "against the flat 'offset/field' columns instead.")
                 self._device_transform = spec.func
         self._jitted_transform = None
         self._transform_takes_key = False
@@ -383,6 +408,12 @@ class DataLoader:
                         raise TypeError("unexpected reader item %r" % type(item))
                     columns = {k: v for k, v in columns.items() if v is not None}
                 else:
+                    if getattr(self.reader, "ngram", None) is not None:
+                        # NGram windows arrive as {offset: row}: flatten to
+                        # 'offset/field' columns so every timestep's tensors reach
+                        # the device as ordinary static-shape arrays (shardings,
+                        # pad_shapes, and transforms key by the flat name)
+                        item = _flatten_ngram_window(item)
                     columns = _rows_to_columns(
                         [item],
                         object_fields=getattr(self.reader, "device_decode_fields", ()),
