@@ -35,6 +35,10 @@ def main():
     parser.add_argument("--learning-rate", type=float, default=0.1)
     parser.add_argument("--workers", type=int, default=4)
     parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--model", choices=["resnet50", "vit_b16", "vit_s16"],
+                        default="resnet50",
+                        help="consumer model: ResNet-50 (conv, batch-norm state) or "
+                             "a ViT (patchify + attention; same data plane)")
     parser.add_argument("--host-decode", action="store_true",
                         help="disable the two-stage on-device JPEG decode (baseline)")
     parser.add_argument("--augment", action="store_true",
@@ -51,7 +55,13 @@ def main():
     mesh = make_mesh()  # all local devices on a 'dp' axis
     sharding = batch_sharding(mesh)
 
-    model = ResNet50(num_classes=args.num_classes)
+    if args.model == "resnet50":
+        model = ResNet50(num_classes=args.num_classes)
+    else:
+        from petastorm_tpu.models.vit import ViT_B16, ViT_S16
+
+        model = (ViT_B16 if args.model == "vit_b16" else ViT_S16)(
+            num_classes=args.num_classes)
     rng = jax.random.PRNGKey(0)
     dummy = jnp.zeros((2, 224, 224, 3), jnp.float32)
     variables = model.init(rng, dummy, train=False)
@@ -63,11 +73,17 @@ def main():
     def train_step(params, batch_stats, opt_state, image, label):
         def loss_fn(p):
             x = image.astype(jnp.float32) / 255.0
-            out, updates = model.apply(
-                {"params": p, "batch_stats": batch_stats}, x, train=True,
-                mutable=["batch_stats"])
+            variables = {"params": p}
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
+                out, updates = model.apply(variables, x, train=True,
+                                           mutable=["batch_stats"])
+                new_stats = updates["batch_stats"]
+            else:  # ViT: no mutable state (dropout off at rate 0.0 default)
+                out = model.apply(variables, x, train=False)
+                new_stats = batch_stats
             loss = optax.softmax_cross_entropy_with_integer_labels(out, label).mean()
-            return loss, updates["batch_stats"]
+            return loss, new_stats
 
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
