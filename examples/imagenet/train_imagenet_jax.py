@@ -55,6 +55,23 @@ def main():
     mesh = make_mesh()  # all local devices on a 'dp' axis
     sharding = batch_sharding(mesh)
 
+    reader = make_batch_reader(
+        args.dataset_url, workers_count=args.workers, num_epochs=None,
+        shuffle_row_groups=True, decode_on_device=not args.host_decode,
+        schema_fields=["image", "label"],
+    )
+
+    # init at the shape batches will actually have: ViT's position embedding is
+    # resolution-dependent (ResNet is agnostic via global pooling, but one init
+    # path keeps the example honest for both)
+    if args.decode_resize:
+        init_hw = (args.decode_resize, args.decode_resize)
+    else:
+        field_shape = reader.schema.fields["image"].shape
+        init_hw = tuple(field_shape[:2]) if field_shape and None not in field_shape \
+            else (224, 224)
+    if args.augment and init_hw[0] > 224 and init_hw[1] > 224:
+        init_hw = (224, 224)  # the device_transform random-crops to 224 below
     if args.model == "resnet50":
         model = ResNet50(num_classes=args.num_classes)
     else:
@@ -63,7 +80,7 @@ def main():
         model = (ViT_B16 if args.model == "vit_b16" else ViT_S16)(
             num_classes=args.num_classes)
     rng = jax.random.PRNGKey(0)
-    dummy = jnp.zeros((2, 224, 224, 3), jnp.float32)
+    dummy = jnp.zeros((2,) + init_hw + (3,), jnp.float32)
     variables = model.init(rng, dummy, train=False)
     params, batch_stats = variables["params"], variables.get("batch_stats", {})
     tx = optax.sgd(args.learning_rate, momentum=0.9, nesterov=True)
@@ -102,11 +119,6 @@ def main():
             img = jnp.where(flips[:, None, None, None], img[:, :, ::-1, :], img)
             return {**batch, "image": img}
 
-    reader = make_batch_reader(
-        args.dataset_url, workers_count=args.workers, num_epochs=None,
-        shuffle_row_groups=True, decode_on_device=not args.host_decode,
-        schema_fields=["image", "label"],
-    )
     # Stores with mixed image sizes (raw, un-resized corpora) batch at one static
     # shape via the on-device resize; uniform pre-resized stores skip it (no-op).
     resize = None
