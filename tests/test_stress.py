@@ -67,6 +67,34 @@ def test_reader_process_child_killed_mid_epoch_heals(scalar_dataset):
     assert after_kill >= 8  # the stream survived the death
 
 
+def test_sigkill_mid_epoch_with_spmd_sharded_decode(tmp_path):
+    """Elastic pool × SPMD stage-2 × batch sharding: a child SIGKILLed mid-epoch
+    respawns while the loader is delivering mesh-sharded device-decoded batches —
+    every row of the epoch arrives exactly once, still sharded across all devices."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from test_common import create_test_jpeg_dataset
+
+    url = "file://" + str(tmp_path / "jds")
+    create_test_jpeg_dataset(url, num_rows=48)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+    reader = make_reader(url, reader_pool_type="process", workers_count=2,
+                         decode_on_device=True, num_epochs=1,
+                         shuffle_row_groups=False, results_timeout_s=60)
+    seen = []
+    killed = False
+    with DataLoader(reader, batch_size=8, sharding=sharding) as loader:
+        for batch in loader:
+            assert len(batch["image_jpeg"].sharding.device_set) == 8
+            seen.extend(np.asarray(batch["id"]).tolist())
+            if not killed:
+                os.kill(reader._executor._procs[0].pid, signal.SIGKILL)
+                killed = True
+    assert sorted(seen) == list(range(48))  # exactly-once through the death
+
+
 def test_reader_process_child_killed_fail_fast_without_respawns(scalar_dataset):
     """With the respawn budget zeroed, the death surfaces as a clean RuntimeError at
     the consumer (never a hang, never silently-missing rows) — reference-style
