@@ -47,6 +47,9 @@ def main():
     parser.add_argument("--decode-resize", type=int, default=0,
                         help="on-device resize target (pixels, square) for stores "
                              "with MIXED image sizes; 0 = require a uniform store")
+    parser.add_argument("--trace", metavar="PATH", default=None,
+                        help="write a chrome://tracing span trace of the pipeline + "
+                             "train steps to PATH at exit")
     args = parser.parse_args()
     if args.decode_resize and args.host_decode:
         parser.error("--decode-resize requires the on-device decode path "
@@ -124,15 +127,24 @@ def main():
     resize = None
     if args.decode_resize:
         resize = (args.decode_resize, args.decode_resize)
+    tracer = None
+    if args.trace:
+        from petastorm_tpu.trace import TraceRecorder
+
+        tracer = TraceRecorder()
     step = 0
     t0 = time.time()
     with DataLoader(reader, args.batch_size, sharding=sharding,
                     device_transform=device_transform,
-                    device_decode_resize=resize) as loader:
+                    device_decode_resize=resize, trace=tracer) as loader:
+        import contextlib
+
         for batch in loader:
-            params, batch_stats, opt_state, loss = train_step(
-                params, batch_stats, opt_state, batch["image"],
-                jnp.asarray(batch["label"]))
+            with tracer.span("train.step") if tracer is not None \
+                    else contextlib.nullcontext():
+                params, batch_stats, opt_state, loss = train_step(
+                    params, batch_stats, opt_state, batch["image"],
+                    jnp.asarray(batch["label"]))
             step += 1
             if step % 20 == 0:
                 jax.block_until_ready(loss)
@@ -145,6 +157,8 @@ def main():
                 break
     print("done: %d steps, %.1f img/s overall"
           % (step, step * args.batch_size / (time.time() - t0)))
+    if tracer is not None:
+        print("trace written to", tracer.dump(args.trace))
 
 
 if __name__ == "__main__":

@@ -1056,7 +1056,7 @@ class InMemDataLoader:
 
     def __init__(self, reader, batch_size, num_epochs=1, shuffle=True, seed=0,
                  sharding=None, last_batch="drop", device_transform=None,
-                 device_decode_resize=None):
+                 device_decode_resize=None, trace=None):
         if last_batch not in ("drop", "partial"):
             raise ValueError("last_batch must be drop|partial, got %r" % last_batch)
         import jax
@@ -1107,13 +1107,16 @@ class InMemDataLoader:
         else:
             self.local_batch_size = self.batch_size
         self._sharding = sharding
+        self._trace = trace  # fill spans recorded via the inner DataLoader; gather
+        # dispatch spans recorded per batch below
         chunks = []
         dropped = set()
         # fill UNSHARDED: chunk/partial-batch row counts rarely divide the batch axis;
         # the resident store and gathered batches are laid out below instead
         with DataLoader(reader, self.batch_size, sharding=None,
                         last_batch="partial", prefetch=2,
-                        device_decode_resize=device_decode_resize) as fill:
+                        device_decode_resize=device_decode_resize,
+                        trace=trace) as fill:
             for batch in fill:
                 kept = {}
                 for k, v in batch.items():
@@ -1211,7 +1214,10 @@ class InMemDataLoader:
                 idx = perm[start:start + self.batch_size]
                 if len(idx) < self.batch_size and self.last_batch == "drop":
                     break
+                t_g = time.perf_counter()
                 batch = self._gather(self._store, idx)
+                if self._trace is not None:
+                    self._trace.add("inmem.gather", t_g, time.perf_counter() - t_g)
                 if self._sharding is not None:
                     # shard the short final batch too when its row count divides the
                     # sharding's batch axis; otherwise it stays on the gather's layout
@@ -1250,6 +1256,7 @@ class InMemDataLoader:
             perm = jnp.arange(self._local_rows)
         for b in range(self._batches_per_epoch):
             idx = perm[b * self.local_batch_size:(b + 1) * self.local_batch_size]
+            t_g = time.perf_counter()
             local = self._gather(self._store, idx)
             batch = {}
             for k, v in local.items():
@@ -1259,6 +1266,9 @@ class InMemDataLoader:
                     batch[k] = v  # field without a declared layout stays local
                 else:
                     batch[k] = jax.make_array_from_process_local_data(s, v)
+            if self._trace is not None:
+                # gather + global assembly dispatch: the per-batch serving cost
+                self._trace.add("inmem.gather", t_g, time.perf_counter() - t_g)
             batch = self._apply_transform(batch, step0 + b, takes_key)
             yield batch
 

@@ -41,6 +41,23 @@ def test_loader_records_all_stage_spans(scalar_dataset, tmp_path):
         assert s["ts"] >= 0 and s["dur"] >= 0 and s["pid"] and s["tid"]
 
 
+def test_inmem_loader_trace(scalar_dataset):
+    """InMemDataLoader records fill-pipeline spans (via the inner DataLoader) plus a
+    gather span per served batch."""
+    from petastorm_tpu.loader import InMemDataLoader
+
+    tracer = TraceRecorder()
+    reader = make_batch_reader(scalar_dataset.url, num_epochs=1,
+                               shuffle_row_groups=False, workers_count=1)
+    loader = InMemDataLoader(reader, batch_size=10, num_epochs=1, trace=tracer)
+    batches = sum(1 for _ in loader)
+    names = {e["name"] for e in tracer.events()}
+    assert "reader.next" in names  # fill pipeline spans
+    assert "inmem.gather" in names
+    gathers = [e for e in tracer.events() if e["name"] == "inmem.gather"]
+    assert len(gathers) == batches
+
+
 def test_trace_disabled_is_default(scalar_dataset):
     reader = make_batch_reader(scalar_dataset.url, num_epochs=1, workers_count=1)
     with DataLoader(reader, 10) as loader:
