@@ -134,31 +134,34 @@ def main():
         tracer = TraceRecorder()
     step = 0
     t0 = time.time()
-    with DataLoader(reader, args.batch_size, sharding=sharding,
-                    device_transform=device_transform,
-                    device_decode_resize=resize, trace=tracer) as loader:
-        import contextlib
+    try:
+        with DataLoader(reader, args.batch_size, sharding=sharding,
+                        device_transform=device_transform,
+                        device_decode_resize=resize, trace=tracer) as loader:
+            import contextlib
 
-        for batch in loader:
-            with tracer.span("train.step") if tracer is not None \
-                    else contextlib.nullcontext():
-                params, batch_stats, opt_state, loss = train_step(
-                    params, batch_stats, opt_state, batch["image"],
-                    jnp.asarray(batch["label"]))
-            step += 1
-            if step % 20 == 0:
-                jax.block_until_ready(loss)
-                dt = time.time() - t0
-                print("step %d loss %.4f  %.1f img/s  stages=%s"
-                      % (step, float(loss), step * args.batch_size / dt,
-                         loader.stats.snapshot()))
-            if step >= args.steps:
-                jax.block_until_ready(loss)
-                break
+            for batch in loader:
+                with tracer.span("train.step") if tracer is not None \
+                        else contextlib.nullcontext():
+                    params, batch_stats, opt_state, loss = train_step(
+                        params, batch_stats, opt_state, batch["image"],
+                        jnp.asarray(batch["label"]))
+                step += 1
+                if step % 20 == 0:
+                    jax.block_until_ready(loss)
+                    dt = time.time() - t0
+                    print("step %d loss %.4f  %.1f img/s  stages=%s"
+                          % (step, float(loss), step * args.batch_size / dt,
+                             loader.stats.snapshot()))
+                if step >= args.steps:
+                    jax.block_until_ready(loss)
+                    break
+    finally:
+        # a crash or Ctrl-C mid-run is exactly when the trace matters
+        if tracer is not None:
+            print("trace written to", tracer.dump(args.trace))
     print("done: %d steps, %.1f img/s overall"
           % (step, step * args.batch_size / (time.time() - t0)))
-    if tracer is not None:
-        print("trace written to", tracer.dump(args.trace))
 
 
 if __name__ == "__main__":

@@ -109,29 +109,48 @@ def main(argv=None):
                 from petastorm_tpu.trace import TraceRecorder
 
                 tracer = TraceRecorder()
-            loader = DataLoader(reader, args.loader_batch_size, trace=tracer)
             bs = args.loader_batch_size
-            try:
-                if args.overlap_step_ms:
-                    from petastorm_tpu.benchmark.throughput import overlap_throughput
+            xfer0 = None
+            if args.decode_on_device:
+                from petastorm_tpu.ops.jpeg import transfer_byte_counters
 
-                    step = _make_synthetic_step(args.overlap_step_ms)
-                    result = overlap_throughput(
-                        loader, step, step_repeats=1,
-                        warmup_batches=max(1, args.warmup_rows // bs),
-                        measure_batches=max(1, args.measure_rows // bs),
-                    )
-                else:
-                    result = loader_throughput(
-                        loader,
-                        warmup_batches=max(1, args.warmup_rows // bs),
-                        measure_batches=max(1, args.measure_rows // bs),
-                    )
+                xfer0 = transfer_byte_counters()  # delta, not process-lifetime total
+            try:
+                # the with-block matters: an abandoned pipeline torn down at
+                # interpreter exit can kill a daemon transfer thread mid C++
+                # dispatch (observed: 'FATAL: exception not rethrown' abort)
+                with DataLoader(reader, args.loader_batch_size,
+                                trace=tracer) as loader:
+                    if args.overlap_step_ms:
+                        from petastorm_tpu.benchmark.throughput import (
+                            overlap_throughput,
+                        )
+
+                        step = _make_synthetic_step(args.overlap_step_ms)
+                        result = overlap_throughput(
+                            loader, step, step_repeats=1,
+                            warmup_batches=max(1, args.warmup_rows // bs),
+                            measure_batches=max(1, args.measure_rows // bs),
+                        )
+                    else:
+                        result = loader_throughput(
+                            loader,
+                            warmup_batches=max(1, args.warmup_rows // bs),
+                            measure_batches=max(1, args.measure_rows // bs),
+                        )
             finally:
                 if tracer is not None:
                     # dump in finally: the trace matters MOST when the run dies
                     # mid-measure (the spans up to the failure show where)
                     tracer.dump(args.trace)
+            if xfer0 is not None:
+                xfer = transfer_byte_counters()
+                raw = xfer["raw"] - xfer0["raw"]
+                shipped = xfer["shipped"] - xfer0["shipped"]
+                if raw:
+                    print("coefficient transfer: shipped %.1f MB of %.1f MB raw "
+                          "int16 (x%.2f narrowing)"
+                          % (shipped / 1e6, raw / 1e6, shipped / raw))
         else:
             result = reader_throughput(reader, args.warmup_rows, args.measure_rows)
         print(result)

@@ -1216,8 +1216,6 @@ class InMemDataLoader:
                     break
                 t_g = time.perf_counter()
                 batch = self._gather(self._store, idx)
-                if self._trace is not None:
-                    self._trace.add("inmem.gather", t_g, time.perf_counter() - t_g)
                 if self._sharding is not None:
                     # shard the short final batch too when its row count divides the
                     # sharding's batch axis; otherwise it stays on the gather's layout
@@ -1234,6 +1232,10 @@ class InMemDataLoader:
                             "InMemDataLoader: final partial batch (%d rows) does not "
                             "divide the sharding's batch axis; yielded unsharded",
                             len(idx))
+                if self._trace is not None:
+                    # span covers gather + layout dispatch — the same serving work
+                    # the multi-process path's span covers (gather + assembly)
+                    self._trace.add("inmem.gather", t_g, time.perf_counter() - t_g)
                 batch = self._apply_transform(batch, step, takes_key)
                 step += 1
                 yield batch

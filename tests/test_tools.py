@@ -150,6 +150,23 @@ def test_benchmark_cli_trace(capsys, scalar_dataset, tmp_path):
         main([scalar_dataset.url, "--batch", "--trace", str(out)])
 
 
+def test_benchmark_cli_decode_on_device_reports_narrowing(capsys, tmp_path):
+    """--decode-on-device prints the REALIZED coefficient-transfer narrowing (the
+    shipped/raw byte ratio the bench artifact reports) so operators see it too."""
+    from test_common import create_test_jpeg_dataset
+
+    from petastorm_tpu.benchmark.cli import main
+    from petastorm_tpu.ops.jpeg import transfer_byte_counters
+
+    url = "file://" + str(tmp_path / "jds")
+    create_test_jpeg_dataset(url, num_rows=24)
+    transfer_byte_counters(reset=True)
+    main([url, "--loader", "--loader-batch-size", "6", "--decode-on-device",
+          "--warmup-rows", "6", "--measure-rows", "12"])
+    out = capsys.readouterr().out
+    assert "coefficient transfer" in out and "narrowing" in out
+
+
 def test_benchmark_cli_decode_on_device_requires_loader(scalar_dataset):
     """ADVICE r2: --decode-on-device without --loader would silently benchmark
     stage-1 staging payloads; the CLI must refuse."""
