@@ -238,8 +238,12 @@ def main():
     # acknowledging work without executing it (observed: ResNet-50 b128 "steps" of
     # 0.2-1.1 ms across a whole run), and every number in that window is
     # untrustworthy. Floors sit above every observed fake and 6-8x under the best
-    # real captures (16 ms / 23 ms).
-    _PHYSICS_FLOOR_S = {"conv_stem": 2e-3, "resnet50": 4e-3}
+    # real captures (16 ms / 23 ms). The train step is fwd+bwd+SGD (~3x fwd
+    # FLOPs): floor 10 ms, ~6x under the expected ~60-70 ms real step. The
+    # tabular/ngram steps are tiny matmuls whose real dispatch cannot beat the
+    # tunnel's ~15 ms+ RPC latency; 2 ms sits far above every observed fake ack.
+    _PHYSICS_FLOOR_S = {"conv_stem": 2e-3, "resnet50": 4e-3,
+                        "resnet50_train": 1e-2, "tabular": 2e-3, "ngram": 2e-3}
 
     def window_health(step_key, step_s):
         """Degraded iff this window's standalone step time is far off the run's
@@ -253,23 +257,16 @@ def main():
             weather["step_floor_s"][step_key] = floor = step_s
         return step_s <= 2.0 * floor
 
-    def measure(decode_on_device, warmup_batches=4, measure_batches=20,
-                max_windows=4, reserve_s=240.0):
+    def measure_loader(make_loader, step_fn, step_key, warmup_batches=4,
+                       measure_batches=20, max_windows=4, reserve_s=240.0,
+                       min_windows=2):
         """Training-loop-realistic measurement: steps dispatch ASYNC (block only at the
         end), as a real jax loop does — per-step block_until_ready would charge one
-        tunnel round-trip (~100ms) to every batch. Runs 2–``max_windows`` windows,
-        keeps the best, records all; extra windows only run while the latest one
-        looks weather-degraded."""
-        # One worker per spare core: the pool's hot loops (native entropy decode,
-        # pyarrow IO) release the GIL, so extra threads on a small host only add GIL
-        # convoy latency to the transfer thread's dispatch (measured 3800 -> 1400
-        # rows/s going 1 -> 4 workers on a 1-core host).
-        workers = max(1, min(8, (os.cpu_count() or 2) - 1))
-        reader = make_batch_reader(
-            "file://" + root, workers_count=workers, shuffle_row_groups=True, seed=0,
-            num_epochs=None, decode_on_device=decode_on_device,
-        )
-        loader = DataLoader(reader, BATCH, prefetch=3, host_queue_size=8)
+        tunnel round-trip (~100ms) to every batch. Runs ``min_windows``–``max_windows``
+        windows, keeps the best, records all; extra windows only run while the latest
+        one looks weather-degraded. ``step_fn(batch) -> device value``; one instance
+        of this machinery serves every acceptance config (jpeg/tabular/ngram)."""
+        loader = make_loader()
         windows = []
         cands = []
         with loader:
@@ -277,14 +274,14 @@ def main():
             last_batch = None
             for _ in range(warmup_batches):  # compile + page cache
                 b = next(it)
-                jax.block_until_ready(step(b["image"], b["label"]))
+                jax.block_until_ready(step_fn(b))
                 last_batch = b
             for _window in range(max_windows):
                 # per-window standalone step cost (async x10, block once) + H2D
                 # probe: the degraded-window signals, re-sampled each window
                 t0 = time.perf_counter()
                 for _ in range(10):
-                    r = step(last_batch["image"], last_batch["label"])
+                    r = step_fn(last_batch)
                 jax.block_until_ready(r)
                 step_s = (time.perf_counter() - t0) / 10
                 h2d_mb_s = h2d_probe()
@@ -295,15 +292,15 @@ def main():
                 loader.stats.reset()  # stage split covers exactly the measured window
                 t0 = time.perf_counter()
                 for b in it:
-                    r = step(b["image"], b["label"])
-                    n += int(b["label"].shape[0])
+                    r = step_fn(b)
+                    n += int(len(next(iter(b.values()))))
                     batches += 1
                     if batches >= measure_batches:
                         break
                 jax.block_until_ready(r)
                 dt = time.perf_counter() - t0
                 rows_per_sec = n / dt if dt else 0.0
-                healthy = window_health("conv_stem", step_s)
+                healthy = window_health(step_key, step_s)
                 windows.append({
                     "rows_per_sec": round(rows_per_sec, 1),
                     "step_ms": round(step_s * 1e3, 2),
@@ -311,9 +308,30 @@ def main():
                     "healthy": healthy,  # provisional; re-judged vs final floors
                 })
                 cands.append((rows_per_sec, step_s, loader.stats.snapshot()))
-                if (_window >= 1 and healthy) or time_left() < reserve_s:
+                if (_window >= min_windows - 1 and healthy) \
+                        or time_left() < reserve_s:
                     break
-        return {"windows": windows, "cands": cands, "step_key": "conv_stem"}
+        return {"windows": windows, "cands": cands, "step_key": step_key}
+
+    def make_jpeg_loader(decode_on_device):
+        # One worker per spare core: the pool's hot loops (native entropy decode,
+        # pyarrow IO) release the GIL, so extra threads on a small host only add GIL
+        # convoy latency to the transfer thread's dispatch (measured 3800 -> 1400
+        # rows/s going 1 -> 4 workers on a 1-core host).
+        workers = max(1, min(8, (os.cpu_count() or 2) - 1))
+        reader = make_batch_reader(
+            "file://" + root, workers_count=workers, shuffle_row_groups=True, seed=0,
+            num_epochs=None, decode_on_device=decode_on_device,
+        )
+        return DataLoader(reader, BATCH, prefetch=3, host_queue_size=8)
+
+    def measure(decode_on_device, warmup_batches=4, measure_batches=20,
+                max_windows=4, reserve_s=240.0):
+        return measure_loader(
+            lambda: make_jpeg_loader(decode_on_device),
+            lambda b: step(b["image"], b["label"]), "conv_stem",
+            warmup_batches=warmup_batches, measure_batches=measure_batches,
+            max_windows=max_windows, reserve_s=reserve_s)
 
     def finalize_measure(meas):
         """Re-judge every window against the run's FINAL floors (an early window
@@ -359,8 +377,54 @@ def main():
 
         return jstep
 
+    def make_resnet_train_step():
+        """REAL training step for the north-star overlap (VERDICT r4 #3): ResNet-50
+        forward + backward + SGD-momentum update with donated state, so idle is
+        measured against the true per-step device cost and H2D window — not a
+        forward-only stand-in. State evolves every dispatch (donated buffers), so
+        repeated steps on one batch are distinct computations the service's
+        content cache cannot collapse; the jitter scalar stays as insurance."""
+        import optax
+
+        from petastorm_tpu.models.resnet import ResNet50
+
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((8, 224, 224, 3), jnp.float32), train=False)
+        tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+        # params are ARGS (donated), never closures — see make_resnet_step
+        import functools
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def _train(params, batch_stats, opt_state, image, label, t):
+            def loss_fn(p):
+                x = image.astype(jnp.float32) * (1.0 / 255.0) + t
+                out, updates = model.apply(
+                    {"params": p, "batch_stats": batch_stats}, x, train=True,
+                    mutable=["batch_stats"])
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    out, label.astype(jnp.int32)).mean()
+                return loss, updates["batch_stats"]
+
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_stats, opt_state, loss
+
+        state = [jax.device_put(variables["params"]),
+                 jax.device_put(variables["batch_stats"]),
+                 jax.device_put(tx.init(variables["params"]))]
+
+        def jstep(batch):
+            state[0], state[1], state[2], loss = _train(
+                state[0], state[1], state[2], batch["image"], batch["label"],
+                np.float32(next(_tick) % 997) * np.float32(1e-6))
+            return loss
+
+        return jstep
+
     def measure_overlap(jstep, decode_on_device, measure_batches, max_windows=3,
-                        reserve_s=60.0):
+                        reserve_s=60.0, step_key="resnet50"):
         """North-star idle proof (VERDICT r2 #1): overlap the pipeline with the
         flagship model's forward (ResNet-50, ``__graft_entry__.entry``) auto-scaled
         to ≥ the pipeline's per-batch cost, and report consumer starvation
@@ -392,12 +456,12 @@ def main():
         with loader:
             for _window in range(max_windows):
                 res = overlap_throughput(
-                    loader, lambda b: jstep(b["image"]), warmup_batches=3,
+                    loader, jstep, warmup_batches=3,
                     measure_batches=measure_batches,
                     deadline=time.perf_counter() + max(30.0, time_left()))
                 h2d_mb_s = h2d_probe()
-                # one floor across both overlap modes (same step fn)
-                healthy = window_health("resnet50", res.step_seconds or 1e-9)
+                # one floor per step fn, shared across its overlap modes
+                healthy = window_health(step_key, res.step_seconds or 1e-9)
                 windows.append({
                     "device_idle_fraction": round(res.device_idle_fraction, 4),
                     "rows_per_sec": round(res.rows_per_second, 1),
@@ -412,7 +476,7 @@ def main():
                 if (healthy and res.device_idle_fraction <= 0.05) \
                         or time_left() < reserve_s:
                     break
-        return {"windows": windows, "results": results, "step_key": "resnet50"}
+        return {"windows": windows, "results": results, "step_key": step_key}
 
     def finalize_overlap(meas):
         """Re-judge windows vs final floors, then pick healthy-first / lowest-idle
@@ -431,12 +495,179 @@ def main():
                                -meas["results"][j].device_idle_fraction))
         return meas["results"][i], meas["windows"], meas["windows"][i]["healthy"]
 
-    host = measure(decode_on_device=False, measure_batches=14, reserve_s=270.0)
-    from petastorm_tpu.ops.jpeg import transfer_byte_counters
+    def merge_meas(dst, src):
+        """Fold a retry's windows into the original measurement pool (the budget-
+        driven healthy-window retries, VERDICT r4 #2): finalize_* then re-judges the
+        UNION against the run's final floors and picks the overall best."""
+        if dst is None or src is None:
+            return dst or src
+        dst["windows"].extend(src["windows"])
+        for key in ("cands", "results"):
+            if key in dst and key in src:
+                dst[key].extend(src[key])
+        return dst
 
-    transfer_byte_counters(reset=True)
-    device = measure(decode_on_device=True, reserve_s=210.0)
-    xfer = transfer_byte_counters()
+    def bench_tabular():
+        """Acceptance config #3 (BASELINE.json: Criteo-1TB-shaped tabular): 13
+        numeric float32 + 26 categorical int32 columns + label through
+        ``make_batch_reader`` → ``DataLoader`` → a jitted embedding-free MLP layer
+        (the Criteo dense tower's first matmul). ``vs_host`` compares against the
+        reference-equivalent path measured in the SAME run: reader-only host
+        consumption, the contract petastorm's own ``reader_throughput`` benchmarks
+        (petastorm/benchmark/throughput.py ~L60)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from petastorm_tpu.benchmark.throughput import reader_throughput
+
+        rows_t, bs_t = 131072, 4096
+        root_t = os.path.join(tempfile.gettempdir(), "ptpu_bench_tabular_v1")
+        marker_t = os.path.join(root_t, "_done")
+        if not os.path.exists(marker_t):
+            import shutil
+
+            shutil.rmtree(root_t, ignore_errors=True)
+            os.makedirs(root_t)
+            rng_t = np.random.RandomState(7)
+            cols = {"label": rng_t.randint(0, 2, rows_t).astype(np.int32)}
+            for i in range(13):
+                cols["i%d" % i] = rng_t.standard_normal(rows_t).astype(np.float32)
+            for i in range(26):
+                cols["c%d" % i] = rng_t.randint(0, 1 << 20, rows_t).astype(np.int32)
+            pq.write_table(pa.table(cols), os.path.join(root_t, "part-0.parquet"),
+                           row_group_size=16384)
+            with open(marker_t, "w") as f:
+                f.write("ok")
+        feat = ["i%d" % i for i in range(13)] + ["c%d" % i for i in range(26)]
+        wt = (np.random.RandomState(11).standard_normal((39, 128)) * 0.05
+              ).astype(np.float32)
+
+        @jax.jit
+        def _tstep(cols, t):
+            x = jnp.stack([cols[k].astype(jnp.bfloat16) for k in feat], axis=1)
+            h = jnp.maximum(x @ jnp.asarray(wt, jnp.bfloat16), 0)
+            return jnp.sum(h.astype(jnp.float32)) + t
+
+        def tstep(batch):
+            return _tstep({k: batch[k] for k in feat},
+                          np.float32(next(_tick) % 997) * np.float32(1e-6))
+
+        with make_batch_reader("file://" + root_t, workers_count=1, num_epochs=None,
+                               shuffle_row_groups=True, seed=0) as r_host:
+            host_rps = reader_throughput(r_host, warmup_rows=8192,
+                                         measure_rows=32768).rows_per_second
+
+        def make_loader():
+            reader = make_batch_reader("file://" + root_t, workers_count=1,
+                                       num_epochs=None, shuffle_row_groups=True,
+                                       seed=0)
+            return DataLoader(reader, bs_t, prefetch=3, host_queue_size=8)
+
+        meas = measure_loader(make_loader, tstep, "tabular", warmup_batches=3,
+                              measure_batches=10, max_windows=3,
+                              reserve_s=max(120.0, time_left() - 45.0))
+        fin = finalize_measure(meas)
+        return {
+            "rows_per_sec": round(fin["rows_per_sec"], 1),
+            "host_rows_per_sec": round(host_rps, 1),
+            "vs_host": round(fin["rows_per_sec"] / host_rps, 3) if host_rps else None,
+            "healthy": fin["healthy_window"],
+            "windows": fin["windows"],
+            "stages": fin["stages"],
+        }
+
+    def bench_ngram():
+        """Acceptance config #4 (BASELINE.json: NGram windowed reader, sequential
+        timeseries). Device path: ``make_reader(schema_fields=NGram)`` →
+        ``DataLoader`` delivering flat ``offset/field`` device columns
+        (loader.py NGram delivery); one row == one window, so rows/s IS windows/s.
+        ``vs_host`` is the same-run reference-equivalent path: iterating the NGram
+        reader's ``{offset: row}`` windows on host (petastorm's only NGram
+        consumption mode)."""
+        from petastorm_tpu import types as ptypes
+        from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+        from petastorm_tpu.metadata import write_dataset
+        from petastorm_tpu.ngram import NGram
+        from petastorm_tpu.reader import make_reader
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+
+        rows_n, bs_n = 16384, 256
+        root_n = os.path.join(tempfile.gettempdir(), "ptpu_bench_ngram_v1")
+        marker_n = os.path.join(root_n, "_done")
+        if not os.path.exists(marker_n):
+            import shutil
+
+            shutil.rmtree(root_n, ignore_errors=True)
+            schema_n = Unischema("BenchSeq", [
+                UnischemaField("ts", np.int64, (), ScalarCodec(ptypes.LongType()),
+                               False),
+                UnischemaField("sensor", np.float32, (16,), NdarrayCodec(), False),
+            ])
+            rng_n = np.random.RandomState(3)
+
+            def seq_rows():
+                for t in range(rows_n):
+                    yield {"ts": t,
+                           "sensor": rng_n.standard_normal(16).astype(np.float32)}
+
+            write_dataset("file://" + root_n, schema_n, seq_rows(),
+                          rows_per_file=8192)
+            with open(marker_n, "w") as f:
+                f.write("ok")
+
+        def make_ngram():
+            return NGram(fields={-1: ["ts", "sensor"], 0: ["ts", "sensor"],
+                                 1: ["ts", "sensor"]},
+                         delta_threshold=2, timestamp_field="ts")
+
+        wn = (np.random.RandomState(13).standard_normal((16, 32)) * 0.1
+              ).astype(np.float32)
+
+        @jax.jit
+        def _nstep(s_prev, s_cur, s_next, t):
+            x = jnp.stack([s_prev, s_cur, s_next], axis=1).astype(jnp.bfloat16)
+            h = jnp.maximum(x @ jnp.asarray(wn, jnp.bfloat16), 0)
+            return jnp.sum(h.astype(jnp.float32)) + t
+
+        def nstep(batch):
+            return _nstep(batch["-1/sensor"], batch["0/sensor"], batch["1/sensor"],
+                          np.float32(next(_tick) % 997) * np.float32(1e-6))
+
+        # host baseline: the reader's own window assembly, consumed on host
+        with make_reader("file://" + root_n, schema_fields=make_ngram(),
+                         shuffle_row_groups=False, num_epochs=None,
+                         workers_count=1) as r_host:
+            it = iter(r_host)
+            for _ in range(256):
+                next(it)
+            n = 0
+            t0 = time.perf_counter()
+            for _w in it:
+                n += 1
+                if n >= 2048:
+                    break
+            host_wps = n / (time.perf_counter() - t0)
+
+        def make_loader():
+            reader = make_reader("file://" + root_n, schema_fields=make_ngram(),
+                                 shuffle_row_groups=False, num_epochs=None,
+                                 workers_count=1)
+            return DataLoader(reader, bs_n, prefetch=3, host_queue_size=8)
+
+        meas = measure_loader(make_loader, nstep, "ngram", warmup_batches=3,
+                              measure_batches=8, max_windows=2,
+                              reserve_s=max(100.0, time_left() - 35.0))
+        fin = finalize_measure(meas)
+        return {
+            "windows_per_sec": round(fin["rows_per_sec"], 1),
+            "host_windows_per_sec": round(host_wps, 1),
+            "vs_host": round(fin["rows_per_sec"] / host_wps, 3) if host_wps
+            else None,
+            "healthy": fin["healthy_window"],
+            "windows": fin["windows"],
+            "stages": fin["stages"],
+        }
+
     def attempt(fn, what, retries=1):
         """The tunnel service intermittently drops RPCs (remote_compile body closed,
         mid-run); a transient failure must degrade the artifact, not erase it."""
@@ -447,41 +678,124 @@ def main():
                 sys.stderr.write("bench: %s failed (attempt %d): %s\n" % (what, i, e))
         return None
 
-    jstep = attempt(make_resnet_step, "resnet step build")
-    if jstep is not None:
+    host_meas = measure(decode_on_device=False, measure_batches=14, reserve_s=300.0)
+    from petastorm_tpu.ops.jpeg import transfer_byte_counters
+
+    transfer_byte_counters(reset=True)
+    device_meas = measure(decode_on_device=True, reserve_s=260.0)
+    xfer = transfer_byte_counters()
+
+    # Remaining acceptance configs (VERDICT r4 #4): cheap host-dominated modes, run
+    # BEFORE the big overlap budget so they always land in the artifact.
+    tabular = attempt(bench_tabular, "tabular bench", retries=0)
+    ngram = attempt(bench_ngram, "ngram bench", retries=0)
+
+    fwd = attempt(make_resnet_step, "resnet step build")
+    fwd_step = (lambda b: fwd(b["image"])) if fwd else None
+    if fwd is not None:
         # seed the resnet step floor BEFORE the first overlap window: without it the
         # first window self-floors and its health flag is vacuously true even in a
         # degraded interval (also warms the compile off the measured windows)
         def _seed_floor():
             img = jax.device_put(np.zeros((BATCH,) + IMG, np.uint8))
-            jax.block_until_ready(jstep(img))  # compile
+            jax.block_until_ready(fwd(img))  # compile
             t0 = time.perf_counter()
             r = None
             for _ in range(10):
-                r = jstep(img)
+                r = fwd(img)
             jax.block_until_ready(r)
             window_health("resnet50", (time.perf_counter() - t0) / 10)
 
         attempt(_seed_floor, "resnet floor seed", retries=0)
-    # hostdec overlap FIRST: it is the north-star number (consumer starvation with a
-    # busy device = idle), so it gets budget priority over the device-decode overlap
+
+    train_step = attempt(make_resnet_train_step, "resnet train step build")
+    if train_step is not None:
+        def _seed_train_floor():
+            fake = {"image": jax.device_put(np.zeros((BATCH,) + IMG, np.uint8)),
+                    "label": jax.device_put(np.zeros((BATCH,), np.int32))}
+            jax.block_until_ready(train_step(fake))  # compile
+            t0 = time.perf_counter()
+            r = None
+            for _ in range(10):
+                r = train_step(fake)
+            jax.block_until_ready(r)
+            window_health("resnet50_train", (time.perf_counter() - t0) / 10)
+
+        attempt(_seed_train_floor, "train floor seed", retries=0)
+
+    # TRAIN overlap FIRST (VERDICT r4 #3): the north-star number is device idle at a
+    # ResNet-50 TRAINING step — fwd+bwd+optimizer with donated state — fed by the
+    # host-decode pipeline (consumer starvation there IS device idle). The fwd-only
+    # overlaps stay for r3/r4 comparability and for bounding decode's on-chip share.
+    train_res = attempt(lambda: measure_overlap(
+        train_step, decode_on_device=False, measure_batches=10, max_windows=3,
+        reserve_s=120.0, step_key="resnet50_train"), "train overlap") \
+        if train_step else None
     hostdec_res = attempt(lambda: measure_overlap(
-        jstep, decode_on_device=False, measure_batches=10, max_windows=4,
-        reserve_s=90.0), "hostdec overlap") if jstep else None
+        fwd_step, decode_on_device=False, measure_batches=10, max_windows=2,
+        reserve_s=80.0), "hostdec overlap") if fwd_step else None
     devdec_res = attempt(lambda: measure_overlap(
-        jstep, decode_on_device=True, measure_batches=16, max_windows=2,
-        reserve_s=30.0), "devdec overlap") if jstep else None
+        fwd_step, decode_on_device=True, measure_batches=16, max_windows=1,
+        reserve_s=45.0), "devdec overlap") if fwd_step else None
+
+    # Budget-driven healthy-window retries (VERDICT r4 #2): while any gate path
+    # lacks a healthy window and budget remains, re-open windows on exactly the
+    # unhealthy paths and fold them into the same pools — the end-of-round bench
+    # spends its remaining budget hunting a healthy interval instead of idling.
+    def _gate():
+        return {
+            "host": finalize_measure(host_meas)["healthy_window"],
+            "device": finalize_measure(device_meas)["healthy_window"],
+            "train": finalize_overlap(train_res)[2],
+            "hostdec": finalize_overlap(hostdec_res)[2],
+            "devdec": finalize_overlap(devdec_res)[2],
+        }
+
+    retry_round = 0
+    while retry_round < 4 and time_left() > 150.0:
+        g = _gate()
+        if all(g.values()):
+            break
+        retry_round += 1
+        sys.stderr.write("bench: retry round %d, unhealthy paths: %s\n"
+                         % (retry_round, sorted(k for k, v in g.items() if not v)))
+        if not g["device"]:
+            device_meas = merge_meas(device_meas, attempt(lambda: measure(
+                decode_on_device=True, max_windows=2, reserve_s=130.0),
+                "device measure retry", retries=0))
+        if not g["host"] and time_left() > 150.0:
+            host_meas = merge_meas(host_meas, attempt(lambda: measure(
+                decode_on_device=False, measure_batches=14, max_windows=2,
+                reserve_s=130.0), "host measure retry", retries=0))
+        if not g["train"] and train_step and time_left() > 150.0:
+            train_res = merge_meas(train_res, attempt(lambda: measure_overlap(
+                train_step, decode_on_device=False, measure_batches=10,
+                max_windows=2, reserve_s=130.0, step_key="resnet50_train"),
+                "train overlap retry", retries=0))
+        if not g["hostdec"] and fwd_step and time_left() > 150.0:
+            hostdec_res = merge_meas(hostdec_res, attempt(lambda: measure_overlap(
+                fwd_step, decode_on_device=False, measure_batches=10,
+                max_windows=2, reserve_s=130.0), "hostdec overlap retry",
+                retries=0))
+        if not g["devdec"] and fwd_step and time_left() > 150.0:
+            devdec_res = merge_meas(devdec_res, attempt(lambda: measure_overlap(
+                fwd_step, decode_on_device=True, measure_batches=16,
+                max_windows=1, reserve_s=130.0), "devdec overlap retry",
+                retries=0))
+
     # all measurements done: re-judge every window against the run's final floors
     # and select bests (finalize_* docstrings)
-    host = finalize_measure(host)
-    device = finalize_measure(device)
+    host = finalize_measure(host_meas)
+    device = finalize_measure(device_meas)
+    overlap_train, train_windows, train_healthy = finalize_overlap(train_res)
     overlap_hostdec, hostdec_windows, hostdec_healthy = finalize_overlap(hostdec_res)
     overlap, overlap_windows, overlap_healthy = finalize_overlap(devdec_res)
 
     vs = device["rows_per_sec"] / host["rows_per_sec"] if host["rows_per_sec"] else 1.0
 
     all_paths_healthy = bool(device["healthy_window"] and host["healthy_window"]
-                             and overlap_healthy and hostdec_healthy)
+                             and train_healthy and overlap_healthy
+                             and hostdec_healthy)
 
     def classify_regime():
         """One word a reader checks BEFORE trusting any absolute number.
@@ -499,7 +813,7 @@ def main():
           round 4).
         - ``no_measurements``: nothing ran.
         """
-        all_windows = (device["windows"] + host["windows"]
+        all_windows = (device["windows"] + host["windows"] + train_windows
                        + overlap_windows + hostdec_windows)
         if not all_windows:
             return "no_measurements"
@@ -509,25 +823,39 @@ def main():
         if any(w["healthy"] for w in all_windows):
             return "healthy" if all_paths_healthy else "mixed"
         return "fake_fast_service_untrusted" if any(below_floor) else "degraded"
+    regime = classify_regime()
     # NOTE key semantics (r3 judging confusion): the former free-device
     # 'device_idle_fraction' (≥90% by construction whenever the pipeline outruns a
-    # bare conv step) is GONE; the north-star idle is 'overlap_hostdec_device_idle_
-    # fraction' (consumer starvation with the device kept busy — host-decode
-    # pipeline, so starvation IS idle). 'healthy' flags + per-window arrays expose
-    # service weather instead of letting one degraded interval masquerade as the
-    # pipeline's capability.
-    print(json.dumps({
+    # bare conv step) is GONE; the north-star idle is
+    # 'overlap_train_device_idle_fraction' (consumer starvation with the device kept
+    # busy at a REAL fwd+bwd+SGD step — host-decode pipeline, so starvation IS
+    # idle), with 'overlap_hostdec_*' the fwd-only r3/r4-comparable secondary.
+    # 'healthy' flags + per-window arrays expose service weather instead of letting
+    # one degraded interval masquerade as the pipeline's capability.
+    full = {
         "metric": "jpeg224_rows_per_sec_device_decode",
         "value": round(device["rows_per_sec"], 1),
         "unit": "rows/s",
         "vs_baseline": round(vs, 3),
         "healthy_windows": all_paths_healthy,
-        "regime": classify_regime(),
+        "regime": regime,
         "step_ms": round(device["step_ms"], 2),
         "h2d_cal_mb_s": round(weather["h2d_best_mb_s"], 1),
         "host_decode_rows_per_sec": round(host["rows_per_sec"], 1),
         "device_windows": device["windows"],
         "host_windows": host["windows"],
+        "overlap_train_device_idle_fraction":
+            round(overlap_train.device_idle_fraction, 4) if overlap_train
+            else None,
+        "overlap_train_rows_per_sec":
+            round(overlap_train.rows_per_second, 1) if overlap_train else None,
+        "overlap_train_step_repeats":
+            overlap_train.step_repeats if overlap_train else None,
+        "overlap_train_step_ms":
+            round((overlap_train.step_seconds or 0) * 1e3, 2) if overlap_train
+            else None,
+        "overlap_train_windows": train_windows,
+        "overlap_train_stages": overlap_train.stages if overlap_train else None,
         "overlap_device_idle_fraction":
             round(overlap.device_idle_fraction, 4) if overlap else None,
         "overlap_rows_per_sec":
@@ -547,6 +875,8 @@ def main():
         "overlap_hostdec_windows": hostdec_windows,
         "overlap_hostdec_stages": overlap_hostdec.stages if overlap_hostdec
             else None,
+        "tabular": tabular,
+        "ngram": ngram,
         "content": content,
         # realized coefficient-transfer narrowing (truncation + spectral split +
         # packs): shipped H2D bytes as a fraction of full-int16 coefficients
@@ -554,6 +884,53 @@ def main():
             round(xfer["shipped"] / xfer["raw"], 4) if xfer["raw"] else None,
         "stages": device["stages"],
         "host_stages": host["stages"],
+        "wall_s": round(time.perf_counter() - _t_main, 1),
+    }
+
+    # best healthy TRAIN window (falling back to fwd hostdec): the affirmative
+    # north-star capture, or null when no healthy window opened this run
+    def best_healthy():
+        for res, wins, ok in ((overlap_train, train_windows, train_healthy),
+                              (overlap_hostdec, hostdec_windows, hostdec_healthy)):
+            if res is not None and ok:
+                return {"rows_per_sec": round(res.rows_per_second, 1),
+                        "idle": round(res.device_idle_fraction, 4),
+                        "step_ms": round((res.step_seconds or 0) * 1e3, 2)}
+        return None
+
+    # Auditable record (VERDICT r4 #2): EVERY full bench output lands in
+    # BENCH_HISTORY.jsonl with a wallclock stamp, so healthy-window captures
+    # survive even when the driver artifact rides bad weather.
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_HISTORY.jsonl"), "a") as f:
+            f.write(json.dumps(
+                {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                 **full}) + "\n")
+    except OSError as e:
+        sys.stderr.write("bench: history append failed: %s\n" % e)
+
+    print(json.dumps(full))
+    # LAST line: compact summary guaranteed to survive the driver's 2000-char tail
+    # capture (VERDICT r4 #1 — r3/r4 artifacts lost their own headline to
+    # truncation). Everything a reader must check before trusting a number.
+    print(json.dumps({
+        "metric": full["metric"],
+        "value": full["value"],
+        "unit": full["unit"],
+        "vs_baseline": full["vs_baseline"],
+        "regime": regime,
+        "healthy_windows": all_paths_healthy,
+        "best_healthy": best_healthy(),
+        "train_idle": full["overlap_train_device_idle_fraction"],
+        "coeff_bytes_shipped_ratio": full["coeff_bytes_shipped_ratio"],
+        "tabular": None if tabular is None else {
+            "rows_per_sec": tabular["rows_per_sec"], "vs_host": tabular["vs_host"],
+            "healthy": tabular["healthy"]},
+        "ngram": None if ngram is None else {
+            "windows_per_sec": ngram["windows_per_sec"],
+            "vs_host": ngram["vs_host"], "healthy": ngram["healthy"]},
+        "history": "BENCH_HISTORY.jsonl",
     }))
 
 

@@ -90,7 +90,7 @@ def main():
     opt_state = tx.init(params)
 
     @jax.jit
-    def train_step(params, batch_stats, opt_state, image, label):
+    def train_step(params, batch_stats, opt_state, image, label, dropout_rng):
         def loss_fn(p):
             x = image.astype(jnp.float32) / 255.0
             variables = {"params": p}
@@ -99,8 +99,9 @@ def main():
                 out, updates = model.apply(variables, x, train=True,
                                            mutable=["batch_stats"])
                 new_stats = updates["batch_stats"]
-            else:  # ViT: no mutable state (dropout off at rate 0.0 default)
-                out = model.apply(variables, x, train=False)
+            else:  # ViT: no mutable state; dropout stays LIVE in training
+                out = model.apply(variables, x, train=True,
+                                  rngs={"dropout": dropout_rng})
                 new_stats = batch_stats
             loss = optax.softmax_cross_entropy_with_integer_labels(out, label).mean()
             return loss, new_stats
@@ -140,12 +141,14 @@ def main():
                         device_decode_resize=resize, trace=tracer) as loader:
             import contextlib
 
+            dropout_base = jax.random.PRNGKey(0)
             for batch in loader:
                 with tracer.span("train.step") if tracer is not None \
                         else contextlib.nullcontext():
                     params, batch_stats, opt_state, loss = train_step(
                         params, batch_stats, opt_state, batch["image"],
-                        jnp.asarray(batch["label"]))
+                        jnp.asarray(batch["label"]),
+                        jax.random.fold_in(dropout_base, step))
                 step += 1
                 if step % 20 == 0:
                     jax.block_until_ready(loss)

@@ -148,8 +148,11 @@ def main(argv=None):
                 raw = xfer["raw"] - xfer0["raw"]
                 shipped = xfer["shipped"] - xfer0["shipped"]
                 if raw:
+                    # printed as the shipped/raw RATIO — same semantics as the
+                    # artifact key `coeff_bytes_shipped_ratio` (ADVICE r4: the old
+                    # "x0.42 narrowing" phrasing read as a speedup factor)
                     print("coefficient transfer: shipped %.1f MB of %.1f MB raw "
-                          "int16 (x%.2f narrowing)"
+                          "int16 (%.2f of raw shipped)"
                           % (shipped / 1e6, raw / 1e6, shipped / raw))
         else:
             result = reader_throughput(reader, args.warmup_rows, args.measure_rows)

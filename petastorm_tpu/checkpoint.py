@@ -32,6 +32,12 @@ the write, and :func:`apply` routes each process its own shard entry on restore
 (keyed by ``cur_shard``, falling back to process index). Pod preemption therefore
 resumes EVERY process at its exact cursor from the one checkpoint directory — no
 row lost or duplicated on any shard, no hand-rolled per-process paths.
+
+When batches flow through a :class:`petastorm_tpu.loader.DataLoader`, pass the
+LOADER to these entry points instead of the reader — it duck-types as a reader
+(``state_dict``/``load_state_dict``/``cur_shard``) and checkpoints at the
+CONSUMER watermark, so rows prefetched into loader buffers but not yet yielded
+replay after restore instead of being skipped (``DataLoader.state_dict`` docs).
 """
 from __future__ import annotations
 
@@ -43,10 +49,15 @@ _GLOBAL_KEY = "ptpu_per_process"
 
 
 def save_args(reader):
-    """``ocp.args.JsonSave`` of the reader's exact-resume state — pass as one item of
+    """COLLECTIVE under multi-process JAX — every process must call this (it
+    allgathers the pod's states); calling it on process 0 only deadlocks the pod.
+
+    ``ocp.args.JsonSave`` of the reader's exact-resume state — pass as one item of
     an ``ocp.args.Composite`` alongside params/opt-state. Under multi-process JAX the
     payload carries EVERY process's state (small JSON, one allgather) so the single
-    orbax item is pod-exact."""
+    orbax item is pod-exact. For a non-collective per-process payload (old callers
+    that checkpoint each process separately), use
+    ``ocp.args.JsonSave(reader.state_dict())`` directly."""
     import orbax.checkpoint as ocp
 
     return ocp.args.JsonSave(global_state_dict(reader))
@@ -60,7 +71,10 @@ def restore_args():
 
 
 def global_state_dict(reader):
-    """This pod's complete data-plane state: ``{_GLOBAL_KEY: {shard_key: state}}``
+    """COLLECTIVE under multi-process JAX — every process must call it in the same
+    order (one allgather); a subset-of-processes call deadlocks.
+
+    This pod's complete data-plane state: ``{_GLOBAL_KEY: {shard_key: state}}``
     with one entry per process under multi-process JAX, or the plain per-process
     state dict single-process."""
     import jax
@@ -96,7 +110,8 @@ def apply(reader, restored_state):
 
 def save(path, reader):
     """Standalone orbax checkpoint of just the data-plane state at ``path``
-    (pod-exact under multi-process JAX, see :func:`save_args`)."""
+    (pod-exact under multi-process JAX, see :func:`save_args`; COLLECTIVE — all
+    processes must call it)."""
     import orbax.checkpoint as ocp
 
     ckptr = ocp.Checkpointer(ocp.JsonCheckpointHandler())

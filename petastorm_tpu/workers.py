@@ -34,6 +34,44 @@ class _ExcResult:
         self.exc = exc
 
 
+def _iter_results(results_q, stop_event, timeout, stop_fn):
+    """Shared results-drain loop for the threaded/process pools.
+
+    Ends on the ``_DONE`` marker, re-raises worker exceptions (stopping the pool
+    first), raises :class:`TimeoutWaitingForResultError` when nothing arrives
+    within ``timeout`` — and returns PROMPTLY once ``stop_event`` is set and the
+    queue is empty. The prompt return matters: ``stop()`` drains the results queue
+    (including a ``_DONE`` already posted), so a consumer on ANOTHER thread that
+    was blocked in ``get()`` at stop time — e.g. a tf.data generator thread being
+    finalized while the main thread tears the reader down — used to sleep out the
+    full ``results_timeout_s`` (the flaky exactly-300.07s ``test_tf_tensors_eager``
+    hang, VERDICT r4 #7)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            value = results_q.get(timeout=0.2)
+        except queue.Empty:
+            if stop_event.is_set():
+                return  # stopped: the stream is over for this consumer
+            if time.monotonic() > deadline:
+                raise TimeoutWaitingForResultError(
+                    "No worker result within %.0fs" % timeout
+                ) from None
+            continue
+        if value is _DONE:
+            return
+        if isinstance(value, _ExcResult):
+            stop_fn()
+            raise value.exc
+        yield value
+        # fresh budget per consumer request (matching the old per-get semantics):
+        # time the CONSUMER spent between next() calls must not count against the
+        # worker-result timeout
+        deadline = time.monotonic() + timeout
+
+
 class ExecutorBase:
     def start(self, worker, plan):
         raise NotImplementedError
@@ -133,19 +171,8 @@ class ThreadExecutor(ExecutorBase):
                     return
 
     def results(self):
-        while True:
-            try:
-                value = self._results.get(timeout=self._timeout)
-            except queue.Empty:
-                raise TimeoutWaitingForResultError(
-                    "No worker result within %.0fs" % self._timeout
-                ) from None
-            if value is _DONE:
-                return
-            if isinstance(value, _ExcResult):
-                self.stop()
-                raise value.exc
-            yield value
+        return _iter_results(self._results, self._stop_event, self._timeout,
+                             self.stop)
 
     def stop(self):
         self._stop_event.set()
@@ -450,19 +477,8 @@ class ProcessExecutor(ExecutorBase):
                     return
 
     def results(self):
-        while True:
-            try:
-                value = self._results.get(timeout=self._timeout)
-            except queue.Empty:
-                raise TimeoutWaitingForResultError(
-                    "No worker result within %.0fs" % self._timeout
-                ) from None
-            if value is _DONE:
-                return
-            if isinstance(value, _ExcResult):
-                self.stop()
-                raise value.exc
-            yield value
+        return _iter_results(self._results, self._stop_event, self._timeout,
+                             self.stop)
 
     def stop(self):
         self._stop_event.set()

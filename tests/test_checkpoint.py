@@ -175,3 +175,127 @@ def test_cross_shard_state_raises(scalar_dataset):
     other = _sharded_reader(scalar_dataset.url, 1)
     with other, pytest.raises(ValueError, match="wrong rows"):
         other.load_state_dict(state)
+
+
+# -- DataLoader consumer-watermark checkpointing (round 5) --------------------------
+
+
+def _rowgroup_dataset(tmp_path, n_rows=64, rg=8):
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "rg_ds")
+    os.makedirs(path)
+    table = pa.table({"id": np.arange(n_rows, dtype=np.int64),
+                      "val": np.arange(n_rows, dtype=np.float32)})
+    pq.write_table(table, os.path.join(path, "part-0.parquet"), row_group_size=rg)
+    return "file://" + path
+
+
+def _ordered_reader(url):
+    return make_batch_reader(url, shuffle_row_groups=False, num_epochs=1,
+                             reader_pool_type="dummy")
+
+
+def test_loader_state_dict_consumer_watermark(tmp_path):
+    """Checkpoint THROUGH a prefetching DataLoader mid-stream: the saved state must
+    reflect what the CONSUMER received, not what the producer prefetched — rows
+    buffered in loader queues at save time replay after restore (nothing lost),
+    and with batch == row group the resume is exact (disjoint union)."""
+    from petastorm_tpu.loader import DataLoader
+
+    url = _rowgroup_dataset(tmp_path)
+    pre = []
+    loader = DataLoader(_ordered_reader(url), batch_size=8, prefetch=3,
+                        host_queue_size=8, to_device=False)
+    with loader:
+        it = iter(loader)
+        for _ in range(3):
+            pre.extend(int(x) for x in next(it)["id"])
+        state = loader.state_dict()
+    # the reader itself ran AHEAD of the consumer (prefetch): its own state at save
+    # time must have consumed at least as much as the watermark state
+    assert pre == list(range(24))
+
+    resumed = DataLoader(_ordered_reader(url), batch_size=8, to_device=False)
+    resumed.load_state_dict(state)
+    post = []
+    with resumed:
+        for b in resumed:
+            post.extend(int(x) for x in b["id"])
+    assert sorted(pre + post) == list(range(64))  # exact: nothing lost, no replay
+    assert not set(pre) & set(post)
+
+
+def test_loader_state_dict_beats_reader_state(tmp_path):
+    """The motivating failure: saving the READER's state mid-stream through a
+    prefetching loader skips every row sitting in the loader's buffers on restore
+    (delivered to the producer thread, never seen by the consumer); the loader's
+    consumer-watermark state replays them."""
+    import time
+
+    from petastorm_tpu.loader import DataLoader
+
+    url = _rowgroup_dataset(tmp_path)
+    loader = DataLoader(_ordered_reader(url), batch_size=8, prefetch=3,
+                        host_queue_size=8, to_device=False)
+    with loader:
+        it = iter(loader)
+        pre = [int(x) for x in next(it)["id"]]
+        time.sleep(0.5)  # let the producer run ahead into the queues
+        reader_state = loader.reader.state_dict()
+        loader_state = loader.state_dict()
+
+    def rows_after_restore(state):
+        resumed = DataLoader(_ordered_reader(url), batch_size=8, to_device=False)
+        resumed.load_state_dict(state)
+        with resumed:
+            return [int(x) for b in resumed for x in b["id"]]
+
+    lost_path = rows_after_restore(reader_state)
+    exact_path = rows_after_restore(loader_state)
+    # reader-state restore: the prefetched-but-unconsumed rows are gone for good
+    assert set(pre) | set(lost_path) != set(range(64))
+    # loader-state restore: every row not consumed pre-save comes back
+    assert sorted(pre + exact_path) == list(range(64))
+
+
+def test_loader_state_dict_orbax_roundtrip(tmp_path):
+    """ptck.save/restore accept a DataLoader (duck-typed reader): pod-exact
+    machinery composes with consumer-watermark state."""
+    from petastorm_tpu.loader import DataLoader
+
+    url = _rowgroup_dataset(tmp_path)
+    pre = []
+    loader = DataLoader(_ordered_reader(url), batch_size=8, prefetch=3,
+                        to_device=False)
+    with loader:
+        it = iter(loader)
+        for _ in range(2):
+            pre.extend(int(x) for x in next(it)["id"])
+        ptck.save(str(tmp_path / "lckpt"), loader)
+
+    resumed = DataLoader(_ordered_reader(url), batch_size=8, to_device=False)
+    ptck.restore(str(tmp_path / "lckpt"), resumed)
+    post = []
+    with resumed:
+        for b in resumed:
+            post.extend(int(x) for x in b["id"])
+    assert sorted(pre + post) == list(range(64))
+    assert not set(pre) & set(post)
+
+
+def test_loader_state_dict_shuffling_refuses(tmp_path):
+    """A shuffled row can linger in the buffer indefinitely — a mid-epoch watermark
+    would lose it. state_dict must refuse, pointing at the epoch-boundary path."""
+    from petastorm_tpu.loader import DataLoader
+
+    url = _rowgroup_dataset(tmp_path)
+    loader = DataLoader(_ordered_reader(url), batch_size=8, to_device=False,
+                        shuffling_queue_capacity=16)
+    with loader:
+        next(iter(loader))
+        with pytest.raises(ValueError, match="epoch boundary"):
+            loader.state_dict()

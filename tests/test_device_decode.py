@@ -200,9 +200,42 @@ def test_sharded_loader_with_presharding_codec_signature(jpeg_dataset):
             img = batch["image_jpeg"]
             assert img.shape == (8, 32, 48, 3)
             assert len(img.sharding.device_set) == 8  # resharded after decode
+            # the fallback is correct but single-device — it must be SURFACED
+            # (VERDICT r4 #6), not silent
+            assert loader.stats.decode_unsharded_batches >= 1
+            assert "decode_unsharded_batches" in loader.stats.snapshot()
     finally:
         codecs_mod.CompressedImageCodec.device_decode_batch = orig
     assert calls  # the legacy signature really was invoked, without a TypeError
+
+
+def test_decode_unsharded_fallback_counter_and_warning(jpeg_dataset, caplog):
+    """An 8-way batch sharding with an undivisible batch makes staged decode fall
+    back to a single device: the loader must count it in
+    ``PipelineStats.decode_unsharded_batches`` and warn once BEFORE the layout
+    error surfaces (VERDICT r4 #6 — on a pod host this fallback silently makes one
+    chip decode for eight)."""
+    import logging
+
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+    reader = make_batch_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
+                               shuffle_row_groups=False)
+    loader = DataLoader(reader, batch_size=6, sharding=sharding)  # 6 % 8 != 0
+    with caplog.at_level(logging.WARNING, logger="petastorm_tpu.loader"):
+        with loader:
+            try:
+                for _ in loader:
+                    pass
+            except Exception:  # noqa: BLE001 — 6 rows cannot device_put 8-way; the
+                pass  # counter/warning must fire BEFORE that layout error
+    assert loader.stats.decode_unsharded_batches >= 1
+    warnings = [r for r in caplog.records
+                if "SINGLE device" in r.getMessage()]
+    assert len(warnings) == 1  # warn-once contract
 
 
 def test_device_decode_then_device_transform(jpeg_dataset):

@@ -235,7 +235,9 @@ def test_stats_populate_through_device_path(scalar_dataset):
     assert snap["batches"] == n > 0
     assert snap["rows"] == n * 8
     assert set(snap) == {"rows", "batches", "read_s", "batch_s", "decode_s", "h2d_s",
-                         "queue_wait_s", "device_queue_wait_s"}
+                         "queue_wait_s", "device_queue_wait_s",
+                         "decode_unsharded_batches"}
+    assert snap["decode_unsharded_batches"] == 0  # no sharding configured → no fallback
     assert snap["read_s"] >= 0 and snap["device_queue_wait_s"] >= 0
 
 

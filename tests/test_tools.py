@@ -164,7 +164,7 @@ def test_benchmark_cli_decode_on_device_reports_narrowing(capsys, tmp_path):
     main([url, "--loader", "--loader-batch-size", "6", "--decode-on-device",
           "--warmup-rows", "6", "--measure-rows", "12"])
     out = capsys.readouterr().out
-    assert "coefficient transfer" in out and "narrowing" in out
+    assert "coefficient transfer" in out and "of raw shipped" in out
 
 
 def test_benchmark_cli_decode_on_device_requires_loader(scalar_dataset):
