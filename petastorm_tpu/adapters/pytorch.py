@@ -50,6 +50,13 @@ class LoaderBase:
                 "petastorm_tpu.loader.DataLoader, or rebuild the reader with "
                 "decode_on_device=False for the torch path."
             )
+        if getattr(reader, "ngram", None) is not None \
+                and getattr(reader, "is_batched_reader", False):
+            raise ValueError(
+                "The torch adapters do not support batched NGram readers (their "
+                "flat 'offset/field' columns are the JAX DataLoader's device "
+                "convention). Use make_reader(schema_fields=ngram) here, or the "
+                "JAX DataLoader for the columnar path.")
         self.reader = reader
         self._stopped = False
 

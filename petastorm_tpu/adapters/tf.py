@@ -103,6 +103,12 @@ def make_petastorm_dataset(reader):
     schema = reader.schema
 
     if reader.ngram is not None:
+        if getattr(reader, "is_batched_reader", False):
+            raise ValueError(
+                "The TF adapter does not support batched NGram readers (their "
+                "flat 'offset/field' columns are the JAX DataLoader's device "
+                "convention). Use make_reader(schema_fields=ngram) here, or the "
+                "JAX DataLoader for the columnar path.")
         return _make_ngram_dataset(tf, reader)
 
     dtypes = _schema_to_tf_dtypes(tf, schema)

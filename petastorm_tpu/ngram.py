@@ -128,6 +128,54 @@ class NGram:
         return ngrams
 
 
+def form_ngram_columns(columns, ngram):
+    """Columnar windowing for the BATCH reader path: one row group's ``{name:
+    column}`` → flat ``{'offset/name': column[order[starts + pos]]}`` window
+    columns.
+
+    TPU-first extension over the reference (whose NGram exists only on the
+    per-row path, one python dict per window): window assembly is one argsort of
+    the timestamps plus one fancy-index gather per (offset, field) — no
+    per-window python at all — and the flat ``offset/field`` naming is exactly
+    the device-column convention the JAX loader already delivers, so batches go
+    straight to ``jax.Array`` columns. Row count of every output column is the
+    window count (one row == one window).
+
+    Windows never span row groups (reference semantics: ``form_ngram`` runs per
+    row group). Returns ``{}`` when the group is shorter than the window or no
+    window satisfies ``delta_threshold``.
+    """
+    ts_name = ngram.timestamp_field_name
+    ts = columns.get(ts_name)
+    if ts is None:
+        raise ValueError(
+            "NGram timestamp field %r is not among the read columns" % ts_name)
+    ts = np.asarray(ts)
+    if len(ts) < ngram.length:
+        return {}
+    order = np.argsort(ts, kind="stable")
+    starts = valid_window_starts(ts[order], ngram.length, ngram.delta_threshold,
+                                 ngram.timestamp_overlap)
+    if len(starts) == 0:
+        return {}
+    offsets = sorted(ngram.fields)
+    out = {}
+    for pos, offset in enumerate(offsets):
+        idx = order[starts + pos]
+        for name in ngram.get_field_names_at_timestep(offset):
+            col = columns.get(name)
+            if col is None:
+                # match the per-row path, which raises when a requested field is
+                # absent — silently dropping 'offset/name' would lose a feature
+                # column without any error (review r5)
+                raise ValueError(
+                    "NGram field %r (offset %d) is not among the batch columns "
+                    "%s — was it removed by a transform_spec?"
+                    % (name, offset, sorted(columns)))
+            out["%d/%s" % (offset, name)] = col[idx]
+    return out
+
+
 def valid_window_starts(sorted_timestamps, length, delta_threshold, overlap=True):
     """Start indices of valid windows over sorted timestamps — vectorized.
 

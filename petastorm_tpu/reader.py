@@ -331,8 +331,15 @@ class ArrowWorker(_WorkerBase):
     """Vectorized batch path (reference ``ArrowReaderWorker``): row group → columnar numpy dict.
 
     Stays columnar the whole way — the shape the JAX loader wants. TransformSpec runs on a
-    pandas DataFrame (reference contract).
+    pandas DataFrame (reference contract). With an ``ngram``, the columnar batch is
+    windowed in-worker (post-transform) via :func:`petastorm_tpu.ngram.form_ngram_columns`
+    into flat ``offset/field`` columns — a TPU-first extension; the reference's NGram
+    exists only on the per-row path (petastorm/ngram.py ~L40).
     """
+
+    def __init__(self, *args, ngram=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._ngram = ngram
 
     def __call__(self, item):
         piece, _partition = item
@@ -357,6 +364,10 @@ class ArrowWorker(_WorkerBase):
                     columns[name] = stack_as_column(series.to_list())
                 else:
                     columns[name] = series.to_numpy()  # no per-row materialization
+        if self._ngram is not None:
+            from petastorm_tpu.ngram import form_ngram_columns
+
+            columns = form_ngram_columns(columns, self._ngram)
         return columns
 
     def _load_columns(self, item):
@@ -894,7 +905,11 @@ class Reader:
             epoch, ordinal, columns = nxt
             self._mark_consumed((epoch, ordinal))  # batch delivery is atomic
             if not columns or len(next(iter(columns.values()))) == 0:
-                continue  # fully-filtered row group: skip empty batches
+                continue  # fully-filtered (or windowless) row group: skip
+            if self.ngram is not None:
+                # flat 'offset/field' window columns cannot be namedtuple
+                # attributes — batched NGram delivers plain dicts
+                return dict(columns)
             return self._row_type(**{name: columns.get(name)
                                      for name in self.schema.fields})
 
@@ -984,6 +999,19 @@ class Reader:
 # --------------------------------------------------------------------------------------
 
 
+def _resolve_ngram_schema(schema_fields, stored_schema, predicate):
+    """Shared NGram policy for both reader factories: which options NGram forbids
+    and how its read-schema view is built. Returns ``(ngram-or-None, read_schema)``."""
+    if isinstance(schema_fields, NGram):
+        if predicate is not None:
+            raise ValueError("NGram readers do not support predicates")
+        schema_fields.resolve_regex_field_names(stored_schema)
+        return schema_fields, schema_fields.make_schema_view(stored_schema)
+    if schema_fields:
+        return None, stored_schema.create_schema_view(schema_fields)
+    return None, stored_schema
+
+
 def _resolve_device_fields(schema, decode_on_device, ngram=None, transform_spec=None):
     """Fields whose codec decode should stop at the host staging half (stage 1)."""
     if not decode_on_device:
@@ -1049,17 +1077,8 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     if partition_info:
         stored_schema = _schema_with_partitions(stored_schema, partition_info)
 
-    ngram = None
-    if isinstance(schema_fields, NGram):
-        if predicate is not None:
-            raise ValueError("NGram readers do not support predicates")
-        ngram = schema_fields
-        ngram.resolve_regex_field_names(stored_schema)
-        read_schema = ngram.make_schema_view(stored_schema)
-    elif schema_fields is not None:
-        read_schema = stored_schema.create_schema_view(schema_fields)
-    else:
-        read_schema = stored_schema
+    ngram, read_schema = _resolve_ngram_schema(schema_fields, stored_schema,
+                                               predicate)
 
     final_schema = read_schema
     if transform_spec is not None and not transform_spec.device:
@@ -1118,8 +1137,6 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         dataset_url_or_urls, storage_options, filesystem
     )
     stored_schema = infer_or_load_unischema(fs, path if not isinstance(path, list) else path[0])
-    if isinstance(schema_fields, NGram):
-        raise ValueError("make_batch_reader does not support NGram; use make_reader")
 
     paths = path if isinstance(path, list) else [path]
     pieces = []
@@ -1130,22 +1147,26 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     if partition_info:
         stored_schema = _schema_with_partitions(stored_schema, partition_info)
 
-    read_schema = (
-        stored_schema.create_schema_view(schema_fields) if schema_fields else stored_schema
-    )
+    # NGram here is the TPU-first COLUMNAR path (no reference analog): windows are
+    # assembled in-worker as flat 'offset/field' columns via one gather per
+    # (offset, field); batches deliver as plain dicts (flat names cannot be
+    # namedtuple attributes)
+    ngram, read_schema = _resolve_ngram_schema(schema_fields, stored_schema,
+                                               predicate)
     final_schema = read_schema
     if transform_spec is not None and not transform_spec.device:
         final_schema = transform_schema(read_schema, transform_spec)
 
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
-    device_fields = _resolve_device_fields(read_schema, decode_on_device,
+    device_fields = _resolve_device_fields(read_schema, decode_on_device, ngram,
                                            transform_spec=transform_spec)
     worker = ArrowWorker(
         fs, read_schema, stored_schema, predicate, transform_spec, cache,
         shuffle_row_drop_partitions, filters, seed if seed is not None else shard_seed,
         device_fields=device_fields, partition_info=partition_info,
         io_retries=io_retries, io_retry_backoff_s=io_retry_backoff_s,
+        ngram=ngram,
     )
     r = Reader(
         fs, path, final_schema, stored_schema, worker, pieces,
@@ -1153,7 +1174,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         cur_shard=cur_shard, shard_count=shard_count, shard_seed=shard_seed,
         shuffle_row_drop_partitions=shuffle_row_drop_partitions,
         reader_pool_type=reader_pool_type, workers_count=workers_count,
-        results_queue_size=results_queue_size, is_batched_reader=True,
+        results_queue_size=results_queue_size, is_batched_reader=True, ngram=ngram,
         results_timeout_s=results_timeout_s,
         wire_serializer=wire_serializer or "arrow", worker_respawns=worker_respawns,
     )

@@ -178,3 +178,165 @@ def test_ngram_per_timestep_fields():
     assert ngram.get_field_names_at_timestep(0) == ["id", "sensor_name"]
     assert ngram.get_field_names_at_timestep(1) == ["id"]
     assert "timestamp_ms" in ngram.get_all_field_names()
+
+# -- columnar NGram via make_batch_reader (round 5; no reference analog) ------------
+
+
+def _window_map_per_row(url, ngram):
+    """{first-id: window} via the per-row reference path, for oracle comparison."""
+    out = {}
+    with make_reader(url, schema_fields=ngram, num_epochs=1,
+                     reader_pool_type="dummy", shuffle_row_groups=False) as reader:
+        for w in reader:
+            out[int(w[0].id)] = w
+    return out
+
+
+def test_batched_ngram_matches_per_row_windows(synthetic_dataset):
+    """make_batch_reader(schema_fields=NGram) assembles the SAME windows as the
+    per-row path, as flat 'offset/field' columns — one gather per (offset, field)
+    instead of per-window python dicts."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    fields = {0: ["id", "matrix", "timestamp_ms"], 1: ["id", "timestamp_ms"]}
+    ngram = NGram(fields=fields, delta_threshold=10, timestamp_field="timestamp_ms")
+    expected = _window_map_per_row(synthetic_dataset.url, ngram)
+    assert expected
+
+    seen = 0
+    with make_batch_reader(synthetic_dataset.url, schema_fields=ngram, num_epochs=1,
+                           reader_pool_type="dummy",
+                           shuffle_row_groups=False) as reader:
+        for batch in reader:
+            assert isinstance(batch, dict)
+            assert set(batch) == {"0/id", "0/matrix", "0/timestamp_ms",
+                                  "1/id", "1/timestamp_ms"}
+            for j, rid in enumerate(batch["0/id"]):
+                w = expected[int(rid)]
+                np.testing.assert_allclose(batch["0/matrix"][j],
+                                           np.asarray(w[0].matrix), rtol=1e-6)
+                assert int(batch["1/id"][j]) == int(w[1].id)
+                assert int(batch["1/timestamp_ms"][j]) \
+                    - int(batch["0/timestamp_ms"][j]) == 10
+                seen += 1
+    assert seen == len(expected)  # every per-row window, exactly once
+
+
+def test_batched_ngram_process_pool_wire(synthetic_dataset):
+    """Flat window columns (slashed names, 3-D tensor columns) survive the process
+    pool's wire serialization."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    ngram = NGram(fields={0: ["id", "matrix"], 1: ["id"]}, delta_threshold=10,
+                  timestamp_field="timestamp_ms")
+    ids = []
+    with make_batch_reader(synthetic_dataset.url, schema_fields=ngram, num_epochs=1,
+                           reader_pool_type="process", workers_count=2,
+                           shuffle_row_groups=False) as reader:
+        for batch in reader:
+            assert batch["0/matrix"].shape[1:] == (8, 4)
+            ids.extend(int(x) for x in batch["0/id"])
+    assert ids and len(ids) == len(set(ids))
+
+
+def test_batched_ngram_delta_threshold_and_overlap(tmp_path):
+    """Columnar windowing honors delta_threshold and timestamp_overlap=False over a
+    vanilla parquet store (gaps break windows; non-overlap keeps disjoint spans)."""
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.reader import make_batch_reader
+
+    path = str(tmp_path / "seq")
+    os.makedirs(path)
+    # timestamps: 0,10,...,90 then a gap, then 200,210,220
+    ts = np.concatenate([np.arange(0, 100, 10), np.array([200, 210, 220])])
+    pq.write_table(pa.table({"ts": ts.astype(np.int64),
+                             "v": np.arange(len(ts), dtype=np.float32)}),
+                   os.path.join(path, "p0.parquet"))
+    url = "file://" + path
+
+    ngram = NGram(fields={0: ["ts", "v"], 1: ["ts", "v"], 2: ["ts", "v"]},
+                  delta_threshold=10, timestamp_field="ts")
+    with make_batch_reader(url, schema_fields=ngram, num_epochs=1,
+                           reader_pool_type="dummy",
+                           shuffle_row_groups=False) as reader:
+        starts = np.concatenate([np.asarray(b["0/ts"]) for b in reader])
+    # 8 windows in the first run (starts 0..70), 1 in the second (200)
+    np.testing.assert_array_equal(np.sort(starts),
+                                  np.concatenate([np.arange(0, 80, 10), [200]]))
+
+    nov = NGram(fields={0: ["ts"], 1: ["ts"], 2: ["ts"]}, delta_threshold=10,
+                timestamp_field="ts", timestamp_overlap=False)
+    with make_batch_reader(url, schema_fields=nov, num_epochs=1,
+                           reader_pool_type="dummy",
+                           shuffle_row_groups=False) as reader:
+        starts = np.concatenate([np.asarray(b["0/ts"]) for b in reader])
+    np.testing.assert_array_equal(np.sort(starts), [0, 30, 60, 200])
+
+
+def test_batched_ngram_through_device_loader(synthetic_dataset):
+    """Batched NGram → DataLoader: the worker's flat columns go straight to device
+    jax.Array columns (no per-row flatten step at all on this path)."""
+    import jax
+
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    ngram = NGram(fields={0: ["id", "matrix"], 1: ["id"]}, delta_threshold=10,
+                  timestamp_field="timestamp_ms")
+    expected = _window_map_per_row(synthetic_dataset.url, ngram)
+
+    reader = make_batch_reader(synthetic_dataset.url, schema_fields=ngram,
+                               num_epochs=1, shuffle_row_groups=False)
+    seen = 0
+    with DataLoader(reader, batch_size=4) as loader:
+        for batch in loader:
+            assert set(batch) == {"0/id", "0/matrix", "1/id"}
+            for v in batch.values():
+                assert isinstance(v, jax.Array)
+            for j, rid in enumerate(np.asarray(batch["0/id"])):
+                w = expected[int(rid)]
+                np.testing.assert_allclose(np.asarray(batch["0/matrix"])[j],
+                                           np.asarray(w[0].matrix), rtol=1e-6)
+                assert int(np.asarray(batch["1/id"])[j]) == int(w[1].id)
+                seen += 1
+    assert seen >= 8
+
+
+def _batched_ngram_reader(url):
+    from petastorm_tpu.reader import make_batch_reader
+
+    ngram = NGram(fields={0: ["id"], 1: ["id"]}, delta_threshold=10,
+                  timestamp_field="timestamp_ms")
+    return make_batch_reader(url, schema_fields=ngram, num_epochs=1,
+                             shuffle_row_groups=False)
+
+
+def test_batched_ngram_torch_adapter_rejects(synthetic_dataset):
+    """The torch adapter rejects batched NGram readers with a pointed error (their
+    windows are the JAX loader's flat device columns, not {offset: row} dicts)."""
+    from petastorm_tpu.adapters.pytorch import DataLoader as TorchDataLoader
+
+    reader = _batched_ngram_reader(synthetic_dataset.url)
+    try:
+        with pytest.raises(ValueError, match="batched NGram"):
+            TorchDataLoader(reader)
+    finally:
+        reader.stop()
+        reader.join()
+
+
+def test_batched_ngram_tf_adapter_rejects(synthetic_dataset):
+    tf = pytest.importorskip("tensorflow")  # noqa: F841 — import gate only
+    from petastorm_tpu.adapters.tf import make_petastorm_dataset
+
+    reader = _batched_ngram_reader(synthetic_dataset.url)
+    try:
+        with pytest.raises(ValueError, match="batched NGram"):
+            make_petastorm_dataset(reader)
+    finally:
+        reader.stop()
+        reader.join()
