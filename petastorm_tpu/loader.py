@@ -1360,6 +1360,64 @@ class InMemDataLoader:
             return {k: v[idx] for k, v in store.items()}
 
         self._gather = jax.jit(_gather)
+        #: (epoch, next batch within epoch) the NEXT yield will serve — the
+        #: exact-resume cursor (epochs are deterministic by seed/epoch fold)
+        self._pos = (0, 0)
+        self._resume = None
+
+    # -- exact resume (epochs are deterministic, so the cursor IS the state) -----------
+
+    def state_dict(self):
+        """Exact-resume cursor: ``(epoch, batch)`` of the next batch to serve, plus
+        the stream-identity config. Epoch order is a pure function of
+        ``seed``/``epoch`` (per process under multi-process JAX), so restoring the
+        cursor into a same-config loader reproduces the stream EXACTLY-once — no
+        replay at all, stronger than the streaming loader's row-group watermark.
+        Duck-types for :mod:`petastorm_tpu.checkpoint` like the other loaders.
+
+        A pending restored cursor (``load_state_dict`` before the first batch) is
+        returned as-is — saving immediately after restoring must not forget the
+        restore point. After a pass completes, the cursor points past its last
+        epoch (an exhausted stream restores to an empty one — correct); a
+        RE-iteration is a new pass and resets the cursor when it starts."""
+        epoch, batch = self._resume if self._resume is not None else self._pos
+        return {"inmem": True, "epoch": int(epoch), "batch": int(batch),
+                "seed": self._seed, "shuffle": bool(self.shuffle),
+                "rows": int(self.rows), "batch_size": int(self.batch_size),
+                "last_batch": self.last_batch,
+                "num_epochs": self.num_epochs}
+
+    def load_state_dict(self, state):
+        """Resume a same-config loader at a saved cursor (before iterating)."""
+        if not state.get("inmem"):
+            raise ValueError(
+                "not an InMemDataLoader state (checkpoint from a streaming loader/"
+                "reader? restore it into the matching object)")
+        mismatches = {
+            k: (state.get(k), have) for k, have in (
+                ("seed", self._seed), ("shuffle", bool(self.shuffle)),
+                ("rows", int(self.rows)), ("batch_size", int(self.batch_size)),
+                ("last_batch", self.last_batch),
+                # a shorter num_epochs would silently serve NOTHING when the
+                # cursor's epoch is past it — a different finite stream entirely
+                ("num_epochs", self.num_epochs),
+            ) if state.get(k) != have
+        }
+        if mismatches:
+            raise ValueError(
+                "InMemDataLoader state does not match this loader's stream config "
+                "(saved vs built): %s — a different config is a different epoch "
+                "stream, and resuming would serve wrong rows" % (mismatches,))
+        self._resume = (int(state["epoch"]), int(state["batch"]))
+        return self
+
+    @property
+    def cur_shard(self):
+        """Per-process routing key for pod checkpoints (process index: each process
+        serves its own resident shard)."""
+        import jax
+
+        return jax.process_index() if self._multiprocess else None
 
     def __len__(self):
         if self._multiprocess:
@@ -1371,8 +1429,12 @@ class InMemDataLoader:
         import jax
         import jax.numpy as jnp
 
-        epoch = 0
-        step = 0
+        resume, self._resume = self._resume, None
+        epoch = resume[0] if resume else 0
+        skip = resume[1] if resume else 0  # batches to skip in the FIRST epoch only
+        # a fresh pass resets the cursor: without this, a checkpoint taken early in
+        # a RE-iteration would carry the previous pass's end-of-stream position
+        self._pos = (epoch, skip)
         takes_key = False
         if self._device_transform is not None:
             import inspect
@@ -1383,17 +1445,23 @@ class InMemDataLoader:
             except (TypeError, ValueError):
                 takes_key = False
         while self.num_epochs is None or epoch < self.num_epochs:
+            # absolute step (for the transform's rng fold) is position-derived so a
+            # resumed stream folds the SAME keys an uninterrupted run would
+            per_epoch = len(self)
             if self._multiprocess:
-                yield from self._multiprocess_epoch(epoch, takes_key, step)
+                yield from self._multiprocess_epoch(epoch, takes_key,
+                                                    epoch * per_epoch, skip)
                 epoch += 1
-                step += self._batches_per_epoch
+                skip = 0
                 continue
             if self.shuffle:
                 key = jax.random.fold_in(jax.random.PRNGKey(self._seed), epoch)
                 perm = jax.random.permutation(key, self.rows)
             else:
                 perm = jnp.arange(self.rows)
-            for start in range(0, self.rows, self.batch_size):
+            for bidx, start in enumerate(range(0, self.rows, self.batch_size)):
+                if bidx < skip:
+                    continue
                 idx = perm[start:start + self.batch_size]
                 if len(idx) < self.batch_size and self.last_batch == "drop":
                     break
@@ -1419,12 +1487,14 @@ class InMemDataLoader:
                     # span covers gather + layout dispatch — the same serving work
                     # the multi-process path's span covers (gather + assembly)
                     self._trace.add("inmem.gather", t_g, time.perf_counter() - t_g)
-                batch = self._apply_transform(batch, step, takes_key)
-                step += 1
+                batch = self._apply_transform(batch, epoch * per_epoch + bidx,
+                                              takes_key)
+                self._pos = (epoch, bidx + 1)
                 yield batch
             epoch += 1
+            skip = 0
 
-    def _multiprocess_epoch(self, epoch, takes_key, step0):
+    def _multiprocess_epoch(self, epoch, takes_key, step0, skip=0):
         """One epoch under multi-process JAX: per-process local permutation gathers,
         each assembled into a global jax.Array from the device-resident local share
         (no host round trip — same path the streaming loader's decode assembly uses)."""
@@ -1439,7 +1509,7 @@ class InMemDataLoader:
             perm = jax.random.permutation(key, self._local_rows)
         else:
             perm = jnp.arange(self._local_rows)
-        for b in range(self._batches_per_epoch):
+        for b in range(skip, self._batches_per_epoch):
             idx = perm[b * self.local_batch_size:(b + 1) * self.local_batch_size]
             t_g = time.perf_counter()
             local = self._gather(self._store, idx)
@@ -1455,6 +1525,7 @@ class InMemDataLoader:
                 # gather + global assembly dispatch: the per-batch serving cost
                 self._trace.add("inmem.gather", t_g, time.perf_counter() - t_g)
             batch = self._apply_transform(batch, step0 + b, takes_key)
+            self._pos = (epoch, b + 1)
             yield batch
 
     def _apply_transform(self, batch, step, takes_key):

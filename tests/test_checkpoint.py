@@ -299,3 +299,112 @@ def test_loader_state_dict_shuffling_refuses(tmp_path):
         next(iter(loader))
         with pytest.raises(ValueError, match="epoch boundary"):
             loader.state_dict()
+
+
+# -- InMemDataLoader exact-resume cursor (round 5) ----------------------------------
+
+
+def test_inmem_loader_state_dict_exact_resume(tmp_path):
+    """Interrupt an InMemDataLoader mid-epoch, rebuild (same config), restore:
+    the resumed stream is IDENTICAL to the uninterrupted run's remainder —
+    exactly-once, no replay (epochs are deterministic by seed/epoch)."""
+    from petastorm_tpu.loader import InMemDataLoader
+
+    url = _rowgroup_dataset(tmp_path)
+
+    def build():
+        return InMemDataLoader(_ordered_reader(url), batch_size=8, num_epochs=3,
+                               shuffle=True, seed=5)
+
+    full = [tuple(int(x) for x in b["id"]) for b in build()]
+    assert len(full) == 24  # 8 batches/epoch x 3
+
+    loader = build()
+    it = iter(loader)
+    pre = [tuple(int(x) for x in next(it)["id"]) for _ in range(11)]
+    state = loader.state_dict()
+    assert state["inmem"] and state["epoch"] == 1 and state["batch"] == 3
+
+    resumed = build()
+    resumed.load_state_dict(state)
+    post = [tuple(int(x) for x in b["id"]) for b in resumed]
+    assert pre == full[:11]
+    assert post == full[11:]  # picks up at batch 12 of the uninterrupted stream
+
+
+def test_inmem_loader_state_dict_orbax_roundtrip(tmp_path):
+    """The InMem cursor rides the same orbax entry points (duck-typed reader)."""
+    from petastorm_tpu.loader import InMemDataLoader
+
+    url = _rowgroup_dataset(tmp_path)
+
+    def build():
+        return InMemDataLoader(_ordered_reader(url), batch_size=8, num_epochs=2,
+                               shuffle=True, seed=9)
+
+    full = [tuple(int(x) for x in b["id"]) for b in build()]
+    loader = build()
+    it = iter(loader)
+    consumed = [tuple(int(x) for x in next(it)["id"]) for _ in range(5)]
+    ptck.save(str(tmp_path / "imckpt"), loader)
+
+    resumed = build()
+    ptck.restore(str(tmp_path / "imckpt"), resumed)
+    post = [tuple(int(x) for x in b["id"]) for b in resumed]
+    assert consumed + post == full
+
+
+def test_inmem_loader_state_dict_config_mismatch_raises(tmp_path):
+    from petastorm_tpu.loader import InMemDataLoader
+
+    url = _rowgroup_dataset(tmp_path)
+    loader = InMemDataLoader(_ordered_reader(url), batch_size=8, num_epochs=2,
+                             shuffle=True, seed=5)
+    state = loader.state_dict()
+    other = InMemDataLoader(_ordered_reader(url), batch_size=16, num_epochs=2,
+                            shuffle=True, seed=5)
+    with pytest.raises(ValueError, match="stream config"):
+        other.load_state_dict(state)
+    with pytest.raises(ValueError, match="InMemDataLoader state"):
+        # a reader/streaming-loader state is not an InMem cursor
+        InMemDataLoader(_ordered_reader(url), batch_size=8).load_state_dict(
+            {"consumed": {}, "resume_epoch": 0})
+
+
+def test_inmem_loader_cursor_edge_cases(tmp_path):
+    """Cursor invariants (review r5): a restored-but-not-yet-iterated loader saves
+    its restore point (not (0,0)); a shorter num_epochs refuses the cursor; a
+    re-iteration resets the cursor to the new pass."""
+    from petastorm_tpu.loader import InMemDataLoader
+
+    url = _rowgroup_dataset(tmp_path)
+
+    def build(num_epochs=3):
+        return InMemDataLoader(_ordered_reader(url), batch_size=8,
+                               num_epochs=num_epochs, shuffle=True, seed=5)
+
+    loader = build()
+    it = iter(loader)
+    for _ in range(11):
+        next(it)
+    state = loader.state_dict()
+
+    # save-after-restore without iterating must return the restore point
+    restored = build()
+    restored.load_state_dict(state)
+    assert restored.state_dict()["epoch"] == state["epoch"]
+    assert restored.state_dict()["batch"] == state["batch"]
+
+    # a different num_epochs is a different finite stream — refuse, don't serve
+    # an empty pass
+    with pytest.raises(ValueError, match="stream config"):
+        build(num_epochs=1).load_state_dict(state)
+
+    # finishing a pass then RE-iterating: the cursor tracks the new pass, not the
+    # exhausted one
+    one = build(num_epochs=1)
+    assert len(list(one)) == 8
+    it2 = iter(one)
+    next(it2)
+    s2 = one.state_dict()
+    assert (s2["epoch"], s2["batch"]) == (0, 1)

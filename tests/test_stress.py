@@ -174,3 +174,49 @@ def test_reset_midstream_many_cycles(scalar_dataset):
             reader.reset()
         ids = [int(x) for b in reader for x in np.asarray(b.id)]
     assert sorted(ids) == expected
+
+
+def test_sigkill_then_watermark_checkpoint_resume(tmp_path):
+    """Elastic pool × consumer-watermark checkpoint: a child SIGKILLed mid-stream
+    respawns, the loader is checkpointed THROUGH its prefetch buffers right after,
+    and a fresh loader restores — the union of pre-save and post-restore rows
+    covers the dataset with no row lost to the death or to buffered batches."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu import checkpoint as ptck
+    from petastorm_tpu.loader import DataLoader
+
+    path = str(tmp_path / "kds")
+    os.makedirs(path)
+    pq.write_table(pa.table({"id": np.arange(128, dtype=np.int64)}),
+                   os.path.join(path, "p0.parquet"), row_group_size=8)
+    url = "file://" + path
+
+    def build():
+        return make_batch_reader(url, reader_pool_type="process", workers_count=2,
+                                 shuffle_row_groups=False, num_epochs=1,
+                                 results_timeout_s=60)
+
+    reader = build()
+    pre = []
+    loader = DataLoader(reader, batch_size=8, prefetch=3, host_queue_size=8,
+                        to_device=False)
+    with loader:
+        it = iter(loader)
+        for i in range(6):
+            pre.extend(int(x) for x in next(it)["id"])
+            if i == 2:
+                os.kill(reader._executor._procs[0].pid, signal.SIGKILL)
+        ptck.save(str(tmp_path / "kckpt"), loader)
+
+    resumed = DataLoader(build(), batch_size=8, to_device=False)
+    ptck.restore(str(tmp_path / "kckpt"), resumed)
+    post = []
+    with resumed:
+        for b in resumed:
+            post.extend(int(x) for x in b["id"])
+    assert len(pre) == 48 and len(set(pre)) == 48
+    # nothing lost: every row not consumed pre-save arrives post-restore
+    # (at-least-once: a row group in flight at save time may replay)
+    assert set(pre) | set(post) == set(range(128))
