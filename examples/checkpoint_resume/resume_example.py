@@ -72,6 +72,42 @@ def main():
     # ---- the production shape: one orbax step holds params AND the data cursor ----
     orbax_roundtrip(url, kwargs)
 
+    # ---- training through a DataLoader? checkpoint the LOADER (consumer
+    # watermark): rows prefetched into its buffers replay instead of vanishing ----
+    loader_watermark(url, kwargs)
+
+
+def loader_watermark(url, kwargs):
+    from petastorm_tpu import checkpoint as ptck
+    from petastorm_tpu.loader import DataLoader
+
+    import os
+
+    # orbax refuses an existing destination: point at a fresh subpath
+    ckpt_dir = os.path.join(tempfile.mkdtemp(prefix="loader_ckpt"), "step0")
+    loader = DataLoader(make_batch_reader(url, **kwargs), batch_size=8,
+                        prefetch=3, to_device=False)
+    pre = []
+    with loader:
+        it = iter(loader)
+        for _ in range(4):
+            pre.extend(int(x) for x in next(it)["id"])
+        # the producer thread has read AHEAD of these 4 batches; saving the
+        # READER here would skip the buffered rows — the loader's state saves at
+        # what the training loop actually received
+        ptck.save(ckpt_dir, loader)
+
+    resumed = DataLoader(make_batch_reader(url, **kwargs), batch_size=8,
+                         to_device=False)
+    ptck.restore(ckpt_dir, resumed)
+    post = []
+    with resumed:
+        for b in resumed:
+            post.extend(int(x) for x in b["id"])
+    assert set(pre) | set(post) == set(range(ROWS))
+    print("loader watermark: %d rows pre-save + %d post-restore; prefetched rows "
+          "replayed, none lost." % (len(pre), len(post)))
+
 
 def orbax_roundtrip(url, kwargs):
     import jax.numpy as jnp

@@ -1385,7 +1385,8 @@ class InMemDataLoader:
                 "seed": self._seed, "shuffle": bool(self.shuffle),
                 "rows": int(self.rows), "batch_size": int(self.batch_size),
                 "last_batch": self.last_batch,
-                "num_epochs": self.num_epochs}
+                "num_epochs": None if self.num_epochs is None
+                else int(self.num_epochs)}
 
     def load_state_dict(self, state):
         """Resume a same-config loader at a saved cursor (before iterating)."""
