@@ -61,6 +61,7 @@ def main():
     out.update(device_decode_phase())
     out.update(inmem_phase())
     out.update(checkpoint_phase())
+    out.update(loader_watermark_phase())
     with open(os.environ["PTPU_MP_OUT"], "w") as f:
         json.dump(out, f)
 
@@ -197,6 +198,56 @@ def checkpoint_phase():
     reader2.stop()
     reader2.join()
     return {"ckpt_pre": sorted(pre), "ckpt_post": sorted(post)}
+
+
+def loader_watermark_phase():
+    """Pod-exact checkpoint THROUGH a prefetching sharded DataLoader (round 5):
+    both processes step the SAME number of GLOBAL batches (global assembly is
+    collective — asymmetric cursors are checkpoint_phase's reader-level job);
+    one collective orbax save captures each process's CONSUMER watermark (not
+    the prefetch-ahead reader cursor, which has read further), restore routes
+    each process its own shard entry by ``cur_shard``, and the union of
+    pre+post local rows covers every shard pod-wide — nothing lost to loader
+    buffers."""
+    ckdir = os.environ.get("PTPU_MP_LCKPT")
+    if not ckdir:
+        return {}
+    from petastorm_tpu import checkpoint as ptck
+
+    pid = jax.process_index()
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+
+    def build():
+        reader = make_batch_reader(
+            os.environ["PTPU_MP_URL"], cur_shard=pid, shard_count=2, shard_seed=0,
+            shuffle_row_groups=False, num_epochs=1, reader_pool_type="dummy")
+        return DataLoader(reader, batch_size=16, sharding=sharding, prefetch=3,
+                          host_queue_size=8)
+
+    def local_rows(batch):
+        out = []
+        for shard in batch["id"].addressable_shards:
+            out.extend(np.asarray(shard.data).ravel().tolist())
+        return out
+
+    pre = []
+    loader = build()
+    with loader:
+        it = iter(loader)
+        # batches are GLOBAL (collective assembly): both processes must step the
+        # same count — asymmetry lives in the reader cursors via shard sizes
+        for _ in range(2):
+            pre.extend(local_rows(next(it)))
+        ptck.save(ckdir, loader)  # collective: allgathers both watermarks
+
+    resumed = build()
+    ptck.restore(ckdir, resumed)
+    post = []
+    with resumed:
+        for batch in resumed:
+            post.extend(local_rows(batch))
+    return {"lwm_pre": sorted(pre), "lwm_post": sorted(post)}
 
 
 if __name__ == "__main__":
