@@ -968,6 +968,11 @@ class Reader:
         return state
 
     def load_state_dict(self, state):
+        if "plan" not in state or "consumed" not in state:
+            raise ValueError(
+                "not a Reader state (keys: %s) — a WeightedSamplingReader/"
+                "InMemDataLoader checkpoint must be restored into the matching "
+                "object" % sorted(state))
         self.stop()
         self.join()
         if state["plan"]["num_items"] != self._num_items:

@@ -37,6 +37,10 @@ class WeightedSamplingReader:
                                   sorted(getattr(r, "device_decode_fields", ()))))
         self.device_decode_fields = fields
 
+        #: final cursor of each exhausted sub-reader (captured at exhaustion so a
+        #: later ``state_dict()`` can still checkpoint it as fully-consumed)
+        self._final_states = {}
+
     def __iter__(self):
         return self
 
@@ -48,9 +52,79 @@ class WeightedSamplingReader:
             try:
                 return next(self._readers[pick])
             except StopIteration:
+                exhausted = self._readers[pick]
+                if hasattr(exhausted, "state_dict"):
+                    self._final_states[pick] = exhausted.state_dict()
                 self._readers[pick] = None
                 alive = [i for i, r in enumerate(self._readers) if r is not None]
         raise StopIteration
+
+    # -- exact resume -------------------------------------------------------------------
+
+    def state_dict(self):
+        """Exact-resume state for the stochastic mixer: the mixing RNG's full state
+        plus every sub-reader's cursor (the final cursor for already-exhausted
+        ones). Restoring into a same-config mixer continues the SAME draw sequence
+        with each sub-reader at its own cursor — sub-reader semantics are the
+        usual at-least-once at row-group granularity, so a replayed in-flight
+        group may shift which rows later draws return; the mix proportions and
+        coverage guarantees are unchanged. (A sub-reader that was exhausted at
+        save time restores as empty and is re-discovered exhausted on its first
+        draw, which costs extra RNG draws relative to the uninterrupted run —
+        draw-for-draw equality holds while every sub-reader is live.)
+        Duck-types for :mod:`petastorm_tpu.checkpoint` like every other
+        reader/loader."""
+        states = []
+        for i, r in enumerate(self._readers):
+            if r is None:
+                final = self._final_states.get(i)
+                if final is None:
+                    # exhausted BEFORE capture was possible: the sub-reader never
+                    # had a state_dict — restoring it fresh would silently replay
+                    # its whole corpus, so refuse exactly like the live case
+                    raise AttributeError(
+                        "sub-reader %d was exhausted without a capturable state "
+                        "(no state_dict); WeightedSamplingReader can only "
+                        "checkpoint checkpointable readers" % i)
+                states.append(final)
+            elif hasattr(r, "state_dict"):
+                states.append(r.state_dict())
+            else:
+                raise AttributeError(
+                    "sub-reader %d (%s) has no state_dict; WeightedSamplingReader "
+                    "can only checkpoint checkpointable readers"
+                    % (i, type(r).__name__))
+        return {
+            "weighted": True,
+            "rng_state": self._rng.bit_generator.state,
+            "readers": states,
+        }
+
+    def load_state_dict(self, state):
+        """Restore into a mixer built over FRESH same-config sub-readers."""
+        if not state.get("weighted"):
+            raise ValueError(
+                "not a WeightedSamplingReader state (single-reader checkpoint? "
+                "restore it into that reader instead)")
+        saved = state["readers"]
+        if len(saved) != len(self._readers):
+            raise ValueError(
+                "saved state mixes %d readers, this mixer has %d — rebuild with "
+                "the original composition" % (len(saved), len(self._readers)))
+        for i, (sub_state, r) in enumerate(zip(saved, self._readers)):
+            if r is None:
+                raise ValueError(
+                    "sub-reader %d of this mixer is already exhausted — restore "
+                    "requires a FRESHLY built mixer over unconsumed same-config "
+                    "sub-readers" % i)
+            if sub_state is None:
+                raise ValueError(
+                    "saved state for sub-reader %d is empty (checkpoint from an "
+                    "incompatible version?)" % i)
+            r.load_state_dict(sub_state)
+        self._rng.bit_generator.state = state["rng_state"]
+        self._final_states = {}
+        return self
 
     def stop(self):
         for r in self._readers:

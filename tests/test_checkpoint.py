@@ -477,3 +477,126 @@ def test_loader_state_dict_with_device_sharding(tmp_path):
             post.extend(int(x) for x in np.asarray(b["id"]))
     assert sorted(pre + post) == list(range(64))
     assert not set(pre) & set(post)
+
+
+# -- WeightedSamplingReader exact-resume (round 5) ----------------------------------
+
+
+def _two_mixed_datasets(tmp_path):
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    urls = []
+    for name, lo in (("a", 0), ("b", 1000)):
+        path = str(tmp_path / name)
+        os.makedirs(path)
+        pq.write_table(pa.table({"id": np.arange(lo, lo + 64, dtype=np.int64)}),
+                       os.path.join(path, "p.parquet"), row_group_size=8)
+        urls.append("file://" + path)
+    return urls
+
+
+def _mixer(urls, seed=3):
+    from petastorm_tpu.weighted_sampling import WeightedSamplingReader
+
+    readers = [make_batch_reader(u, shuffle_row_groups=False, num_epochs=1,
+                                 reader_pool_type="dummy") for u in urls]
+    return WeightedSamplingReader(readers, [0.5, 0.5], seed=seed)
+
+
+def test_weighted_sampling_state_dict_exact_resume(tmp_path):
+    """Checkpoint the stochastic mixer mid-stream: the restored mixer continues the
+    SAME draw sequence with each sub-reader at its cursor — the remaining stream
+    is identical to the uninterrupted run's tail (dummy pool + batch == row group:
+    sub-reader cursors are exact)."""
+    urls = _two_mixed_datasets(tmp_path)
+
+    def ids(batches):
+        return [tuple(int(x) for x in np.asarray(b.id)) for b in batches]
+
+    with _mixer(urls) as full_reader:
+        full = ids(full_reader)
+
+    mixer = _mixer(urls)
+    it = iter(mixer)
+    pre = ids([next(it) for _ in range(5)])
+    state = mixer.state_dict()
+    mixer.stop()
+    mixer.join()
+
+    resumed = _mixer(urls)
+    resumed.load_state_dict(state)
+    with resumed:
+        post = ids(resumed)
+    assert pre == full[:5]
+    assert post == full[5:]  # draw-for-draw identical remainder
+
+
+def test_weighted_sampling_state_dict_orbax_and_exhaustion(tmp_path):
+    """The mixer state rides orbax, and a sub-reader exhausted before the save
+    restores as exhausted — total coverage still exact across the preemption."""
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.weighted_sampling import WeightedSamplingReader
+
+    # dataset 'small' exhausts quickly; 'big' keeps going
+    paths = {}
+    for name, n, lo in (("small", 8, 0), ("big", 64, 1000)):
+        path = str(tmp_path / name)
+        os.makedirs(path)
+        pq.write_table(pa.table({"id": np.arange(lo, lo + n, dtype=np.int64)}),
+                       os.path.join(path, "p.parquet"), row_group_size=8)
+        paths[name] = "file://" + path
+
+    def build():
+        return WeightedSamplingReader(
+            [make_batch_reader(paths["small"], shuffle_row_groups=False,
+                               num_epochs=1, reader_pool_type="dummy"),
+             make_batch_reader(paths["big"], shuffle_row_groups=False,
+                               num_epochs=1, reader_pool_type="dummy")],
+            [0.5, 0.5], seed=1)
+
+    mixer = build()
+    it = iter(mixer)
+    pre = []
+    for _ in range(10):  # draw until 'small' (1 batch) is exhausted
+        pre.extend(int(x) for x in np.asarray(next(it).id))
+        if mixer._readers[0] is None:
+            break
+    assert mixer._readers[0] is None  # 'small' died mid-stream
+    ptck.save(str(tmp_path / "wckpt"), mixer)
+    mixer.stop()
+    mixer.join()
+
+    resumed = build()
+    ptck.restore(str(tmp_path / "wckpt"), resumed)
+    post = []
+    with resumed:
+        for b in resumed:
+            post.extend(int(x) for x in np.asarray(b.id))
+    seen = pre + post
+    assert sorted(seen) == sorted(set(seen))  # no batch replayed
+    assert set(seen) == set(range(8)) | set(range(1000, 1064))
+
+
+def test_weighted_sampling_state_mismatch_raises(tmp_path):
+    from petastorm_tpu.weighted_sampling import WeightedSamplingReader
+
+    urls = _two_mixed_datasets(tmp_path)
+    mixer = _mixer(urls)
+    state = mixer.state_dict()
+    mixer.stop()
+    mixer.join()
+    single = WeightedSamplingReader(
+        [make_batch_reader(urls[0], num_epochs=1, reader_pool_type="dummy")],
+        [1.0], seed=3)
+    with pytest.raises(ValueError, match="mixes 2 readers"):
+        single.load_state_dict(state)
+    reader = make_batch_reader(urls[0], num_epochs=1, reader_pool_type="dummy")
+    with reader, pytest.raises(ValueError):
+        reader.load_state_dict(state)  # mixer state into a plain reader
