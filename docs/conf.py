@@ -15,6 +15,14 @@ extensions = [
     "sphinx.ext.viewcode",
     "sphinx.ext.intersphinx",
 ]
+# static_analysis.md is markdown; render it when myst is available (RTD/CI
+# installs it), degrade to a toctree warning when not
+try:
+    import myst_parser  # noqa: F401
+
+    extensions.append("myst_parser")
+except ImportError:
+    pass
 autodoc_mock_imports = ["jax", "jaxlib", "flax", "optax", "cv2", "torch",
                         "tensorflow", "pyspark"]
 intersphinx_mapping = {

@@ -42,26 +42,22 @@ def main():
     kwargs = dict(shuffle_row_groups=True, seed=7, num_epochs=1, workers_count=2)
 
     # ---- phase 1: consume part of the epoch, checkpoint, "crash" ----
-    reader = make_batch_reader(url, **kwargs)
     seen_before = []
-    for batch in reader:
-        seen_before.extend(np.asarray(batch.id).tolist())
-        if len(seen_before) >= ROWS // 3:
-            break
-    ckpt = reader.state_dict()          # goes into the same tree as model params
-    reader.stop()
-    reader.join()
+    with make_batch_reader(url, **kwargs) as reader:
+        for batch in reader:
+            seen_before.extend(np.asarray(batch.id).tolist())
+            if len(seen_before) >= ROWS // 3:
+                break
+        ckpt = reader.state_dict()      # goes into the same tree as model params
     blob = json.dumps(ckpt)             # JSON/orbax/pickle friendly
     print("preempted after %d rows; checkpoint: %s..." % (len(seen_before), blob[:70]))
 
     # ---- phase 2: new process, restore, finish the epoch ----
-    reader = make_batch_reader(url, **kwargs)
-    reader.load_state_dict(json.loads(blob))
     seen_after = []
-    for batch in reader:
-        seen_after.extend(np.asarray(batch.id).tolist())
-    reader.stop()
-    reader.join()
+    with make_batch_reader(url, **kwargs) as reader:
+        reader.load_state_dict(json.loads(blob))
+        for batch in reader:
+            seen_after.extend(np.asarray(batch.id).tolist())
 
     union = set(seen_before) | set(seen_after)
     assert union == set(range(ROWS)), "resume missed rows!"

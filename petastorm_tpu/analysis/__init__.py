@@ -1,0 +1,36 @@
+"""graftlint: project-native static analysis for petastorm_tpu.
+
+The hot paths of this codebase are exactly the places generic linters go blind:
+lock discipline across the executor/loader thread pools (``workers.py``,
+``loader.py``), clean reader/executor shutdown, JAX tracing hazards inside
+``@jax.jit`` bodies, and the Unischema field/codec contract. ``graftlint`` is an
+AST-based rule engine with four project-specific rule families:
+
+- **concurrency** (``GL-C0xx``): shared mutable attributes written outside the
+  lock that otherwise guards them; untimed blocking ``Queue.get()``/``join()``
+  on stop/shutdown paths; threads started without daemon-or-join handling.
+- **resource lifecycle** (``GL-L0xx``): readers/executors/loaders constructed
+  but never consumed via a context manager or try/finally.
+- **JAX tracing** (``GL-J0xx``): ``np.*`` calls, Python branches on traced
+  values, and host I/O inside jitted functions.
+- **schema/codec contracts** (``GL-S0xx``): literal ``UnischemaField``
+  declarations whose codec cannot faithfully store the declared numpy dtype.
+
+Entry points: the ``petastorm-tpu-lint`` console script (exit 0 clean / 1 new
+findings / 2 internal error), ``python -m petastorm_tpu.analysis``, or
+:func:`analyze_paths` programmatically. Intentional violations are suppressed
+inline (``# graftlint: disable=<rule-id>``) or through the checked-in baseline
+(``.graftlint-baseline.json``); see docs/static_analysis.md.
+"""
+from petastorm_tpu.analysis.baseline import Baseline
+from petastorm_tpu.analysis.engine import analyze_paths, analyze_source, default_rules
+from petastorm_tpu.analysis.findings import Finding, Severity
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "Severity",
+    "analyze_paths",
+    "analyze_source",
+    "default_rules",
+]

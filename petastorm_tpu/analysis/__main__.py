@@ -1,0 +1,6 @@
+"""``python -m petastorm_tpu.analysis`` — same entry as petastorm-tpu-lint."""
+import sys
+
+from petastorm_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,27 @@
+"""Rule registry: the four project-specific rule families."""
+from petastorm_tpu.analysis.rules.concurrency import (
+    BlockingTeardownRule,
+    LockDisciplineRule,
+    ThreadHandlingRule,
+)
+from petastorm_tpu.analysis.rules.lifecycle import ResourceLifecycleRule
+from petastorm_tpu.analysis.rules.schema import SchemaCodecContractRule
+from petastorm_tpu.analysis.rules.tracing import (
+    HostIoInJitRule,
+    NumpyInJitRule,
+    TracedBranchRule,
+)
+
+#: every registered rule class, in reporting order
+ALL_RULES = [
+    LockDisciplineRule,
+    BlockingTeardownRule,
+    ThreadHandlingRule,
+    ResourceLifecycleRule,
+    NumpyInJitRule,
+    TracedBranchRule,
+    HostIoInJitRule,
+    SchemaCodecContractRule,
+]
+
+__all__ = [cls.__name__ for cls in ALL_RULES] + ["ALL_RULES"]

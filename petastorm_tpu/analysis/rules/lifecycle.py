@@ -1,0 +1,182 @@
+"""Resource-lifecycle rule: readers/executors/loaders must not leak.
+
+Every one of these objects owns background threads, child processes, sockets, or
+device buffers; an instance abandoned without ``stop()``/``close()`` leaks them
+until interpreter exit (and under pytest, across the whole session). The rule
+tracks constructor calls of the project's closeable types through their enclosing
+function and requires one of the accepted ownership outcomes below.
+"""
+from __future__ import annotations
+
+import ast
+
+from petastorm_tpu.analysis.findings import Severity
+from petastorm_tpu.analysis.engine import Rule
+from petastorm_tpu.analysis.rules._astutil import call_func_name, walk_scope
+
+#: Constructors/factories returning objects that expose close()/stop() and
+#: support the context-manager protocol. Project types only — stdlib `open()`
+#: etc. is the standard linters' turf.
+CLOSEABLE_FACTORIES = frozenset({
+    "make_reader", "make_batch_reader", "Reader",
+    "make_executor", "ThreadExecutor", "ProcessExecutor", "SyncExecutor",
+    "DataLoader", "InMemDataLoader", "BatchedDataLoader",
+    "make_weighted_reader", "WeightedSamplingReader",
+})
+
+#: calls that merely CONSUME an iterable without taking ownership of it
+_CONSUMERS = frozenset({"list", "iter", "next", "enumerate", "sorted", "zip",
+                        "sum", "min", "max", "len", "tuple", "set", "dict",
+                        "print", "repr", "str", "isinstance", "type"})
+
+_CLOSERS = frozenset({"stop", "close", "join", "terminate", "shutdown"})
+
+
+class ResourceLifecycleRule(Rule):
+    """GL-L001: a closeable constructed but not consumed via ``with``, closed in
+    a ``finally``, or handed off (returned / yielded / stored / wrapped by
+    another closeable that assumes ownership)."""
+
+    rule_id = "GL-L001"
+    severity = Severity.ERROR
+    description = ("reader/executor/loader constructed without a context "
+                   "manager or try/finally close")
+    fix_hint = ("use `with make_reader(...) as r:` (or close in a `finally:`); "
+                "passing a reader into DataLoader(...) transfers ownership to "
+                "the loader's own `with` block")
+
+    def check(self, tree, ctx):
+        scopes = [tree] + [n for n in ast.walk(tree)
+                           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            scope_nodes = list(walk_scope(scope))
+            for node in scope_nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_func_name(node)
+                if name not in CLOSEABLE_FACTORIES:
+                    continue
+                ok, tracked = self._call_context_ok(node, ctx)
+                if ok:
+                    continue
+                if tracked is not None and self._name_ok(tracked, scope_nodes, ctx):
+                    continue
+                yield ctx.finding(
+                    self, node,
+                    "`%s(...)` result is never closed: not used as a context "
+                    "manager, closed in a finally, or handed off" % name)
+
+    def _call_context_ok(self, call, ctx):
+        """(resolved?, tracked_name): classify the constructor call by its parent.
+
+        Returns (True, None) when the call site itself is fine, (False, name)
+        when the result is bound to a local name that must be followed, and
+        (False, None) when the result is plainly dropped."""
+        parent = ctx.parent(call)
+        if isinstance(parent, ast.withitem):
+            return True, None
+        if isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom, ast.Await)):
+            return True, None  # ownership moves to the caller
+        if isinstance(parent, ast.keyword):
+            parent = ctx.parent(parent)
+        if isinstance(parent, ast.Call):
+            outer = call_func_name(parent)
+            if outer in CLOSEABLE_FACTORIES or outer == "closing":
+                # wrapped by another closeable (DataLoader closes its reader on
+                # __exit__) or contextlib.closing — the wrapper is now tracked
+                return self._call_context_ok(parent, ctx)
+            if outer in _CONSUMERS:
+                return False, None  # list(make_reader(...)) consumes AND leaks
+            return True, None  # passed to unknown callee: assume it takes ownership
+        if isinstance(parent, ast.Assign):
+            if len(parent.targets) == 1 and isinstance(parent.targets[0], ast.Name):
+                return False, parent.targets[0].id
+            return True, None  # attribute/subscript/tuple target: escapes tracking
+        if isinstance(parent, (ast.Starred, ast.Subscript, ast.Attribute,
+                               ast.IfExp, ast.BoolOp)):
+            return True, None  # too dynamic to judge
+        if isinstance(parent, ast.Expr):
+            if self._in_pytest_raises(parent, ctx):
+                return True, None  # the constructor is EXPECTED to raise
+            return False, None  # bare statement: constructed and dropped
+        return True, None
+
+    @staticmethod
+    def _in_pytest_raises(node, ctx):
+        """True inside a ``with pytest.raises(...):`` body — a bare constructor
+        call there asserts the constructor throws, so nothing is ever built."""
+        while node is not None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call) and \
+                            call_func_name(item.context_expr) == "raises":
+                        return True
+            node = ctx.parent(node)
+        return False
+
+    def _name_ok(self, name, scope_nodes, ctx):
+        """True when the bound name reaches an accepted ownership outcome
+        anywhere in the enclosing scope."""
+        for node in scope_nodes:
+            # with name: / with wrapper(name):
+            if isinstance(node, ast.withitem) and self._expr_uses_name(
+                    node.context_expr, name):
+                return True
+            # try: ... finally: name.stop()/close()/join()
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call) and \
+                                isinstance(sub.func, ast.Attribute) and \
+                                sub.func.attr in _CLOSERS and \
+                                isinstance(sub.func.value, ast.Name) and \
+                                sub.func.value.id == name:
+                            return True
+            # return name / yield name (ownership to caller / fixture finalizer);
+            # only the BARE name counts — `return list(reader)` returns the
+            # consumed rows, the reader itself still leaks
+            if isinstance(node, (ast.Return, ast.Yield)) and node.value is not None \
+                    and self._is_bare_name(node.value, name):
+                return True
+            # self.x = name / container[k] = name: lifetime escapes the function
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Name) \
+                    and node.value.id == name:
+                if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in node.targets):
+                    return True
+            # passed onward: DataLoader(name, ...) transfers ownership; any other
+            # non-consumer call is assumed to take it too (addfinalizer, helpers)
+            if isinstance(node, ast.Call):
+                callee = call_func_name(node)
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                # elements of literal list/tuple args transfer too:
+                # WeightedSamplingReader([r1, r2], ...) owns both readers
+                for container in list(args):
+                    if isinstance(container, (ast.List, ast.Tuple)):
+                        args.extend(container.elts)
+                for arg in args:
+                    if isinstance(arg, ast.Name) and arg.id == name:
+                        if callee not in _CONSUMERS:
+                            return True
+                    # name.stop passed as a callback (request.addfinalizer(r.stop))
+                    if isinstance(arg, ast.Attribute) and \
+                            isinstance(arg.value, ast.Name) and \
+                            arg.value.id == name and arg.attr in _CLOSERS:
+                        return True
+        return False
+
+    @staticmethod
+    def _expr_uses_name(expr, name):
+        return any(isinstance(n, ast.Name) and n.id == name
+                   for n in ast.walk(expr))
+
+    @staticmethod
+    def _is_bare_name(expr, name):
+        if isinstance(expr, ast.Name):
+            return expr.id == name
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Dict)):
+            values = expr.values if isinstance(expr, ast.Dict) else expr.elts
+            return any(isinstance(e, ast.Name) and e.id == name for e in values)
+        return False

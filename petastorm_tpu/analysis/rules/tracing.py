@@ -1,0 +1,250 @@
+"""JAX tracing-hazard rules for jitted functions.
+
+Inside ``@jax.jit``/``pjit`` bodies, three host-side habits turn into runtime
+tracer errors or silent trace-time freezing:
+
+- ``np.*`` calls materialize tracers on host (ConcretizationTypeError) or bake a
+  trace-time constant into the compiled program;
+- a Python ``if``/``while`` on a traced value raises TracerBoolConversionError
+  (use ``jnp.where``/``lax.cond``, or mark the argument static);
+- host I/O (print/open/time/logging) executes once at trace time, not per step —
+  ``jax.debug.print`` is the traced alternative.
+
+Both decorator form (``@jax.jit``, ``@functools.partial(jax.jit, ...)``) and
+call form (``return jax.jit(fn)`` on a local ``def fn``) are recognized, and
+``static_argnames``/``static_argnums`` are honored when declared literally.
+"""
+from __future__ import annotations
+
+import ast
+
+from petastorm_tpu.analysis.findings import Severity
+from petastorm_tpu.analysis.engine import Rule
+from petastorm_tpu.analysis.rules._astutil import (
+    attr_chain,
+    call_kwarg,
+    literal_ints,
+    literal_strings,
+)
+
+_JIT_CHAINS = {"jax.jit", "jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_PARTIAL_CHAINS = {"functools.partial", "partial"}
+
+#: np attributes that are fine inside a trace: dtype/type metadata queries that
+#: never touch array *values*
+_NP_ALLOWED = {"dtype", "iinfo", "finfo", "result_type", "promote_types",
+               "can_cast", "broadcast_shapes"}
+
+#: static (trace-time) array attributes — branching on these is fine
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_STATIC_CALLS = {"isinstance", "len", "hasattr", "getattr", "callable", "type",
+                 "issubclass"}
+
+_IO_NAMES = {"print", "open", "input", "breakpoint"}
+_IO_ROOTS = {"time", "os", "sys", "logging", "shutil", "socket", "subprocess",
+             "io", "pathlib", "requests", "logger", "log"}
+_IO_EXEMPT_PREFIXES = ("jax.debug.", "os.path.")
+
+
+def _jit_call_info(call):
+    """(is_jit, static_names, static_nums, fn_arg) for a Call node that may be
+    ``jax.jit(...)`` or ``functools.partial(jax.jit, ...)``; fn_arg is the first
+    positional argument (the wrapped function) or None."""
+    chain = attr_chain(call.func)
+    if chain in _JIT_CHAINS:
+        jit_kw = call
+        fn_arg = call.args[0] if call.args else None
+    elif chain in _PARTIAL_CHAINS and call.args \
+            and attr_chain(call.args[0]) in _JIT_CHAINS:
+        jit_kw = call
+        fn_arg = call.args[1] if len(call.args) > 1 else None
+    else:
+        return False, (), (), None
+    names = literal_strings(call_kwarg(jit_kw, "static_argnames")) or ()
+    nums = literal_ints(call_kwarg(jit_kw, "static_argnums")) or ()
+    return True, tuple(names), tuple(nums), fn_arg
+
+
+def _decorated_jits(tree):
+    """(funcdef, static_names, static_nums) for decorator-form jitted functions."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if attr_chain(dec) in _JIT_CHAINS:
+                out.append((node, (), ()))
+                break
+            if isinstance(dec, ast.Call):
+                is_jit, names, nums, _ = _jit_call_info(dec)
+                if is_jit:
+                    out.append((node, names, nums))
+                    break
+    return out
+
+
+def _call_form_jits(tree):
+    """(funcdef, static_names, static_nums) for ``jax.jit(fn)`` where ``fn``
+    resolves to a def earlier in the file (nearest preceding def wins)."""
+    defs = [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        is_jit, names, nums, fn_arg = _jit_call_info(node)
+        if not is_jit or not isinstance(fn_arg, ast.Name):
+            continue
+        candidates = [d for d in defs
+                      if d.name == fn_arg.id and d.lineno <= node.lineno]
+        if candidates:
+            out.append((max(candidates, key=lambda d: d.lineno), names, nums))
+    return out
+
+
+def _traced_params(funcdef, static_names, static_nums):
+    args = list(funcdef.args.posonlyargs) + list(funcdef.args.args)
+    names = [a.arg for a in args]
+    if names and names[0] == "self":
+        names = names[1:]
+    static = set(static_names)
+    for i in static_nums:
+        if 0 <= i < len(names):
+            static.add(names[i])
+    names += [a.arg for a in funcdef.args.kwonlyargs]
+    return {n for n in names if n not in static}
+
+
+def _jitted_functions(tree):
+    """Deduped [(funcdef, traced_param_names)] across both recognition forms."""
+    seen = {}
+    for funcdef, names, nums in _decorated_jits(tree) + _call_form_jits(tree):
+        if funcdef not in seen:
+            seen[funcdef] = _traced_params(funcdef, names, nums)
+    return list(seen.items())
+
+
+class NumpyInJitRule(Rule):
+    """GL-J001: ``np.*`` call inside a jitted function."""
+
+    rule_id = "GL-J001"
+    severity = Severity.WARNING
+    description = "numpy call inside a @jax.jit function"
+    fix_hint = ("use jnp.* (traced) instead; np.* on a tracer raises "
+                "ConcretizationTypeError, and on static values it bakes a "
+                "trace-time constant into the program")
+
+    def check(self, tree, ctx):
+        aliases = ctx.numpy_aliases
+        for funcdef, _params in _jitted_functions(tree):
+            for node in ast.walk(funcdef):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                if not chain or "." not in chain:
+                    continue
+                root, rest = chain.split(".", 1)
+                if root in aliases and rest.split(".")[-1] not in _NP_ALLOWED:
+                    yield ctx.finding(
+                        self, node,
+                        "`%s(...)` inside jitted `%s` runs on host at trace "
+                        "time" % (chain, funcdef.name))
+
+
+class TracedBranchRule(Rule):
+    """GL-J002: Python ``if``/``while`` on a traced argument inside a jitted
+    function (raises TracerBoolConversionError at run time)."""
+
+    rule_id = "GL-J002"
+    severity = Severity.ERROR
+    description = "Python branch on a traced value inside a @jax.jit function"
+    fix_hint = ("use jnp.where / jax.lax.cond (traced), or declare the argument "
+                "in static_argnames if it is genuinely static")
+
+    def check(self, tree, ctx):
+        for funcdef, params in _jitted_functions(tree):
+            if not params:
+                continue
+            for node in ast.walk(funcdef):
+                if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                    hit = self._traced_names_in_test(node.test, params)
+                    if hit:
+                        kind = {"If": "if", "While": "while",
+                                "IfExp": "conditional expression"}[
+                                    type(node).__name__]
+                        finding = ctx.finding(
+                            self, node,
+                            "%s-branch on traced argument%s `%s` of jitted "
+                            "`%s`" % (kind, "s" if len(hit) > 1 else "",
+                                      "`, `".join(sorted(hit)), funcdef.name))
+                        # an If/While node's end_lineno spans its whole BODY; a
+                        # suppression comment must sit on the header, not
+                        # anywhere inside the branch
+                        finding.end_line = getattr(
+                            node.test, "end_lineno", None) or finding.line
+                        yield finding
+
+    def _traced_names_in_test(self, test, params):
+        """Traced parameter names the test's truthiness actually depends on.
+        Identity checks (`x is None`), static metadata (`x.shape`, `x.ndim`,
+        `x.dtype`, `x.size`) and trace-time-static calls (isinstance/len/...)
+        are pruned before collecting names."""
+        hits = set()
+
+        def visit(node):
+            if isinstance(node, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return
+            if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+                return
+            if isinstance(node, ast.Call):
+                func_name = node.func.id if isinstance(node.func, ast.Name) else None
+                if func_name in _STATIC_CALLS:
+                    return
+                # a call's VALUE is traced if its args are — or if it is a METHOD
+                # call on a traced value (`x.any()`, `x.sum()`); walk the
+                # receiver too, but not the bare function Name itself
+                if isinstance(node.func, ast.Attribute):
+                    visit(node.func.value)
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    visit(arg)
+                return
+            if isinstance(node, ast.Name) and node.id in params:
+                hits.add(node.id)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(test)
+        return hits
+
+
+class HostIoInJitRule(Rule):
+    """GL-J003: host I/O inside a jitted function executes at trace time only."""
+
+    rule_id = "GL-J003"
+    severity = Severity.WARNING
+    description = "host I/O inside a @jax.jit function"
+    fix_hint = ("host I/O runs once at trace time, not per step; use "
+                "jax.debug.print / jax.debug.callback, or hoist it out of the "
+                "jitted function")
+
+    def check(self, tree, ctx):
+        for funcdef, _params in _jitted_functions(tree):
+            for node in ast.walk(funcdef):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                flagged = None
+                if isinstance(node.func, ast.Name) and node.func.id in _IO_NAMES:
+                    flagged = node.func.id
+                elif chain and "." in chain:
+                    if any(chain.startswith(p) for p in _IO_EXEMPT_PREFIXES):
+                        continue
+                    if chain.split(".", 1)[0] in _IO_ROOTS:
+                        flagged = chain
+                if flagged:
+                    yield ctx.finding(
+                        self, node,
+                        "`%s(...)` inside jitted `%s` executes at trace time, "
+                        "not per step" % (flagged, funcdef.name))

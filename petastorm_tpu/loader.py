@@ -554,6 +554,14 @@ class DataLoader:
 
     # -- consumer side ------------------------------------------------------------------
 
+    def _advance_consumed(self, n):
+        """Bump the consumer watermark under the checkpoint lock: the producer
+        prunes the delivery log against ``_rows_consumed`` concurrently
+        (``_ckpt_record``), so an unlocked ``+=`` could tear against that read."""
+        if n:
+            with self._ckpt_lock:
+                self._rows_consumed += n
+
     def _start_producer(self):
         """Arm the pipeline for a fresh iteration. MUST run on the consumer thread
         (ADVICE r2: ``_stop.clear()`` used to run on the transfer thread at first
@@ -834,16 +842,16 @@ class DataLoader:
                 for batch in self._host_batches(host_q):
                     rest, staged = self._decode_staged(batch)
                     rest.update({k: np.asarray(v) for k, v in staged.items()})
-                    self._rows_consumed += _batch_row_count(rest)
+                    self._advance_consumed(_batch_row_count(rest))
                     yield rest
             else:
                 for batch in self._host_batches(host_q):
-                    self._rows_consumed += _batch_row_count(batch)
+                    self._advance_consumed(_batch_row_count(batch))
                     yield batch
             return
         if self.prefetch <= 0:  # synchronous transfer (debug)
             for batch, local_rows in self._device_batches(host_q):
-                self._rows_consumed += local_rows
+                self._advance_consumed(local_rows)
                 yield batch
             return
         # Async transfer thread: host batches → decode dispatch + device_put → a small
@@ -885,7 +893,7 @@ class DataLoader:
                         raise transfer_error[0]
                     return
                 batch, local_rows = item
-                self._rows_consumed += local_rows
+                self._advance_consumed(local_rows)
                 yield batch
         finally:
             if not finished and gen == self._generation:

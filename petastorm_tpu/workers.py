@@ -86,6 +86,13 @@ class ExecutorBase:
     def join(self):
         pass
 
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+        self.join()
+
 
 class SyncExecutor(ExecutorBase):
     """Synchronous in-process execution (reference DummyPool): deterministic, for tests/debug."""
@@ -128,7 +135,8 @@ class ThreadExecutor(ExecutorBase):
         self._results = queue.Queue(maxsize=self._queue_size)
         self._stop_event.clear()
         plan_iter = iter(plan)
-        self._active = self._workers_count
+        with self._active_lock:
+            self._active = self._workers_count
         for i in range(self._workers_count):
             t = threading.Thread(
                 target=self._run_worker, args=(worker, plan_iter), daemon=True,
@@ -248,8 +256,9 @@ class ProcessExecutor(ExecutorBase):
 
         self._results = queue.Queue(maxsize=self._queue_size)
         self._stop_event.clear()
-        self._tmpdir = tempfile.mkdtemp(prefix="ptpu-pool-")
-        address = os.path.join(self._tmpdir, "sock")
+        with self._respawn_lock:
+            self._tmpdir = tempfile.mkdtemp(prefix="ptpu-pool-")
+            address = os.path.join(self._tmpdir, "sock")
         authkey = os.urandom(32)
         listener = Listener(address, family="AF_UNIX", authkey=authkey)
         # children must find petastorm_tpu BEFORE the bootstrap handshake can hand them
@@ -262,7 +271,9 @@ class ProcessExecutor(ExecutorBase):
         self._child_env = {**os.environ, "PYTHONPATH": child_pp,
                            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")}
         for _ in range(self._workers_count):
-            self._procs.append(self._popen_child(address, authkey))
+            child = self._popen_child(address, authkey)
+            with self._respawn_lock:  # _spawn_one/join also touch the proc list
+                self._procs.append(child)
         # accept on a helper thread + child liveness poll on this one: a child that dies
         # before connecting (import error, crash) must raise here, not hang Reader
         # construction forever. Public API only — no reaching into Listener internals
@@ -283,11 +294,13 @@ class ProcessExecutor(ExecutorBase):
             while len(self._conns) < self._workers_count:
                 conn = self._await_accept(accepted, self._procs, "Pool child")
                 self._handshake(conn)
-                self._conns.append(conn)
+                with self._respawn_lock:
+                    self._conns.append(conn)
         finally:
             listener.close()  # also unblocks the acceptor thread if we raised
         plan_iter = iter(plan)
-        self._active = self._workers_count
+        with self._active_lock:
+            self._active = self._workers_count
         for i, conn in enumerate(self._conns):
             t = threading.Thread(target=self._drive_child, args=(conn, plan_iter),
                                  daemon=True, name="ptpu-pdrv-%d" % i)
@@ -501,6 +514,10 @@ class ProcessExecutor(ExecutorBase):
         with self._respawn_lock:  # excludes a racing _spawn_one registration
             conns, self._conns = self._conns, []
             procs, self._procs = self._procs, []
+            # taking the tmpdir under the same lock keeps a straggling
+            # _spawn_one from creating its socket in a directory this method is
+            # about to rmtree (it fails cleanly on None instead)
+            tmpdir, self._tmpdir = self._tmpdir, None
         for conn in conns:
             try:
                 conn.close()
@@ -511,9 +528,8 @@ class ProcessExecutor(ExecutorBase):
                 p.wait(timeout=5)
             except Exception:  # noqa: BLE001
                 p.kill()
-        if self._tmpdir:
-            shutil.rmtree(self._tmpdir, ignore_errors=True)
-            self._tmpdir = None
+        if tmpdir:
+            shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size=16,

@@ -4,6 +4,7 @@ Console scripts mirror the reference's CLIs:
   petastorm-tpu-generate-metadata  (reference: petastorm-generate-metadata)
   petastorm-tpu-copy-dataset       (reference: petastorm-copy-dataset)
   petastorm-tpu-throughput         (reference: petastorm-throughput)
+  petastorm-tpu-lint               (no reference analog: graftlint static analysis)
 """
 from setuptools import find_packages, setup
 
@@ -36,6 +37,7 @@ setup(
             "petastorm-tpu-generate-metadata=petastorm_tpu.tools.generate_metadata:main",
             "petastorm-tpu-copy-dataset=petastorm_tpu.tools.copy_dataset:main",
             "petastorm-tpu-throughput=petastorm_tpu.benchmark.cli:main",
+            "petastorm-tpu-lint=petastorm_tpu.analysis.cli:main",
         ],
     },
 )

@@ -78,20 +78,21 @@ def inmem_phase():
         cur_shard=jax.process_index(), shard_count=jax.process_count(),
         shard_seed=0, shuffle_row_groups=False, num_epochs=1, workers_count=1,
     )
-    loader = InMemDataLoader(reader, batch_size=16, num_epochs=2, seed=4,
-                             sharding=sharding)
     epochs = [[], []]
     shapes = set()
     device_counts = set()
-    n_batches = len(loader)
-    i = 0
-    for batch in loader:
-        arr = batch["id"]
-        shapes.add(tuple(arr.shape))
-        device_counts.add(len(arr.sharding.device_set))
-        for shard in arr.addressable_shards:
-            epochs[i // n_batches].extend(np.asarray(shard.data).ravel().tolist())
-        i += 1
+    with InMemDataLoader(reader, batch_size=16, num_epochs=2, seed=4,
+                         sharding=sharding) as loader:
+        n_batches = len(loader)
+        i = 0
+        for batch in loader:
+            arr = batch["id"]
+            shapes.add(tuple(arr.shape))
+            device_counts.add(len(arr.sharding.device_set))
+            for shard in arr.addressable_shards:
+                epochs[i // n_batches].extend(
+                    np.asarray(shard.data).ravel().tolist())
+            i += 1
     reader.stop()
     reader.join()
     return {

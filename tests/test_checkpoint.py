@@ -358,13 +358,13 @@ def test_inmem_loader_state_dict_config_mismatch_raises(tmp_path):
     from petastorm_tpu.loader import InMemDataLoader
 
     url = _rowgroup_dataset(tmp_path)
-    loader = InMemDataLoader(_ordered_reader(url), batch_size=8, num_epochs=2,
-                             shuffle=True, seed=5)
-    state = loader.state_dict()
-    other = InMemDataLoader(_ordered_reader(url), batch_size=16, num_epochs=2,
-                            shuffle=True, seed=5)
-    with pytest.raises(ValueError, match="stream config"):
-        other.load_state_dict(state)
+    with InMemDataLoader(_ordered_reader(url), batch_size=8, num_epochs=2,
+                         shuffle=True, seed=5) as loader:
+        state = loader.state_dict()
+    with InMemDataLoader(_ordered_reader(url), batch_size=16, num_epochs=2,
+                         shuffle=True, seed=5) as other:
+        with pytest.raises(ValueError, match="stream config"):
+            other.load_state_dict(state)
     with pytest.raises(ValueError, match="InMemDataLoader state"):
         # a reader/streaming-loader state is not an InMem cursor
         InMemDataLoader(_ordered_reader(url), batch_size=8).load_state_dict(
@@ -592,11 +592,11 @@ def test_weighted_sampling_state_mismatch_raises(tmp_path):
     state = mixer.state_dict()
     mixer.stop()
     mixer.join()
-    single = WeightedSamplingReader(
-        [make_batch_reader(urls[0], num_epochs=1, reader_pool_type="dummy")],
-        [1.0], seed=3)
-    with pytest.raises(ValueError, match="mixes 2 readers"):
-        single.load_state_dict(state)
+    with WeightedSamplingReader(
+            [make_batch_reader(urls[0], num_epochs=1, reader_pool_type="dummy")],
+            [1.0], seed=3) as single:
+        with pytest.raises(ValueError, match="mixes 2 readers"):
+            single.load_state_dict(state)
     reader = make_batch_reader(urls[0], num_epochs=1, reader_pool_type="dummy")
     with reader, pytest.raises(ValueError):
         reader.load_state_dict(state)  # mixer state into a plain reader

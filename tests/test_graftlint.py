@@ -1,0 +1,842 @@
+"""graftlint rule tests: per rule family, a seeded-bug fixture caught at the
+exact expected line and a clean fixture that stays clean — plus engine-level
+coverage (inline suppressions, baseline matching, CLI exit codes)."""
+import json
+import textwrap
+
+import pytest
+
+from petastorm_tpu.analysis import analyze_source
+from petastorm_tpu.analysis.baseline import Baseline
+from petastorm_tpu.analysis.cli import main as lint_main
+
+
+def _lint(src):
+    findings, suppressed = analyze_source(textwrap.dedent(src), path="fixture.py")
+    return findings, suppressed
+
+
+def _line_of(src, needle):
+    """1-based line of the first line containing ``needle``."""
+    for i, line in enumerate(textwrap.dedent(src).splitlines(), start=1):
+        if needle in line:
+            return i
+    raise AssertionError("marker %r not in fixture" % needle)
+
+
+def _only_rule(findings, rule_id):
+    assert findings, "expected a %s finding, got none" % rule_id
+    assert all(f.rule_id == rule_id for f in findings), findings
+    return findings
+
+
+# -- GL-C001: lock discipline -----------------------------------------------------------
+
+_C001_POSITIVE = """
+    import threading
+
+    class Executor:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._active = 0
+
+        def work(self):
+            with self._lock:
+                self._active += 1
+
+        def reset_counters(self):
+            self._active = 0  # BUG: unguarded write
+"""
+
+
+def test_lock_discipline_fires_at_unguarded_write():
+    findings, _ = _lint(_C001_POSITIVE)
+    f = _only_rule(findings, "GL-C001")[0]
+    assert f.line == _line_of(_C001_POSITIVE, "BUG: unguarded write")
+    assert "_active" in f.message and "reset_counters" in f.message
+
+
+def test_lock_discipline_clean_when_write_is_guarded():
+    findings, _ = _lint("""
+        import threading
+
+        class Executor:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._active = 0
+
+            def work(self):
+                with self._lock:
+                    self._active += 1
+
+            def reset_counters(self):
+                with self._lock:
+                    self._active = 0
+    """)
+    assert findings == []
+
+
+def test_lock_discipline_ignores_self_synchronizing_types():
+    """Event.set()/clear() and Queue ops synchronize internally — mutating them
+    outside the class lock is not a finding."""
+    findings, _ = _lint("""
+        import queue
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stop_event = threading.Event()
+                self._n = 0
+
+            def tick(self):
+                with self._lock:
+                    self._n += 1
+                    if self._stop_event.is_set():
+                        return
+
+            def start(self):
+                self._stop_event.clear()
+    """)
+    assert findings == []
+
+
+def test_lock_discipline_closure_runs_without_the_lock():
+    """A nested function defined under `with self._lock` runs LATER on another
+    thread — writes inside it are unguarded."""
+    src = """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = None
+
+            def read(self):
+                with self._lock:
+                    return self._state
+
+            def arm(self):
+                with self._lock:
+                    def cb():
+                        self._state = "done"  # BUG: closure write
+                    return cb
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-C001")[0]
+    assert f.line == _line_of(src, "BUG: closure write")
+
+
+# -- GL-C002: blocking teardown ---------------------------------------------------------
+
+_C002_POSITIVE = """
+    import queue
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._results = queue.Queue()
+            self._worker = threading.Thread(target=print, daemon=True)
+
+        def stop(self):
+            leftover = self._results.get()  # BUG: untimed get
+            self._worker.join()  # BUG: untimed join
+"""
+
+
+def test_blocking_teardown_fires_on_untimed_get_and_join():
+    findings, _ = _lint(_C002_POSITIVE)
+    findings = _only_rule(findings, "GL-C002")
+    assert {f.line for f in findings} == {
+        _line_of(_C002_POSITIVE, "BUG: untimed get"),
+        _line_of(_C002_POSITIVE, "BUG: untimed join"),
+    }
+
+
+def test_blocking_teardown_clean_with_timeouts():
+    findings, _ = _lint("""
+        import queue
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._results = queue.Queue()
+                self._worker = threading.Thread(target=print, daemon=True)
+
+            def stop(self):
+                try:
+                    self._results.get_nowait()
+                except queue.Empty:
+                    pass
+                self._worker.join(timeout=10)
+
+            def consume(self):
+                return self._results.get()  # not a teardown path: allowed
+    """)
+    assert findings == []
+
+
+def test_blocking_teardown_fires_on_explicit_blocking_get():
+    """`get(True)` / `get(block=True)` without a timeout block exactly like a
+    bare `get()` (review finding)."""
+    src = """
+        import queue
+
+        class Pool:
+            def __init__(self):
+                self._results = queue.Queue()
+
+            def stop(self):
+                a = self._results.get(True)  # BUG: get(True)
+                b = self._results.get(block=True)  # BUG: block=True
+                c = self._results.get(True, 5)  # timeout given: fine
+    """
+    findings, _ = _lint(src)
+    findings = _only_rule(findings, "GL-C002")
+    assert {f.line for f in findings} == {
+        _line_of(src, "BUG: get(True)"),
+        _line_of(src, "BUG: block=True"),
+    }
+
+
+def test_blocking_teardown_knows_queue_get_signature():
+    """Queue.get's FIRST positional is `block`, not a timeout: `get(5)` blocks
+    forever and must fire; `get(True, 5)` has a timeout and must not; and
+    `join(None)` blocks where `join(5)` does not (review finding)."""
+    src = """
+        import queue
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._results = queue.Queue()
+                self._worker = threading.Thread(target=print, daemon=True)
+
+            def stop(self):
+                a = self._results.get(5)  # BUG: block=5, no timeout
+                b = self._results.get(True, 5)  # timed: fine
+                self._worker.join(None)  # BUG: join(None)
+                self._worker.join(5)  # timed: fine
+    """
+    findings, _ = _lint(src)
+    findings = _only_rule(findings, "GL-C002")
+    assert {f.line for f in findings} == {
+        _line_of(src, "BUG: block=5"),
+        _line_of(src, "BUG: join(None)"),
+    }
+
+
+def test_blocking_teardown_fires_on_thread_list_join_loop():
+    src = """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._threads = []
+
+            def start(self):
+                for _ in range(4):
+                    t = threading.Thread(target=print, daemon=True)
+                    t.start()
+                    self._threads.append(t)
+
+            def join(self):
+                for t in self._threads:
+                    t.join()  # BUG: untimed loop join
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-C002")[0]
+    assert f.line == _line_of(src, "BUG: untimed loop join")
+
+
+# -- GL-C003: thread handling -----------------------------------------------------------
+
+_C003_POSITIVE = """
+    import threading
+
+    def fire_and_forget():
+        t = threading.Thread(target=print)  # BUG: no daemon, never joined
+        t.start()
+"""
+
+
+def test_thread_handling_fires_without_daemon_or_join():
+    findings, _ = _lint(_C003_POSITIVE)
+    f = _only_rule(findings, "GL-C003")[0]
+    assert f.line == _line_of(_C003_POSITIVE, "BUG: no daemon")
+
+
+def test_thread_handling_not_fooled_by_substring_join():
+    """`fmt.join(parts)` is a string join, not `t.join()` — the thread is still
+    unhandled (word-boundary matching, review finding)."""
+    src = """
+        import threading
+
+        def sneaky(parts):
+            fmt = ","
+            t = threading.Thread(target=print)  # BUG: unjoined despite fmt.join
+            t.start()
+            return fmt.join(parts)
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-C003")[0]
+    assert f.line == _line_of(src, "BUG: unjoined despite fmt.join")
+
+
+def test_thread_handling_clean_with_daemon_or_join():
+    findings, _ = _lint("""
+        import threading
+
+        def daemonized():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+
+        def joined():
+            t = threading.Thread(target=print)
+            t.start()
+            t.join(timeout=5)
+    """)
+    assert findings == []
+
+
+# -- GL-L001: resource lifecycle --------------------------------------------------------
+
+_L001_POSITIVE = """
+    from petastorm_tpu import make_reader
+
+    def leak(url):
+        reader = make_reader(url)  # BUG: never closed
+        return list(reader)
+"""
+
+
+def test_lifecycle_fires_on_unclosed_reader():
+    findings, _ = _lint(_L001_POSITIVE)
+    f = _only_rule(findings, "GL-L001")[0]
+    assert f.line == _line_of(_L001_POSITIVE, "BUG: never closed")
+
+
+def test_lifecycle_clean_forms():
+    findings, _ = _lint("""
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.loader import DataLoader
+
+        def with_block(url):
+            with make_reader(url) as reader:
+                return list(reader)
+
+        def try_finally(url):
+            reader = make_reader(url)
+            try:
+                return list(reader)
+            finally:
+                reader.stop()
+
+        def ownership_transfer(url):
+            reader = make_reader(url)
+            with DataLoader(reader, batch_size=8) as loader:
+                return list(loader)
+
+        def returned(url):
+            return make_reader(url)
+
+        def fixture_style(url):
+            reader = make_reader(url)
+            yield reader
+            reader.stop()
+    """)
+    assert findings == []
+
+
+def test_lifecycle_allows_constructor_expected_to_raise():
+    findings, _ = _lint("""
+        import pytest
+
+        from petastorm_tpu import make_reader
+
+        def test_bad_url():
+            with pytest.raises(IOError):
+                make_reader("file:///nope")
+    """)
+    assert findings == []
+
+
+# -- GL-J001/J002/J003: JAX tracing hazards ---------------------------------------------
+
+_J001_POSITIVE = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def bad(x):
+        return np.asarray(x) + 1  # BUG: np call in jit
+"""
+
+
+def test_numpy_in_jit_fires():
+    src_findings, _ = _lint(_J001_POSITIVE)
+    f = _only_rule(src_findings, "GL-J001")[0]
+    assert f.line == _line_of(_J001_POSITIVE, "BUG: np call in jit")
+
+
+def test_numpy_outside_jit_and_jnp_inside_are_clean():
+    findings, _ = _lint("""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def host_prep(x):
+            return np.asarray(x)
+
+        @jax.jit
+        def good(x):
+            y = jnp.asarray(x, np.float32)  # np.float32 attr (not a call): fine
+            info = np.iinfo(np.int32)  # dtype metadata: allowed
+            return y * info.max
+    """)
+    assert findings == []
+
+
+_J002_POSITIVE = """
+    import jax
+
+    @jax.jit
+    def bad(x):
+        if x > 0:  # BUG: traced branch
+            return x
+        return -x
+"""
+
+
+def test_traced_branch_fires():
+    findings, _ = _lint(_J002_POSITIVE)
+    f = _only_rule(findings, "GL-J002")[0]
+    assert f.line == _line_of(_J002_POSITIVE, "BUG: traced branch")
+    assert "`x`" in f.message
+
+
+def test_traced_branch_static_forms_are_clean():
+    findings, _ = _lint("""
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("flip",))
+        def static_kwarg(x, flip):
+            if flip:  # static_argnames: concrete at trace time
+                return x[::-1]
+            return x
+
+        @jax.jit
+        def metadata(x, y=None):
+            if y is None:  # identity check: static
+                y = x
+            if x.ndim == 3:  # shape metadata: static
+                y = y + 1
+            return y
+    """)
+    assert findings == []
+
+
+def test_traced_branch_fires_on_method_call_receiver():
+    """`if x.any():` is the canonical TracerBoolConversionError — the traced
+    receiver of a method call must be seen (review finding)."""
+    src = """
+        import jax
+
+        @jax.jit
+        def bad(x):
+            if x.any():  # BUG: traced method receiver
+                return x
+            return -x
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-J002")[0]
+    assert f.line == _line_of(src, "BUG: traced method receiver")
+
+
+def test_traced_branch_call_form_jit_is_recognized():
+    src = """
+        import jax
+
+        def build_step():
+            def step(params, batch):
+                if batch:  # BUG: traced branch in call-form jit
+                    return params
+                return params
+
+            return jax.jit(step)
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-J002")[0]
+    assert f.line == _line_of(src, "BUG: traced branch in call-form jit")
+
+
+_J003_POSITIVE = """
+    import jax
+
+    @jax.jit
+    def bad(x):
+        print("tracing", x)  # BUG: host io
+        return x
+"""
+
+
+def test_host_io_in_jit_fires():
+    findings, _ = _lint(_J003_POSITIVE)
+    f = _only_rule(findings, "GL-J003")[0]
+    assert f.line == _line_of(_J003_POSITIVE, "BUG: host io")
+
+
+def test_jax_debug_print_is_clean():
+    findings, _ = _lint("""
+        import jax
+
+        @jax.jit
+        def good(x):
+            jax.debug.print("x = {}", x)
+            return x
+    """)
+    assert findings == []
+
+
+# -- GL-S001: schema/codec contracts ----------------------------------------------------
+
+_S001_POSITIVE = """
+    import numpy as np
+
+    from petastorm_tpu import types as ptypes
+    from petastorm_tpu.codecs import (
+        CompressedImageCodec,
+        NdarrayCodec,
+        ScalarCodec,
+    )
+    from petastorm_tpu.unischema import UnischemaField
+
+    OVERFLOW = UnischemaField("big", np.int64, (),
+                              ScalarCodec(ptypes.IntegerType()), False)  # BUG: overflow
+    OBJ_NPY = UnischemaField("obj", np.object_, (4,), NdarrayCodec(), False)  # BUG: object npy
+    FLOAT_IMG = UnischemaField("img", np.float32, (8, 8, 3),
+                               CompressedImageCodec("jpeg"), False)  # BUG: float image
+    TENSOR_SCALAR = UnischemaField("mat", np.float32, (3, 3),
+                                   ScalarCodec(ptypes.FloatType()), False)  # BUG: tensor scalar
+    NARROWING = UnischemaField("loss", np.float64, (),
+                               ScalarCodec(ptypes.FloatType()), False)  # BUG: narrowing
+"""
+
+
+def test_schema_codec_contract_fires_per_incompatibility():
+    findings, _ = _lint(_S001_POSITIVE)
+    findings = _only_rule(findings, "GL-S001")
+    expected = {
+        _line_of(_S001_POSITIVE, "OVERFLOW = "),
+        _line_of(_S001_POSITIVE, "OBJ_NPY = "),
+        _line_of(_S001_POSITIVE, "FLOAT_IMG = "),
+        _line_of(_S001_POSITIVE, "TENSOR_SCALAR = "),
+        _line_of(_S001_POSITIVE, "NARROWING = "),
+    }
+    assert {f.line for f in findings} == expected
+
+
+def test_schema_codec_contract_accepts_compatible_fields():
+    findings, _ = _lint("""
+        import numpy as np
+
+        from petastorm_tpu import types as ptypes
+        from petastorm_tpu.codecs import (
+            CompressedImageCodec,
+            CompressedNdarrayCodec,
+            NdarrayCodec,
+            ScalarCodec,
+        )
+        from petastorm_tpu.unischema import UnischemaField
+
+        OK = [
+            UnischemaField("id", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+            # widening storage (uint8 fits int16) matches the reference schemas
+            UnischemaField("u8", np.uint8, (), ScalarCodec(ptypes.ShortType()), False),
+            UnischemaField("name", np.str_, (), ScalarCodec(ptypes.StringType()), False),
+            UnischemaField("f", np.float32, (), ScalarCodec(ptypes.DoubleType()), False),
+            UnischemaField("dec", np.object_, (),
+                           ScalarCodec(ptypes.DecimalType(12, 9)), False),
+            UnischemaField("image", np.uint8, (16, 16, 3),
+                           CompressedImageCodec("png"), False),
+            UnischemaField("matrix", np.float32, (8, 4), NdarrayCodec(), False),
+            UnischemaField("mz", np.float32, (4, 4), CompressedNdarrayCodec(), False),
+            UnischemaField("plain", np.int32, (), None, False),
+        ]
+    """)
+    assert findings == []
+
+
+# -- engine: suppressions, baseline, CLI ------------------------------------------------
+
+
+def test_inline_suppression_same_line():
+    findings, suppressed = _lint("""
+        import jax
+
+        @jax.jit
+        def intentional(x):
+            print("trace marker")  # graftlint: disable=GL-J003
+            return x
+    """)
+    assert findings == [] and suppressed == 1
+
+
+def test_file_level_suppression():
+    findings, suppressed = _lint("""
+        # graftlint: disable-file=GL-J003
+        import jax
+
+        @jax.jit
+        def noisy(x):
+            print("a", x)
+            print("b", x)
+            return x
+    """)
+    assert findings == [] and suppressed == 2
+
+
+def test_suppression_is_rule_specific():
+    findings, suppressed = _lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def half_suppressed(x):
+            return np.asarray(print(x))  # graftlint: disable=GL-J003
+    """)
+    assert suppressed == 1
+    assert [f.rule_id for f in findings] == ["GL-J001"]
+
+
+def test_inline_suppression_on_multiline_statement_trailing_line():
+    """The natural trailing-comment spot on a multi-line call is its LAST line;
+    the suppression must still reach the finding anchored at the first line
+    (review finding)."""
+    findings, suppressed = _lint("""
+        import numpy as np
+
+        from petastorm_tpu import types as ptypes
+        from petastorm_tpu.codecs import ScalarCodec
+        from petastorm_tpu.unischema import UnischemaField
+
+        F = UnischemaField(
+            "big", np.int64, (),
+            ScalarCodec(ptypes.IntegerType()),
+            False)  # graftlint: disable=GL-S001
+    """)
+    assert findings == [] and suppressed == 1
+
+
+def test_traced_branch_suppression_must_sit_on_the_header():
+    """A disable comment buried inside an if-BODY must not suppress the branch
+    finding on the header (the If node spans its whole body)."""
+    findings, suppressed = _lint("""
+        import jax
+
+        @jax.jit
+        def bad(x):
+            if x > 0:
+                y = x + 1  # graftlint: disable=GL-J002
+                return y
+            return -x
+    """)
+    assert suppressed == 0
+    assert [f.rule_id for f in findings] == ["GL-J002"]
+
+
+def test_overlapping_paths_deduplicate(tmp_path):
+    """`lint dir/ dir/m.py` must analyze m.py once — duplicates would double
+    findings and spuriously exhaust baseline counts (review finding)."""
+    fixture = _write_fixture(tmp_path, _J003_POSITIVE)
+    bl_path = tmp_path / ".graftlint-baseline.json"
+    assert lint_main([str(tmp_path), "--baseline", str(bl_path),
+                      "--write-baseline"]) == 0
+    assert json.loads(bl_path.read_text())["entries"][0]["count"] == 1
+    assert lint_main([str(tmp_path), str(fixture),
+                      "--baseline", str(bl_path)]) == 0
+
+
+def test_suppression_inside_string_literal_is_inert():
+    """A graftlint directive inside a STRING (fixture code, docs quoting the
+    syntax) must not suppress anything — only real comments count (review
+    finding: this very test file embeds directive-bearing fixture strings)."""
+    findings, suppressed = _lint('''
+        import jax
+
+        FIXTURE = """
+        # graftlint: disable-file=GL-J003
+        """
+
+        @jax.jit
+        def bad(x):
+            print("boom", x)
+            return x
+    ''')
+    assert suppressed == 0
+    assert [f.rule_id for f in findings] == ["GL-J003"]
+
+
+def test_syntax_error_reports_parse_rule():
+    findings, _ = _lint("def broken(:\n    pass\n")
+    assert [f.rule_id for f in findings] == ["GL-X001"]
+    assert findings[0].code  # real fingerprint, not "" (review finding)
+
+
+def test_parse_errors_are_never_baselined(tmp_path):
+    """--write-baseline must refuse GL-X001: a baselined parse error (with its
+    once-empty fingerprint) would green-light EVERY future breakage of the
+    file (review finding)."""
+    broken = tmp_path / "broken.py"
+    broken.write_text("def broken(:\n    pass\n")
+    bl_path = tmp_path / ".graftlint-baseline.json"
+    assert lint_main([str(broken), "--baseline", str(bl_path),
+                      "--write-baseline"]) == 0
+    assert json.loads(bl_path.read_text())["entries"] == []
+    assert lint_main([str(broken), "--baseline", str(bl_path)]) == 1
+
+
+def _write_fixture(tmp_path, body):
+    p = tmp_path / "fixture.py"
+    p.write_text(textwrap.dedent(body))
+    return p
+
+
+def test_baseline_matches_by_code_not_line(tmp_path):
+    """A baselined finding stays baselined after unrelated lines shift."""
+    fixture = _write_fixture(tmp_path, _J003_POSITIVE)
+    bl_path = tmp_path / ".graftlint-baseline.json"
+    assert lint_main([str(fixture), "--baseline", str(bl_path),
+                      "--write-baseline"]) == 0
+    payload = json.loads(bl_path.read_text())
+    assert len(payload["entries"]) == 1
+    assert payload["entries"][0]["rule"] == "GL-J003"
+    # same findings, baselined -> clean
+    assert lint_main([str(fixture), "--baseline", str(bl_path)]) == 0
+    # shift every line down: the (rule, path, code) fingerprint still matches
+    fixture.write_text("# shifted\n# shifted\n" + fixture.read_text())
+    assert lint_main([str(fixture), "--baseline", str(bl_path)]) == 0
+
+
+def test_write_baseline_on_subset_preserves_other_files(tmp_path):
+    """--write-baseline over a.py only must not prune b.py's accepted entries:
+    'not scanned this run' is not 'fixed' (review finding)."""
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text(textwrap.dedent(_J003_POSITIVE))
+    b.write_text(textwrap.dedent(_J002_POSITIVE))
+    bl_path = tmp_path / ".graftlint-baseline.json"
+    assert lint_main([str(a), str(b), "--baseline", str(bl_path),
+                      "--write-baseline"]) == 0
+    assert len(json.loads(bl_path.read_text())["entries"]) == 2
+    # rewrite from a subset: b.py's entry must survive
+    assert lint_main([str(a), "--baseline", str(bl_path),
+                      "--write-baseline"]) == 0
+    entries = json.loads(bl_path.read_text())["entries"]
+    assert {e["path"] for e in entries} == {"a.py", "b.py"}
+    assert lint_main([str(a), str(b), "--baseline", str(bl_path)]) == 0
+
+
+def test_write_baseline_with_select_preserves_other_rules(tmp_path):
+    """--select GL-C001 --write-baseline must not prune a GL-J003 entry for a
+    file it scanned: 'rule not run' is not 'fixed' (review finding)."""
+    fixture = _write_fixture(tmp_path, _J003_POSITIVE)
+    bl_path = tmp_path / ".graftlint-baseline.json"
+    assert lint_main([str(fixture), "--baseline", str(bl_path),
+                      "--write-baseline"]) == 0
+    assert len(json.loads(bl_path.read_text())["entries"]) == 1
+    assert lint_main([str(fixture), "--baseline", str(bl_path),
+                      "--select", "GL-C001", "--write-baseline"]) == 0
+    entries = json.loads(bl_path.read_text())["entries"]
+    assert [e["rule"] for e in entries] == ["GL-J003"]
+    assert lint_main([str(fixture), "--baseline", str(bl_path)]) == 0
+
+
+def test_partially_fixed_baseline_entry_is_reported_stale(tmp_path, capsys):
+    """A count:2 entry with one occurrence fixed must surface as stale — its
+    leftover count would silently absorb the next NEW identical finding
+    (review finding)."""
+    # the second occurrence is TEXTUALLY identical so both share one
+    # (rule, path, code) fingerprint -> a single count:2 baseline entry
+    fixture = _write_fixture(tmp_path, _J003_POSITIVE + """\
+    @jax.jit
+    def bad2(x):
+        print("tracing", x)  # BUG: host io
+        return x
+    """)
+    bl_path = tmp_path / ".graftlint-baseline.json"
+    assert lint_main([str(fixture), "--baseline", str(bl_path),
+                      "--write-baseline"]) == 0
+    entry = json.loads(bl_path.read_text())["entries"][0]
+    assert entry["count"] == 2
+    # fix ONE of the two occurrences
+    fixture.write_text(textwrap.dedent(_J003_POSITIVE))
+    capsys.readouterr()
+    assert lint_main([str(fixture), "--baseline", str(bl_path)]) == 0
+    assert "stale" in capsys.readouterr().out
+
+
+def test_new_finding_fails_despite_baseline(tmp_path):
+    fixture = _write_fixture(tmp_path, _J003_POSITIVE)
+    bl_path = tmp_path / ".graftlint-baseline.json"
+    assert lint_main([str(fixture), "--baseline", str(bl_path),
+                      "--write-baseline"]) == 0
+    fixture.write_text(fixture.read_text() + textwrap.dedent("""
+        @jax.jit
+        def another(x):
+            print("new finding", x)
+            return x
+    """))
+    assert lint_main([str(fixture), "--baseline", str(bl_path)]) == 1
+
+
+def test_cli_exit_codes(tmp_path, monkeypatch, capsys):
+    clean = _write_fixture(tmp_path, "x = 1\n")
+    assert lint_main([str(clean), "--no-baseline"]) == 0
+
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(textwrap.dedent(_J002_POSITIVE))
+    assert lint_main([str(dirty), "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "GL-J002" in out
+
+    import petastorm_tpu.analysis.cli as cli_mod
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("internal analyzer crash")
+
+    monkeypatch.setattr(cli_mod, "analyze_paths", boom)
+    assert lint_main([str(clean), "--no-baseline"]) == 2
+
+
+def test_cli_nonexistent_path_is_internal_error(tmp_path):
+    """A typo'd path must exit 2, not silently report '0 findings' — otherwise
+    a renamed directory would leave the CI lint gate permanently green."""
+    assert lint_main([str(tmp_path / "no_such_dir"), "--no-baseline"]) == 2
+    not_py = tmp_path / "data.txt"
+    not_py.write_text("not python")
+    assert lint_main([str(not_py), "--no-baseline"]) == 2
+
+
+def test_cli_select_and_list_rules(tmp_path, capsys):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(textwrap.dedent(_J002_POSITIVE))
+    # selecting an unrelated rule: the J002 bug is out of scope -> clean
+    assert lint_main([str(dirty), "--no-baseline", "--select", "GL-L001"]) == 0
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("GL-C001", "GL-C002", "GL-C003", "GL-L001",
+                    "GL-J001", "GL-J002", "GL-J003", "GL-S001"):
+        assert rule_id in out
+
+
+def test_cli_json_format(tmp_path, capsys):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(textwrap.dedent(_J002_POSITIVE))
+    assert lint_main([str(dirty), "--no-baseline", "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["findings"][0]["rule"] == "GL-J002"

@@ -1,0 +1,31 @@
+"""The repo must lint clean against its own analyzer.
+
+This is the self-application gate from the graftlint design: every rule family
+runs over ``petastorm_tpu/``, ``tests/`` and ``examples/`` and no NON-BASELINED
+finding may exist. New code that trips a rule either gets fixed or is added to
+``.graftlint-baseline.json`` with a justification — silently regressing the lock
+discipline of the executor/loader layer is not an option.
+"""
+import os
+
+from petastorm_tpu.analysis.cli import main as lint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_repo_lints_clean():
+    paths = [os.path.join(REPO_ROOT, d)
+             for d in ("petastorm_tpu", "tests", "examples")]
+    baseline = os.path.join(REPO_ROOT, ".graftlint-baseline.json")
+    rc = lint_main(paths + ["--baseline", baseline])
+    assert rc == 0, (
+        "petastorm-tpu-lint found new findings — run "
+        "`petastorm-tpu-lint petastorm_tpu/ tests/ examples/` for details, fix "
+        "them, or baseline with a justification")
+
+
+def test_package_lints_clean_without_any_suppression_mechanism():
+    """petastorm_tpu/ itself must be clean even with the baseline disabled:
+    the concurrency fixes in workers.py/loader.py are real, not baselined."""
+    rc = lint_main([os.path.join(REPO_ROOT, "petastorm_tpu"), "--no-baseline"])
+    assert rc == 0

@@ -212,18 +212,16 @@ def test_abandoned_iterator_stops_pipeline(scalar_dataset):
     import time
 
     reader = make_batch_reader(scalar_dataset.url, num_epochs=None)
-    loader = DataLoader(reader, batch_size=4, prefetch=2)
-    it = iter(loader)
-    next(it)
-    del it
-    deadline = time.time() + 10
-    while time.time() < deadline and (
-            loader._transfer_thread.is_alive() or loader._producer.is_alive()):
-        time.sleep(0.05)
-    assert not loader._transfer_thread.is_alive()
-    assert not loader._producer.is_alive()
-    reader.stop()
-    reader.join()
+    with DataLoader(reader, batch_size=4, prefetch=2) as loader:
+        it = iter(loader)
+        next(it)
+        del it
+        deadline = time.time() + 10
+        while time.time() < deadline and (
+                loader._transfer_thread.is_alive() or loader._producer.is_alive()):
+            time.sleep(0.05)
+        assert not loader._transfer_thread.is_alive()
+        assert not loader._producer.is_alive()
 
 
 def test_stats_populate_through_device_path(scalar_dataset):
@@ -368,21 +366,19 @@ def test_stop_midstream_joins_promptly(scalar_dataset):
 
     for taken in (0, 1, 3):
         reader = make_batch_reader(scalar_dataset.url, num_epochs=None)
-        loader = DataLoader(reader, batch_size=4, prefetch=2)
-        it = iter(loader)
-        for _ in range(taken):
-            next(it)
-        t0 = time.time()
-        loader.stop()
-        loader.join()
-        assert time.time() - t0 < 15, "join stalled: teardown race regressed"
-        if loader._producer is not None:  # taken=0: generator body never ran
-            assert not loader._producer.is_alive()
-        if loader._transfer_thread is not None:
-            assert not loader._transfer_thread.is_alive()
-        it.close()
-        reader.stop()
-        reader.join()
+        with DataLoader(reader, batch_size=4, prefetch=2) as loader:
+            it = iter(loader)
+            for _ in range(taken):
+                next(it)
+            t0 = time.time()
+            loader.stop()
+            loader.join()
+            assert time.time() - t0 < 15, "join stalled: teardown race regressed"
+            if loader._producer is not None:  # taken=0: generator body never ran
+                assert not loader._producer.is_alive()
+            if loader._transfer_thread is not None:
+                assert not loader._transfer_thread.is_alive()
+            it.close()
 
 
 def test_reiteration_restarts_pipeline(scalar_dataset):
@@ -391,25 +387,23 @@ def test_reiteration_restarts_pipeline(scalar_dataset):
     thread, racing stop(); re-iteration could leak a live previous thread set)."""
     reader = make_batch_reader(scalar_dataset.url, num_epochs=None,
                                shuffle_row_groups=False)
-    loader = DataLoader(reader, batch_size=5, prefetch=2)
-    it1 = iter(loader)
-    next(it1)  # start, then abandon mid-epoch
-    it2 = iter(loader)
-    first = next(it2)
-    assert len(first["id"]) == 5
-    # closing the SUPERSEDED iterator runs its finalizer mid-flight of the new
-    # iteration; the generation guard must keep it from stopping it2's pipeline
-    it1.close()
-    for _ in range(6):  # > prefetch+queue depth: proves the pipeline is still live
-        batch = next(it2)
-        assert len(batch["id"]) == 5
-    loader.stop()
-    loader.join()
-    # the superseded iterator's threads must be gone too
-    assert not loader._producer.is_alive()
-    it2.close()
-    reader.stop()
-    reader.join()
+    with DataLoader(reader, batch_size=5, prefetch=2) as loader:
+        it1 = iter(loader)
+        next(it1)  # start, then abandon mid-epoch
+        it2 = iter(loader)
+        first = next(it2)
+        assert len(first["id"]) == 5
+        # closing the SUPERSEDED iterator runs its finalizer mid-flight of the new
+        # iteration; the generation guard must keep it from stopping it2's pipeline
+        it1.close()
+        for _ in range(6):  # > prefetch+queue depth: proves the pipeline is live
+            batch = next(it2)
+            assert len(batch["id"]) == 5
+        loader.stop()
+        loader.join()
+        # the superseded iterator's threads must be gone too
+        assert not loader._producer.is_alive()
+        it2.close()
 
 
 def test_inmem_partial_tail_sharding(scalar_dataset):

@@ -125,19 +125,17 @@ def test_loader_abandoned_during_staged_decode(tmp_path):
     for iteration in range(3):
         reader = make_reader(url, decode_on_device=True, num_epochs=None,
                              workers_count=1, shuffle_row_groups=False)
-        loader = DataLoader(reader, batch_size=8, prefetch=3)
-        it = iter(loader)
-        next(it)  # decode compiled, pipeline saturated with staged work
-        it.close()  # abandon mid-flight
-        t0 = time.time()
-        loader.stop()
-        loader.join()
-        assert time.time() - t0 < 15
-        assert not loader._producer.is_alive()
-        if loader._transfer_thread is not None:
-            assert not loader._transfer_thread.is_alive()
-        reader.stop()
-        reader.join()
+        with DataLoader(reader, batch_size=8, prefetch=3) as loader:
+            it = iter(loader)
+            next(it)  # decode compiled, pipeline saturated with staged work
+            it.close()  # abandon mid-flight
+            t0 = time.time()
+            loader.stop()
+            loader.join()
+            assert time.time() - t0 < 15
+            assert not loader._producer.is_alive()
+            if loader._transfer_thread is not None:
+                assert not loader._transfer_thread.is_alive()
 
 
 @pytest.mark.parametrize("pool", ["thread", "process"])

@@ -49,8 +49,8 @@ def test_inmem_loader_trace(scalar_dataset):
     tracer = TraceRecorder()
     reader = make_batch_reader(scalar_dataset.url, num_epochs=1,
                                shuffle_row_groups=False, workers_count=1)
-    loader = InMemDataLoader(reader, batch_size=10, num_epochs=1, trace=tracer)
-    batches = sum(1 for _ in loader)
+    with InMemDataLoader(reader, batch_size=10, num_epochs=1, trace=tracer) as loader:
+        batches = sum(1 for _ in loader)
     names = {e["name"] for e in tracer.events()}
     assert "reader.next" in names  # fill pipeline spans
     assert "inmem.gather" in names

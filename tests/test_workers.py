@@ -1,6 +1,8 @@
 """Executor pool tests without the reader (reference model:
 petastorm/workers_pool/tests/test_workers_pool.py + test_ventilator.py): backpressure,
-exception propagation, stop/join — driven with toy workers."""
+exception propagation, stop/join — driven with toy workers. Executors are consumed
+as context managers (``__exit__`` = stop + join); the explicit stop()/join() calls
+that remain are the behavior under test, and both are idempotent."""
 import time
 
 import pytest
@@ -28,28 +30,24 @@ class _Boom:
 
 @pytest.mark.parametrize("pool", ["dummy", "thread", "process"])
 def test_all_items_processed(pool):
-    ex = make_executor(pool, workers_count=3, results_queue_size=4)
-    ex.start(_square, EpochPlan(list(range(20)), num_epochs=1))
-    results = sorted(ex.results())
-    ex.stop()
-    ex.join()
+    with make_executor(pool, workers_count=3, results_queue_size=4) as ex:
+        ex.start(_square, EpochPlan(list(range(20)), num_epochs=1))
+        results = sorted(ex.results())
     assert results == sorted(x * x for x in range(20))
 
 
 @pytest.mark.parametrize("pool", ["thread", "process"])
 def test_exception_propagates(pool):
-    ex = make_executor(pool, workers_count=2, results_queue_size=4)
-    ex.start(_Boom(), EpochPlan(list(range(10)), num_epochs=1))
-    with pytest.raises(ValueError, match="worker failure"):
-        list(ex.results())
-    ex.join()
+    with make_executor(pool, workers_count=2, results_queue_size=4) as ex:
+        ex.start(_Boom(), EpochPlan(list(range(10)), num_epochs=1))
+        with pytest.raises(ValueError, match="worker failure"):
+            list(ex.results())
 
 
 def test_multiple_epochs_through_executor():
-    ex = ThreadExecutor(workers_count=2, results_queue_size=4)
-    ex.start(_square, EpochPlan([1, 2, 3], num_epochs=3))
-    assert sorted(ex.results()) == sorted([1, 4, 9] * 3)
-    ex.join()
+    with ThreadExecutor(workers_count=2, results_queue_size=4) as ex:
+        ex.start(_square, EpochPlan([1, 2, 3], num_epochs=3))
+        assert sorted(ex.results()) == sorted([1, 4, 9] * 3)
 
 
 def test_backpressure_bounded_queue():
@@ -60,24 +58,22 @@ def test_backpressure_bounded_queue():
         processed.append(x)
         return x
 
-    ex = ThreadExecutor(workers_count=1, results_queue_size=2)
-    ex.start(track, EpochPlan(list(range(100)), num_epochs=1))
-    it = ex.results()
-    next(it)
-    time.sleep(0.2)
-    assert len(processed) <= 1 + 2 + 1  # consumed + queue + in-hand
-    ex.stop()
-    ex.join()
+    with ThreadExecutor(workers_count=1, results_queue_size=2) as ex:
+        ex.start(track, EpochPlan(list(range(100)), num_epochs=1))
+        it = ex.results()
+        next(it)
+        time.sleep(0.2)
+        assert len(processed) <= 1 + 2 + 1  # consumed + queue + in-hand
 
 
 def test_stop_mid_stream():
-    ex = ThreadExecutor(workers_count=2, results_queue_size=2)
-    ex.start(_square, EpochPlan(list(range(1000)), num_epochs=1))
-    it = ex.results()
-    for _ in range(5):
-        next(it)
-    ex.stop()
-    ex.join()  # must not hang
+    with ThreadExecutor(workers_count=2, results_queue_size=2) as ex:
+        ex.start(_square, EpochPlan(list(range(1000)), num_epochs=1))
+        it = ex.results()
+        for _ in range(5):
+            next(it)
+        ex.stop()
+        ex.join()  # must not hang
 
 
 def test_timeout_raises():
@@ -85,11 +81,11 @@ def test_timeout_raises():
         time.sleep(10)
         return x
 
-    ex = ThreadExecutor(workers_count=1, results_queue_size=2, results_timeout_s=0.3)
-    ex.start(slow, EpochPlan([1], num_epochs=1))
-    with pytest.raises(TimeoutWaitingForResultError):
-        next(ex.results())
-    ex.stop()
+    with ThreadExecutor(workers_count=1, results_queue_size=2,
+                        results_timeout_s=0.3) as ex:
+        ex.start(slow, EpochPlan([1], num_epochs=1))
+        with pytest.raises(TimeoutWaitingForResultError):
+            next(ex.results())
 
 
 def test_sync_executor_lazy():
@@ -99,21 +95,19 @@ def test_sync_executor_lazy():
         calls.append(x)
         return x
 
-    ex = SyncExecutor()
-    ex.start(track, EpochPlan(list(range(100)), num_epochs=1))
-    it = ex.results()
-    next(it)
-    assert len(calls) == 1  # fully lazy
+    with SyncExecutor() as ex:
+        ex.start(track, EpochPlan(list(range(100)), num_epochs=1))
+        it = ex.results()
+        next(it)
+        assert len(calls) == 1  # fully lazy
 
 
 def test_process_executor_infinite_plan_bounded():
-    ex = ProcessExecutor(workers_count=2, results_queue_size=4)
-    ex.start(_square, EpochPlan([1, 2], num_epochs=None))
-    it = ex.results()
-    got = [next(it) for _ in range(10)]
-    assert all(v in (1, 4) for v in got)
-    ex.stop()
-    ex.join()
+    with ProcessExecutor(workers_count=2, results_queue_size=4) as ex:
+        ex.start(_square, EpochPlan([1, 2], num_epochs=None))
+        it = ex.results()
+        got = [next(it) for _ in range(10)]
+        assert all(v in (1, 4) for v in got)
 
 
 def _slow_square(x):
@@ -129,16 +123,14 @@ def test_process_child_killed_fail_fast_when_respawns_disabled():
     import os
     import signal
 
-    ex = ProcessExecutor(workers_count=2, results_queue_size=4, results_timeout_s=60,
-                         worker_respawns=0)
-    ex.start(_slow_square, EpochPlan(list(range(40)), num_epochs=1))
-    time.sleep(1.0)  # children connected and mid-task
-    os.kill(ex._procs[0].pid, signal.SIGKILL)
-    with pytest.raises(RuntimeError, match="worker process died"):
-        for _ in ex.results():
-            pass
-    ex.stop()
-    ex.join()
+    with ProcessExecutor(workers_count=2, results_queue_size=4, results_timeout_s=60,
+                         worker_respawns=0) as ex:
+        ex.start(_slow_square, EpochPlan(list(range(40)), num_epochs=1))
+        time.sleep(1.0)  # children connected and mid-task
+        os.kill(ex._procs[0].pid, signal.SIGKILL)
+        with pytest.raises(RuntimeError, match="worker process died"):
+            for _ in ex.results():
+                pass
 
 
 def test_process_child_killed_heals_by_respawn():
@@ -148,17 +140,18 @@ def test_process_child_killed_heals_by_respawn():
     import os
     import signal
 
-    ex = ProcessExecutor(workers_count=2, results_queue_size=4, results_timeout_s=120)
-    ex.start(_slow_square, EpochPlan(list(range(20)), num_epochs=1))
-    time.sleep(1.0)  # children connected and mid-task
-    os.kill(ex._procs[0].pid, signal.SIGKILL)
-    got = sorted(r for r in ex.results())
-    handles = list(ex._procs)  # originals + the replacement, captured before join
-    ex.stop()
-    ex.join()
-    assert got == sorted(x * x for x in range(20))
-    assert len(handles) == 3  # two originals + one respawned replacement
-    assert all(p.poll() is not None for p in handles)  # every child reaped
+    with ProcessExecutor(workers_count=2, results_queue_size=4,
+                         results_timeout_s=120) as ex:
+        ex.start(_slow_square, EpochPlan(list(range(20)), num_epochs=1))
+        time.sleep(1.0)  # children connected and mid-task
+        os.kill(ex._procs[0].pid, signal.SIGKILL)
+        got = sorted(r for r in ex.results())
+        handles = list(ex._procs)  # originals + the replacement, captured before join
+        ex.stop()
+        ex.join()
+        assert got == sorted(x * x for x in range(20))
+        assert len(handles) == 3  # two originals + one respawned replacement
+        assert all(p.poll() is not None for p in handles)  # every child reaped
 
 
 def test_process_respawn_budget_exhaustion_is_fatal():
@@ -167,20 +160,18 @@ def test_process_respawn_budget_exhaustion_is_fatal():
     import os
     import signal
 
-    ex = ProcessExecutor(workers_count=1, results_queue_size=4, results_timeout_s=120,
-                         worker_respawns=1)
-    ex.start(_slow_square, EpochPlan(list(range(40)), num_epochs=1))
-    with pytest.raises(RuntimeError, match="worker process died"):
-        count = 0
-        for _ in ex.results():
-            count += 1
-            if count in (2, 4):  # kill the current child twice: budget is 1
-                time.sleep(0.1)
-                for p in ex._procs:
-                    if p.poll() is None:
-                        os.kill(p.pid, signal.SIGKILL)
-    ex.stop()
-    ex.join()
+    with ProcessExecutor(workers_count=1, results_queue_size=4, results_timeout_s=120,
+                         worker_respawns=1) as ex:
+        ex.start(_slow_square, EpochPlan(list(range(40)), num_epochs=1))
+        with pytest.raises(RuntimeError, match="worker process died"):
+            count = 0
+            for _ in ex.results():
+                count += 1
+                if count in (2, 4):  # kill the current child twice: budget is 1
+                    time.sleep(0.1)
+                    for p in ex._procs:
+                        if p.poll() is None:
+                            os.kill(p.pid, signal.SIGKILL)
 
 
 def test_results_consumer_unblocks_promptly_after_stop():
@@ -193,23 +184,22 @@ def test_results_consumer_unblocks_promptly_after_stop():
 
     from petastorm_tpu.workers import ThreadExecutor
 
-    ex = ThreadExecutor(workers_count=1, results_timeout_s=300.0)
-    ex.start(lambda item: item, iter([1, 2, 3]))
-    assert sorted(ex.results()) == [1, 2, 3]  # stream fully consumed (incl. _DONE)
+    with ThreadExecutor(workers_count=1, results_timeout_s=300.0) as ex:
+        ex.start(lambda item: item, iter([1, 2, 3]))
+        assert sorted(ex.results()) == [1, 2, 3]  # stream fully consumed (incl. _DONE)
 
-    waited = []
+        waited = []
 
-    def late_consumer():
-        t0 = time.monotonic()
-        for _ in ex.results():  # empty queue, workers gone: blocks until stop()
-            pass
-        waited.append(time.monotonic() - t0)
+        def late_consumer():
+            t0 = time.monotonic()
+            for _ in ex.results():  # empty queue, workers gone: blocks until stop()
+                pass
+            waited.append(time.monotonic() - t0)
 
-    t = threading.Thread(target=late_consumer)
-    t.start()
-    time.sleep(0.5)
-    ex.stop()
-    t.join(timeout=10)
-    assert not t.is_alive(), "late consumer still blocked after stop()"
-    assert waited and waited[0] < 5.0, waited
-    ex.join()
+        t = threading.Thread(target=late_consumer)
+        t.start()
+        time.sleep(0.5)
+        ex.stop()
+        t.join(timeout=10)
+        assert not t.is_alive(), "late consumer still blocked after stop()"
+        assert waited and waited[0] < 5.0, waited
