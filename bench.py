@@ -339,7 +339,12 @@ def main():
         window must retroactively demote it), then pick the best: healthy windows
         outrank unhealthy ones at ANY rows/s (a fake-fast service window can post
         arbitrary throughput with zero device backpressure and must not become the
-        artifact of record)."""
+        artifact of record). Tolerates ``meas=None`` — a primary measurement that
+        failed outright through attempt() degrades the artifact (zeroed row, no
+        windows, unhealthy) instead of erasing it (ADVICE r5 bench.py:686)."""
+        if meas is None:
+            return {"rows_per_sec": 0.0, "step_ms": 0.0, "stages": None,
+                    "windows": [], "healthy_window": False}
         key = meas["step_key"]
         floor = weather["step_floor_s"].get(key)
         for w, (rows, step_s, _st) in zip(meas["windows"], meas["cands"]):
@@ -683,11 +688,18 @@ def main():
                 sys.stderr.write("bench: %s failed (attempt %d): %s\n" % (what, i, e))
         return None
 
-    host_meas = measure(decode_on_device=False, measure_batches=14, reserve_s=300.0)
+    # the two primary measurements ride attempt() like everything else: a
+    # transient tunnel RPC drop during either must degrade the artifact (zeroed
+    # unhealthy row via finalize_measure(None), retried by the budget loop
+    # below), never erase it (ADVICE r5 bench.py:686)
+    host_meas = attempt(lambda: measure(
+        decode_on_device=False, measure_batches=14, reserve_s=300.0),
+        "host measure", retries=0)
     from petastorm_tpu.ops.jpeg import transfer_byte_counters
 
     transfer_byte_counters(reset=True)
-    device_meas = measure(decode_on_device=True, reserve_s=260.0)
+    device_meas = attempt(lambda: measure(decode_on_device=True, reserve_s=260.0),
+                          "device measure", retries=0)
     xfer = transfer_byte_counters()
 
     # Remaining acceptance configs (VERDICT r4 #4): cheap host-dominated modes, run

@@ -7,9 +7,13 @@ forkserver, which fork-bombs unguarded user scripts) and never forks a threaded 
 ``exec_in_new_process`` bootstrap (petastorm/workers_pool/exec_in_new_process.py ~L20),
 with ``multiprocessing.connection`` replacing ZeroMQ.
 
-Protocol: parent sends sys.path, the serializer name, then the pickled worker; then
-items. Child answers ``("ok", kind, nframes)`` followed by ``nframes`` raw frames from
-the wire serializer (pickle-5 out-of-band buffers or Arrow IPC — see
+Protocol: parent sends sys.path, the serializer name (an ``shm``-family name is
+followed by the slab-ring attach config — segment names + slab size), then the
+pickled worker; then items. On the socket wire each item message is the item itself;
+on the shm wire it is ``(slab_id_or_None, item)`` — the parent's slab grant for this
+item's result (None = ring starved, serialize over the socket). Child answers
+``("ok", kind, nframes)`` followed by ``nframes`` raw frames from the wire serializer
+(pickle-5 out-of-band buffers, Arrow IPC, or a slab descriptor — see
 petastorm_tpu/serializers.py), or ``("exc", exception)``; ``None`` item = shut down.
 """
 import pickle
@@ -21,6 +25,7 @@ def main():
     address = sys.argv[1]
     authkey = sys.stdin.buffer.read(32)
     conn = Client(address, authkey=authkey)
+    serializer = None
     try:
         # parent's sys.path first, so the worker pickle can resolve user modules
         for entry in conn.recv():
@@ -28,12 +33,22 @@ def main():
                 sys.path.append(entry)
         from petastorm_tpu.serializers import make_serializer
 
-        serializer = make_serializer(conn.recv())
+        serializer_name = conn.recv()
+        serializer = make_serializer(serializer_name)
+        shm_wire = serializer_name.startswith("shm")
+        if shm_wire:
+            slab_names, slab_bytes = conn.recv()
+            serializer.bind_slabs(slab_names, slab_bytes)
         worker = conn.recv()
         while True:
-            item = conn.recv()
-            if item is None:
+            msg = conn.recv()
+            if msg is None:
                 return
+            if shm_wire:
+                slab_id, item = msg
+                serializer.set_slab(slab_id)
+            else:
+                item = msg
             try:
                 result = worker(item)
                 kind, frames = serializer.serialize(result)
@@ -50,6 +65,8 @@ def main():
     except (EOFError, BrokenPipeError, ConnectionResetError):
         return
     finally:
+        if serializer is not None and hasattr(serializer, "close"):
+            serializer.close()  # detach (never unlink) any attached slabs
         conn.close()
 
 

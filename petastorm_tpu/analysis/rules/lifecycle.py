@@ -16,12 +16,17 @@ from petastorm_tpu.analysis.rules._astutil import call_func_name, walk_scope
 
 #: Constructors/factories returning objects that expose close()/stop() and
 #: support the context-manager protocol. Project types only — stdlib `open()`
-#: etc. is the standard linters' turf.
+#: etc. is the standard linters' turf. ``SharedMemory`` is the one stdlib
+#: exception: a segment constructed without a ``close()``/``unlink()`` path
+#: outlives the process in ``/dev/shm`` (not just the interpreter), and the shm
+#: wire (petastorm_tpu/parallel/shm_ring.py) makes it a recurring project idiom
+#: — so the PR-1 analyzer covers it alongside the ring's own types.
 CLOSEABLE_FACTORIES = frozenset({
     "make_reader", "make_batch_reader", "Reader",
     "make_executor", "ThreadExecutor", "ProcessExecutor", "SyncExecutor",
     "DataLoader", "InMemDataLoader", "BatchedDataLoader",
     "make_weighted_reader", "WeightedSamplingReader",
+    "SharedMemory", "SlabRing", "SlabClient",
 })
 
 #: calls that merely CONSUME an iterable without taking ownership of it
@@ -29,21 +34,24 @@ _CONSUMERS = frozenset({"list", "iter", "next", "enumerate", "sorted", "zip",
                         "sum", "min", "max", "len", "tuple", "set", "dict",
                         "print", "repr", "str", "isinstance", "type"})
 
-_CLOSERS = frozenset({"stop", "close", "join", "terminate", "shutdown"})
+_CLOSERS = frozenset({"stop", "close", "join", "terminate", "shutdown", "unlink"})
 
 
 class ResourceLifecycleRule(Rule):
     """GL-L001: a closeable constructed but not consumed via ``with``, closed in
     a ``finally``, or handed off (returned / yielded / stored / wrapped by
-    another closeable that assumes ownership)."""
+    another closeable that assumes ownership). Covers ``SharedMemory`` (and the
+    slab-ring types built on it): a segment with no ``close()``/``unlink()``
+    path leaks a ``/dev/shm`` file past process exit."""
 
     rule_id = "GL-L001"
     severity = Severity.ERROR
-    description = ("reader/executor/loader constructed without a context "
-                   "manager or try/finally close")
+    description = ("reader/executor/loader/shared-memory segment constructed "
+                   "without a context manager or try/finally close")
     fix_hint = ("use `with make_reader(...) as r:` (or close in a `finally:`); "
                 "passing a reader into DataLoader(...) transfers ownership to "
-                "the loader's own `with` block")
+                "the loader's own `with` block; a SharedMemory segment needs a "
+                "close()+unlink() (creator) or close() (attacher) path")
 
     def check(self, tree, ctx):
         scopes = [tree] + [n for n in ast.walk(tree)

@@ -52,6 +52,15 @@ def _make_synthetic_step(target_ms):
 
 
 def main(argv=None):
+    import sys
+
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "wire":
+        # `petastorm-tpu-bench wire ...`: the process-pool wire micro-benchmark
+        # (socket-pickle vs socket-arrow vs shm slabs) — see benchmark/wire.py
+        from petastorm_tpu.benchmark import wire
+
+        return wire.main(argv[1:])
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("dataset_url")
     parser.add_argument("--batch", action="store_true",

@@ -60,10 +60,21 @@ class PipelineStats:
     several — the undivisible-batch / local-mesh-derivation-failure / pre-``sharding``-
     kwarg-codec fallbacks (VERDICT r4 #6). Nonzero on a pod means one chip is
     decoding for many; fix the batch size / sharding / codec signature.
+
+    The ``shm_*`` fields mirror the process pool's shared-memory wire gauges
+    (``Reader.wire_stats()``, refreshed per reader delivery; all zero on thread/
+    dummy pools and socket wires): ``shm_slabs_in_flight`` (slabs currently out
+    of the ring), ``shm_bytes`` (payload bytes that traveled through shared
+    memory), ``shm_fallbacks`` (items that degraded to the socket wire —
+    oversized payload or a starved ring), ``shm_acquire_wait_s`` (cumulative
+    driver-thread wait for a free slab — sustained growth means the ring is
+    undersized for the consumer's release cadence).
     """
 
     __slots__ = ("rows", "batches", "read_s", "batch_s", "decode_s", "h2d_s",
-                 "queue_wait_s", "device_queue_wait_s", "decode_unsharded_batches")
+                 "queue_wait_s", "device_queue_wait_s", "decode_unsharded_batches",
+                 "shm_slabs_in_flight", "shm_bytes", "shm_fallbacks",
+                 "shm_acquire_wait_s")
 
     def __init__(self):
         self.reset()
@@ -78,6 +89,10 @@ class PipelineStats:
         self.queue_wait_s = 0.0
         self.device_queue_wait_s = 0.0
         self.decode_unsharded_batches = 0
+        self.shm_slabs_in_flight = 0
+        self.shm_bytes = 0
+        self.shm_fallbacks = 0
+        self.shm_acquire_wait_s = 0.0
 
     def snapshot(self):
         return {
@@ -90,7 +105,20 @@ class PipelineStats:
             "queue_wait_s": round(self.queue_wait_s, 4),
             "device_queue_wait_s": round(self.device_queue_wait_s, 4),
             "decode_unsharded_batches": self.decode_unsharded_batches,
+            "shm_slabs_in_flight": self.shm_slabs_in_flight,
+            "shm_bytes": self.shm_bytes,
+            "shm_fallbacks": self.shm_fallbacks,
+            "shm_acquire_wait_s": round(self.shm_acquire_wait_s, 4),
         }
+
+    def update_wire(self, wire_stats):
+        """Fold the pool's shm gauges (``Reader.wire_stats()`` dict) in."""
+        if not wire_stats:
+            return
+        self.shm_slabs_in_flight = wire_stats.get("shm_slabs_in_flight", 0)
+        self.shm_bytes = wire_stats.get("shm_bytes", 0)
+        self.shm_fallbacks = wire_stats.get("shm_fallbacks", 0)
+        self.shm_acquire_wait_s = wire_stats.get("shm_acquire_wait_s", 0.0)
 
 
 def _is_device_dtype(arr):
@@ -223,6 +251,43 @@ def _batch_row_count(batch):
     if not batch:
         return 0
     return int(len(next(iter(batch.values()))))
+
+
+def _detach_slab_views(columns):
+    """Copy every zero-copy slab view out of a view-mode reader delivery before it
+    enters a buffering stage: top-level read-only ndarrays, read-only ELEMENTS of
+    object (ragged) columns, and staged payload objects exposing ``detach()`` —
+    all go stale when the Reader releases the batch's lease at its next fetch."""
+    out = {}
+    for name, v in columns.items():
+        if isinstance(v, np.ndarray):
+            if v.dtype.hasobject:
+                fresh = np.empty(v.shape, dtype=object)
+                for idx, e in np.ndenumerate(v):
+                    if isinstance(e, np.ndarray) and not e.flags.writeable:
+                        e = e.copy()
+                    elif hasattr(e, "detach"):
+                        e = e.detach()
+                    fresh[idx] = e
+                v = fresh
+            elif not v.flags.writeable:
+                v = v.copy()
+        out[name] = v
+    return out
+
+
+def _batch_valid_rows(batch):
+    """Rows the READER actually delivered in this batch: under ``last_batch='pad'``
+    the tail batch repeats its final row up to ``batch_size`` with a ``__valid__``
+    mask, and counting the padding would advance the consumer checkpoint watermark
+    past the producer's delivered-row log (ADVICE r5 loader.py:846 — harmless at
+    the tail today, wrong the moment padding ever happens mid-stream)."""
+    if not batch:
+        return 0
+    valid = batch.get("__valid__")
+    if isinstance(valid, np.ndarray) and valid.dtype == np.bool_:
+        return int(valid.sum())
+    return _batch_row_count(batch)
 
 
 def _concat(chunks):
@@ -360,8 +425,11 @@ class DataLoader:
             device_decode_resize, getattr(reader, "device_decode_fields", None))
         self._device_shuffle_capacity = int(device_shuffle_capacity or 0)
         #: optional petastorm_tpu.trace.TraceRecorder — per-span chrome-trace view of
-        #: the same stages PipelineStats totals (None = zero overhead)
+        #: the same stages PipelineStats totals (None = zero overhead). The pool
+        #: wire joins in: an shm-wire reader records shm.acquire_wait spans too.
         self._trace = trace
+        if trace is not None and hasattr(reader, "set_trace"):
+            reader.set_trace(trace)
         self._device_transform = device_transform
         if device_transform is None:
             spec = getattr(reader, "transform_spec", None)
@@ -436,6 +504,14 @@ class DataLoader:
         ckpt_cum = 0  # cumulative rows delivered by the reader this generation
         ckpt_deliveries = 0
         ckpt_next_snap = 1
+        # shm wire integration: gauges refresh per delivery, and view-mode batches
+        # (zero-copy READ-ONLY slab views, invalidated at the reader's next fetch)
+        # are detached before they enter the batcher — its chunk deque holds views
+        # across deliveries, which would otherwise read recycled slabs
+        wire_stats_fn = getattr(self.reader, "wire_stats", None)
+        if wire_stats_fn is not None and not wire_stats_fn():
+            wire_stats_fn = None  # thread/dummy pool or socket wire: nothing to poll
+        detach_views = bool(getattr(self.reader, "wire_views", False))
         try:
             it = iter(self.reader)
             while True:
@@ -450,6 +526,8 @@ class DataLoader:
                     # even when the throttle skipped the tail deliveries
                     if self._ckpt_enabled and ckpt_deliveries:
                         self._ckpt_record(ckpt_cum)
+                    if wire_stats_fn is not None:
+                        stats.update_wire(wire_stats_fn())
                     break
                 if self._stop.is_set():
                     return
@@ -472,6 +550,10 @@ class DataLoader:
                         [item],
                         object_fields=getattr(self.reader, "device_decode_fields", ()),
                     )
+                if detach_views:
+                    columns = _detach_slab_views(columns)
+                if wire_stats_fn is not None:
+                    stats.update_wire(wire_stats_fn())
                 t0 = time.perf_counter()
                 if self._pad_shapes:
                     columns = _pad_ragged_columns(columns, self._pad_shapes)
@@ -793,7 +875,7 @@ class DataLoader:
             for batch in self._host_batches(host_q):
                 if self._stop.is_set():
                     return
-                n = _batch_row_count(batch)
+                n = _batch_valid_rows(batch)
                 yield self._to_device(batch), n
             return
         from petastorm_tpu.ops.device_shuffle import DeviceShuffleBuffer
@@ -842,11 +924,11 @@ class DataLoader:
                 for batch in self._host_batches(host_q):
                     rest, staged = self._decode_staged(batch)
                     rest.update({k: np.asarray(v) for k, v in staged.items()})
-                    self._advance_consumed(_batch_row_count(rest))
+                    self._advance_consumed(_batch_valid_rows(rest))
                     yield rest
             else:
                 for batch in self._host_batches(host_q):
-                    self._advance_consumed(_batch_row_count(batch))
+                    self._advance_consumed(_batch_valid_rows(batch))
                     yield batch
             return
         if self.prefetch <= 0:  # synchronous transfer (debug)

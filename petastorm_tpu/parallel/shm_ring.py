@@ -1,0 +1,282 @@
+"""Shared-memory slab ring for the process pool's zero-copy wire.
+
+The ProcessExecutor wire used to be the last multi-copy hop on the decode path:
+children pushed every payload frame through a ``multiprocessing.connection`` unix
+socket (one kernel copy out, one allocation+copy in), after which the writable-batch
+contract forced another full copy of every read-only reconstruction. This module
+provides the slab transport that removes the socket hop (Zerrow's "true zero-copy
+Arrow pipelines" observation, PAPERS.md): the parent owns a ring of
+``multiprocessing.shared_memory`` segments ("slabs") with a thread-safe free list;
+per item, a driver thread acquires a slab and grants it to the child alongside the
+work item; the child writes its serialized frames straight into the slab and answers
+with a tiny descriptor; the parent reconstructs buffer views into the slab with no
+copy at all. See :class:`petastorm_tpu.serializers.ShmSerializer` for the framing
+and :class:`petastorm_tpu.workers.ProcessExecutor` for the grant protocol.
+
+Lifecycle rules (the leak-proof part):
+
+- The PARENT is the only creator and the only unlinker. ``SlabRing.close()`` —
+  called from ``ProcessExecutor.join()`` — unlinks every segment, so nothing
+  survives in ``/dev/shm`` whatever the children did (including SIGKILL mid-write).
+- Children attach by name and explicitly deregister from their process's
+  ``resource_tracker`` (gh-82300: an attaching process otherwise unlinks the
+  parent's segments when it exits — exactly the respawn path).
+- A slab granted to a child that dies mid-item is released back to the ring by the
+  driver thread before the replacement child is spawned.
+- Consumer-held leases (:class:`SlabLease`) release idempotently, and release after
+  ``close()`` is a no-op — teardown order cannot double-free or resurrect a slab.
+
+The ring also keeps the wire gauges (slabs in flight, bytes through shm, socket
+fallbacks, cumulative acquire wait) surfaced via ``PipelineStats`` / ``Reader.
+wire_stats()``, and records ``shm.acquire_wait`` spans into an attached
+:class:`petastorm_tpu.trace.TraceRecorder`.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+#: /dev/shm segment name prefix — the test suite's leak fixture and operators
+#: debugging a wedged pool both grep for it.
+SEGMENT_PREFIX = "ptpu_shm_"
+
+_supported_cache = None
+
+
+def _noop():
+    pass
+
+
+def shm_supported():
+    """True when ``multiprocessing.shared_memory`` works on this platform (probed
+    once): a missing ``/dev/shm`` mount, a SELinux denial, or a python built
+    without ``_posixshmem`` all degrade the wire to the socket path."""
+    global _supported_cache
+    if _supported_cache is None:
+        try:
+            from multiprocessing import shared_memory
+
+            probe = shared_memory.SharedMemory(create=True, size=16)
+            try:
+                probe.buf[0] = 1
+            finally:
+                probe.close()
+                probe.unlink()
+            _supported_cache = True
+        except Exception as e:  # noqa: BLE001 — any failure means "not here"
+            logger.warning("shared-memory wire unavailable (%s); the process pool "
+                           "will use the socket wire", e)
+            _supported_cache = False
+    return _supported_cache
+
+
+def _untrack(segment):
+    """Deregister an ATTACHED segment from this process's resource_tracker.
+
+    gh-82300: on POSIX, ``SharedMemory(name=...)`` registers the segment with the
+    tracker even when it did not create it, and the tracker unlinks everything it
+    knows at process exit — so a pool child exiting cleanly would tear the
+    parent's ring out from under the other children."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(segment._name, "shared_memory")
+    except Exception:  # noqa: BLE001 — tracker internals vary; worst case is a
+        pass           # spurious unlink warning at child exit, not a leak
+
+
+class SlabLease:
+    """One consumer-held reference to an acquired slab.
+
+    ``release()`` returns the slab to the ring exactly once — atomically, so the
+    cross-thread teardown pattern the pools support (a consumer thread iterating
+    while another thread calls ``stop()``, both reaching the same lease) cannot
+    double-insert the slab id into the free list and hand one slab to two
+    children. Dropping the last reference releases too (refcount ``__del__``),
+    so a consumer that simply discards a batch cannot wedge the ring — the
+    explicit hook (``Reader.release_batch()``) just makes the return prompt and
+    deterministic.
+    """
+
+    __slots__ = ("_ring", "slab_id", "_released", "_lock")
+
+    def __init__(self, ring, slab_id):
+        self._ring = ring
+        self.slab_id = slab_id
+        self._released = False
+        self._lock = threading.Lock()
+
+    def release(self):
+        with self._lock:  # exactly-once even under concurrent release/__del__
+            if self._released:
+                return
+            self._released = True
+        self._ring.release(self.slab_id)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+class SlabRing:
+    """Parent-side slab owner: fixed-size segments + a thread-safe free list."""
+
+    def __init__(self, slab_bytes, num_slabs, trace=None):
+        from multiprocessing import shared_memory
+
+        if slab_bytes <= 0 or num_slabs <= 0:
+            raise ValueError("slab_bytes and num_slabs must be positive")
+        self.slab_bytes = int(slab_bytes)
+        self._lock = threading.Lock()
+        self._closed = False
+        self._segs = []
+        token = "%d_%s" % (os.getpid(), os.urandom(4).hex())
+        try:
+            for i in range(num_slabs):
+                seg = shared_memory.SharedMemory(
+                    create=True, size=self.slab_bytes,
+                    name="%s%s_%d" % (SEGMENT_PREFIX, token, i))
+                self._segs.append(seg)
+        except BaseException:
+            self.close()  # a half-built ring must not leak its earlier segments
+            raise
+        self.names = [seg.name for seg in self._segs]
+        self._free = queue.Queue()
+        for i in range(num_slabs):
+            self._free.put(i)
+        self._trace = trace
+        # wire gauges (read via stats(); exported through PipelineStats.shm_*)
+        self._grants = 0
+        self._bytes_through = 0
+        self._fallbacks = 0
+        self._acquire_wait_s = 0.0
+
+    def __len__(self):
+        return len(self._segs)
+
+    # -- free-list protocol -------------------------------------------------------------
+
+    def acquire(self, timeout=2.0):
+        """A free slab id, or None after ``timeout`` (the caller then degrades to
+        the socket wire for that item — graceful, never blocking the pool)."""
+        if self._closed:
+            return None
+        t0 = time.perf_counter()
+        try:
+            slab_id = self._free.get(timeout=timeout)
+        except queue.Empty:
+            slab_id = None
+        waited = time.perf_counter() - t0
+        with self._lock:
+            self._acquire_wait_s += waited
+            if slab_id is not None:
+                self._grants += 1
+        if self._trace is not None and waited > 1e-4:
+            self._trace.add("shm.acquire_wait", t0, waited)
+        return slab_id
+
+    def release(self, slab_id):
+        """Return a slab to the free list (no-op after close())."""
+        if self._closed:
+            return
+        self._free.put(slab_id)
+
+    def buffer(self, slab_id):
+        """Writable memoryview over one slab's full extent."""
+        return self._segs[slab_id].buf
+
+    def set_trace(self, trace):
+        self._trace = trace
+
+    # -- accounting ---------------------------------------------------------------------
+
+    def add_bytes(self, n):
+        with self._lock:
+            self._bytes_through += int(n)
+
+    def count_fallback(self):
+        with self._lock:
+            self._fallbacks += 1
+
+    def stats(self):
+        """Wire gauges: slab occupancy, shm byte volume, socket fallbacks,
+        cumulative acquire wait."""
+        with self._lock:
+            in_flight = len(self._segs) - self._free.qsize() if not self._closed else 0
+            return {
+                "shm_slabs_total": len(self._segs),
+                "shm_slabs_in_flight": in_flight,
+                "shm_grants": self._grants,
+                "shm_bytes": self._bytes_through,
+                "shm_fallbacks": self._fallbacks,
+                "shm_acquire_wait_s": round(self._acquire_wait_s, 4),
+            }
+
+    # -- teardown -----------------------------------------------------------------------
+
+    def close(self):
+        """Unlink + unmap every segment (idempotent). Runs from
+        ``ProcessExecutor.join()`` AFTER children are reaped, so no writer is
+        live; consumer views may still exist (view-mode batches a consumer kept
+        past join), in which case the unmap is deferred to interpreter exit but
+        the ``/dev/shm`` entry is removed HERE either way — segments never
+        outlive the pool on disk."""
+        with self._lock:  # stats() reads occupancy from these concurrently
+            self._closed = True
+            segs, self._segs = self._segs, []
+        for seg in segs:
+            try:
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+            except Exception:  # noqa: BLE001 — unlink is best-effort per segment
+                pass
+            try:
+                seg.close()
+            except BufferError:
+                # exported views still alive (a consumer kept a view-mode batch):
+                # the name is already unlinked above, the mapping frees with the
+                # last view / at process exit. Shadow close() so the segment's
+                # __del__ does not retry and spam "Exception ignored" at GC.
+                seg.close = _noop
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class SlabClient:
+    """Child-side attach-by-name view of the parent's ring (write-only use).
+
+    Segments attach lazily on first grant and are detached — never unlinked —
+    by ``close()``; every attachment is deregistered from the child's
+    resource_tracker (see :func:`_untrack`).
+    """
+
+    def __init__(self, names, slab_bytes):
+        self._names = list(names)
+        self.slab_bytes = int(slab_bytes)
+        self._segs = {}
+
+    def buffer(self, slab_id):
+        seg = self._segs.get(slab_id)
+        if seg is None:
+            from multiprocessing import shared_memory
+
+            seg = shared_memory.SharedMemory(name=self._names[slab_id])
+            _untrack(seg)
+            self._segs[slab_id] = seg
+        return seg.buf
+
+    def close(self):
+        segs, self._segs = self._segs, {}
+        for seg in segs.values():
+            try:
+                seg.close()
+            except Exception:  # noqa: BLE001 — exit path
+                pass

@@ -44,6 +44,7 @@ from petastorm_tpu.ngram import NGram
 from petastorm_tpu.plan import EpochPlan, shard_indices
 from petastorm_tpu.transform import transform_schema
 from petastorm_tpu.unischema import Unischema, UnischemaField
+from petastorm_tpu.serializers import SHM_LEASE_KEY as _SHM_LEASE_KEY
 from petastorm_tpu.utils import decode_row
 from petastorm_tpu.workers import make_executor
 
@@ -833,6 +834,9 @@ class Reader:
         self._resume_epoch = 0  # every epoch below this is fully consumed
         self.last_row_consumed = False
         self.stopped = False
+        #: slab lease of the CURRENT batch/row-buffer on the shm view wire — held
+        #: until the consumer asks for the next batch (or calls release_batch())
+        self._held_lease = None
         self._start()
 
     def _start(self):
@@ -871,11 +875,16 @@ class Reader:
                     self._mark_consumed(self._buffer_tag)
                     self._buffer_tag = None
                 return self._wrap_row(row)
+            # moving past the drained buffer: its slab (shm view wire) returns to
+            # the ring — rows handed out so far must already be done with
+            self.release_batch()
             nxt = next(self._results_iter, None)
             if nxt is None:
-                self.last_row_consumed = True
+                if not getattr(self._executor, "truncated", False):
+                    self.last_row_consumed = True
                 raise StopIteration
             epoch, ordinal, payload = nxt
+            self._held_lease = getattr(payload, "shm_lease", None)
             if not payload:
                 self._mark_consumed((epoch, ordinal))  # fully-filtered group
                 continue
@@ -897,14 +906,21 @@ class Reader:
         return self._row_type(**{name: row.get(name) for name in self.schema.fields})
 
     def _next_batch(self):
+        # previous batch's slab (shm view wire) returns to the ring: a batch's
+        # views stay valid until the consumer asks for the NEXT batch
+        self.release_batch()
         while True:
             nxt = next(self._results_iter, None)
             if nxt is None:
-                self.last_row_consumed = True
+                if not getattr(self._executor, "truncated", False):
+                    self.last_row_consumed = True
                 raise StopIteration
             epoch, ordinal, columns = nxt
+            if isinstance(columns, dict):
+                self._held_lease = columns.pop(_SHM_LEASE_KEY, None)
             self._mark_consumed((epoch, ordinal))  # batch delivery is atomic
             if not columns or len(next(iter(columns.values()))) == 0:
+                self.release_batch()
                 continue  # fully-filtered (or windowless) row group: skip
             if self.ngram is not None:
                 # flat 'offset/field' window columns cannot be namedtuple
@@ -912,6 +928,42 @@ class Reader:
                 return dict(columns)
             return self._row_type(**{name: columns.get(name)
                                      for name in self.schema.fields})
+
+    # -- shm wire integration -----------------------------------------------------------
+
+    def release_batch(self):
+        """Return the current batch's shared-memory slab to the pool's ring (shm
+        VIEW wire only; a no-op on every other pool/wire configuration).
+
+        On ``wire_serializer='shm-view'``/``'shm-arrow-view'`` the arrays of the
+        most recent batch are zero-copy read-only views into a pool-owned slab.
+        They stay valid until the next ``__next__()`` call releases them
+        implicitly; consumers that finish a batch early (e.g. right after a
+        ``jax.device_put``) call this to return the slab sooner. After the call
+        the previous batch's arrays must not be touched."""
+        lease, self._held_lease = self._held_lease, None
+        if lease is not None:
+            lease.release()
+
+    def wire_stats(self):
+        """Process-pool wire gauges (shm slab occupancy, bytes through shared
+        memory, socket fallbacks, acquire wait) — {} for thread/dummy pools and
+        socket wires. Exported through ``PipelineStats`` by the DataLoader."""
+        fn = getattr(self._executor, "wire_stats", None)
+        return fn() if fn is not None else {}
+
+    def set_trace(self, tracer):
+        """Attach a :class:`petastorm_tpu.trace.TraceRecorder` to the pool wire
+        (records ``shm.acquire_wait`` spans); the DataLoader wires its own."""
+        fn = getattr(self._executor, "set_trace", None)
+        if fn is not None:
+            fn(tracer)
+
+    @property
+    def wire_views(self):
+        """True when batches are zero-copy READ-ONLY slab views (shm view wire):
+        buffering consumers must detach (copy) columns before the next fetch."""
+        return bool(getattr(self._executor, "wire_views", False))
 
     # -- lifecycle ----------------------------------------------------------------------
 
@@ -929,6 +981,7 @@ class Reader:
         self._start()
 
     def stop(self):
+        self.release_batch()  # a held slab must not survive the stream it came from
         if self._executor is not None:
             self._executor.stop()
         self.stopped = True
@@ -1135,8 +1188,11 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
 
     ``wire_serializer``: process-pool result wire format; defaults to ``"arrow"`` here
     (columnar batches ride Arrow IPC — reference ``ArrowTableSerializer`` parity) and
-    ``"pickle"`` for :func:`make_reader` row payloads. Thread/dummy pools share memory
-    and ignore it.
+    ``"pickle"`` for :func:`make_reader` row payloads. ``"shm"`` selects the
+    shared-memory slab wire (docs/performance.md) — batch results keep their Arrow
+    framing but the frames travel through a slab ring instead of the socket
+    (``"shm"``/``"shm-view"`` normalize to ``"shm-arrow"``/``"shm-arrow-view"``
+    here). Thread/dummy pools share memory and ignore it.
     """
     fs, path = get_filesystem_and_path_or_paths(
         dataset_url_or_urls, storage_options, filesystem
@@ -1181,7 +1237,9 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         reader_pool_type=reader_pool_type, workers_count=workers_count,
         results_queue_size=results_queue_size, is_batched_reader=True, ngram=ngram,
         results_timeout_s=results_timeout_s,
-        wire_serializer=wire_serializer or "arrow", worker_respawns=worker_respawns,
+        wire_serializer={"shm": "shm-arrow", "shm-view": "shm-arrow-view"}.get(
+            wire_serializer, wire_serializer) or "arrow",
+        worker_respawns=worker_respawns,
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields

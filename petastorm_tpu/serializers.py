@@ -3,7 +3,7 @@ petastorm/reader_impl/pickle_serializer.py ``PickleSerializer`` and
 petastorm/reader_impl/arrow_table_serializer.py ``ArrowTableSerializer`` ~L20, which
 rode ZeroMQ multipart for zero-copy).
 
-Here the wire is a ``multiprocessing.connection`` unix socket; both serializers speak
+Here the wire is a ``multiprocessing.connection`` unix socket; all serializers speak
 the same frame protocol — ``serialize(obj) -> (kind, [buffer, ...])`` and
 ``deserialize(kind, [buffer, ...]) -> obj`` — so the pool can ship each buffer with
 ``send_bytes`` and avoid the single monolithic pickle stream:
@@ -16,6 +16,24 @@ the same frame protocol — ``serialize(obj) -> (kind, [buffer, ...])`` and
   as one Arrow IPC stream (tensor columns flatten to FixedSizeList with the shape in
   field metadata); payloads it cannot express fall back to pickle frames (the ``kind``
   byte disambiguates on the receiving end).
+- :class:`ShmSerializer` composes with EITHER framing above: the frames the inner
+  serializer produces are written by the child directly into a granted shared-memory
+  slab (:mod:`petastorm_tpu.parallel.shm_ring`) and only a small descriptor crosses
+  the socket; the parent reconstructs buffer views into the slab — no socket copy,
+  no recv allocation. Oversized payloads (or items with no slab grant) fall back to
+  the inner serializer's socket frames transparently: ``deserialize`` dispatches on
+  the ``kind`` byte either way.
+
+Writable-batch contract: deserialized payloads must match the thread pool's
+contract — arrays a consumer may mutate in place. The default (``writable=True``)
+copies exactly the read-only reconstructions (one payload copy, the same count the
+old socket wire paid AFTER its recv copy). ``writable=False`` ("view mode",
+serializer names ending in ``-view``) skips that copy and delivers READ-ONLY
+zero-copy views into the slab plus a :class:`~petastorm_tpu.parallel.shm_ring.
+SlabLease` riding with the batch; a consumer that mutates gets an immediate
+``ValueError: assignment destination is read-only`` (fail-loud, never corruption),
+and the slab returns to the ring when the lease is released —
+``Reader.release_batch()``, batch drop (refcount), or pool ``join()``.
 """
 from __future__ import annotations
 
@@ -25,6 +43,15 @@ import numpy as np
 
 KIND_PICKLE = 0
 KIND_ARROW = 1
+KIND_SHM = 2
+
+#: reserved key under which a view-mode batch's slab lease rides inside the tagged
+#: columnar payload dict — the Reader pops it before exposing the batch
+SHM_LEASE_KEY = "__shm_lease__"
+
+#: frame offsets inside a slab are rounded up to this (cache-line / SIMD-friendly
+#: reconstruction of ndarray views)
+_SLAB_ALIGN = 64
 
 
 def _ensure_writable(obj):
@@ -46,7 +73,13 @@ def _ensure_writable(obj):
 
 
 class PickleSerializer:
-    """Pickle protocol 5 with out-of-band buffers (no intermediate stream copy)."""
+    """Pickle protocol 5 with out-of-band buffers (no intermediate stream copy).
+
+    ``ensure_writable=False`` (the shm view mode) skips the read-only→writable
+    copy and hands back zero-copy reconstructions as-is."""
+
+    def __init__(self, ensure_writable=True):
+        self._ensure = ensure_writable
 
     def serialize(self, obj):
         buffers = []
@@ -56,7 +89,8 @@ class PickleSerializer:
     def deserialize(self, kind, frames):
         if kind != KIND_PICKLE:
             raise ValueError("PickleSerializer got kind %r" % kind)
-        return _ensure_writable(pickle.loads(frames[0], buffers=frames[1:]))
+        obj = pickle.loads(frames[0], buffers=frames[1:])
+        return _ensure_writable(obj) if self._ensure else obj
 
 
 def _arrow_expressible(columns):
@@ -85,7 +119,7 @@ class ArrowTableSerializer(PickleSerializer):
 
     def deserialize(self, kind, frames):
         if kind == KIND_ARROW:
-            return self._decode(frames[0])
+            return self._decode(frames[0], ensure_writable=self._ensure)
         return super().deserialize(kind, frames)
 
     @staticmethod
@@ -127,7 +161,7 @@ class ArrowTableSerializer(PickleSerializer):
         return sink.getvalue()
 
     @staticmethod
-    def _decode(frame):
+    def _decode(frame, ensure_writable=True):
         import pyarrow as pa
 
         with pa.ipc.open_stream(pa.py_buffer(frame)) as reader:
@@ -151,7 +185,171 @@ class ArrowTableSerializer(PickleSerializer):
                     col.to_pylist(), dtype=np.str_ if kind == "U" else np.bytes_)
             else:
                 columns[field.name] = col.to_numpy(zero_copy_only=False)
-        return epoch, ordinal, _ensure_writable(columns)
+        if ensure_writable:
+            columns = _ensure_writable(columns)
+        return epoch, ordinal, columns
+
+
+class _LeasedRows(list):
+    """Per-row payload list that carries its slab lease (view mode); the Reader
+    holds the lease while it drains the buffered rows."""
+
+    shm_lease = None
+
+
+class ShmSerializer:
+    """Slab transport composing an inner framing (pickle or Arrow).
+
+    Child side (``bind_slabs`` + per-item ``set_slab``): writes the inner
+    serializer's frames into the granted slab and ships a descriptor —
+    ``(inner_kind, slab_id, [(offset, length), ...])`` — as the only socket frame.
+    Items without a grant, or whose frames exceed the slab size, ship the inner
+    frames over the socket unchanged (the ``kind`` disambiguates).
+
+    Parent side (``bind_ring``): reconstructs the inner frames as zero-copy
+    memoryviews into the slab. With ``writable=True`` (default) the inner
+    deserializer's writable-batch copy runs and the slab is released immediately;
+    with ``writable=False`` read-only views are delivered with a
+    :class:`~petastorm_tpu.parallel.shm_ring.SlabLease` attached to the payload.
+    """
+
+    def __init__(self, inner_name="pickle", writable=True):
+        if inner_name not in ("pickle", "arrow"):
+            raise ValueError("ShmSerializer inner must be 'pickle' or 'arrow', "
+                             "got %r" % inner_name)
+        self.inner_name = inner_name
+        self.writable = writable
+        inner_cls = PickleSerializer if inner_name == "pickle" else ArrowTableSerializer
+        self.inner = inner_cls(ensure_writable=writable)
+        self._client = None   # child side: SlabClient
+        self._slab = None     # child side: per-item grant
+        self._ring = None     # parent side: SlabRing
+
+    # -- child side ---------------------------------------------------------------------
+
+    def bind_slabs(self, names, slab_bytes):
+        from petastorm_tpu.parallel.shm_ring import SlabClient
+
+        self._client = SlabClient(names, slab_bytes)
+
+    def set_slab(self, slab_id):
+        """Install the parent's grant for the NEXT serialize() call (None = the
+        parent could not acquire a slab; serialize falls back to socket frames)."""
+        self._slab = slab_id
+
+    def close(self):
+        if self._client is not None:
+            self._client.close()
+
+    def serialize(self, obj):
+        kind, frames = self.inner.serialize(obj)
+        slab, self._slab = self._slab, None
+        if slab is None or self._client is None:
+            return kind, frames
+        views = [memoryview(f).cast("B") for f in frames]
+        end = 0
+        offsets = []
+        for v in views:
+            start = -(-end // _SLAB_ALIGN) * _SLAB_ALIGN  # round up
+            end = start + v.nbytes
+            offsets.append((start, v.nbytes))
+        if end > self._client.slab_bytes:
+            # oversized payload: socket fallback for this item; the parent sees a
+            # non-shm kind and returns the unused slab to the ring
+            return kind, frames
+        buf = self._client.buffer(slab)
+        for v, (start, length) in zip(views, offsets):
+            buf[start:start + length] = v
+        return KIND_SHM, [pickle.dumps((kind, slab, offsets))]
+
+    # -- parent side --------------------------------------------------------------------
+
+    def bind_ring(self, ring):
+        self._ring = ring
+
+    def deserialize(self, kind, frames):
+        if kind != KIND_SHM:
+            return self.inner.deserialize(kind, frames)
+        if self._ring is None:
+            raise ValueError("shm descriptor received but no slab ring is bound")
+        inner_kind, slab, offsets = pickle.loads(frames[0])
+        from petastorm_tpu.parallel.shm_ring import SlabLease
+
+        lease = SlabLease(self._ring, slab)
+        try:
+            base = self._ring.buffer(slab)
+            self._ring.add_bytes(sum(length for _s, length in offsets))
+            if self.writable and inner_kind == KIND_PICKLE:
+                result = self._deserialize_owned(base, inner_kind, offsets)
+            else:
+                # arrow framing reconstructs only flat numeric/string columns
+                # (object payloads never ride it), all visible to the writable
+                # walk — zero-copy views are safe; view mode wants views anyway
+                views = [base[start:start + length].toreadonly()
+                         for start, length in offsets]
+                result = self.inner.deserialize(inner_kind, views)
+                del views
+            if not self.writable:
+                attached = self._attach_lease(result, lease)
+                if attached is not None:
+                    return attached
+                # unrecognized result shape (ad-hoc worker return): the lease has
+                # nowhere to ride, so views into the slab would go stale at the
+                # release below. Rebuild the payload from OWNED buffers — the
+                # writable-path treatment — then release; correctness never
+                # depends on the consumer knowing about leases.
+                if inner_kind == KIND_PICKLE:
+                    result = self._deserialize_owned(base, inner_kind, offsets)
+                else:
+                    result = _ensure_writable(result)
+        except BaseException:
+            lease.release()
+            raise
+        # every slab reference was either copied by the inner deserializer
+        # (arrow) or backed by owned buffers (pickle) — return the slab now
+        lease.release()
+        return result
+
+    def _deserialize_owned(self, base, inner_kind, offsets):
+        """Inner deserialize with the out-of-band buffers backed by OWNED writable
+        copies instead of slab views: pickle-5 reattaches buffers ANYWHERE in the
+        object graph — object-array ELEMENTS (ragged columns), custom staging
+        payloads — where the writable-contract walk cannot reach them, so slab
+        views there would go stale at release and corrupt silently on slab reuse.
+        Reconstructions come out writable (_ensure_writable then no-ops), and
+        this is the one payload copy the safe modes budget either way."""
+        head_start, head_len = offsets[0]
+        frames = [base[head_start:head_start + head_len].toreadonly()]
+        frames += [bytearray(base[start:start + length])
+                   for start, length in offsets[1:]]
+        return self.inner.deserialize(inner_kind, frames)
+
+    @staticmethod
+    def _attach_lease(result, lease):
+        """Ride the lease with the payload the decode path produces; None when the
+        result shape is unrecognized (caller then copies out and releases)."""
+        if isinstance(result, tuple) and len(result) == 3:
+            epoch, ordinal, payload = result
+            if isinstance(payload, dict):
+                payload[SHM_LEASE_KEY] = lease
+                return result
+            if isinstance(payload, list):
+                leased = _LeasedRows(payload)
+                leased.shm_lease = lease
+                return (epoch, ordinal, leased)
+        return None
+
+
+#: serializer name → (constructor kwargs) for the shm family; the name string is
+#: what crosses the bootstrap handshake, so both ends agree from it alone
+_SHM_NAMES = {
+    "shm": dict(inner_name="pickle", writable=True),
+    "shm-pickle": dict(inner_name="pickle", writable=True),
+    "shm-arrow": dict(inner_name="arrow", writable=True),
+    "shm-view": dict(inner_name="pickle", writable=False),
+    "shm-pickle-view": dict(inner_name="pickle", writable=False),
+    "shm-arrow-view": dict(inner_name="arrow", writable=False),
+}
 
 
 def make_serializer(name):
@@ -159,4 +357,8 @@ def make_serializer(name):
         return PickleSerializer()
     if name == "arrow":
         return ArrowTableSerializer()
-    raise ValueError("Unknown serializer %r (expected 'pickle' or 'arrow')" % name)
+    if name in _SHM_NAMES:
+        return ShmSerializer(**_SHM_NAMES[name])
+    raise ValueError(
+        "Unknown serializer %r (expected 'pickle', 'arrow', or one of %s)"
+        % (name, sorted(_SHM_NAMES)))

@@ -4,7 +4,9 @@ The reference ships no tracing at all (SURVEY.md §6: "no spans, no per-stage ti
 in the hot path"); ``PipelineStats`` already gives cheap per-stage TOTALS, and this
 module adds the per-span view when you need to see *when* each stage ran: hand a
 :class:`TraceRecorder` to ``DataLoader(trace=...)`` and every pipeline stage (reader
-fetch, batch formation, device decode dispatch, H2D, queue waits) records one
+fetch, batch formation, device decode dispatch, H2D, queue waits — plus, on the
+process pool's shared-memory wire, ``shm.acquire_wait`` spans from driver threads
+starved for a free slab) records one
 duration event per occurrence, tagged with its thread. Dump with :meth:`dump` and
 load the file in ``chrome://tracing`` / Perfetto to see producer, transfer, and
 consumer lanes and where the bubbles are.

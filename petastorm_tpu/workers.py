@@ -34,7 +34,7 @@ class _ExcResult:
         self.exc = exc
 
 
-def _iter_results(results_q, stop_event, timeout, stop_fn):
+def _iter_results(results_q, stop_event, timeout, stop_fn, on_truncated=None):
     """Shared results-drain loop for the threaded/process pools.
 
     Ends on the ``_DONE`` marker, re-raises worker exceptions (stopping the pool
@@ -45,7 +45,12 @@ def _iter_results(results_q, stop_event, timeout, stop_fn):
     was blocked in ``get()`` at stop time — e.g. a tf.data generator thread being
     finalized while the main thread tears the reader down — used to sleep out the
     full ``results_timeout_s`` (the flaky exactly-300.07s ``test_tf_tensors_eager``
-    hang, VERDICT r4 #7)."""
+    hang, VERDICT r4 #7).
+
+    The stop-event return is a TRUNCATION, not exhaustion: ``on_truncated`` fires
+    on that branch (and only that branch) so the executor can mark the stream as
+    aborted — ``Reader.__next__`` must not flag ``last_row_consumed`` when the
+    stream ended because somebody called ``stop()`` mid-pass (ADVICE r5)."""
     import time
 
     deadline = time.monotonic() + timeout
@@ -54,6 +59,8 @@ def _iter_results(results_q, stop_event, timeout, stop_fn):
             value = results_q.get(timeout=0.2)
         except queue.Empty:
             if stop_event.is_set():
+                if on_truncated is not None:
+                    on_truncated()
                 return  # stopped: the stream is over for this consumer
             if time.monotonic() > deadline:
                 raise TimeoutWaitingForResultError(
@@ -61,6 +68,11 @@ def _iter_results(results_q, stop_event, timeout, stop_fn):
                 ) from None
             continue
         if value is _DONE:
+            # a _DONE that lands AFTER stop() is ambiguous: the stop-drain may
+            # have discarded results ahead of it, so the stream cannot be called
+            # fully consumed (the marker races the drain — workers re-post it)
+            if stop_event.is_set() and on_truncated is not None:
+                on_truncated()
             return
         if isinstance(value, _ExcResult):
             stop_fn()
@@ -73,12 +85,26 @@ def _iter_results(results_q, stop_event, timeout, stop_fn):
 
 
 class ExecutorBase:
+    #: True when the result stream ended because ``stop()`` aborted it mid-pass
+    #: rather than because the plan was exhausted (consumers use it to keep
+    #: completion flags like ``Reader.last_row_consumed`` truthful)
+    truncated = False
+
     def start(self, worker, plan):
         raise NotImplementedError
 
     def results(self):
         """Generator of worker results; raises worker exceptions; ends when plan exhausted."""
         raise NotImplementedError
+
+    def _mark_truncated(self):
+        self.truncated = True
+
+    def _drain_results(self):
+        """Shared ``results()`` body for the queue-backed pools (thread/process):
+        one copy of the drain/timeout/truncation wiring."""
+        return _iter_results(self._results, self._stop_event, self._timeout,
+                             self.stop, on_truncated=self._mark_truncated)
 
     def stop(self):
         pass
@@ -105,10 +131,12 @@ class SyncExecutor(ExecutorBase):
     def start(self, worker, plan):
         self._worker = worker
         self._plan = plan
+        self.truncated = False
 
     def results(self):
         for item in self._plan:
             if self._stopped:
+                self.truncated = True
                 return
             yield self._worker(item)
 
@@ -134,6 +162,7 @@ class ThreadExecutor(ExecutorBase):
     def start(self, worker, plan):
         self._results = queue.Queue(maxsize=self._queue_size)
         self._stop_event.clear()
+        self.truncated = False
         plan_iter = iter(plan)
         with self._active_lock:
             self._active = self._workers_count
@@ -179,8 +208,7 @@ class ThreadExecutor(ExecutorBase):
                     return
 
     def results(self):
-        return _iter_results(self._results, self._stop_event, self._timeout,
-                             self.stop)
+        return self._drain_results()
 
     def stop(self):
         self._stop_event.set()
@@ -218,10 +246,23 @@ class ProcessExecutor(ExecutorBase):
     fork of a threaded parent (JAX deadlock hazard). The worker is pickled once per child;
     per-task traffic is (item, result) over a unix socket. One driver thread per child gives
     one-item-in-flight-per-child backpressure plus the bounded results queue.
+
+    With a ``serializer`` from the ``shm`` family the result payloads do NOT ride the
+    socket: ``start()`` creates a :class:`petastorm_tpu.parallel.shm_ring.SlabRing`,
+    each driver thread acquires a slab and grants it to its child together with the
+    work item, and the child writes the serialized frames straight into the slab —
+    only a small descriptor crosses the socket. Items whose payload exceeds the slab
+    (or that find the ring momentarily empty) fall back to the socket wire per item;
+    platforms without working shared memory degrade the whole pool to the socket
+    wire with a warn-once. ``join()`` unlinks every slab — a pool can never leak
+    ``/dev/shm`` segments, whatever its children did (SIGKILL mid-write included).
     """
 
     def __init__(self, workers_count=4, results_queue_size=16, results_timeout_s=300.0,
-                 serializer="pickle", worker_respawns=2, **_ignored):
+                 serializer="pickle", worker_respawns=2, shm_slab_bytes=None,
+                 shm_slabs=None, **_ignored):
+        import os
+
         self._workers_count = workers_count
         self._queue_size = results_queue_size
         self._timeout = results_timeout_s
@@ -229,6 +270,17 @@ class ProcessExecutor(ExecutorBase):
         from petastorm_tpu.serializers import make_serializer
 
         self._serializer = make_serializer(serializer)
+        #: shm wire config (ignored for socket serializers): slab size defaults to
+        #: 32 MB — comfortably a decoded row-group batch; oversized payloads fall
+        #: back per item. PTPU_SHM_SLAB_BYTES tunes it through the reader factories
+        #: without new kwargs at every layer.
+        self._shm_slab_bytes = int(shm_slab_bytes
+                                   or os.environ.get("PTPU_SHM_SLAB_BYTES", 0)
+                                   or (32 << 20))
+        self._shm_slabs = shm_slabs
+        self._ring = None
+        self._shm_unavailable = False
+        self._tracer = None
         self._procs = []
         self._conns = []
         self._threads = []
@@ -256,6 +308,8 @@ class ProcessExecutor(ExecutorBase):
 
         self._results = queue.Queue(maxsize=self._queue_size)
         self._stop_event.clear()
+        self.truncated = False
+        self._setup_shm()
         with self._respawn_lock:
             self._tmpdir = tempfile.mkdtemp(prefix="ptpu-pool-")
             address = os.path.join(self._tmpdir, "sock")
@@ -348,12 +402,72 @@ class ProcessExecutor(ExecutorBase):
         p.stdin.close()
         return p
 
+    def _setup_shm(self):
+        """Create the slab ring when an shm-family serializer was requested.
+
+        Graceful degradation is part of the contract: a platform without working
+        shared memory (or a ring-creation failure, e.g. a tiny ``/dev/shm``)
+        swaps the pool down to the inner socket serializer with a warn-once and a
+        ``wire_stats()['shm_unavailable']`` marker — same results, socket copies.
+        """
+        from petastorm_tpu.serializers import ShmSerializer
+
+        if not isinstance(self._serializer, ShmSerializer):
+            return
+        from petastorm_tpu.parallel.shm_ring import SlabRing, shm_supported
+
+        ring = None
+        if shm_supported():
+            try:
+                ring = SlabRing(self._shm_slab_bytes,
+                                self._shm_slabs or (self._workers_count + 2),
+                                trace=self._tracer)
+            except Exception as e:  # noqa: BLE001 — degrade, never fail the pool
+                logger.warning("shared-memory slab ring creation failed (%s); "
+                               "falling back to the socket wire", e)
+        if ring is None:
+            self._shm_unavailable = True
+            self._serializer_name = self._serializer.inner_name
+            self._serializer = self._serializer.inner
+            return
+        self._serializer.bind_ring(ring)
+        with self._respawn_lock:  # join() takes the ring under the same lock
+            self._ring = ring
+
+    def set_trace(self, tracer):
+        """Attach a :class:`petastorm_tpu.trace.TraceRecorder`: the slab ring
+        records ``shm.acquire_wait`` spans (driver threads starved for a slab)."""
+        self._tracer = tracer
+        if self._ring is not None:
+            self._ring.set_trace(tracer)
+
+    def wire_stats(self):
+        """Wire-transport gauges (shm slab occupancy/bytes/fallbacks/wait), or a
+        degradation marker, or {} for plain socket serializers."""
+        if self._ring is not None:
+            return self._ring.stats()
+        if self._shm_unavailable:
+            return {"shm_unavailable": 1}
+        return {}
+
+    @property
+    def wire_views(self):
+        """True when deserialized payloads are zero-copy READ-ONLY slab views
+        (shm view mode) — consumers that buffer rows must detach them first."""
+        from petastorm_tpu.serializers import ShmSerializer
+
+        return (isinstance(self._serializer, ShmSerializer)
+                and not self._serializer.writable)
+
     def _handshake(self, conn):
-        """Bootstrap a connected child: parent sys.path, wire serializer, worker."""
+        """Bootstrap a connected child: parent sys.path, wire serializer (plus the
+        slab-ring attach config in shm mode), worker."""
         import sys
 
         conn.send(list(sys.path))
         conn.send(self._serializer_name)
+        if self._ring is not None:
+            conn.send((self._ring.names, self._ring.slab_bytes))
         conn.send(self._worker)
 
     def _spawn_one(self):
@@ -428,6 +542,12 @@ class ProcessExecutor(ExecutorBase):
         return conn
 
     def _drive_child(self, conn, plan_iter):
+        from petastorm_tpu.serializers import KIND_SHM
+
+        # local snapshot: join() nulls self._ring (under the respawn lock) while a
+        # straggling driver may still be mid-item past its 10s join timeout — the
+        # ring object itself stays safe to call (close() makes release a no-op)
+        ring = self._ring
         try:
             fatal = False
             while not fatal and not self._stop_event.is_set():
@@ -437,17 +557,36 @@ class ProcessExecutor(ExecutorBase):
                     except StopIteration:
                         break
                 while True:  # item attempts: survives child death via respawn
+                    # slab grant per ATTEMPT: a respawned child gets a fresh grant,
+                    # and a dead child's in-flight slab is reclaimed below
+                    slab = None
+                    if ring is not None:
+                        slab = ring.acquire()
+                        if slab is None:  # ring starved: socket wire for this item
+                            ring.count_fallback()
                     try:
-                        conn.send(item)
+                        conn.send((slab, item) if ring is not None else item)
                         header = conn.recv()
                         if header[0] == "exc":
+                            if slab is not None:
+                                ring.release(slab)
                             self._put(_ExcResult(header[1]))
                             fatal = True
                             break
                         _, kind, nframes = header
                         frames = [conn.recv_bytes() for _ in range(nframes)]
+                        if slab is not None and kind != KIND_SHM:
+                            # granted but unused (oversized payload): reclaim first
+                            # so a deserialize error cannot leak the slab
+                            ring.release(slab)
+                            ring.count_fallback()
+                            slab = None
+                        # kind == KIND_SHM transfers slab ownership to deserialize
+                        # (released there, or leased to the consumer in view mode)
                         result = self._serializer.deserialize(kind, frames)
                     except (EOFError, BrokenPipeError, ConnectionResetError) as e:
+                        if slab is not None:  # dead child's in-flight slab
+                            ring.release(slab)
                         replacement = self._respawn(e)
                         if replacement is None:
                             self._put(_ExcResult(
@@ -490,8 +629,7 @@ class ProcessExecutor(ExecutorBase):
                     return
 
     def results(self):
-        return _iter_results(self._results, self._stop_event, self._timeout,
-                             self.stop)
+        return self._drain_results()
 
     def stop(self):
         self._stop_event.set()
@@ -518,6 +656,7 @@ class ProcessExecutor(ExecutorBase):
             # _spawn_one from creating its socket in a directory this method is
             # about to rmtree (it fails cleanly on None instead)
             tmpdir, self._tmpdir = self._tmpdir, None
+            ring, self._ring = self._ring, None
         for conn in conns:
             try:
                 conn.close()
@@ -528,18 +667,28 @@ class ProcessExecutor(ExecutorBase):
                 p.wait(timeout=5)
             except Exception:  # noqa: BLE001
                 p.kill()
+        if ring is not None:
+            # AFTER children are reaped (no live writer) and BEFORE returning:
+            # every slab is unlinked here, so /dev/shm is clean even if a consumer
+            # abandoned leased batches mid-stream
+            ring.close()
         if tmpdir:
             shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size=16,
-                  results_timeout_s=300.0, serializer="pickle", worker_respawns=2):
+                  results_timeout_s=300.0, serializer="pickle", worker_respawns=2,
+                  shm_slab_bytes=None, shm_slabs=None):
     """Factory matching the reference's ``reader_pool_type`` kwarg ('thread'|'process'|'dummy').
 
-    ``serializer`` ('pickle'|'arrow') selects the process-pool wire format (reference
-    Pickle/ArrowTable serializer parity); thread/dummy pools share memory and ignore it.
+    ``serializer`` selects the process-pool wire format: 'pickle'|'arrow' (reference
+    Pickle/ArrowTable serializer parity, socket frames) or the shared-memory slab
+    family 'shm'/'shm-arrow' (+ '-view' variants — zero-copy read-only delivery; see
+    petastorm_tpu/serializers.py); thread/dummy pools share memory and ignore it.
     ``worker_respawns`` bounds the process pool's elastic recovery (dead children are
     replaced and their item re-dispatched up to this many times; 0 = fail fast).
+    ``shm_slab_bytes``/``shm_slabs`` size the slab ring (defaults: 32 MB ×
+    (workers_count + 2); also tunable via the PTPU_SHM_SLAB_BYTES env var).
     """
     if reader_pool_type in ("dummy", "sync"):
         return SyncExecutor()
@@ -547,7 +696,8 @@ def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size
         return ThreadExecutor(workers_count, results_queue_size, results_timeout_s)
     if reader_pool_type == "process":
         return ProcessExecutor(workers_count, results_queue_size, results_timeout_s,
-                               serializer=serializer, worker_respawns=worker_respawns)
+                               serializer=serializer, worker_respawns=worker_respawns,
+                               shm_slab_bytes=shm_slab_bytes, shm_slabs=shm_slabs)
     raise ValueError(
         "Unknown reader_pool_type %r (expected 'thread', 'process' or 'dummy')"
         % reader_pool_type

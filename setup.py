@@ -37,6 +37,7 @@ setup(
             "petastorm-tpu-generate-metadata=petastorm_tpu.tools.generate_metadata:main",
             "petastorm-tpu-copy-dataset=petastorm_tpu.tools.copy_dataset:main",
             "petastorm-tpu-throughput=petastorm_tpu.benchmark.cli:main",
+            "petastorm-tpu-bench=petastorm_tpu.benchmark.cli:main",
             "petastorm-tpu-lint=petastorm_tpu.analysis.cli:main",
         ],
     },

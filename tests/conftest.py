@@ -27,6 +27,29 @@ def pytest_configure(config):
                        ("hdfs", "an HDFS cluster"), ("spark", "a real pyspark session")]:
         config.addinivalue_line(
             "markers", "%s: smoke test against %s (needs credentials/env)" % (marker, svc))
+    # the tier-1 gate and CI both run `-m 'not slow'`: register the marker so the
+    # filter is well-defined (currently no test opts out — minutes-scale additions
+    # should carry @pytest.mark.slow rather than bloating the default run)
+    config.addinivalue_line(
+        "markers", "slow: excluded from the default/tier-1 run (-m 'not slow')")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_shm_segments():
+    """Every test must leave /dev/shm free of pool slabs: ProcessExecutor.join()
+    unlinks the whole ring, so a segment surviving a test is a leaked slab (the
+    ISSUE-2 leak-proof-lifecycle acceptance gate). Scoped to our own name prefix
+    — other processes' segments are none of our business."""
+    import glob
+
+    pattern = "/dev/shm/ptpu_shm_*"
+    if not os.path.isdir("/dev/shm"):
+        yield
+        return
+    before = set(glob.glob(pattern))
+    yield
+    leaked = set(glob.glob(pattern)) - before
+    assert not leaked, "leaked shared-memory slabs: %s" % sorted(leaked)
 
 
 @pytest.fixture(scope="session")

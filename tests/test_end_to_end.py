@@ -147,6 +147,22 @@ def test_reset(synthetic_dataset):
     assert first == second
 
 
+@pytest.mark.parametrize("pool", ["dummy", "thread"])
+def test_stop_truncation_does_not_mark_last_row_consumed(synthetic_dataset, pool):
+    """ADVICE r5 workers.py:57: after stop() mid-pass the result stream ends via
+    the executor's TRUNCATED branch, and ``last_row_consumed`` — exported API
+    meaning "the dataset was fully consumed" — must stay False; only genuine
+    exhaustion (the _DONE marker) may set it."""
+    with make_reader(synthetic_dataset.url, num_epochs=1, reader_pool_type=pool,
+                     shuffle_row_groups=False) as reader:
+        next(reader)
+        reader.stop()
+        with pytest.raises(StopIteration):
+            for _ in range(10_000):  # drain buffered rows, then hit the stop branch
+                next(reader)
+        assert not reader.last_row_consumed
+
+
 def test_shuffle_row_groups_changes_order(synthetic_dataset):
     def order(shuffle, seed=5):
         with make_reader(synthetic_dataset.url, shuffle_row_groups=shuffle, seed=seed,

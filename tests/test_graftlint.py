@@ -361,6 +361,60 @@ def test_lifecycle_allows_constructor_expected_to_raise():
     assert findings == []
 
 
+_L001_SHM_POSITIVE = """
+    from multiprocessing import shared_memory
+
+    def leak_segment(nbytes):
+        seg = shared_memory.SharedMemory(create=True, size=nbytes)  # BUG: no unlink path
+        seg.buf[:4] = b"data"
+        return bytes(seg.buf[:4])
+"""
+
+
+def test_lifecycle_fires_on_shared_memory_without_close_or_unlink():
+    """The shm-wire extension (ISSUE 2): a SharedMemory segment constructed with
+    no close()/unlink() path outlives the PROCESS in /dev/shm, so GL-L001 covers
+    it like the project's own closeables."""
+    findings, _ = _lint(_L001_SHM_POSITIVE)
+    f = _only_rule(findings, "GL-L001")[0]
+    assert f.line == _line_of(_L001_SHM_POSITIVE, "BUG: no unlink path")
+
+
+def test_lifecycle_shared_memory_clean_forms():
+    findings, _ = _lint("""
+        from multiprocessing import shared_memory
+
+        from petastorm_tpu.parallel.shm_ring import SlabRing
+
+        def creator_try_finally(nbytes):
+            seg = shared_memory.SharedMemory(create=True, size=nbytes)
+            try:
+                seg.buf[0] = 1
+            finally:
+                seg.close()
+                seg.unlink()
+
+        def attacher_unlink_only(name):
+            seg = shared_memory.SharedMemory(name=name)
+            try:
+                return bytes(seg.buf[:4])
+            finally:
+                seg.unlink()
+
+        def ring_owned_by_pool():
+            ring = SlabRing(1024, 2)
+            try:
+                return ring.acquire()
+            finally:
+                ring.close()
+
+        class Owner:
+            def start(self):
+                self._seg = shared_memory.SharedMemory(create=True, size=64)
+    """)
+    assert findings == []
+
+
 # -- GL-J001/J002/J003: JAX tracing hazards ---------------------------------------------
 
 _J001_POSITIVE = """

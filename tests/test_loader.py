@@ -48,6 +48,55 @@ def test_pad_last_batch(scalar_dataset):
     assert n_valid == len(scalar_dataset.data)
 
 
+def test_pad_last_batch_consumer_watermark_counts_valid_rows_only(scalar_dataset):
+    """ADVICE r5 loader.py:846: under ``last_batch='pad'`` the consumer watermark
+    must count only rows the reader DELIVERED (sum of ``__valid__``), never the
+    repeated padding — otherwise it overruns the producer's delivered-row log."""
+    total = len(scalar_dataset.data)
+    reader = make_batch_reader(scalar_dataset.url, shuffle_row_groups=False)
+    loader = DataLoader(reader, batch_size=8, last_batch="pad", to_device=False)
+    with loader:
+        batches = list(loader)
+    assert total % 8 != 0 and len(batches[-1]["id"]) == 8  # padding actually occurred
+    assert loader._rows_consumed == total  # not rounded up to a batch multiple
+    # the consumer has exactly caught the producer's log: the checkpoint is the
+    # final all-delivered state, which a fresh reader restores cleanly
+    state = loader.state_dict()
+    with make_batch_reader(scalar_dataset.url, shuffle_row_groups=False) as r2:
+        r2.load_state_dict(state)
+        assert sum(1 for _ in r2) == 0  # nothing left to replay
+
+
+def test_detach_slab_views_covers_nested_object_elements():
+    """Review finding (PR 2): the view-wire detach must copy read-only ELEMENTS
+    of object (ragged) columns and detach() staged payloads, not just top-level
+    read-only arrays — the outer object array is writable, its slab-view elements
+    are not."""
+    from petastorm_tpu.loader import _detach_slab_views
+
+    class _Staged:
+        def __init__(self):
+            self.detached = False
+
+        def detach(self):
+            self.detached = True
+            return self
+
+    ro_elem = np.arange(4)
+    ro_elem.setflags(write=False)
+    ragged = np.empty(3, dtype=object)
+    ragged[:] = [ro_elem, np.arange(2), _Staged()]
+    ro_flat = np.arange(5, dtype=np.float32)
+    ro_flat.setflags(write=False)
+    out = _detach_slab_views({"ragged": ragged, "flat": ro_flat,
+                              "ok": np.arange(3)})
+    assert out["flat"].flags.writeable and out["flat"] is not ro_flat
+    assert out["ok"].flags.writeable  # already-writable column passes through
+    assert out["ragged"][0].flags.writeable and out["ragged"][0] is not ro_elem
+    np.testing.assert_array_equal(out["ragged"][0], np.arange(4))
+    assert out["ragged"][2].detached  # staged payloads detach from their buffers
+
+
 def test_shuffling_buffer_changes_order_and_preserves_set(scalar_dataset):
     def ids(shuffle_cap, seed):
         reader = make_batch_reader(scalar_dataset.url, shuffle_row_groups=False)
@@ -234,7 +283,8 @@ def test_stats_populate_through_device_path(scalar_dataset):
     assert snap["rows"] == n * 8
     assert set(snap) == {"rows", "batches", "read_s", "batch_s", "decode_s", "h2d_s",
                          "queue_wait_s", "device_queue_wait_s",
-                         "decode_unsharded_batches"}
+                         "decode_unsharded_batches", "shm_slabs_in_flight",
+                         "shm_bytes", "shm_fallbacks", "shm_acquire_wait_s"}
     assert snap["decode_unsharded_batches"] == 0  # no sharding configured → no fallback
     assert snap["read_s"] >= 0 and snap["device_queue_wait_s"] >= 0
 

@@ -1,13 +1,22 @@
 """Wire serializers (reference parity: Pickle/ArrowTable serializers, SURVEY §3.2) —
-frame round-trips plus the process-pool integration over both wire formats."""
+frame round-trips plus the process-pool integration over the socket wires AND the
+shared-memory slab wire (ISSUE 2): payload equality across all three, the
+writable-batch contract, oversized-payload fallback, slab-lease lifecycle, and
+zero leaked /dev/shm segments after join (the conftest fixture checks every test;
+the kill tests here exercise the respawn reclaim path explicitly)."""
+import glob
+
 import numpy as np
 import pytest
 
 from petastorm_tpu.serializers import (
     KIND_ARROW,
     KIND_PICKLE,
+    KIND_SHM,
+    SHM_LEASE_KEY,
     ArrowTableSerializer,
     PickleSerializer,
+    ShmSerializer,
     make_serializer,
 )
 
@@ -56,12 +65,19 @@ def test_arrow_serializer_falls_back_to_pickle():
 def test_make_serializer_names():
     assert isinstance(make_serializer("pickle"), PickleSerializer)
     assert isinstance(make_serializer("arrow"), ArrowTableSerializer)
+    for name, inner, writable in [("shm", "pickle", True),
+                                  ("shm-arrow", "arrow", True),
+                                  ("shm-view", "pickle", False),
+                                  ("shm-arrow-view", "arrow", False)]:
+        s = make_serializer(name)
+        assert isinstance(s, ShmSerializer)
+        assert s.inner_name == inner and s.writable is writable
     with pytest.raises(ValueError):
         make_serializer("zmq")
 
 
-@pytest.mark.parametrize("wire", ["pickle", "arrow"])
-def test_process_pool_end_to_end_both_wires(scalar_dataset, wire):
+@pytest.mark.parametrize("wire", ["pickle", "arrow", "shm", "shm-view"])
+def test_process_pool_end_to_end_all_wires(scalar_dataset, wire):
     from petastorm_tpu.reader import make_batch_reader
 
     with make_batch_reader(scalar_dataset.url, reader_pool_type="process",
@@ -111,3 +127,314 @@ def test_malformed_wire_frames_raise_cleanly():
             s.deserialize(kind, bad)
         with pytest.raises(Exception):
             s.deserialize(kind, [])  # missing frames entirely
+    s = make_serializer("shm")
+    with pytest.raises(Exception):
+        s.deserialize(KIND_SHM, [b"\x00\xff garbage descriptor"])  # no ring bound
+
+
+# -- shared-memory slab wire (ISSUE 2) --------------------------------------------------
+
+
+def _property_payloads():
+    """Payload zoo for the cross-wire round-trip property: every dtype family the
+    decode path produces, tensor + scalar + string columns, plus shapes that push
+    each framing through its fallbacks (complex → arrow-inexpressible, object →
+    pickle-only)."""
+    rng = np.random.RandomState(7)
+    ragged = np.empty(3, dtype=object)
+    ragged[:] = [[1], [2, 3], [4, 5, 6]]
+    return [
+        (0, 0, {"f32": rng.standard_normal((6, 3)).astype(np.float32),
+                "i64": np.arange(6),
+                "img": rng.randint(0, 255, (6, 4, 4, 3)).astype(np.uint8),
+                "flag": np.array([True, False] * 3)}),
+        (1, 5, {"s": np.array(["a", "bb", "ccc"]),
+                "b": np.array([b"x", b"\xff\x00", b"z"], dtype="S4"),
+                "v": np.arange(3, dtype=np.float64)}),
+        (2, 7, {"c64": (rng.standard_normal(4)
+                        + 1j * rng.standard_normal(4)).astype(np.complex64)}),
+        (3, 9, {"ragged": ragged}),
+        (4, 1, [{"row": 0, "x": np.arange(4, dtype=np.int16)},
+                {"row": 1, "x": np.arange(4, 8, dtype=np.int16)}]),
+    ]
+
+
+def _assert_column_equal(got, want):
+    if isinstance(want, np.ndarray) and want.dtype == object:
+        # ragged object columns: element-wise (assert_array_equal's broadcast
+        # comparison is ambiguous over different-length ndarray elements)
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+        return
+    np.testing.assert_array_equal(got, want)
+
+
+def _assert_payload_equal(got, want):
+    assert got[0] == want[0] and got[1] == want[1]
+    if isinstance(want[2], dict):
+        cols = dict(got[2])
+        cols.pop(SHM_LEASE_KEY, None)
+        assert set(cols) == set(want[2])
+        for k, arr in want[2].items():
+            _assert_column_equal(cols[k], arr)
+    else:
+        assert len(got[2]) == len(want[2])
+        for g, w in zip(got[2], want[2]):
+            assert set(g) == set(w)
+            for k in w:
+                np.testing.assert_array_equal(g[k], w[k])
+
+
+def _slab_roundtrip(wire, payload, slab_bytes=1 << 20, nslabs=2):
+    """Drive one payload through the shm wire without a pool: a child-side
+    serializer bound to a SlabClient writes into a parent-owned ring, the
+    parent-side serializer deserializes the descriptor. Returns
+    (kind, result, ring) — caller closes the ring."""
+    from petastorm_tpu.parallel.shm_ring import SlabRing
+
+    ring = SlabRing(slab_bytes, nslabs)
+    parent = make_serializer(wire)
+    child = make_serializer(wire)
+    parent.bind_ring(ring)
+    child.bind_slabs(ring.names, ring.slab_bytes)
+    slab = ring.acquire()
+    child.set_slab(slab)
+    kind, frames = child.serialize(payload)
+    if kind != KIND_SHM:
+        ring.release(slab)  # child fell back: the grant returns unused
+    result = parent.deserialize(kind, frames)
+    child.close()
+    return kind, result, ring
+
+
+@pytest.mark.parametrize("wire", ["pickle", "arrow", "shm", "shm-arrow",
+                                  "shm-view", "shm-arrow-view"])
+@pytest.mark.parametrize("idx", range(5))
+def test_wire_roundtrip_property_all_wires(wire, idx):
+    """Round-trip equality for every payload in the zoo across all three wire
+    families (socket-pickle, socket-arrow, shm over both framings + view mode)."""
+    payload = _property_payloads()[idx]
+    if wire in ("pickle", "arrow"):
+        s = make_serializer(wire)
+        kind, frames = s.serialize(payload)
+        _assert_payload_equal(s.deserialize(kind, [bytes(f) for f in frames]),
+                              payload)
+        return
+    kind, result, ring = _slab_roundtrip(wire, payload)
+    try:
+        _assert_payload_equal(result, payload)
+    finally:
+        ring.close()
+    assert not glob.glob("/dev/shm/%s*" % ring.names[0])
+
+
+def test_shm_wire_writable_contract_default_and_view():
+    """Default shm wire preserves the thread pool's writable-batch contract
+    (mutating consumers keep working; the slab is released before the batch is
+    handed out); view mode delivers read-only zero-copy views that FAIL LOUD on
+    mutation and holds the slab via the lease until released."""
+    payload = (0, 0, {"img": np.zeros((4, 3, 3), np.uint8),
+                      "ids": np.arange(4)})
+    kind, result, ring = _slab_roundtrip("shm", payload)
+    try:
+        assert kind == KIND_SHM
+        assert result[2]["img"].flags.writeable
+        result[2]["img"][0] = 7  # must not raise, must not touch the slab
+        assert ring.stats()["shm_slabs_in_flight"] == 0  # released at deserialize
+    finally:
+        ring.close()
+
+    kind, result, ring = _slab_roundtrip("shm-view", payload)
+    try:
+        assert kind == KIND_SHM
+        lease = result[2].pop(SHM_LEASE_KEY)
+        assert lease is not None
+        assert not result[2]["img"].flags.writeable
+        with pytest.raises(ValueError):
+            result[2]["img"][0] = 7  # read-only view: loud, never corruption
+        assert ring.stats()["shm_slabs_in_flight"] == 1  # consumer holds the slab
+        lease.release()
+        assert ring.stats()["shm_slabs_in_flight"] == 0
+        lease.release()  # idempotent: double release must not double-free
+        assert ring.stats()["shm_slabs_in_flight"] == 0
+    finally:
+        ring.close()
+
+
+def test_shm_writable_object_columns_survive_slab_reuse():
+    """Review finding (PR 2): pickle-5 reattaches out-of-band buffers anywhere in
+    the object graph — the ELEMENTS of a ragged object column included — where the
+    writable-contract walk cannot copy them. Writable mode must therefore back the
+    pickle buffers with owned copies so the immediate slab release cannot corrupt:
+    overwrite the slab after deserialize and the ragged rows must stay intact."""
+    ragged = np.empty(3, dtype=object)
+    ragged[:] = [np.arange(3), np.arange(5, dtype=np.float32), np.arange(2) + 7]
+    payload = (0, 0, {"ragged": ragged, "flat": np.arange(4)})
+    for wire in ("shm", "shm-arrow"):  # arrow falls back to pickle frames here
+        kind, result, ring = _slab_roundtrip(wire, payload)
+        try:
+            assert kind == KIND_SHM
+            assert ring.stats()["shm_slabs_in_flight"] == 0  # released already
+            # simulate the next item recycling the slab the result rode in
+            ring.buffer(0)[:] = b"\xaa" * ring.slab_bytes
+            ring.buffer(1)[:] = b"\xaa" * ring.slab_bytes
+            _assert_payload_equal(result, payload)
+            for e in result[2]["ragged"]:
+                assert e.flags.writeable
+        finally:
+            ring.close()
+
+
+def test_shm_view_unrecognized_result_shape_copies_out_before_release():
+    """Review finding (PR 2): a view-mode result the lease cannot ride (ad-hoc
+    worker return, not the tagged 3-tuple) must be rebuilt from OWNED buffers
+    before the slab is released — including object-array elements the writable
+    walk cannot reach — so slab reuse cannot corrupt it."""
+    ragged = np.empty(2, dtype=object)
+    ragged[:] = [np.arange(3), np.arange(5, dtype=np.float32)]
+    payload = {"ragged": ragged, "flat": np.arange(4)}  # bare dict: no lease slot
+    kind, result, ring = _slab_roundtrip("shm-view", payload)
+    try:
+        assert kind == KIND_SHM
+        assert SHM_LEASE_KEY not in result
+        assert ring.stats()["shm_slabs_in_flight"] == 0  # released already
+        ring.buffer(0)[:] = b"\xaa" * ring.slab_bytes
+        ring.buffer(1)[:] = b"\xaa" * ring.slab_bytes
+        np.testing.assert_array_equal(result["flat"], np.arange(4))
+        for got, want in zip(result["ragged"], ragged):
+            np.testing.assert_array_equal(got, want)
+    finally:
+        ring.close()
+
+
+def test_shm_oversized_payload_falls_back_to_socket_frames():
+    """A payload larger than the slab ships over the inner serializer's socket
+    frames — same bytes, the grant returns to the ring unused."""
+    payload = (0, 0, {"big": np.zeros((64 << 10,), np.uint8)})
+    kind, result, ring = _slab_roundtrip("shm", payload, slab_bytes=4 << 10)
+    try:
+        assert kind == KIND_PICKLE  # inner framing, not a descriptor
+        _assert_payload_equal(result, payload)
+        assert result[2]["big"].flags.writeable
+        assert ring.stats()["shm_slabs_in_flight"] == 0
+    finally:
+        ring.close()
+
+
+def _shm_payload_worker(i):
+    return (0, i, {"x": np.full((50_000,), i, np.int32)})
+
+
+def _slow_shm_payload_worker(i):
+    import time
+
+    time.sleep(0.3)
+    return (0, i, {"x": np.full((50_000,), i, np.int32)})
+
+
+def test_shm_pool_oversized_fallback_end_to_end():
+    """Tiny slabs force EVERY item through the per-item socket fallback: results
+    stay byte-identical and the fallback gauge counts them."""
+    from petastorm_tpu.plan import EpochPlan
+    from petastorm_tpu.workers import ProcessExecutor
+
+    with ProcessExecutor(workers_count=2, results_queue_size=4, serializer="shm",
+                         results_timeout_s=120, shm_slab_bytes=16 << 10) as ex:
+        ex.start(_shm_payload_worker, EpochPlan(list(range(8)), num_epochs=1))
+        got = sorted(ex.results(), key=lambda r: r[1])
+        stats = ex.wire_stats()
+    assert [r[1] for r in got] == list(range(8))
+    for _e, i, cols in got:
+        np.testing.assert_array_equal(cols["x"], np.full((50_000,), i, np.int32))
+        assert cols["x"].flags.writeable
+    assert stats["shm_fallbacks"] >= 8
+
+
+def test_shm_pool_child_killed_mid_item_reclaims_slab_and_unlinks():
+    """The respawn path (ISSUE 2 acceptance): a child SIGKILLed mid-item has its
+    in-flight slab reclaimed, the replacement child attaches the same ring, every
+    result arrives exactly once, and join() leaves /dev/shm empty."""
+    import os
+    import signal
+    import time
+
+    from petastorm_tpu.plan import EpochPlan
+    from petastorm_tpu.workers import ProcessExecutor
+
+    with ProcessExecutor(workers_count=2, results_queue_size=4, serializer="shm",
+                         results_timeout_s=120) as ex:
+        ex.start(_slow_shm_payload_worker, EpochPlan(list(range(12)), num_epochs=1))
+        time.sleep(1.0)  # children connected and mid-item
+        os.kill(ex._procs[0].pid, signal.SIGKILL)
+        got = sorted(ex.results(), key=lambda r: r[1])
+        ring_names = list(ex._ring.names)
+        ex.stop()
+        ex.join()
+        assert [r[1] for r in got] == list(range(12))  # exactly once, incl. re-dispatch
+        for _e, i, cols in got:
+            np.testing.assert_array_equal(cols["x"],
+                                          np.full((50_000,), i, np.int32))
+        # every segment unlinked by join(), none leaked by the dead child
+        for name in ring_names:
+            assert not os.path.exists("/dev/shm/%s" % name)
+
+
+def test_shm_view_wire_through_reader_release_hook(scalar_dataset):
+    """View wire end-to-end through make_batch_reader: batches arrive read-only,
+    release_batch() returns the slab early, and iteration stays correct."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    with make_batch_reader(scalar_dataset.url, reader_pool_type="process",
+                           workers_count=2, num_epochs=1, shuffle_row_groups=False,
+                           wire_serializer="shm-view") as reader:
+        ids = []
+        for batch in reader:
+            arr = np.asarray(batch.id)
+            assert SHM_LEASE_KEY not in getattr(batch, "_fields", ())
+            ids.extend(arr.tolist())
+            reader.release_batch()  # explicit early return of the slab
+    assert sorted(ids) == [r["id"] for r in scalar_dataset.data]
+
+
+def test_shm_unavailable_degrades_to_socket_wire(monkeypatch):
+    """Platforms without working shared memory keep the exact socket behavior:
+    warn-once degradation, results identical, a wire_stats marker set."""
+    import petastorm_tpu.parallel.shm_ring as shm_ring
+    from petastorm_tpu.plan import EpochPlan
+    from petastorm_tpu.workers import ProcessExecutor
+
+    monkeypatch.setattr(shm_ring, "_supported_cache", False)
+    with ProcessExecutor(workers_count=2, results_queue_size=4, serializer="shm",
+                         results_timeout_s=120) as ex:
+        ex.start(_shm_payload_worker, EpochPlan(list(range(6)), num_epochs=1))
+        got = sorted(ex.results(), key=lambda r: r[1])
+        stats = ex.wire_stats()
+    assert [r[1] for r in got] == list(range(6))
+    for _e, i, cols in got:
+        np.testing.assert_array_equal(cols["x"], np.full((50_000,), i, np.int32))
+        assert cols["x"].flags.writeable
+    assert stats == {"shm_unavailable": 1}
+
+
+@pytest.mark.parametrize("wire", ["pickle", "shm", "shm-view"])
+def test_wire_bench_smoke(wire):
+    """The CI wire micro-benchmark invocation, in-suite and fast (tiny payloads,
+    correctness-only assertions) — `-m 'not slow'` keeps it in the default run."""
+    from petastorm_tpu.benchmark.wire import run_wire_bench
+
+    rows = run_wire_bench([32 << 10], items=4, warmup=1, wires=(wire,),
+                          workers=1, check=True)
+    assert len(rows) == 1 and rows[0]["items"] == 4 and rows[0]["checked"]
+
+
+def test_wire_bench_zero_warmup_times_the_whole_stream():
+    """Review finding (PR 2): --warmup 0 must start the clock before the first
+    item, not report a ~0s elapsed (and absurd MB/s) from a never-set t0."""
+    from petastorm_tpu.benchmark.wire import run_wire_bench
+
+    row = run_wire_bench([64 << 10], items=3, warmup=0, wires=("pickle",),
+                         workers=1, check=True)[0]
+    # pool spawn alone takes well over a millisecond: a sane elapsed proves the
+    # clock covered the stream instead of collapsing to back-to-back perf_counter
+    assert row["items"] == 3 and row["seconds"] > 0.001
