@@ -941,6 +941,10 @@ def main():
         "best_healthy": best_healthy(),
         "train_idle": full["overlap_train_device_idle_fraction"],
         "coeff_bytes_shipped_ratio": full["coeff_bytes_shipped_ratio"],
+        # per-stage breakdowns in the trajectory artifact (ISSUE 3): the device
+        # measure's and the north-star train overlap's PipelineStats snapshots
+        "stages": full["stages"],
+        "train_stages": full["overlap_train_stages"],
         "tabular": None if tabular is None else {
             "rows_per_sec": tabular["rows_per_sec"], "vs_host": tabular["vs_host"],
             "healthy": tabular["healthy"]},
@@ -966,7 +970,8 @@ if __name__ == "__main__":
                           # one schema for BOTH last-line shapes: every key the
                           # success summary carries, nulled
                           "best_healthy": None, "train_idle": None,
-                          "coeff_bytes_shipped_ratio": None, "tabular": None,
+                          "coeff_bytes_shipped_ratio": None, "stages": None,
+                          "train_stages": None, "tabular": None,
                           "ngram": None, "history": "BENCH_HISTORY.jsonl",
                           "error": "%s: %s" % (type(e).__name__, str(e)[:300])}))
         sys.exit(1)

@@ -136,7 +136,7 @@ def main():
 
         tracer = TraceRecorder()
     step = 0
-    t0 = time.time()
+    t0 = time.perf_counter()
     try:
         with DataLoader(reader, args.batch_size, sharding=sharding,
                         device_transform=device_transform,
@@ -154,7 +154,7 @@ def main():
                 step += 1
                 if step % 20 == 0:
                     jax.block_until_ready(loss)
-                    dt = time.time() - t0
+                    dt = time.perf_counter() - t0
                     print("step %d loss %.4f  %.1f img/s  stages=%s"
                           % (step, float(loss), step * args.batch_size / dt,
                              loader.stats.snapshot()))
@@ -166,7 +166,7 @@ def main():
         if tracer is not None:
             print("trace written to", tracer.dump(args.trace))
     print("done: %d steps, %.1f img/s overall"
-          % (step, step * args.batch_size / (time.time() - t0)))
+          % (step, step * args.batch_size / (time.perf_counter() - t0)))
 
 
 if __name__ == "__main__":

@@ -70,7 +70,7 @@ def main():
         updates, opt_state = tx.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     steps = 0
     if args.in_memory:
         from petastorm_tpu.loader import InMemDataLoader
@@ -87,7 +87,8 @@ def main():
         for batch in loader:
             params, opt_state, loss = train_step(params, opt_state, batch)
             steps += 1
-    print("trained %d steps in %.1fs, final loss %.4f" % (steps, time.time() - t0,
+    print("trained %d steps in %.1fs, final loss %.4f" % (steps,
+                                                          time.perf_counter() - t0,
                                                           float(loss)))
 
 

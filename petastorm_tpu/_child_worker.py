@@ -2,7 +2,7 @@
 
 Children are started as ``python -m petastorm_tpu._child_worker <socket>`` — a fresh
 interpreter that NEVER re-imports the user's ``__main__`` (unlike multiprocessing spawn/
-forkserver, which fork-bombs unguarded user scripts) and never forks a threaded parent
+forkserver, which fork-bombs unguarded scripts) and never forks a threaded parent
 (deadlock hazard under JAX). This is the same design as the reference's
 ``exec_in_new_process`` bootstrap (petastorm/workers_pool/exec_in_new_process.py ~L20),
 with ``multiprocessing.connection`` replacing ZeroMQ.
@@ -12,12 +12,24 @@ followed by the slab-ring attach config — segment names + slab size), then the
 pickled worker; then items. On the socket wire each item message is the item itself;
 on the shm wire it is ``(slab_id_or_None, item)`` — the parent's slab grant for this
 item's result (None = ring starved, serialize over the socket). Child answers
-``("ok", kind, nframes)`` followed by ``nframes`` raw frames from the wire serializer
-(pickle-5 out-of-band buffers, Arrow IPC, or a slab descriptor — see
+``("ok", kind, nframes, trace_blob)`` followed by ``nframes`` raw frames from the
+wire serializer (pickle-5 out-of-band buffers, Arrow IPC, or a slab descriptor — see
 petastorm_tpu/serializers.py), or ``("exc", exception)``; ``None`` item = shut down.
+
+``trace_blob`` is the cross-process trace piggyback (ISSUE 3):
+``(pid, wall_anchor, perf_anchor, [(name, t0, dur), ...])`` — the child's spans
+around THIS item (``child.work`` = the worker call, ``child.serialize`` = wire
+encode), with ``t0`` from the child's ``perf_counter`` and one (wall, perf)
+anchor pair sampled at child start for clock alignment. Recording is two
+``perf_counter`` pairs per ITEM (a row group, not a row) — noise next to the
+worker's parquet IO/decode — so it is always on and the parent merges the spans
+into its :class:`petastorm_tpu.trace.TraceRecorder` only when one is attached
+(``set_trace``), discarding them otherwise.
 """
+import os
 import pickle
 import sys
+import time
 from multiprocessing.connection import Client
 
 
@@ -26,6 +38,11 @@ def main():
     authkey = sys.stdin.buffer.read(32)
     conn = Client(address, authkey=authkey)
     serializer = None
+    # clock-alignment anchors: one wall/perf pair, sampled back to back so the
+    # parent can map this child's perf_counter values onto the shared wall clock
+    wall_anchor = time.time()
+    perf_anchor = time.perf_counter()
+    pid = os.getpid()
     try:
         # parent's sys.path first, so the worker pickle can resolve user modules
         for entry in conn.recv():
@@ -50,8 +67,11 @@ def main():
             else:
                 item = msg
             try:
+                t0 = time.perf_counter()
                 result = worker(item)
+                t1 = time.perf_counter()
                 kind, frames = serializer.serialize(result)
+                t2 = time.perf_counter()
             except Exception as e:  # noqa: BLE001 - ship to parent
                 try:
                     pickle.dumps(e)
@@ -59,7 +79,10 @@ def main():
                 except Exception:  # unpicklable exception: reconstruct
                     conn.send(("exc", RuntimeError("%s: %s" % (type(e).__name__, e))))
                 continue
-            conn.send(("ok", kind, len(frames)))
+            spans = [("child.work", t0, t1 - t0),
+                     ("child.serialize", t1, t2 - t1)]
+            conn.send(("ok", kind, len(frames),
+                       (pid, wall_anchor, perf_anchor, spans)))
             for frame in frames:
                 conn.send_bytes(frame)
     except (EOFError, BrokenPipeError, ConnectionResetError):

@@ -1,9 +1,10 @@
-"""Rule registry: the four project-specific rule families."""
+"""Rule registry: the five project-specific rule families."""
 from petastorm_tpu.analysis.rules.concurrency import (
     BlockingTeardownRule,
     LockDisciplineRule,
     ThreadHandlingRule,
 )
+from petastorm_tpu.analysis.rules.hotpath import WallClockDurationRule
 from petastorm_tpu.analysis.rules.lifecycle import ResourceLifecycleRule
 from petastorm_tpu.analysis.rules.schema import SchemaCodecContractRule
 from petastorm_tpu.analysis.rules.tracing import (
@@ -22,6 +23,7 @@ ALL_RULES = [
     TracedBranchRule,
     HostIoInJitRule,
     SchemaCodecContractRule,
+    WallClockDurationRule,
 ]
 
 __all__ = [cls.__name__ for cls in ALL_RULES] + ["ALL_RULES"]

@@ -80,6 +80,11 @@ def main(argv=None):
     parser.add_argument("--trace", metavar="PATH", default=None,
                         help="write a chrome://tracing / Perfetto span trace of the "
                              "measured pipeline to PATH (requires --loader)")
+    parser.add_argument("--report", action="store_true",
+                        help="print the bottleneck analyzer's verdict (producer-"
+                             "bound / wire-bound / consumer-bound, with stage "
+                             "utilizations and p50/p90/p99 latencies) after the "
+                             "measurement (requires --loader)")
     parser.add_argument("--overlap-step-ms", type=float, default=0.0,
                         help="overlap mode: keep the device busy with a calibrated "
                              "synthetic step of ~this many milliseconds per batch and "
@@ -97,6 +102,9 @@ def main(argv=None):
     if args.trace and not args.loader:
         parser.error("--trace requires --loader (the spans are the loader's "
                      "pipeline stages)")
+    if args.report and not args.loader:
+        parser.error("--report requires --loader (the analyzer reads the "
+                     "loader's stage counters)")
 
     from petastorm_tpu.benchmark.throughput import reader_throughput
     from petastorm_tpu.reader import make_batch_reader, make_reader
@@ -118,6 +126,14 @@ def main(argv=None):
                 from petastorm_tpu.trace import TraceRecorder
 
                 tracer = TraceRecorder()
+            loader_kwargs = {}
+            if args.report:
+                # per-stage histograms ride into the report's p50/p90/p99 lines;
+                # a PRIVATE registry so the one-shot report never mixes with (or
+                # leaks into) the process-wide default registry
+                from petastorm_tpu.obs.metrics import MetricsRegistry
+
+                loader_kwargs["metrics"] = MetricsRegistry()
             bs = args.loader_batch_size
             xfer0 = None
             if args.decode_on_device:
@@ -129,7 +145,7 @@ def main(argv=None):
                 # interpreter exit can kill a daemon transfer thread mid C++
                 # dispatch (observed: 'FATAL: exception not rethrown' abort)
                 with DataLoader(reader, args.loader_batch_size,
-                                trace=tracer) as loader:
+                                trace=tracer, **loader_kwargs) as loader:
                     if args.overlap_step_ms:
                         from petastorm_tpu.benchmark.throughput import (
                             overlap_throughput,
@@ -163,9 +179,14 @@ def main(argv=None):
                     print("coefficient transfer: shipped %.1f MB of %.1f MB raw "
                           "int16 (%.2f of raw shipped)"
                           % (shipped / 1e6, raw / 1e6, shipped / raw))
+            if args.report:
+                # stats cover the measured window (loader_throughput resets them)
+                report = loader.bottleneck_report()
         else:
             result = reader_throughput(reader, args.warmup_rows, args.measure_rows)
         print(result)
+        if args.report:
+            print(report.render())
     finally:
         reader.stop()
         reader.join()

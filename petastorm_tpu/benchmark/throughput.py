@@ -29,6 +29,15 @@ class BenchmarkResult:
         return s
 
 
+def _reset_stage_histograms(loader):
+    """Re-anchor a metrics-enabled loader's stage percentiles alongside
+    ``PipelineStats.reset()``: a bottleneck report read after a benchmark must
+    describe the measured window, not the warmup/compile batches."""
+    obs = getattr(loader, "_obs", None)
+    if obs is not None:
+        obs.reset_stage_histograms()
+
+
 def _count_rows(item):
     d = item._asdict() if hasattr(item, "_asdict") else item
     if isinstance(d, dict):
@@ -72,6 +81,7 @@ def loader_throughput(loader, consume_fn=None, warmup_batches=4, measure_batches
     stats = getattr(loader, "stats", None)
     if stats is not None:
         stats.reset()  # the stage split must cover only the measured window below
+    _reset_stage_histograms(loader)  # percentiles re-anchor with the totals
     n = 0
     batches = 0
     busy = 0.0
@@ -177,6 +187,7 @@ def overlap_throughput(loader, step_fn, warmup_batches=3, measure_batches=30,
     def window(repeats):
         if stats is not None:
             stats.reset()  # idle split covers exactly the measured window
+        _reset_stage_histograms(loader)
         n = 0
         batches = 0
         r = None

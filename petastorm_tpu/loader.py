@@ -48,6 +48,9 @@ class PipelineStats:
 
     - ``read_s``: producer time blocked on the reader (parquet IO + worker decode)
     - ``batch_s``: producer time re-batching/shuffling host rows
+    - ``put_wait_s``: producer time blocked putting into a FULL host-batch queue
+      (downstream backpressure — the producer outruns decode/transfer/step; the
+      bottleneck analyzer's consumer-bound signal)
     - ``decode_s``: transfer-thread time in batched on-device codec decode dispatch
     - ``h2d_s``: transfer-thread time in ``device_put`` / global-array assembly
     - ``queue_wait_s``: transfer-thread time starved waiting on the host-batch queue
@@ -69,9 +72,16 @@ class PipelineStats:
     oversized payload or a starved ring), ``shm_acquire_wait_s`` (cumulative
     driver-thread wait for a free slab — sustained growth means the ring is
     undersized for the consumer's release cadence).
+
+    The same totals are exported as the ``ptpu_pipeline_*`` metric families
+    when the loader is built with ``metrics=`` (see
+    :mod:`petastorm_tpu.obs.metrics`), and
+    ``petastorm_tpu.obs.analyze.analyze_snapshot`` reads this snapshot shape
+    directly (``DataLoader.bottleneck_report()``).
     """
 
-    __slots__ = ("rows", "batches", "read_s", "batch_s", "decode_s", "h2d_s",
+    __slots__ = ("rows", "batches", "read_s", "batch_s", "put_wait_s",
+                 "decode_s", "h2d_s",
                  "queue_wait_s", "device_queue_wait_s", "decode_unsharded_batches",
                  "shm_slabs_in_flight", "shm_bytes", "shm_fallbacks",
                  "shm_acquire_wait_s")
@@ -84,6 +94,7 @@ class PipelineStats:
         self.batches = 0
         self.read_s = 0.0
         self.batch_s = 0.0
+        self.put_wait_s = 0.0
         self.decode_s = 0.0
         self.h2d_s = 0.0
         self.queue_wait_s = 0.0
@@ -100,6 +111,7 @@ class PipelineStats:
             "batches": self.batches,
             "read_s": round(self.read_s, 4),
             "batch_s": round(self.batch_s, 4),
+            "put_wait_s": round(self.put_wait_s, 4),
             "decode_s": round(self.decode_s, 4),
             "h2d_s": round(self.h2d_s, 4),
             "queue_wait_s": round(self.queue_wait_s, 4),
@@ -119,6 +131,89 @@ class PipelineStats:
         self.shm_bytes = wire_stats.get("shm_bytes", 0)
         self.shm_fallbacks = wire_stats.get("shm_fallbacks", 0)
         self.shm_acquire_wait_s = wire_stats.get("shm_acquire_wait_s", 0.0)
+
+
+#: per-span stage keys for the loader's latency histograms (the trace span
+#: names map 1:1: reader.next -> read, batch.form -> batch, ...)
+_OBS_STAGES = ("read", "batch", "host_queue_put", "host_queue_wait", "decode",
+               "h2d", "device_queue_wait")
+
+
+class _LoaderObs:
+    """Pre-resolved metric handles for one loader's hot path (ISSUE 3).
+
+    Built only when ``DataLoader(metrics=...)`` was requested, so the disabled
+    path stays one ``is None`` check per site (the ``trace.py`` contract). Holds
+    one log-bucketed latency histogram per pipeline stage
+    (``ptpu_pipeline_stage_seconds{stage=...}``) and registers two pull
+    collectors: the ``PipelineStats`` totals + live queue depths as
+    ``ptpu_pipeline_*``, and ``Reader.wire_stats()`` (slab-ring gauges) as
+    ``ptpu_wire_*`` — the migration of the pre-existing ad-hoc gauges onto
+    named metric families, with their hot paths unchanged.
+
+    One metrics-enabled loader per registry at a time: the family names carry
+    no per-loader label, so two live pipelines on ONE registry would merge
+    their stage histograms and clobber each other's collector keys. Give each
+    concurrent loader its own ``MetricsRegistry`` (an exporter can serve
+    several registries to distinct files).
+
+    The loader is held through a WEAK reference: collectors survive in the
+    registry when a caller skips the context-manager teardown, but a
+    garbage-collected pipeline stops exporting (and is not kept alive by the
+    registry) instead of freezing its last gauges into every future snapshot.
+    """
+
+    def __init__(self, registry, loader):
+        import weakref
+
+        self.registry = registry
+        self._hists = {
+            stage: registry.histogram(
+                "ptpu_pipeline_stage_seconds",
+                help="per-occurrence pipeline stage latency (seconds)",
+                stage=stage)
+            for stage in _OBS_STAGES
+        }
+        self._handles = [registry.register_collector(
+            "pipeline", self._collect_pipeline)]
+        self._loader_ref = weakref.ref(loader)
+        wire_stats_fn = getattr(loader.reader, "wire_stats", None)
+        if wire_stats_fn is not None:
+            # weak like the loader: the registry must not pin a dead reader
+            wire_ref = weakref.WeakMethod(wire_stats_fn)
+            self._handles.append(registry.register_collector(
+                "wire", lambda: (wire_ref() or dict)()))
+
+    def observe(self, stage, dur):
+        self._hists[stage].observe(dur)
+
+    def stage_histograms(self):
+        return dict(self._hists)
+
+    def reset_stage_histograms(self):
+        """Re-anchor the stage percentiles to a fresh window (benchmarks call
+        this beside ``PipelineStats.reset()`` so the bottleneck report's p50/
+        p90/p99 cover the measured window, not warmup/compile)."""
+        for hist in self._hists.values():
+            hist.reset()
+
+    def _collect_pipeline(self):
+        loader = self._loader_ref()
+        if loader is None:
+            return {}
+        out = dict(loader.stats.snapshot())
+        q = loader._queue
+        dq = loader._dev_queue
+        out["host_queue_depth"] = q.qsize() if q is not None else 0
+        out["device_queue_depth"] = dq.qsize() if dq is not None else 0
+        return out
+
+    def close(self):
+        """Unregister the pull collectors (loader ``__exit__``): a torn-down
+        pipeline must stop contributing stale families to exports."""
+        handles, self._handles = self._handles, []
+        for handle in handles:
+            self.registry.unregister_collector(handle)
 
 
 def _is_device_dtype(arr):
@@ -392,12 +487,24 @@ class DataLoader:
         dispatch, H2D, queue waits) as chrome-trace spans — the per-span view of
         the totals in ``stats``; ``tracer.dump(path)`` loads in ``chrome://tracing``
         / Perfetto. Default None = zero overhead.
+    metrics : petastorm_tpu.obs.MetricsRegistry or True, optional
+        Export the pipeline onto the metrics registry (``True`` = the
+        process-wide default registry): per-stage latency histograms
+        (``ptpu_pipeline_stage_seconds{stage=...}``, log-bucketed p50/p90/p99),
+        the ``PipelineStats`` totals + live queue depths as ``ptpu_pipeline_*``,
+        and the pool wire gauges as ``ptpu_wire_*`` — what
+        ``petastorm_tpu.obs.export`` exporters and ``petastorm-tpu-stats``
+        read. The families carry no per-loader label, so run at most ONE
+        metrics-enabled loader per registry at a time (concurrent train + eval
+        loaders: one private ``MetricsRegistry`` each). Default None =
+        disabled, one ``is None`` check per stage site.
     """
 
     def __init__(self, reader, batch_size, sharding=None, shuffling_queue_capacity=0,
                  seed=None, last_batch="drop", device_transform=None, prefetch=2,
                  to_device=True, host_queue_size=8, pad_shapes=None,
-                 device_shuffle_capacity=0, device_decode_resize=None, trace=None):
+                 device_shuffle_capacity=0, device_decode_resize=None, trace=None,
+                 metrics=None):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if last_batch not in ("drop", "pad", "partial"):
@@ -476,6 +583,15 @@ class DataLoader:
         self._ckpt_log = collections.deque()
         self._ckpt_base = None
         self._rows_consumed = 0
+        #: optional petastorm_tpu.obs wiring (None = disabled, the default):
+        #: stage latency histograms + pull collectors for the stats/wire gauges
+        self._obs = None
+        if metrics:
+            from petastorm_tpu.obs.metrics import MetricsRegistry, default_registry
+
+            registry = metrics if isinstance(metrics, MetricsRegistry) \
+                else default_registry()
+            self._obs = _LoaderObs(registry, self)
 
     # -- producer (background thread: reader → host batches) ---------------------------
     #
@@ -521,6 +637,8 @@ class DataLoader:
                 stats.read_s += dt
                 if self._trace is not None:
                     self._trace.add("reader.next", t0, dt)
+                if self._obs is not None:
+                    self._obs.observe("read", dt)
                 if item is _SENTINEL:
                     # final snapshot: the all-delivered state must be reachable
                     # even when the throttle skipped the tail deliveries
@@ -572,6 +690,8 @@ class DataLoader:
                 stats.batch_s += dt
                 if self._trace is not None:
                     self._trace.add("batch.form", t0, dt)
+                if self._obs is not None:
+                    self._obs.observe("batch", dt)
                 if self._ckpt_enabled:
                     ckpt_cum += _batch_row_count(columns)
                     # Snapshot at delivery boundaries (batched reader items ≈ row
@@ -594,7 +714,7 @@ class DataLoader:
                         return
                     if self.last_batch == "pad":
                         batch = self._pad(batch)
-                    if not _put_with_stop(q, batch, self._stop):
+                    if not self._put_batch(q, batch):
                         return
             # tail flush: the same per-batch stop check as the main loop — a stop()
             # during the flush must not leave the producer blocked on an untimed put
@@ -610,12 +730,26 @@ class DataLoader:
                         continue
                 elif self.last_batch == "pad":
                     batch = self._pad(batch)
-                if not _put_with_stop(q, batch, self._stop):
+                if not self._put_batch(q, batch):
                     return
         except Exception as e:  # noqa: BLE001 — surfaced to consumer thread
             self._producer_error = e
         finally:
             _put_sentinel(q, self._stop)
+
+    def _put_batch(self, q, batch):
+        """Producer put into the host queue, timed: blocking here is DOWNSTREAM
+        backpressure (decode/transfer/step slower than the producer) — the
+        bottleneck analyzer's consumer-bound signal (``put_wait_s``)."""
+        t0 = time.perf_counter()
+        ok = _put_with_stop(q, batch, self._stop)
+        dt = time.perf_counter() - t0
+        self.stats.put_wait_s += dt
+        if self._trace is not None:
+            self._trace.add("wait.host_queue_put", t0, dt)
+        if self._obs is not None:
+            self._obs.observe("host_queue_put", dt)
+        return ok
 
     def _pad(self, batch):
         n = len(next(iter(batch.values()))) if batch else 0
@@ -668,6 +802,10 @@ class DataLoader:
         self._stop.clear()
         self._producer_error = None
         self.stats.reset()
+        if self._obs is not None:
+            # percentiles re-anchor with the totals: bottleneck_report() must
+            # describe ONE window, never fresh totals + stale histograms
+            self._obs.reset_stage_histograms()
         if self._ckpt_enabled:
             with self._ckpt_lock:
                 # fresh watermark per iteration: base = reader state BEFORE any of
@@ -691,6 +829,8 @@ class DataLoader:
             stats.queue_wait_s += dt
             if self._trace is not None:
                 self._trace.add("wait.host_queue", t0, dt)
+            if self._obs is not None:
+                self._obs.observe("host_queue_wait", dt)
             if item is _SENTINEL:
                 if self._producer_error is not None:
                     raise self._producer_error
@@ -756,7 +896,13 @@ class DataLoader:
                     self.stats.decode_unsharded_batches += 1
                 if not self._warned_unsharded_decode:
                     self._warned_unsharded_decode = True
-                    logger.warning(
+                    from petastorm_tpu.obs.log import degradation
+
+                    # once=False: the per-LOADER flag above already gates the
+                    # log (obs.log's own warn-once is per process, and two
+                    # loaders each deserve their one warning)
+                    degradation(
+                        "unsharded_decode",
                         "Staged decode of field %r is running on a SINGLE device "
                         "although its sharding splits the batch axis %d ways "
                         "(batch rows=%d). Decode output is correct but unscaled; "
@@ -764,7 +910,7 @@ class DataLoader:
                         "shard count and use a codec whose device_decode_batch "
                         "accepts the `sharding` kwarg. (Warned once; see "
                         "PipelineStats.decode_unsharded_batches.)",
-                        name, want_shards, len(staged))
+                        name, want_shards, len(staged), once=False)
             if rt is not None:
                 kwargs["resize_to"] = tuple(rt)
             out = field.codec.device_decode_batch(field, staged, **kwargs)
@@ -802,6 +948,8 @@ class DataLoader:
         self.stats.decode_s += dt
         if self._trace is not None:
             self._trace.add("decode.dispatch", t0, dt)
+        if self._obs is not None:
+            self._obs.observe("decode", dt)
         t0 = time.perf_counter()
         device = {k: v for k, v in batch.items() if _is_device_dtype(v)}
         host = {k: v for k, v in batch.items() if k not in device}
@@ -837,6 +985,8 @@ class DataLoader:
         self.stats.h2d_s += dt
         if self._trace is not None:
             self._trace.add("h2d.transfer", t0, dt)
+        if self._obs is not None:
+            self._obs.observe("h2d", dt)
         return arrays, host
 
     def _apply_device_transform(self, arrays):
@@ -969,6 +1119,8 @@ class DataLoader:
                 stats.device_queue_wait_s += dt
                 if self._trace is not None:
                     self._trace.add("wait.device_queue", t0, dt)
+                if self._obs is not None:
+                    self._obs.observe("device_queue_wait", dt)
                 if item is _SENTINEL:
                     finished = True
                     if transfer_error:
@@ -1073,6 +1225,18 @@ class DataLoader:
         """Restore into the underlying reader (before iterating)."""
         self.reader.load_state_dict(state)
 
+    def bottleneck_report(self):
+        """Name the limiting pipeline stage from the stage counters: a
+        :class:`petastorm_tpu.obs.analyze.BottleneckReport` with verdict
+        ``producer-bound`` / ``wire-bound`` / ``consumer-bound`` / ``balanced``
+        and per-side utilization fractions (``print(report)`` for the
+        human-readable rendering; p50/p90/p99 stage detail attached when the
+        loader was built with ``metrics=``). Reads the CURRENT ``stats``
+        window — call after (or during) iteration."""
+        from petastorm_tpu.obs.analyze import analyze_loader
+
+        return analyze_loader(self)
+
     def __enter__(self):
         return self
 
@@ -1081,6 +1245,8 @@ class DataLoader:
         self.join()
         self.reader.stop()
         self.reader.join()
+        if self._obs is not None:
+            self._obs.close()
 
 
 def _put_with_stop(q, item, stop_event):
@@ -1645,7 +1811,7 @@ _UNSET = object()
 #: re-stated here).
 _LOADER_OPTS = ("last_batch", "device_transform", "prefetch", "pad_shapes",
                 "device_shuffle_capacity", "to_device", "host_queue_size",
-                "device_decode_resize", "trace")
+                "device_decode_resize", "trace", "metrics")
 
 
 def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1,
@@ -1653,7 +1819,8 @@ def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1
                     last_batch=_UNSET, device_transform=_UNSET, prefetch=_UNSET,
                     pad_shapes=_UNSET, device_shuffle_capacity=_UNSET,
                     to_device=_UNSET, host_queue_size=_UNSET,
-                    device_decode_resize=_UNSET, trace=_UNSET, **reader_kwargs):
+                    device_decode_resize=_UNSET, trace=_UNSET, metrics=_UNSET,
+                    **reader_kwargs):
     """One-call convenience: ``make_batch_reader`` + :class:`DataLoader`.
 
     ``reader_kwargs`` pass through to :func:`petastorm_tpu.reader.make_batch_reader`

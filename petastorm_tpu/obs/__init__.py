@@ -1,0 +1,27 @@
+"""Unified pipeline observability (ISSUE 3): metrics, exporters, log, analyzer.
+
+Three pillars on one substrate:
+
+- :mod:`petastorm_tpu.obs.metrics` — process-wide registry of counters, gauges
+  and log-bucketed histograms (p50/p90/p99 without stored samples). Components
+  keep the ``trace.py`` contract: disabled costs one ``is None`` check per site.
+- :mod:`petastorm_tpu.obs.export` — Prometheus text-format file export and a
+  background JSONL snapshot reporter; ``petastorm-tpu-stats`` pretty-prints them.
+- :mod:`petastorm_tpu.obs.analyze` — the bottleneck analyzer: names the limiting
+  pipeline stage (producer-bound / wire-bound / consumer-bound) from the stage
+  counters and queue-occupancy gauges (``DataLoader.bottleneck_report()``).
+
+:mod:`petastorm_tpu.obs.log` routes warn-once degradation messages (shm wire
+fallbacks, worker deaths, join timeouts) through one structured logger with a
+``ptpu_degradations_total{cause=...}`` counter per cause.
+"""
+from petastorm_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "default_registry"]

@@ -1,0 +1,175 @@
+"""Metric exporters: Prometheus text files and periodic JSONL snapshots.
+
+Two pull points for the registry (:mod:`petastorm_tpu.obs.metrics`):
+
+- :func:`write_prometheus` — one atomic write of the text exposition format
+  (tmp + rename, so a scraping sidecar never reads a torn file);
+- :class:`Reporter` — a daemon thread snapshotting every ``interval_s`` into a
+  JSONL stream (one ``{"ts": ..., "metrics": {...}}`` object per line) and/or
+  refreshing a Prometheus file. ``petastorm-tpu-stats`` pretty-prints either.
+
+:func:`parse_prometheus_text` is the minimal parser the CI smoke step and the
+test suite validate exports with (no prometheus_client dependency — the
+container must not need a pip install to check its own output).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import re
+import threading
+import time
+
+from petastorm_tpu.obs.metrics import default_registry
+
+#: per-process tmp-name disambiguator: two THREADS writing the same target
+#: concurrently (a Reporter plus a manual write) must not share a tmp file —
+#: pid alone is not enough (itertools.count is atomic under the GIL)
+_tmp_seq = itertools.count()
+
+
+def write_prometheus(path, registry=None):
+    """Atomically write ``registry.to_prometheus()`` to ``path``; returns path."""
+    registry = registry or default_registry()
+    text = registry.to_prometheus()
+    tmp = "%s.tmp.%d.%d" % (path, os.getpid(), next(_tmp_seq))
+    try:
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):  # replace failed: don't litter tmp files
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return path
+
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?P<labels>\{[^}]*\})?\s+'
+    r'(?P<value>[-+]?(?:[0-9.eE+-]+|Inf|NaN))\s*$')
+
+
+def parse_prometheus_text(text):
+    """Parse Prometheus text format into ``{name{labels}: float}`` + checks.
+
+    Raises ``ValueError`` on any malformed line, on a sample whose family has
+    no ``# TYPE`` header, and on histogram buckets whose cumulative counts
+    decrease — the validations the CI stats-smoke step asserts.
+    """
+    samples = {}
+    types = {}
+    bucket_runs = {}  # (family, non-le labels) -> last cumulative count
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"):
+                raise ValueError("line %d: malformed TYPE: %r" % (lineno, line))
+            types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError("line %d: malformed sample: %r" % (lineno, line))
+        name = m.group("name")
+        family = re.sub(r"_(bucket|sum|count)$", "", name)
+        if name not in types and family not in types:
+            raise ValueError("line %d: sample %r has no # TYPE header"
+                             % (lineno, name))
+        value = float(m.group("value"))
+        labels = m.group("labels") or ""
+        if name.endswith("_bucket"):
+            key = (family, re.sub(r'le="[^"]*",?', "", labels))
+            last = bucket_runs.get(key)
+            if last is not None and value < last:
+                raise ValueError(
+                    "line %d: non-monotonic histogram bucket for %s"
+                    % (lineno, family))
+            bucket_runs[key] = value
+        samples[name + labels] = value
+    return samples
+
+
+class Reporter:
+    """Background snapshot thread: JSONL stream and/or Prometheus file.
+
+    Daemonized and stop-event driven (never blocks interpreter exit); one
+    final snapshot is flushed on :meth:`stop` so short runs still leave a
+    record. Use as a context manager around the serving loop::
+
+        with Reporter(jsonl_path="run_stats.jsonl", interval_s=2.0):
+            for batch in loader: ...
+        # petastorm-tpu-stats run_stats.jsonl   (live, from another terminal)
+    """
+
+    def __init__(self, registry=None, interval_s=5.0, jsonl_path=None,
+                 prom_path=None):
+        if jsonl_path is None and prom_path is None:
+            raise ValueError("Reporter needs jsonl_path and/or prom_path")
+        self._registry = registry or default_registry()
+        self._interval_s = float(interval_s)
+        self._jsonl_path = jsonl_path
+        self._prom_path = prom_path
+        self._stop_event = threading.Event()
+        self._thread = None
+
+    def _write_once(self):
+        if self._prom_path is not None:
+            write_prometheus(self._prom_path, self._registry)
+        if self._jsonl_path is not None:
+            line = json.dumps({"ts": time.time(),
+                               "metrics": self._registry.snapshot()})
+            with open(self._jsonl_path, "a") as f:
+                f.write(line + "\n")
+
+    def _run(self):
+        while not self._stop_event.wait(self._interval_s):
+            try:
+                self._write_once()
+            except OSError:
+                pass  # a full/removed disk must not kill the reporter loop
+
+    def start(self):
+        self._stop_event.clear()
+        self._thread = threading.Thread(target=self._run, name="ptpu-obs-report",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop_event.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10)
+        try:
+            self._write_once()  # final snapshot: short runs leave a record too
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()
+
+
+def read_latest_jsonl_snapshot(path):
+    """Last well-formed ``{"ts", "metrics"}`` object in a Reporter JSONL stream
+    (tolerates a torn final line from a live writer); None when none exists."""
+    latest = None
+    with open(path, "r") as f:
+        for line in f:
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "metrics" in obj:
+                latest = obj
+    return latest

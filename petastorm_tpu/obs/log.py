@@ -1,0 +1,81 @@
+"""Structured degradation log: one logger, one counter family, warn-once policy.
+
+Before ISSUE 3 every graceful-degradation path announced itself its own way —
+``logger.warning`` in :mod:`petastorm_tpu.workers` for the shm ring falling back
+to the socket wire, a module-cache warn-once in ``shm_ring.shm_supported``, a
+silent copy-out in ``serializers.py`` — which meant an operator could neither
+grep one logger name nor count how often a cause fired. Here every degradation
+goes through :func:`degradation`:
+
+- logged on the ``petastorm_tpu.obs`` logger with a machine-greppable
+  ``[degradation cause=<cause>]`` suffix, once per cause by default (repeat
+  occurrences stay countable without scrolling the log);
+- counted on the process-wide registry as
+  ``ptpu_degradations_total{cause="<cause>"}`` on EVERY call, so the Prometheus
+  export and ``petastorm-tpu-stats`` show the rate even after the log went
+  quiet.
+
+Known causes (the stable label values; see docs/observability.md):
+``shm_unsupported``, ``shm_ring_create_failed``, ``shm_view_copyout``,
+``worker_died``, ``respawn_failed``, ``thread_join_timeout``,
+``unsharded_decode``.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+from petastorm_tpu.obs.metrics import default_registry
+
+logger = logging.getLogger("petastorm_tpu.obs")
+
+_lock = threading.Lock()
+_announced = set()
+_counters = {}  # cause -> Counter, resolved once (hot sites pay one inc())
+
+
+def _counter(cause):
+    counter = _counters.get(cause)
+    if counter is None:
+        # get-or-create is idempotent, so a racing double-resolve is harmless
+        counter = default_registry().counter(
+            "ptpu_degradations_total",
+            help="graceful-degradation events by cause", cause=cause)
+        with _lock:
+            _counters[cause] = counter
+    return counter
+
+
+def degradation(cause, message, *args, once=True, level=logging.WARNING):
+    """Count + log one degradation occurrence.
+
+    ``cause`` is a short stable slug (the metric label). ``message``/``args``
+    are lazy %-formatted like stdlib logging. ``once=True`` (default) logs the
+    first occurrence per cause per process and only counts the rest;
+    ``once=False`` logs every time (worker deaths, where each event matters).
+    Repeat calls for a known cause cost one ``Counter.inc()`` — per-item
+    degradation paths (shm view copy-out) stay cheap.
+    """
+    _counter(cause).inc()
+    if once:
+        with _lock:
+            if cause in _announced:
+                return
+            _announced.add(cause)
+    logger.log(level, message + " [degradation cause=%s]", *(args + (cause,)))
+
+
+def degradation_counts():
+    """``{cause: count}`` so far this process (CLI / test hook)."""
+    snap = default_registry().snapshot()
+    out = {}
+    prefix = "ptpu_degradations_total{cause="
+    for name, value in snap.items():
+        if name.startswith(prefix):
+            out[name[len(prefix):].strip('"}')] = value
+    return out
+
+
+def _reset_announced_for_tests():
+    with _lock:
+        _announced.clear()

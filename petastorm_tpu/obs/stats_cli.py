@@ -1,0 +1,122 @@
+"""``petastorm-tpu-stats``: pretty-print a live run's metrics snapshot.
+
+Reads what a :class:`petastorm_tpu.obs.export.Reporter` writes — a JSONL
+snapshot stream (last line wins, so it works against a file another process is
+appending to) or a Prometheus text file — groups the families, summarizes the
+histograms as p50/p90/p99, and, when the pipeline stage families are present,
+prints the bottleneck analyzer's verdict.
+
+    petastorm-tpu-stats run_stats.jsonl
+    petastorm-tpu-stats --watch 2 run_stats.jsonl   # redraw every 2s
+    petastorm-tpu-stats metrics.prom
+
+Exit codes: 0 printed a snapshot, 1 no snapshot found / unreadable file.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _load_snapshot(path):
+    """{metric full name: number-or-histogram-summary} from either format."""
+    from petastorm_tpu.obs.export import (
+        parse_prometheus_text,
+        read_latest_jsonl_snapshot,
+    )
+
+    with open(path, "r") as f:
+        head = f.read(1)
+    if head == "{":  # Reporter JSONL stream
+        obj = read_latest_jsonl_snapshot(path)
+        return None if obj is None else obj["metrics"]
+    with open(path, "r") as f:
+        return parse_prometheus_text(f.read())
+
+
+def _pipeline_stats_from(metrics):
+    """Reconstruct a ``PipelineStats.snapshot()``-shaped dict from the exported
+    ``ptpu_pipeline_*`` families (None when the run exported none)."""
+    prefix = "ptpu_pipeline_"
+    snap = {}
+    for name, value in metrics.items():
+        if name.startswith(prefix) and "{" not in name \
+                and isinstance(value, (int, float)):
+            snap[name[len(prefix):]] = value
+    return snap or None
+
+
+def _render(metrics):
+    lines = []
+    scalars = []
+    hists = []
+    for name in sorted(metrics):
+        value = metrics[name]
+        if isinstance(value, dict):  # histogram summary from a JSONL snapshot
+            hists.append((name, value))
+        else:
+            scalars.append((name, value))
+    width = max((len(n) for n, _v in scalars), default=0)
+    for name, value in scalars:
+        if isinstance(value, float) and not value.is_integer():
+            lines.append("%-*s %12.4f" % (width, name, value))
+        else:
+            lines.append("%-*s %12d" % (width, name, int(value)))
+    for name, h in hists:
+        lines.append("%s  count=%d  mean=%.2fms  p50=%.2fms  p90=%.2fms  "
+                     "p99=%.2fms"
+                     % (name, h.get("count", 0), h.get("mean", 0.0) * 1e3,
+                        h.get("p50", 0.0) * 1e3, h.get("p90", 0.0) * 1e3,
+                        h.get("p99", 0.0) * 1e3))
+    snap = _pipeline_stats_from(metrics)
+    if snap is not None and snap.get("batches"):
+        from petastorm_tpu.obs.analyze import analyze_snapshot
+
+        lines.append("")
+        lines.append(analyze_snapshot(snap).render())
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="petastorm-tpu-stats",
+        description="Pretty-print a petastorm_tpu metrics snapshot "
+                    "(Reporter JSONL stream or Prometheus text file).")
+    parser.add_argument(
+        "path", nargs="?",
+        default=os.environ.get("PTPU_STATS_PATH", "ptpu_stats.jsonl"),
+        help="snapshot file (default: $PTPU_STATS_PATH or ./ptpu_stats.jsonl)")
+    parser.add_argument("--watch", type=float, metavar="SECONDS", default=None,
+                        help="redraw every SECONDS until interrupted")
+    args = parser.parse_args(argv)
+
+    def show():
+        try:
+            metrics = _load_snapshot(args.path)
+        except (OSError, ValueError) as e:
+            print("petastorm-tpu-stats: cannot read %s: %s" % (args.path, e),
+                  file=sys.stderr)
+            return 1
+        if not metrics:
+            print("petastorm-tpu-stats: no snapshot in %s yet" % args.path,
+                  file=sys.stderr)
+            return 1
+        print(_render(metrics))
+        return 0
+
+    if args.watch is None:
+        return show()
+    import time
+
+    try:
+        while True:
+            os.system("clear" if os.name == "posix" else "cls")
+            show()
+            time.sleep(max(0.2, args.watch))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,8 +69,11 @@ def shm_supported():
                 probe.unlink()
             _supported_cache = True
         except Exception as e:  # noqa: BLE001 — any failure means "not here"
-            logger.warning("shared-memory wire unavailable (%s); the process pool "
-                           "will use the socket wire", e)
+            from petastorm_tpu.obs.log import degradation
+
+            degradation("shm_unsupported",
+                        "shared-memory wire unavailable (%s); the process pool "
+                        "will use the socket wire", e)
             _supported_cache = False
     return _supported_cache
 

@@ -952,6 +952,15 @@ class Reader:
         fn = getattr(self._executor, "wire_stats", None)
         return fn() if fn is not None else {}
 
+    def register_metrics(self, registry):
+        """Export this reader's wire gauges onto a
+        :class:`petastorm_tpu.obs.MetricsRegistry` as live ``ptpu_wire_*``
+        families (pull-mode — the executor hot path is untouched). For readers
+        consumed WITHOUT a ``DataLoader`` (which wires this itself via
+        ``metrics=``). Returns the collector handle for
+        ``registry.unregister_collector``."""
+        return registry.register_collector("wire", self.wire_stats)
+
     def set_trace(self, tracer):
         """Attach a :class:`petastorm_tpu.trace.TraceRecorder` to the pool wire
         (records ``shm.acquire_wait`` spans); the DataLoader wires its own."""

@@ -41,6 +41,8 @@ import pickle
 
 import numpy as np
 
+from petastorm_tpu.obs.log import degradation
+
 KIND_PICKLE = 0
 KIND_ARROW = 1
 KIND_SHM = 2
@@ -298,6 +300,11 @@ class ShmSerializer:
                 # release below. Rebuild the payload from OWNED buffers — the
                 # writable-path treatment — then release; correctness never
                 # depends on the consumer knowing about leases.
+                degradation(
+                    "shm_view_copyout",
+                    "shm view-mode payload of type %s cannot carry a slab "
+                    "lease; delivering an owned copy instead of zero-copy "
+                    "views", type(result).__name__)
                 if inner_kind == KIND_PICKLE:
                     result = self._deserialize_owned(base, inner_kind, offsets)
                 else:

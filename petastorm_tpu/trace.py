@@ -15,6 +15,12 @@ Overhead when enabled is one ``perf_counter`` pair (already paid for stats) plus
 appended tuple per span — no formatting until :meth:`dump`; disabled (``trace=None``,
 the default) it costs one ``is None`` check per span site.
 
+Cross-process merge (ISSUE 3): pool children record spans around each work item
+(:mod:`petastorm_tpu._child_worker`) and piggyback them on the result header;
+the driver thread folds them in via :meth:`add_child`, clock-aligned through
+each child's wall/perf anchor pair (same host, shared wall clock), so one dump
+shows driver threads AND worker processes on distinct pid lanes.
+
     from petastorm_tpu.trace import TraceRecorder
 
     tracer = TraceRecorder()
@@ -44,9 +50,14 @@ class TraceRecorder:
     def __init__(self, max_events=1_000_000):
         from collections import deque
 
-        self._events = deque(maxlen=max_events)  # (name, (tname, tid), t0_s, dur_s)
+        # (name, lane key (tname, tid), t0_s, dur_s, pid-or-None (None = local))
+        self._events = deque(maxlen=max_events)
         self._lock = threading.Lock()
         self._origin = time.perf_counter()
+        #: wall-clock instant matching ``_origin`` — the cross-process alignment
+        #: anchor (children ship their own (wall, perf) pair; same host, so the
+        #: shared wall clock maps child perf_counter values onto this timeline)
+        self._wall_origin = time.time()
 
     def add(self, name, t0, dur):
         """Record one span: ``t0`` from ``time.perf_counter()``, ``dur`` seconds."""
@@ -55,7 +66,23 @@ class TraceRecorder:
         # and an eval loader both run a "ptpu-loader" producer) and collapsing them
         # onto one trace lane would render bogus nested slices
         with self._lock:
-            self._events.append((name, (t.name, t.ident), t0, dur))
+            self._events.append((name, (t.name, t.ident), t0, dur, None))
+
+    def add_child(self, pid, spans, wall_anchor, perf_anchor, lane=None):
+        """Merge spans recorded in a pool child process onto a pid-tagged lane.
+
+        ``spans`` is ``[(name, t0, dur), ...]`` with ``t0`` from the CHILD's
+        ``perf_counter``; ``(wall_anchor, perf_anchor)`` is a pair the child
+        sampled together, so each span start maps to this recorder's timeline as
+        ``wall_anchor + (t0 - perf_anchor)`` on the shared wall clock. Alignment
+        error is the wall-clock sampling jitter (microseconds on one host)."""
+        if not spans:
+            return
+        lane = lane or ("ptpu-child-%d" % pid)
+        base = (wall_anchor - self._wall_origin) - perf_anchor + self._origin
+        with self._lock:
+            for name, t0, dur in spans:
+                self._events.append((name, (lane, pid), t0 + base, dur, pid))
 
     @contextlib.contextmanager
     def span(self, name):
@@ -71,27 +98,48 @@ class TraceRecorder:
             return len(self._events)
 
     def events(self):
-        """Snapshot of recorded spans as dicts (name/thread/start_s/duration_s)."""
+        """Snapshot of recorded spans as dicts (name/thread/pid/start_s/
+        duration_s); ``pid`` is this process for locally recorded spans."""
         with self._lock:
             evs = list(self._events)
-        return [{"name": n, "thread": t[0], "start_s": t0 - self._origin,
-                 "duration_s": d} for n, t, t0, d in evs]
+        local = os.getpid()
+        return [{"name": n, "thread": t[0], "pid": p if p is not None else local,
+                 "start_s": t0 - self._origin, "duration_s": d}
+                for n, t, t0, d, p in evs]
 
     def dump(self, path):
-        """Write ``chrome://tracing`` / Perfetto JSON (trace-event format)."""
+        """Write ``chrome://tracing`` / Perfetto JSON (trace-event format).
+
+        Lanes are per (process, thread): locally recorded spans render under
+        this process's pid, child spans under THEIR pid with a ``process_name``
+        metadata row per child process — one timeline, distinct pid lanes."""
         with self._lock:
             evs = list(self._events)
-        pid = os.getpid()
-        tids = {}
+        local_pid = os.getpid()
+        lanes = {}  # (pid, lane key) -> (tid, lane display name)
+        for _n, tkey, _t0, _d, p in evs:
+            key = (p if p is not None else local_pid, tkey)
+            if key not in lanes:
+                lanes[key] = tkey[0]
         trace_events = []
-        for tkey in sorted({t for _n, t, _t0, _d in evs}, key=str):
-            tid = tids[tkey] = len(tids) + 1
+        tids = {}
+        child_pids = sorted({p for _n, _t, _t0, _d, p in evs if p is not None
+                             and p != local_pid})
+        if child_pids:  # pid lanes only exist on merged multi-process dumps
+            for pid in [local_pid] + child_pids:
+                trace_events.append({
+                    "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+                    "args": {"name": "ptpu-driver" if pid == local_pid
+                             else "ptpu-pool-child-%d" % pid}})
+        for key in sorted(lanes, key=str):
+            tid = tids[key] = len(tids) + 1
             trace_events.append({  # thread-name metadata row
-                "ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
-                "args": {"name": tkey[0]}})
-        for name, tkey, t0, dur in evs:
+                "ph": "M", "pid": key[0], "tid": tid, "name": "thread_name",
+                "args": {"name": lanes[key]}})
+        for name, tkey, t0, dur, p in evs:
+            pid = p if p is not None else local_pid
             trace_events.append({
-                "ph": "X", "pid": pid, "tid": tids[tkey], "name": name,
+                "ph": "X", "pid": pid, "tid": tids[(pid, tkey)], "name": name,
                 "ts": (t0 - self._origin) * 1e6, "dur": dur * 1e6, "cat": "pipeline"})
         with open(path, "w") as f:
             json.dump({"traceEvents": trace_events,

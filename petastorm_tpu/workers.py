@@ -226,10 +226,13 @@ class ThreadExecutor(ExecutorBase):
         for t in self._threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
             if t.is_alive():
-                logger.warning(
+                from petastorm_tpu.obs.log import degradation
+
+                degradation(
+                    "thread_join_timeout",
                     "Worker thread %s still alive after %.0fs join (blocked in IO?); "
                     "it will exit at its next stop-event check without publishing",
-                    t.name, self._timeout,
+                    t.name, self._timeout, once=False,
                 )
         self._threads = []
 
@@ -423,8 +426,11 @@ class ProcessExecutor(ExecutorBase):
                                 self._shm_slabs or (self._workers_count + 2),
                                 trace=self._tracer)
             except Exception as e:  # noqa: BLE001 — degrade, never fail the pool
-                logger.warning("shared-memory slab ring creation failed (%s); "
-                               "falling back to the socket wire", e)
+                from petastorm_tpu.obs.log import degradation
+
+                degradation("shm_ring_create_failed",
+                            "shared-memory slab ring creation failed (%s); "
+                            "falling back to the socket wire", e, once=False)
         if ring is None:
             self._shm_unavailable = True
             self._serializer_name = self._serializer.inner_name
@@ -531,14 +537,18 @@ class ProcessExecutor(ExecutorBase):
                 return None
             self._respawn_budget -= 1
             budget_left = self._respawn_budget
+        from petastorm_tpu.obs.log import degradation
+
         try:
             conn = self._spawn_one()
         except Exception as e:  # noqa: BLE001 — degrade to the fatal path
-            logger.warning("Pool child respawn failed: %s", e)
+            degradation("respawn_failed", "Pool child respawn failed: %s", e,
+                        once=False)
             return None
-        logger.warning(
+        degradation(
+            "worker_died",
             "Pool worker died (%s); respawned a replacement and re-dispatching its "
-            "item (remaining respawn budget: %d)", err, budget_left)
+            "item (remaining respawn budget: %d)", err, budget_left, once=False)
         return conn
 
     def _drive_child(self, conn, plan_iter):
@@ -573,7 +583,12 @@ class ProcessExecutor(ExecutorBase):
                             self._put(_ExcResult(header[1]))
                             fatal = True
                             break
-                        _, kind, nframes = header
+                        _, kind, nframes, trace_blob = header
+                        if self._tracer is not None and trace_blob is not None:
+                            # cross-process merge: the child's per-item spans,
+                            # clock-aligned onto the parent recorder's timeline
+                            child_pid, wall0, perf0, spans = trace_blob
+                            self._tracer.add_child(child_pid, spans, wall0, perf0)
                         frames = [conn.recv_bytes() for _ in range(nframes)]
                         if slab is not None and kind != KIND_SHM:
                             # granted but unused (oversized payload): reclaim first

@@ -5,6 +5,7 @@ Console scripts mirror the reference's CLIs:
   petastorm-tpu-copy-dataset       (reference: petastorm-copy-dataset)
   petastorm-tpu-throughput         (reference: petastorm-throughput)
   petastorm-tpu-lint               (no reference analog: graftlint static analysis)
+  petastorm-tpu-stats              (no reference analog: metrics snapshot viewer)
 """
 from setuptools import find_packages, setup
 
@@ -39,6 +40,7 @@ setup(
             "petastorm-tpu-throughput=petastorm_tpu.benchmark.cli:main",
             "petastorm-tpu-bench=petastorm_tpu.benchmark.cli:main",
             "petastorm-tpu-lint=petastorm_tpu.analysis.cli:main",
+            "petastorm-tpu-stats=petastorm_tpu.obs.stats_cli:main",
         ],
     },
 )

@@ -225,7 +225,8 @@ def test_decode_unsharded_fallback_counter_and_warning(jpeg_dataset, caplog):
     reader = make_batch_reader(jpeg_dataset.url, decode_on_device=True, num_epochs=1,
                                shuffle_row_groups=False)
     loader = DataLoader(reader, batch_size=6, sharding=sharding)  # 6 % 8 != 0
-    with caplog.at_level(logging.WARNING, logger="petastorm_tpu.loader"):
+    # the warning rides the structured degradation log (ISSUE 3)
+    with caplog.at_level(logging.WARNING, logger="petastorm_tpu.obs"):
         with loader:
             try:
                 for _ in loader:

@@ -625,6 +625,81 @@ def test_schema_codec_contract_accepts_compatible_fields():
     assert findings == []
 
 
+# -- GL-O001: wall-clock durations ------------------------------------------------------
+
+_O001_POSITIVE = """
+    import time
+
+    def measure(fn):
+        t0 = time.time()
+        fn()
+        dt = time.time() - t0  # BUG: wall-clock duration
+        return dt
+"""
+
+
+def test_wall_clock_duration_fires_at_the_subtraction():
+    findings, _ = _lint(_O001_POSITIVE)
+    f = _only_rule(findings, "GL-O001")[0]
+    assert f.line == _line_of(_O001_POSITIVE, "BUG: wall-clock duration")
+    assert "perf_counter" in f.fix_hint
+
+
+def test_wall_clock_duration_direct_double_call_and_from_import():
+    src = """
+        from time import time as now
+
+        def measure(fn):
+            start = now()
+            fn()
+            return now() - start  # BUG: aliased wall clock
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-O001")[0]
+    assert f.line == _line_of(src, "BUG: aliased wall clock")
+
+
+def test_wall_clock_legitimate_uses_stay_clean():
+    findings, _ = _lint("""
+        import os
+        import time
+
+        def stamp():
+            return {"ts": time.time()}  # timestamp: fine
+
+        def deadline_loop():
+            deadline = time.time() + 10  # deadline arithmetic: fine
+            while time.time() < deadline:
+                pass
+
+        def orphan_age(path):
+            return time.time() - os.path.getmtime(path)  # vs mtime: wall clock is right
+
+        def measure(fn):
+            t0 = time.perf_counter()
+            fn()
+            return time.perf_counter() - t0  # the monotonic clock: the fix
+    """)
+    assert findings == []
+
+
+def test_wall_clock_nested_function_is_its_own_scope():
+    """A name sampled in the OUTER scope is not visible to the inner one (the
+    rule tracks assignments per scope, never across closures)."""
+    findings, _ = _lint("""
+        import time
+
+        def outer():
+            t0 = time.time()
+
+            def inner(other):
+                return time.time() - other  # `other` is a parameter, not a sample
+
+            return inner
+    """)
+    assert findings == []
+
+
 # -- engine: suppressions, baseline, CLI ------------------------------------------------
 
 

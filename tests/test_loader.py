@@ -281,7 +281,8 @@ def test_stats_populate_through_device_path(scalar_dataset):
     snap = loader.stats.snapshot()
     assert snap["batches"] == n > 0
     assert snap["rows"] == n * 8
-    assert set(snap) == {"rows", "batches", "read_s", "batch_s", "decode_s", "h2d_s",
+    assert set(snap) == {"rows", "batches", "read_s", "batch_s", "put_wait_s",
+                         "decode_s", "h2d_s",
                          "queue_wait_s", "device_queue_wait_s",
                          "decode_unsharded_batches", "shm_slabs_in_flight",
                          "shm_bytes", "shm_fallbacks", "shm_acquire_wait_s"}
@@ -420,10 +421,10 @@ def test_stop_midstream_joins_promptly(scalar_dataset):
             it = iter(loader)
             for _ in range(taken):
                 next(it)
-            t0 = time.time()
+            t0 = time.perf_counter()
             loader.stop()
             loader.join()
-            assert time.time() - t0 < 15, "join stalled: teardown race regressed"
+            assert time.perf_counter() - t0 < 15, "join stalled: teardown race regressed"
             if loader._producer is not None:  # taken=0: generator body never ran
                 assert not loader._producer.is_alive()
             if loader._transfer_thread is not None:

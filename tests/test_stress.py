@@ -129,10 +129,10 @@ def test_loader_abandoned_during_staged_decode(tmp_path):
             it = iter(loader)
             next(it)  # decode compiled, pipeline saturated with staged work
             it.close()  # abandon mid-flight
-            t0 = time.time()
+            t0 = time.perf_counter()
             loader.stop()
             loader.join()
-            assert time.time() - t0 < 15
+            assert time.perf_counter() - t0 < 15
             assert not loader._producer.is_alive()
             if loader._transfer_thread is not None:
                 assert not loader._transfer_thread.is_alive()

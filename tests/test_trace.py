@@ -116,3 +116,63 @@ def test_recorder_thread_safety():
     for t in threads:
         t.join()
     assert len(tracer) == 800
+
+
+# -- cross-process merge (ISSUE 3) ------------------------------------------------------
+
+
+def _negate(x):
+    return -x
+
+
+def test_merged_child_process_dump_has_distinct_pid_lanes(tmp_path):
+    """One Perfetto dump must contain spans from the DRIVER threads and from at
+    least one pool CHILD process, on distinct pid lanes, and round-trip through
+    json.load as valid trace-event JSON (ISSUE-3 acceptance)."""
+    import os
+
+    from petastorm_tpu.plan import EpochPlan
+    from petastorm_tpu.workers import ProcessExecutor
+
+    tracer = TraceRecorder()
+    with ProcessExecutor(workers_count=2, results_queue_size=4) as ex:
+        ex.set_trace(tracer)
+        ex.start(_negate, EpochPlan(list(range(8)), num_epochs=1))
+        with tracer.span("driver.drain"):
+            got = sorted(ex.results())
+    assert got == sorted(-x for x in range(8))
+
+    evs = tracer.events()
+    child_pids = {e["pid"] for e in evs if e["name"] == "child.work"}
+    assert child_pids and os.getpid() not in child_pids
+    assert {e["name"] for e in evs} >= {"child.work", "child.serialize",
+                                        "driver.drain"}
+
+    path = tracer.dump(str(tmp_path / "merged.json"))
+    doc = json.load(open(path))  # valid trace-event JSON round trip
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    span_pids = {e["pid"] for e in spans}
+    assert os.getpid() in span_pids  # driver lane present
+    assert span_pids & child_pids    # child pid lane(s) present
+    procs = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert procs[os.getpid()] == "ptpu-driver"
+    assert any(name.startswith("ptpu-pool-child") for name in procs.values())
+    # clock alignment: every child span lands inside the driver's drain window
+    drain = next(e for e in spans if e["name"] == "driver.drain")
+    slack = 0.5e6  # us: child may start an item just before the drain span opens
+    for e in spans:
+        if e["pid"] in child_pids:
+            assert drain["ts"] - slack <= e["ts"] \
+                <= drain["ts"] + drain["dur"] + slack, e
+
+
+def test_child_spans_discarded_without_a_recorder():
+    """No tracer attached: the piggybacked child spans are dropped at the driver
+    (the disabled path stays one `is not None` check per result)."""
+    from petastorm_tpu.plan import EpochPlan
+    from petastorm_tpu.workers import ProcessExecutor
+
+    with ProcessExecutor(workers_count=1, results_queue_size=4) as ex:
+        ex.start(_negate, EpochPlan([1, 2, 3], num_epochs=1))
+        assert sorted(ex.results()) == [-3, -2, -1]
