@@ -9,9 +9,12 @@ with ``multiprocessing.connection`` replacing ZeroMQ.
 
 Protocol: parent sends sys.path, the serializer name (an ``shm``-family name is
 followed by the slab-ring attach config — segment names + slab size), then the
-pickled worker; then items. On the socket wire each item message is the item itself;
-on the shm wire it is ``(slab_id_or_None, item)`` — the parent's slab grant for this
-item's result (None = ring starved, serialize over the socket). Child answers
+pickled worker; then items. On the socket wire each item message is
+``(item, hints)``; on the shm wire it is ``(slab_id_or_None, item, hints)`` —
+the slab is the parent's grant for this item's result (None = ring starved,
+serialize over the socket). ``hints`` are the driver's remaining claimed plan
+items (ISSUE 4): the child hands them to ``worker.prefetch`` so its readahead
+pool reads the NEXT row groups while the current one decodes. Child answers
 ``("ok", kind, nframes, trace_blob)`` followed by ``nframes`` raw frames from the
 wire serializer (pickle-5 out-of-band buffers, Arrow IPC, or a slab descriptor — see
 petastorm_tpu/serializers.py), or ``("exc", exception)``; ``None`` item = shut down.
@@ -38,6 +41,7 @@ def main():
     authkey = sys.stdin.buffer.read(32)
     conn = Client(address, authkey=authkey)
     serializer = None
+    worker = None
     # clock-alignment anchors: one wall/perf pair, sampled back to back so the
     # parent can map this child's perf_counter values onto the shared wall clock
     wall_anchor = time.time()
@@ -57,15 +61,20 @@ def main():
             slab_names, slab_bytes = conn.recv()
             serializer.bind_slabs(slab_names, slab_bytes)
         worker = conn.recv()
+        prefetch = getattr(worker, "prefetch", None)
         while True:
             msg = conn.recv()
             if msg is None:
                 return
             if shm_wire:
-                slab_id, item = msg
+                slab_id, item, hints = msg
                 serializer.set_slab(slab_id)
             else:
-                item = msg
+                item, hints = msg
+            if hints and prefetch is not None:
+                # issue the driver's claimed-next reads on this child's IO pool
+                # before working the item — the prefetch itself never raises
+                prefetch(hints)
             try:
                 t0 = time.perf_counter()
                 result = worker(item)
@@ -88,6 +97,11 @@ def main():
     except (EOFError, BrokenPipeError, ConnectionResetError):
         return
     finally:
+        if worker is not None and hasattr(worker, "close"):
+            try:
+                worker.close()  # stop the readahead IO pool before exiting
+            except Exception:  # noqa: BLE001 — teardown must reach conn.close
+                pass
         if serializer is not None and hasattr(serializer, "close"):
             serializer.close()  # detach (never unlink) any attached slabs
         conn.close()

@@ -27,6 +27,10 @@ CLOSEABLE_FACTORIES = frozenset({
     "DataLoader", "InMemDataLoader", "BatchedDataLoader",
     "make_weighted_reader", "WeightedSamplingReader",
     "SharedMemory", "SlabRing", "SlabClient",
+    # ISSUE-4 async-IO runtime: a ReadaheadPool owns live IO threads
+    # (shutdown() is its closer) and a MemCache pins process-wide bytes
+    # (clear() releases them)
+    "ReadaheadPool", "MemCache",
 })
 
 #: calls that merely CONSUME an iterable without taking ownership of it
@@ -34,7 +38,8 @@ _CONSUMERS = frozenset({"list", "iter", "next", "enumerate", "sorted", "zip",
                         "sum", "min", "max", "len", "tuple", "set", "dict",
                         "print", "repr", "str", "isinstance", "type"})
 
-_CLOSERS = frozenset({"stop", "close", "join", "terminate", "shutdown", "unlink"})
+_CLOSERS = frozenset({"stop", "close", "join", "terminate", "shutdown", "unlink",
+                      "clear"})
 
 
 class ResourceLifecycleRule(Rule):

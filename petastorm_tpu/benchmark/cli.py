@@ -61,6 +61,13 @@ def main(argv=None):
         from petastorm_tpu.benchmark import wire
 
         return wire.main(argv[1:])
+    if argv and argv[0] == "io":
+        # `petastorm-tpu-bench io ...`: the async read-path micro-benchmark
+        # (cold sequential vs readahead vs readahead+coalesce vs memcache-warm)
+        # — see benchmark/io.py
+        from petastorm_tpu.benchmark import io as io_bench
+
+        return io_bench.main(argv[1:])
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("dataset_url")
     parser.add_argument("--batch", action="store_true",

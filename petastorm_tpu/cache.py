@@ -22,6 +22,12 @@ class CacheBase:
         """Return cached value for ``key``; on miss call ``fill_cache_func()``, store, return."""
         raise NotImplementedError
 
+    def contains(self, key):
+        """Cheap (possibly stale) membership probe — the readahead layer skips
+        prefetching row groups the cache will serve anyway. ``False`` is always
+        a safe answer."""
+        return False
+
     def cleanup(self):
         pass
 
@@ -53,20 +59,38 @@ class LocalDiskCache(CacheBase):
         ext = "arrow" if self._serializer == "arrow" else "pkl"
         return os.path.join(self._path, "%s.%s" % (digest, ext))
 
+    def contains(self, key):
+        return os.path.exists(self._key_path(key))
+
     def get(self, key, fill_cache_func):
+        from petastorm_tpu.obs.log import degradation
+
         fpath = self._key_path(key)
         if os.path.exists(fpath):
             try:
                 value = self._read(fpath)
-                os.utime(fpath)  # touch for LRU
+                try:  # touch for LRU; a concurrent evictor may have unlinked it
+                    os.utime(fpath)
+                except OSError:
+                    pass
                 return value
-            except Exception:  # noqa: BLE001 - corrupt entry: refill
+            except Exception as e:  # noqa: BLE001 - corrupt/vanished entry: refill
+                degradation(
+                    "disk_cache",
+                    "disk cache read failed for %s (%s); refilling from source",
+                    fpath, e)
                 try:  # another process sharing the cache dir may have unlinked it already
                     os.unlink(fpath)
                 except OSError:
                     pass
         value = fill_cache_func()
-        self._write(fpath, value)
+        try:
+            self._write(fpath, value)
+        except Exception as e:  # noqa: BLE001 — a full/readonly disk must not fail the read
+            degradation(
+                "disk_cache",
+                "disk cache write failed for %s (%s); serving uncached", fpath, e)
+            return value
         if self._size_limit:
             self._evict()
         return value
@@ -88,16 +112,23 @@ class LocalDiskCache(CacheBase):
         import uuid
 
         tmp = "%s.tmp.%s" % (fpath, uuid.uuid4().hex)
-        if self._serializer == "arrow":
-            import pyarrow as pa
+        try:
+            if self._serializer == "arrow":
+                import pyarrow as pa
 
-            with pa.OSFile(tmp, "wb") as sink:
-                with pa.ipc.new_file(sink, value.schema) as writer:
-                    writer.write_table(value)
-        else:
-            with open(tmp, "wb") as f:
-                pickle.dump(value, f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, fpath)
+                with pa.OSFile(tmp, "wb") as sink:
+                    with pa.ipc.new_file(sink, value.schema) as writer:
+                        writer.write_table(value)
+            else:
+                with open(tmp, "wb") as f:
+                    pickle.dump(value, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, fpath)
+        except BaseException:
+            try:  # don't orphan a half-written tmp for the grace-period sweep
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     #: tmp files older than this are considered orphans of a crashed writer and are
     #: reclaimed by eviction; younger ones are in-flight (unlinking those would make
@@ -136,6 +167,9 @@ class LocalDiskCache(CacheBase):
                 pass
 
     def cleanup(self):
+        # ignore_errors covers concurrent removal too: two readers sharing one
+        # cache dir may both clean up at exit, and files vanishing between the
+        # tree walk and the unlink must not raise
         if self._cleanup_on_exit:
             import shutil
 

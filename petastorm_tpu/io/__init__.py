@@ -1,0 +1,138 @@
+"""Async IO layer under the reader workers (ISSUE 4).
+
+BENCH_HISTORY showed the Parquet *read* path as the pipeline bottleneck: workers
+sat in blocking ``read_row_group`` calls while decode and the device idled
+("Hiding Latencies in Network-Based Image Loading for Deep Learning",
+PAPERS.md). This package hides that latency inside each worker instead of
+adding more workers:
+
+- :mod:`petastorm_tpu.io.readahead` — a bounded per-process prefetcher: the
+  next K row-group reads are issued on a small IO thread pool while the
+  current table decodes, so IO overlaps decode within one worker.
+- :mod:`petastorm_tpu.io.coalesce` — adjacent row groups of the same file
+  queued together merge into ONE ranged read (``read_row_groups``) and the
+  resulting table is sliced back apart, cutting per-call / object-store
+  round-trip overhead on sequential scans.
+- :mod:`petastorm_tpu.io.memcache` — a process-wide, byte-budgeted in-memory
+  row-group LRU (keyed by the reader's existing ``_cache_key``) in front of
+  ``LocalDiskCache``: hot row groups skip disk AND parse on re-epochs.
+
+The fourth piece — pull-based piece dispatch with work stealing — lives in
+:mod:`petastorm_tpu.workers` (it is scheduling, not IO), but is configured
+through the same :class:`IoOptions` struct so one knob object travels from the
+reader factories to every layer. Every feature is independently disableable
+and degrades to the synchronous path with a
+``ptpu_degradations_total{cause=...}`` entry when a fallback engages
+(docs/performance.md "Read path").
+"""
+from __future__ import annotations
+
+import os
+
+
+def _env_int(name, default):
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def _env_bool(name, default):
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off")
+
+
+class IoOptions:
+    """Knobs for the async read path — one picklable struct handed from the
+    reader factories (``io_options=`` on ``make_reader``/``make_batch_reader``)
+    to the workers (readahead/coalesce/memcache) and executors (work stealing).
+
+    Every field has an env-var override so deployments tune the read path
+    without threading kwargs through launcher scripts:
+
+    ==================  =========================  ==============================
+    field               env var                    meaning
+    ==================  =========================  ==============================
+    readahead           PTPU_READAHEAD             prefetch next row groups on an
+                                                   IO thread pool (default on)
+    readahead_depth     PTPU_READAHEAD_DEPTH       max row-group reads in flight
+                                                   per worker process (default 3)
+    readahead_bytes     PTPU_READAHEAD_BYTES       byte budget for prefetched
+                                                   tables awaiting consumption
+                                                   (default 256 MB; 0 = no cap)
+    io_threads          PTPU_IO_THREADS            IO pool size (default 2)
+    coalesce            PTPU_IO_COALESCE           merge adjacent queued row
+                                                   groups into ranged reads
+    coalesce_max_run    PTPU_IO_COALESCE_MAX_RUN   max row groups per ranged
+                                                   read (default 4)
+    work_stealing       PTPU_WORK_STEALING         idle workers steal claimed
+                                                   pieces from stuck peers
+    memcache_bytes      PTPU_MEMCACHE_BYTES        in-memory decoded-row-group
+                                                   LRU budget (0 = off, the
+                                                   default)
+    ==================  =========================  ==============================
+    """
+
+    __slots__ = ("readahead", "readahead_depth", "readahead_bytes", "io_threads",
+                 "coalesce", "coalesce_max_run", "work_stealing", "memcache_bytes")
+
+    def __init__(self, readahead=None, readahead_depth=None, readahead_bytes=None,
+                 io_threads=None, coalesce=None, coalesce_max_run=None,
+                 work_stealing=None, memcache_bytes=None):
+        self.readahead = _env_bool("PTPU_READAHEAD", True) \
+            if readahead is None else bool(readahead)
+        self.readahead_depth = max(1, _env_int("PTPU_READAHEAD_DEPTH", 3)
+                                   if readahead_depth is None else int(readahead_depth))
+        self.readahead_bytes = max(0, _env_int("PTPU_READAHEAD_BYTES", 256 << 20)
+                                   if readahead_bytes is None else int(readahead_bytes))
+        self.io_threads = max(1, _env_int("PTPU_IO_THREADS", 2)
+                              if io_threads is None else int(io_threads))
+        self.coalesce = _env_bool("PTPU_IO_COALESCE", True) \
+            if coalesce is None else bool(coalesce)
+        self.coalesce_max_run = max(1, _env_int("PTPU_IO_COALESCE_MAX_RUN", 4)
+                                    if coalesce_max_run is None
+                                    else int(coalesce_max_run))
+        self.work_stealing = _env_bool("PTPU_WORK_STEALING", True) \
+            if work_stealing is None else bool(work_stealing)
+        self.memcache_bytes = max(0, _env_int("PTPU_MEMCACHE_BYTES", 0)
+                                  if memcache_bytes is None else int(memcache_bytes))
+
+    @classmethod
+    def normalize(cls, value):
+        """``None`` → defaults (env-aware), dict → kwargs, IoOptions → itself."""
+        if value is None:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            return cls(**value)
+        raise TypeError("io_options must be an IoOptions, a dict of its fields, "
+                        "or None; got %r" % type(value).__name__)
+
+    @property
+    def lookahead(self):
+        """Per-worker dispatch claim size: how many upcoming plan items each
+        worker holds (and prefetches). 0 when readahead is off — the dispatcher
+        then degenerates to the plain shared pull queue."""
+        return self.readahead_depth if self.readahead else 0
+
+    def __getstate__(self):
+        return {name: getattr(self, name) for name in self.__slots__}
+
+    def __setstate__(self, state):
+        for name in self.__slots__:
+            setattr(self, name, state[name])
+
+    def __repr__(self):
+        return "IoOptions(%s)" % ", ".join(
+            "%s=%r" % (name, getattr(self, name)) for name in self.__slots__)
+
+
+from petastorm_tpu.io.coalesce import plan_runs, split_run_table  # noqa: E402,F401
+from petastorm_tpu.io.memcache import MemCache  # noqa: E402,F401
+from petastorm_tpu.io.readahead import ReadaheadPool  # noqa: E402,F401

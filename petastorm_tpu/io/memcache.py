@@ -1,0 +1,243 @@
+"""Process-wide in-memory row-group LRU: hot row groups skip disk AND parse.
+
+``LocalDiskCache`` removes the *network* read on re-epochs but still pays a
+file read plus unpickle per hit; for the hottest row groups (small validation
+sets iterated every epoch, lookup tables, re-epochs over a cached shard) even
+that is wasted work. :class:`MemCache` keeps the **decoded payloads**
+(the worker's row lists / column dicts) in one process-wide, byte-budgeted LRU
+keyed by the reader's existing ``_cache_key`` — which already encodes path, row
+group, schema fields, predicate, filters, drop-partition and device-decode
+identity, so an entry can never be served to a mismatched read.
+
+Layering: ``MemCache`` wraps any :class:`petastorm_tpu.cache.CacheBase` (the
+disk cache or the null cache) — a miss falls through to the inner cache's
+``get`` and the freshly decoded value is admitted on the way back up.
+
+Hits return a **defensive copy** (fresh containers, copied ndarrays): consumers
+own their batches and may mutate them (the writable-batch contract of the
+default wires), and an aliased cache entry would corrupt every later epoch. The
+copy is a straight memcpy — the expensive parts a hit skips are the parquet
+parse and codec decode.
+
+The store is process-wide (module-level) so every reader in the process —
+including each pool child, which unpickles its worker into its own process —
+shares one budget; entries larger than the whole budget are skipped with a
+``ptpu_degradations_total{cause="memcache_oversized"}`` entry (the value still
+flows to the consumer, uncached).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from petastorm_tpu.cache import CacheBase, NullCache
+from petastorm_tpu.obs.log import degradation
+from petastorm_tpu.obs.metrics import default_registry
+
+
+def payload_nbytes(value):
+    """Byte estimate of a worker payload (column dict, row list, pyarrow table,
+    ndarray, scalars). Conservative-cheap: exact for ndarrays/bytes/tables,
+    ``sys.getsizeof`` for the rest — the budget is a guardrail, not an
+    allocator."""
+    if isinstance(value, np.ndarray):
+        if value.dtype == object:
+            return int(value.nbytes) + sum(payload_nbytes(v) for v in value.flat)
+        return int(value.nbytes)
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return len(value)
+    if isinstance(value, dict):
+        return sum(payload_nbytes(v) for v in value.values()) + 64 * len(value)
+    if isinstance(value, (list, tuple)):
+        return sum(payload_nbytes(v) for v in value) + 16 * len(value)
+    nbytes = getattr(value, "nbytes", None)  # pyarrow.Table and friends
+    if isinstance(nbytes, int):
+        return nbytes
+    return sys.getsizeof(value)
+
+
+def _defensive_copy(value):
+    """Fresh containers + copied ndarrays so a consumer mutating its batch can
+    never corrupt the cached original (or vice versa). Immutable leaves
+    (bytes, str, numbers) pass through. Object-dtype arrays (ragged/forced
+    columns hold per-row ndarrays as ELEMENTS) recurse — ``ndarray.copy()``
+    alone would copy the outer array while the element arrays still alias."""
+    if isinstance(value, np.ndarray):
+        if value.dtype == object:
+            out = np.empty(value.shape, dtype=object)
+            out_flat, in_flat = out.reshape(-1), value.reshape(-1)
+            for i in range(in_flat.size):
+                out_flat[i] = _defensive_copy(in_flat[i])
+            return out
+        return value.copy()
+    if isinstance(value, dict):
+        return {k: _defensive_copy(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_defensive_copy(v) for v in value]
+    if isinstance(value, tuple):
+        return tuple(_defensive_copy(v) for v in value)
+    return value
+
+
+class _Store:
+    """The process-wide LRU: OrderedDict + byte accounting under one lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = OrderedDict()  # key -> (value, nbytes)
+        self._total = 0
+        self._budget = 0
+        reg = default_registry()
+        self._hits = reg.counter("ptpu_io_memcache_hits_total",
+                                 help="row-group reads served from memory")
+        self._misses = reg.counter("ptpu_io_memcache_misses_total",
+                                   help="memcache misses (fell through to the "
+                                        "inner cache / a real read)")
+        self._evictions = reg.counter("ptpu_io_memcache_evictions_total",
+                                      help="entries LRU-evicted for budget")
+        self._bytes_gauge = reg.gauge("ptpu_io_memcache_bytes",
+                                      help="decoded payload bytes held in memory")
+
+    def raise_budget(self, budget):
+        with self._lock:
+            if budget > self._budget:
+                self._budget = budget
+
+    def lookup(self, key):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is None:
+                self._misses.inc()
+                return False, None
+            self._entries.move_to_end(key)
+            self._hits.inc()
+            value = hit[0]
+        return True, _defensive_copy(value)
+
+    def contains(self, key):
+        with self._lock:
+            return key in self._entries
+
+    def put(self, key, value):
+        """Admit ``value``; returns True when it was stored. The caller must
+        then hand its consumer a defensive copy — the stored object must never
+        alias a batch the consumer may mutate (the miss-path twin of the
+        hit-path copy in :meth:`lookup`)."""
+        nbytes = payload_nbytes(value)
+        with self._lock:
+            if nbytes > self._budget:
+                oversized = True
+            else:
+                oversized = False
+                old = self._entries.pop(key, None)
+                if old is not None:
+                    self._total -= old[1]
+                self._entries[key] = (value, nbytes)
+                self._total += nbytes
+                while self._total > self._budget and self._entries:
+                    _, (_, old_bytes) = self._entries.popitem(last=False)
+                    self._total -= old_bytes
+                    self._evictions.inc()
+                self._bytes_gauge.set(self._total)
+        if oversized:
+            degradation(
+                "memcache_oversized",
+                "decoded row group of %d bytes exceeds the whole memcache "
+                "budget (%d); serving uncached", nbytes, self._budget)
+        return not oversized
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._total = 0
+            self._bytes_gauge.set(0)
+
+    def stats(self):
+        with self._lock:
+            count, total = len(self._entries), self._total
+        return {
+            # 'held_bytes', not 'bytes': the collector exporting these as
+            # ptpu_io_<key> must not collide with the registered
+            # ptpu_io_memcache_bytes gauge family (duplicate-family scrape)
+            "memcache_entries": count,
+            "memcache_held_bytes": total,
+            "memcache_hits": self._hits.value,
+            "memcache_misses": self._misses.value,
+            "memcache_evictions": self._evictions.value,
+        }
+
+
+_store_lock = threading.Lock()
+_store = None
+
+
+def shared_store():
+    """The process-wide store (created on first use)."""
+    global _store
+    with _store_lock:
+        if _store is None:
+            _store = _Store()
+        return _store
+
+
+class MemCache(CacheBase):
+    """Byte-budgeted in-memory LRU over decoded row-group payloads, layered in
+    front of an inner cache (:class:`LocalDiskCache` or :class:`NullCache`).
+
+    Instances are thin picklable views onto the process-wide store (each pool
+    child rebuilds its own store on first use); the budget is the max any
+    instance requested. ``clear()`` releases the held bytes — GL-L001 accepts
+    it as this type's closer.
+    """
+
+    def __init__(self, size_limit_bytes, inner=None, store=None):
+        if not size_limit_bytes or int(size_limit_bytes) <= 0:
+            raise ValueError("MemCache needs a positive size_limit_bytes; use "
+                             "the inner cache alone to disable it")
+        self._budget = int(size_limit_bytes)
+        self._inner = inner if inner is not None else NullCache()
+        #: private-store escape hatch (tests/benchmarks needing isolation from
+        #: the process-wide store and its raise-only budget); not picklable —
+        #: dropped on pickling, the unpickled instance reverts to the shared one
+        self._private_store = store
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_private_store"] = None
+        return state
+
+    def _store(self):
+        store = self._private_store if self._private_store is not None \
+            else shared_store()
+        store.raise_budget(self._budget)
+        return store
+
+    def get(self, key, fill_cache_func):
+        store = self._store()
+        hit, value = store.lookup(key)
+        if hit:
+            return value
+        value = self._inner.get(key, fill_cache_func)
+        if store.put(key, value):
+            # the stored object must not alias the batch we hand out: a
+            # consumer mutating it in place (writable-batch contract) would
+            # silently poison every later epoch's hit
+            return _defensive_copy(value)
+        return value
+
+    def contains(self, key):
+        return self._store().contains(key) or self._inner.contains(key)
+
+    def clear(self):
+        """Release the process-wide store's entries (shared across instances)."""
+        self._store().clear()
+
+    def stats(self):
+        return self._store().stats()
+
+    def cleanup(self):
+        self.clear()
+        self._inner.cleanup()

@@ -1,0 +1,336 @@
+"""Bounded row-group readahead: the next K reads run while the current table decodes.
+
+A :class:`ReadaheadPool` owns a small IO thread pool and a keyed table of
+in-flight/completed background reads. The worker's dispatch layer hands it the
+upcoming plan items (``_WorkerBase.prefetch``); when the worker's synchronous
+path later asks for the same ``(path, row_group, columns)`` the read is either
+done (hit — the worker paid zero read latency) or still in flight (the worker
+waits only the *remainder*, recorded as ``io.wait``). Misses fall straight
+through to the synchronous read, so the pool can never make a read slower than
+the blocking path — and a pool that failed to build degrades the whole feature
+to synchronous reads with a ``ptpu_degradations_total{cause=
+"readahead_unavailable"}`` entry.
+
+Failure semantics mirror the synchronous path exactly: background single reads
+run the worker's full transient-retry loop, and a read that exhausted its
+retries re-raises the same exception from :meth:`ReadaheadPool.get` — readahead
+must not grant extra retry budget (tests/test_io_retry.py pins this). Only
+*cancelled* entries (pool shutdown mid-read) fall back to a synchronous read,
+counted as ``cause="readahead_fallback"``.
+
+Bounds: at most ``depth`` background reads pending, and completed-but-unclaimed
+tables are LRU-evicted past ``byte_budget`` (a stolen piece's prefetched table,
+for example, is reclaimed instead of pinned forever).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+from petastorm_tpu.io.coalesce import plan_runs
+from petastorm_tpu.obs.log import degradation
+from petastorm_tpu.obs.metrics import default_registry
+
+
+class _CancelledRead(Exception):
+    """Internal marker: the pool shut down before this read completed."""
+
+
+class _Entry:
+    __slots__ = ("event", "table", "error", "nbytes", "claimed")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.table = None
+        self.error = None
+        self.nbytes = 0
+        self.claimed = False
+
+
+def request_key(piece, columns):
+    """Identity of one background read: file, row group, and the exact column
+    selection (``None`` = all columns)."""
+    return (piece.path, piece.row_group,
+            None if columns is None else tuple(columns))
+
+
+class ReadaheadPool:
+    """Per-process prefetcher for row-group reads.
+
+    ``read_fn(piece, columns) -> table`` is the worker's retrying synchronous
+    read; ``read_run_fn(pieces, columns) -> [tables]`` (optional) is its
+    coalesced ranged read for adjacent row groups. Shut down with
+    :meth:`shutdown` — the pool owns live threads (GL-L001 tracks it).
+    """
+
+    def __init__(self, read_fn, read_run_fn=None, depth=3, byte_budget=256 << 20,
+                 io_threads=2, coalesce=True, coalesce_max_run=4,
+                 wait_timeout_s=300.0, registry=None):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._read_fn = read_fn
+        self._read_run_fn = read_run_fn
+        self._depth = max(1, int(depth))
+        # 0/negative = unbounded ('no byte cap', matching the memcache_bytes=0
+        # convention of 0 being special) — NOT 'hold zero bytes', which would
+        # silently veto every schedule() while readahead reports enabled
+        self._byte_budget = int(byte_budget) if int(byte_budget) > 0 else None
+        self._wait_timeout_s = wait_timeout_s
+        self._coalesce = bool(coalesce) and read_run_fn is not None
+        self._max_run = max(1, int(coalesce_max_run))
+        self._lock = threading.Lock()
+        self._entries = OrderedDict()  # key -> _Entry (insertion = FIFO age)
+        self._pending = 0
+        self._held_bytes = 0
+        self._closed = False
+        self._tracer = None
+        # per-instance tallies for stats() (the registry counters below are
+        # process-wide families shared across pools — right for export, wrong
+        # for one reader's io_stats())
+        self._n_hits = 0
+        self._n_misses = 0
+        self._n_evictions = 0
+        self._n_coalesced_reads = 0
+        self._n_coalesced_items = 0
+        self._pool = ThreadPoolExecutor(max_workers=max(1, int(io_threads)),
+                                        thread_name_prefix="ptpu-io")
+        reg = registry if registry is not None else default_registry()
+        self._hits = reg.counter("ptpu_io_readahead_hits_total",
+                                 help="foreground reads served by readahead")
+        self._misses = reg.counter("ptpu_io_readahead_misses_total",
+                                   help="foreground reads not prefetched")
+        self._evictions = reg.counter("ptpu_io_readahead_evictions_total",
+                                      help="prefetched tables dropped for budget")
+        self._coalesced_reads = reg.counter(
+            "ptpu_io_coalesced_reads_total",
+            help="ranged reads that merged >1 adjacent row group")
+        self._coalesced_items = reg.counter(
+            "ptpu_io_coalesced_items_total",
+            help="row groups delivered through merged ranged reads")
+        self._depth_gauge = reg.gauge("ptpu_io_readahead_depth",
+                                      help="background reads currently in flight")
+        self._bytes_gauge = reg.gauge(
+            "ptpu_io_readahead_bytes",
+            help="completed prefetched table bytes awaiting consumption")
+        self._read_hist = reg.histogram("ptpu_io_read_seconds",
+                                        help="background row-group read latency")
+        self._wait_hist = reg.histogram(
+            "ptpu_io_wait_seconds",
+            help="foreground wait on an in-flight prefetched read")
+
+    def set_trace(self, tracer):
+        """Attach a :class:`petastorm_tpu.trace.TraceRecorder`: background reads
+        record ``io.readahead`` spans, foreground waits ``io.wait``."""
+        self._tracer = tracer
+
+    # -- scheduling ---------------------------------------------------------------------
+
+    def schedule(self, requests):
+        """Queue background reads for ``[(piece, columns), ...]``.
+
+        Already-queued keys are skipped (repeat hints are near-free), the
+        pending count is capped at ``depth``, and nothing is queued while the
+        completed-unclaimed bytes exceed the budget. Returns the number of
+        reads actually queued.
+        """
+        with self._lock:
+            if self._closed or (self._byte_budget is not None
+                                and self._held_bytes >= self._byte_budget):
+                return 0
+            capacity = self._depth - self._pending
+            if capacity <= 0:
+                return 0
+            fresh = []
+            for piece, columns in requests:
+                if len(fresh) >= capacity:
+                    break
+                # columns normalized to a hashable tuple once, here: it is the
+                # entry key AND the run-grouping key downstream
+                columns = None if columns is None else tuple(columns)
+                key = request_key(piece, columns)
+                if key in self._entries:
+                    continue
+                self._entries[key] = _Entry()
+                fresh.append((piece, columns))
+            self._pending += len(fresh)
+            self._depth_gauge.set(self._pending)
+        if not fresh:
+            return 0
+        submitted = set()
+        try:
+            runs = plan_runs(fresh, self._max_run) if self._coalesce \
+                else [([piece], columns) for piece, columns in fresh]
+            for pieces, columns in runs:
+                self._pool.submit(self._read_task, pieces, columns)
+                submitted.update(request_key(p, columns) for p in pieces)
+        except BaseException:
+            # roll back the never-submitted registrations: an entry whose read
+            # was never issued would park a future get() on an event nobody sets
+            with self._lock:
+                for piece, columns in fresh:
+                    key = request_key(piece, columns)
+                    if key not in submitted and \
+                            self._entries.pop(key, None) is not None:
+                        self._pending -= 1
+                self._depth_gauge.set(self._pending)
+            raise
+        return len(fresh)
+
+    def _read_task(self, pieces, columns):
+        t0 = time.perf_counter()
+        tables = error = None
+        try:
+            if len(pieces) == 1:
+                tables = [self._read_fn(pieces[0], columns)]
+            else:
+                tables = self._read_run_fn(pieces, columns)
+                self._coalesced_reads.inc()
+                self._coalesced_items.inc(len(pieces))
+                with self._lock:
+                    self._n_coalesced_reads += 1
+                    self._n_coalesced_items += len(pieces)
+        except Exception as e:  # noqa: BLE001 — stored, re-raised at get()
+            error = e
+        dur = time.perf_counter() - t0
+        self._read_hist.observe(dur)
+        tracer = self._tracer
+        if tracer is not None:
+            tracer.add("io.readahead", t0, dur)
+        with self._lock:
+            if not self._closed:
+                # in-flight count tracks the READS, not the entries: an entry a
+                # timed-out waiter already popped still finished its IO here
+                self._pending -= len(pieces)
+            for i, piece in enumerate(pieces):
+                entry = self._entries.get(request_key(piece, columns))
+                if entry is None or entry.event.is_set():
+                    # shut down / abandoned while reading — or the key was
+                    # abandoned (get timeout) and RE-scheduled, and the fresh
+                    # read already filled the new entry: a second fill would
+                    # double-count held bytes (the claimer subtracts once)
+                    continue
+                if error is not None:
+                    entry.error = error
+                else:
+                    entry.table = tables[i]
+                    entry.nbytes = getattr(tables[i], "nbytes", 0)
+                    self._held_bytes += entry.nbytes
+                entry.event.set()
+            self._evict_over_budget()
+            self._depth_gauge.set(self._pending)
+            self._bytes_gauge.set(self._held_bytes)
+
+    def _evict_over_budget(self):
+        """Age out completed, unclaimed entries. Caller MUST hold ``self._lock``
+        (all call sites do — the analyzer cannot see cross-method ownership).
+
+        Two bounds: tables past the BYTE budget (oldest first), and total
+        completed entries past a small COUNT cap. The count cap is what keeps
+        abandoned entries from living forever: a stolen piece's prefetched
+        table is consumed by nobody, and a read that failed after retries
+        leaves an error entry with ``nbytes == 0`` that the byte budget alone
+        would never touch (exception objects pin traceback frames — a real
+        leak over a long multi-epoch run)."""
+        cap = max(8, 4 * self._depth)
+        for key in list(self._entries):
+            over_bytes = self._byte_budget is not None \
+                and self._held_bytes > self._byte_budget
+            over_count = len(self._entries) > cap
+            if not over_bytes and not over_count:
+                break
+            entry = self._entries[key]
+            if entry.claimed or not entry.event.is_set():
+                continue  # a getter owns it / the read is still in flight
+            if entry.table is None and not over_count:
+                continue  # error entries free no bytes; only the cap drops them
+            del self._entries[key]
+            self._held_bytes -= entry.nbytes  # graftlint: disable=GL-C001
+            self._n_evictions += 1  # graftlint: disable=GL-C001
+            self._evictions.inc()
+
+    # -- consumption --------------------------------------------------------------------
+
+    def get(self, piece, columns):
+        """The prefetched table for ``(piece, columns)``, or ``None`` on a miss
+        (caller reads synchronously). Blocks for an in-flight read (the
+        ``io.wait`` remainder). A read that *failed* re-raises its exception —
+        the background read already spent the retry budget; a read cancelled by
+        shutdown returns ``None`` with a degradation entry (synchronous
+        fallback)."""
+        key = request_key(piece, columns)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or entry.claimed:
+                self._n_misses += 1
+                self._misses.inc()
+                return None
+            entry.claimed = True
+        t0 = time.perf_counter()
+        completed = entry.event.wait(self._wait_timeout_s)
+        wait = time.perf_counter() - t0
+        self._wait_hist.observe(wait)
+        tracer = self._tracer
+        if tracer is not None and wait > 1e-6:
+            tracer.add("io.wait", t0, wait)
+        with self._lock:
+            self._entries.pop(key, None)
+            if entry.table is not None:
+                self._held_bytes -= entry.nbytes
+                self._bytes_gauge.set(self._held_bytes)
+                self._n_hits += 1
+                self._hits.inc()
+                return entry.table
+        if not completed:
+            # hung background read: abandon the entry (its late completion is
+            # discarded above) and read synchronously
+            degradation("readahead_fallback",
+                        "readahead read of %s row group %d still pending after "
+                        "%.0fs; reading synchronously",
+                        piece.path, piece.row_group, self._wait_timeout_s)
+            return None
+        if isinstance(entry.error, _CancelledRead):
+            degradation("readahead_fallback",
+                        "readahead cancelled for %s row group %d; reading "
+                        "synchronously", piece.path, piece.row_group)
+            return None
+        raise entry.error
+
+    # -- lifecycle ----------------------------------------------------------------------
+
+    def shutdown(self):
+        """Cancel pending reads, release waiters, stop the IO threads.
+        Idempotent; the worker calls it from ``close()`` (Reader.join)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            entries = list(self._entries.values())
+            self._entries.clear()
+            self._pending = 0
+            self._held_bytes = 0
+            self._depth_gauge.set(0)
+            self._bytes_gauge.set(0)
+        for entry in entries:
+            if entry.table is None and entry.error is None:
+                entry.error = _CancelledRead()
+            entry.event.set()
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def stats(self):
+        """Live gauges/counters for ``Reader.io_stats()`` (thread/dummy pools —
+        process-pool children keep theirs in their own registries)."""
+        with self._lock:
+            # key names deliberately differ from this pool's REGISTERED gauge
+            # families (ptpu_io_readahead_depth/_bytes): Reader.io_stats feeds
+            # a collector that exports ptpu_io_<key>, and a collision would
+            # emit duplicate Prometheus families (scrapers reject the scrape)
+            return {
+                "readahead_pending": self._pending,
+                "readahead_held_bytes": self._held_bytes,
+                "readahead_hits": self._n_hits,
+                "readahead_misses": self._n_misses,
+                "readahead_evictions": self._n_evictions,
+                "coalesced_reads": self._n_coalesced_reads,
+                "coalesced_items": self._n_coalesced_items,
+            }

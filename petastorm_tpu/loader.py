@@ -183,6 +183,13 @@ class _LoaderObs:
             wire_ref = weakref.WeakMethod(wire_stats_fn)
             self._handles.append(registry.register_collector(
                 "wire", lambda: (wire_ref() or dict)()))
+        io_stats_fn = getattr(loader.reader, "io_stats", None)
+        if io_stats_fn is not None:
+            # async read path (ISSUE 4): readahead hit/miss/pending/bytes,
+            # memcache, dispatch steals — live gauges as ptpu_io_* families
+            io_ref = weakref.WeakMethod(io_stats_fn)
+            self._handles.append(registry.register_collector(
+                "io", lambda: (io_ref() or dict)()))
 
     def observe(self, stage, dur):
         self._hists[stage].observe(dur)

@@ -18,7 +18,9 @@ goes through :func:`degradation`:
 Known causes (the stable label values; see docs/observability.md):
 ``shm_unsupported``, ``shm_ring_create_failed``, ``shm_view_copyout``,
 ``worker_died``, ``respawn_failed``, ``thread_join_timeout``,
-``unsharded_decode``.
+``unsharded_decode`` — and, from the async read path (ISSUE 4),
+``readahead_unavailable``, ``readahead_fallback``, ``memcache_oversized``,
+``disk_cache``.
 """
 from __future__ import annotations
 

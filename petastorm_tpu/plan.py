@@ -79,28 +79,59 @@ class EpochPlan:
     def __iter__(self):
         return self
 
+    def _step(self, epoch, pos, perm):
+        """Advance the cursor triple by ONE raw position (skip map not yet
+        applied): returns ``(yield_epoch, ordinal, epoch, pos, perm)``. The
+        single copy of the rollover/reshuffle algorithm — :meth:`__next__`
+        mutates the instance cursor with it, :meth:`peek` walks a detached
+        copy, so the two cannot drift."""
+        yield_epoch = epoch
+        ordinal = int(perm[pos])
+        pos += 1
+        if pos >= len(self._items):
+            pos = 0
+            epoch += 1
+            if self._num_epochs is None or epoch < self._num_epochs:
+                perm = epoch_permutation(
+                    len(self._items), epoch, self._seed, self._shuffle
+                )
+        return yield_epoch, ordinal, epoch, pos, perm
+
     def __next__(self):
         while True:
             if not self._items:
                 raise StopIteration
             if self._num_epochs is not None and self._epoch >= self._num_epochs:
                 raise StopIteration
-            epoch = self._epoch
-            ordinal = int(self._perm[self._pos])
-            self._pos += 1
-            if self._pos >= len(self._items):
-                self._pos = 0
-                self._epoch += 1
-                if self._num_epochs is None or self._epoch < self._num_epochs:
-                    self._perm = epoch_permutation(
-                        len(self._items), self._epoch, self._seed, self._shuffle
-                    )
+            epoch, ordinal, self._epoch, self._pos, self._perm = \
+                self._step(self._epoch, self._pos, self._perm)
             if self._skip and ordinal in self._skip.get(epoch, ()):
                 continue
             item = self._items[ordinal]
             if self._with_epoch:
                 return (epoch, ordinal, item)
             return item
+
+    def peek(self, n):
+        """The next ``n`` yields of :meth:`__next__` WITHOUT advancing the
+        cursor — the readahead layer's lookahead window (ISSUE 4): a
+        synchronous executor prefetches ``plan.peek(depth)`` while the current
+        item decodes. Same ``_step`` advance as ``__next__`` (skip map, epoch
+        roll-over, per-epoch reshuffle); returns fewer than ``n`` items when
+        the plan is nearly exhausted."""
+        out = []
+        if not self._items:
+            return out
+        epoch, pos, perm = self._epoch, self._pos, self._perm
+        while len(out) < n:
+            if self._num_epochs is not None and epoch >= self._num_epochs:
+                break
+            yield_epoch, ordinal, epoch, pos, perm = self._step(epoch, pos, perm)
+            if self._skip and ordinal in self._skip.get(yield_epoch, ()):
+                continue
+            item = self._items[ordinal]
+            out.append((yield_epoch, ordinal, item) if self._with_epoch else item)
+        return out
 
     def remaining_in_epoch(self):
         return len(self._items) - self._pos

@@ -21,6 +21,7 @@ workers. Partition columns materialize as ordinary row/batch values.
 from __future__ import annotations
 
 import logging
+import os
 import random
 import re
 import threading
@@ -29,6 +30,7 @@ import time
 import numpy as np
 
 from petastorm_tpu.cache import make_cache
+from petastorm_tpu.io import IoOptions
 from petastorm_tpu.errors import (
     PERMANENT_IO_ERRORS as _PERMANENT_IO_ERRORS,
     DecodeFieldError,
@@ -58,7 +60,9 @@ logger = logging.getLogger(__name__)
 
 class _Tagged:
     """Wraps a worker so results carry their (epoch, ordinal) dispatch tag — the bookkeeping
-    exact resume needs (picklable for the process pool)."""
+    exact resume needs (picklable for the process pool). Forwards the async-IO
+    surface (``prefetch``/``close``/``io_stats``/``set_trace``) so executors and
+    pool children talk to the tagged wrapper as if it were the worker."""
 
     def __init__(self, worker):
         self._worker = worker
@@ -66,6 +70,26 @@ class _Tagged:
     def __call__(self, tagged_item):
         epoch, ordinal, item = tagged_item
         return (epoch, ordinal, self._worker(item))
+
+    def prefetch(self, tagged_items):
+        """Readahead hint: strip the dispatch tags, hand the plan items down."""
+        fn = getattr(self._worker, "prefetch", None)
+        if fn is not None:
+            fn([tagged[2] for tagged in tagged_items])
+
+    def close(self):
+        fn = getattr(self._worker, "close", None)
+        if fn is not None:
+            fn()
+
+    def io_stats(self):
+        fn = getattr(self._worker, "io_stats", None)
+        return fn() if fn is not None else {}
+
+    def set_trace(self, tracer):
+        fn = getattr(self._worker, "set_trace", None)
+        if fn is not None:
+            fn(tracer)
 
 
 #: Exception-module roots of the storage client stacks fsspec-bridged filesystems
@@ -100,16 +124,38 @@ def _close_quietly(pf):
         pass
 
 
+#: serializes lazy per-process IO-runtime construction (the readahead pool);
+#: module-level because worker objects must stay picklable (no instance locks)
+_io_init_lock = threading.Lock()
+
+_file_eviction_counter = None
+
+
+def _count_file_eviction():
+    """Bump ``ptpu_io_file_evictions_total`` (resolved once per process)."""
+    global _file_eviction_counter
+    counter = _file_eviction_counter
+    if counter is None:
+        from petastorm_tpu.obs.metrics import default_registry
+
+        counter = _file_eviction_counter = default_registry().counter(
+            "ptpu_io_file_evictions_total",
+            help="cached open-ParquetFile handles closed (LRU bound or "
+                 "transient-IO-retry reopen)")
+    counter.inc()
+
+
 class _WorkerBase:
     """Shared row-group loading: column-pruned reads, predicate masking, drop partitions."""
 
-    #: Max cached open parquet files per thread (fd bound: threads × this).
-    MAX_OPEN_FILES = 64
+    #: Max cached open parquet files per thread (fd bound: threads × this);
+    #: PTPU_MAX_OPEN_FILES overrides for long multi-file epochs on tight ulimits.
+    MAX_OPEN_FILES = int(os.environ.get("PTPU_MAX_OPEN_FILES", "") or 64)
 
     def __init__(self, filesystem, read_schema, stored_schema, predicate, transform_spec,
                  cache, shuffle_row_drop_partitions, filters, seed,
                  device_fields=frozenset(), partition_info=None,
-                 io_retries=2, io_retry_backoff_s=0.1):
+                 io_retries=2, io_retry_backoff_s=0.1, io_options=None):
         self._fs = filesystem
         self._read_schema = read_schema  # fields to deliver (pre-transform view)
         self._stored_schema = stored_schema  # full stored schema (decode source of truth)
@@ -123,11 +169,20 @@ class _WorkerBase:
         self._partition_info = partition_info  # hive key=value layout (or None)
         self._io_retries = io_retries  # extra attempts on transient IO errors
         self._io_retry_backoff_s = io_retry_backoff_s
+        self._io_options = IoOptions.normalize(io_options)
         self._local = None  # threading.local built lazily (not picklable)
+        self._readahead = None  # ReadaheadPool built lazily per process (threads)
+        self._io_closed = False  # latched by close(); reopen() re-arms (reset)
+        self._readahead_unavailable = False  # this worker's pool failed to build
+        self._io_tracer = None
 
     def __getstate__(self):
         state = dict(self.__dict__)
         state["_local"] = None
+        state["_readahead"] = None  # each pool child builds its own IO runtime
+        state["_io_closed"] = False
+        state["_readahead_unavailable"] = False  # a child retries its own build
+        state["_io_tracer"] = None
         return state
 
     def _parquet_file(self, path):
@@ -146,6 +201,7 @@ class _WorkerBase:
             while len(cache) > self.MAX_OPEN_FILES:  # LRU-evict to bound open fds
                 _, old = cache.popitem(last=False)
                 _close_quietly(old)
+                _count_file_eviction()
         else:
             cache.move_to_end(path)
         return pf
@@ -158,28 +214,164 @@ class _WorkerBase:
             pf = cache.pop(path, None)
             if pf is not None:
                 _close_quietly(pf)
+                _count_file_eviction()
+
+    # -- async read path (ISSUE 4) ------------------------------------------------------
+
+    def _readahead_pool(self, create=False):
+        """The per-process readahead pool (None when the feature is off).
+
+        Built lazily on the first ``prefetch`` — never pickled (each pool child
+        constructs its own), never built by foreground reads (a reader whose
+        executor sends no hints stays fully synchronous). A construction
+        failure degrades the feature off for this worker with a logged
+        ``readahead_unavailable`` cause."""
+        if not self._io_options.readahead or self._readahead_unavailable:
+            return None
+        pool = self._readahead
+        if pool is None and create:
+            with _io_init_lock:
+                pool = self._readahead
+                if pool is None and not self._io_closed:
+                    from petastorm_tpu.io.readahead import ReadaheadPool
+
+                    opts = self._io_options
+                    try:
+                        pool = ReadaheadPool(
+                            self._read_columns_sync, read_run_fn=self._read_run,
+                            depth=opts.readahead_depth,
+                            byte_budget=opts.readahead_bytes,
+                            io_threads=opts.io_threads, coalesce=opts.coalesce,
+                            coalesce_max_run=opts.coalesce_max_run)
+                    except Exception as e:  # noqa: BLE001 — degrade to sync reads
+                        from petastorm_tpu.obs.log import degradation
+
+                        degradation(
+                            "readahead_unavailable",
+                            "readahead pool construction failed (%s); reads stay "
+                            "synchronous", e)
+                        # per-WORKER flag, never the caller-owned IoOptions: one
+                        # IoOptions may be shared across readers, and one
+                        # worker's failure must not flip the feature off there
+                        self._readahead_unavailable = True
+                        return None
+                    if self._io_tracer is not None:
+                        pool.set_trace(self._io_tracer)
+                    self._readahead = pool
+        return pool
+
+    def prefetch(self, items):
+        """Dispatch lookahead hint: issue background reads for the upcoming plan
+        ``items`` (``(piece, partition)`` tuples) so IO overlaps the current
+        item's decode. Never raises — a scheduling failure degrades to
+        synchronous reads with a logged cause."""
+        pool = self._readahead_pool(create=True)
+        if pool is None or not items:
+            return
+        try:
+            columns = self._first_read_columns()
+            requests = []
+            for item in items:
+                piece, partition = item
+                if self._cache_contains(piece, partition):
+                    continue  # the (mem/disk) cache will serve it without a read
+                requests.append((piece, columns))
+            if requests:
+                pool.schedule(requests)
+        except Exception as e:  # noqa: BLE001 — prefetch must never fail a read
+            from petastorm_tpu.obs.log import degradation
+
+            degradation("readahead_fallback",
+                        "prefetch scheduling failed (%s); reads stay synchronous", e)
+
+    def _cache_contains(self, piece, partition):
+        key = _cache_key(piece, self._read_schema, self._predicate, self._filters,
+                         partition, self._drop_partitions, self._seed,
+                         self._device_fields)
+        return self._cache.contains(key)
+
+    def _first_read_columns(self):
+        """The column selection of this worker's FIRST read for any piece — what
+        the readahead must request for its prefetched table to be a hit."""
+        raise NotImplementedError
+
+    def close(self):
+        """Release the per-process IO runtime (Reader.join / pool-child exit)
+        and latch prefetching off — a straggling executor thread mid-loop must
+        not rebuild the pool under a teardown. Idempotent; :meth:`reopen`
+        (Reader restart) re-arms it."""
+        with _io_init_lock:
+            self._io_closed = True
+            pool, self._readahead = self._readahead, None
+        if pool is not None:
+            pool.shutdown()
+
+    def reopen(self):
+        """Re-arm lazy readahead construction after a :meth:`close` (the Reader
+        calls this from ``_start`` so ``reset()`` gets a fresh IO runtime)."""
+        with _io_init_lock:
+            self._io_closed = False
+
+    def io_stats(self):
+        """Live async-IO gauges: readahead + memcache (empty dicts when off).
+        Surfaced through ``Reader.io_stats()`` for thread/dummy pools."""
+        out = {}
+        pool = self._readahead
+        if pool is not None:
+            out.update(pool.stats())
+        stats_fn = getattr(self._cache, "stats", None)
+        if stats_fn is not None:
+            out.update(stats_fn())
+        return out
+
+    def set_trace(self, tracer):
+        self._io_tracer = tracer
+        pool = self._readahead
+        if pool is not None:
+            pool.set_trace(tracer)
+
+    # -- reads --------------------------------------------------------------------------
 
     def _read_columns(self, piece, columns):
-        """Read a row group restricted to ``columns`` (None = all). Hive partition
-        columns (directory values, not in the file) are appended as constants.
+        """Read a row group restricted to ``columns`` (None = all), serving from
+        the readahead pool when the dispatch layer prefetched it (the pool's
+        failure semantics mirror the synchronous retry path — see
+        petastorm_tpu/io/readahead.py)."""
+        pool = self._readahead_pool()
+        if pool is not None:
+            table = pool.get(piece, columns)
+            if table is not None:
+                return table
+        return self._read_columns_sync(piece, columns)
+
+    def _read_columns_sync(self, piece, columns):
+        """The blocking read with transient-IO retry. Hive partition columns
+        (directory values, not in the file) are appended as constants.
 
         Transient IO errors (connection resets, timeouts — routine against object
         stores at pod scale) are retried up to ``io_retries`` times with jittered
         exponential backoff, reopening the file each time. The reference has no retry
         anywhere (SURVEY.md §6: a worker exception kills the read); permanent
         conditions (missing file, bad permissions) still fail fast."""
+        return self._retry_io(
+            lambda: self._read_columns_once(piece, columns), piece.path,
+            "%s row group %d" % (piece.path, piece.row_group))
+
+    def _retry_io(self, fn, path, what):
+        """One copy of the transient-retry protocol, shared by single-row-group
+        and coalesced ranged reads (identical budget either way)."""
         attempt = 0
         while True:
             try:
-                return self._read_columns_once(piece, columns)
+                return fn()
             except Exception as e:  # noqa: BLE001 — classified below
                 if not _is_transient_io_error(e) or attempt >= self._io_retries:
                     raise
-                self._evict_parquet_file(piece.path)
+                self._evict_parquet_file(path)
                 delay = self._io_retry_backoff_s * (2 ** attempt) * (0.5 + random.random())
                 logger.warning(
-                    "Transient IO error reading %s row group %d (%s); retry %d/%d in %.2fs",
-                    piece.path, piece.row_group, e, attempt + 1, self._io_retries, delay)
+                    "Transient IO error reading %s (%s); retry %d/%d in %.2fs",
+                    what, e, attempt + 1, self._io_retries, delay)
                 time.sleep(delay)
                 attempt += 1
 
@@ -190,6 +382,9 @@ class _WorkerBase:
         if columns is not None:
             file_columns = [c for c in columns if c in available]
         table = pf.read_row_group(piece.row_group, columns=file_columns)
+        return self._attach_partitions(table, piece, columns)
+
+    def _attach_partitions(self, table, piece, columns):
         if self._partition_info:
             from petastorm_tpu.partitions import attach_partition_columns
 
@@ -197,6 +392,30 @@ class _WorkerBase:
                 table, piece, self._partition_info,
                 wanted=None if columns is None else set(columns))
         return table
+
+    def _read_run(self, pieces, columns):
+        """Coalesced ranged read: adjacent row groups of ONE file in a single
+        ``read_row_groups`` call, sliced back into per-piece tables (the
+        readahead pool's ``read_run_fn``; byte-identical to per-group reads —
+        `petastorm-tpu-bench io --smoke` asserts it in CI)."""
+        return self._retry_io(
+            lambda: self._read_run_once(pieces, columns), pieces[0].path,
+            "%s row groups %s" % (pieces[0].path,
+                                  [p.row_group for p in pieces]))
+
+    def _read_run_once(self, pieces, columns):
+        from petastorm_tpu.io.coalesce import split_run_table
+
+        pf = self._parquet_file(pieces[0].path)
+        available = set(pf.schema_arrow.names)
+        file_columns = columns
+        if columns is not None:
+            file_columns = [c for c in columns if c in available]
+        row_groups = [p.row_group for p in pieces]
+        table = pf.read_row_groups(row_groups, columns=file_columns)
+        sizes = [pf.metadata.row_group(rg).num_rows for rg in row_groups]
+        return [self._attach_partitions(t, piece, columns)
+                for t, piece in zip(split_run_table(table, sizes), pieces)]
 
     def _row_mask(self, table):
         """Boolean keep-mask from filters + predicate over a row-group table (or None)."""
@@ -260,22 +479,36 @@ class PyDictWorker(_WorkerBase):
             return self._form_ngram_dicts(rows)
         return rows
 
+    def _mask_fields(self):
+        """Sorted predicate+filter columns — the head read's selection when a
+        row mask runs first (empty list = no mask read)."""
+        predicate_fields = sorted(self._predicate.get_fields()) if self._predicate else []
+        filter_fields = sorted(_dnf_fields(self._filters)) if self._filters else []
+        return sorted(set(predicate_fields) | set(filter_fields))
+
+    def _first_read_columns(self):
+        # EXACTLY the head read of _load_rows (same _mask_fields source, so the
+        # two cannot drift): predicate/filter columns when a mask runs first
+        # (IO saving kept), the full wanted set otherwise — a prefetched table
+        # is keyed by this list and must match to hit
+        return self._mask_fields() or list(self._read_schema.fields.keys())
+
     def _load_rows(self, item):
         piece, partition = item
         wanted = list(self._read_schema.fields.keys())
-        predicate_fields = sorted(self._predicate.get_fields()) if self._predicate else []
-        filter_fields = sorted(_dnf_fields(self._filters)) if self._filters else []
-        first_pass = sorted(set(predicate_fields) | set(filter_fields)) or None
+        first_pass = self._mask_fields() or None
 
         if first_pass is not None:
             head = self._read_columns(piece, first_pass)
             mask = self._row_mask(head)
             if mask is not None and not mask.any():
                 return []
-            # second pass fetches only the columns the head read didn't already decode
+            # second pass fetches only the columns the head read didn't already
+            # decode — straight to the sync path: this key is never prefetched,
+            # and routing it through the pool would just count a bogus miss
             remaining = sorted(set(wanted) - set(head.column_names))
             if remaining:
-                tail = self._read_columns(piece, remaining)
+                tail = self._read_columns_sync(piece, remaining)
                 table = _merge_tables(head, tail)
             else:
                 table = head
@@ -371,15 +604,20 @@ class ArrowWorker(_WorkerBase):
             columns = form_ngram_columns(columns, self._ngram)
         return columns
 
-    def _load_columns(self, item):
-        piece, partition = item
+    def _first_read_columns(self):
+        # the batch path reads everything at once: wanted + mask columns
         wanted = list(self._read_schema.fields.keys())
         extra = set()
         if self._predicate:
             extra |= set(self._predicate.get_fields())
         if self._filters:
             extra |= _dnf_fields(self._filters)
-        table = self._read_columns(piece, sorted(set(wanted) | extra))
+        return sorted(set(wanted) | extra)
+
+    def _load_columns(self, item):
+        piece, partition = item
+        wanted = list(self._read_schema.fields.keys())
+        table = self._read_columns(piece, self._first_read_columns())
         mask = self._row_mask(table)
         indices = np.arange(table.num_rows)
         if mask is not None:
@@ -791,7 +1029,7 @@ class Reader:
                  shuffle_row_drop_partitions=1,
                  reader_pool_type="thread", workers_count=4, results_queue_size=16,
                  is_batched_reader=False, ngram=None, results_timeout_s=300.0,
-                 wire_serializer="pickle", worker_respawns=2):
+                 wire_serializer="pickle", worker_respawns=2, io_options=None):
         self._fs = filesystem
         self._path = path
         self.schema = schema
@@ -823,8 +1061,10 @@ class Reader:
                                seed=seed if seed is not None else shard_seed,
                                with_epoch=True)
         self._num_items = len(items)
+        self._io_options = IoOptions.normalize(io_options)
         self._pool_args = (reader_pool_type, workers_count, results_queue_size,
-                           results_timeout_s, wire_serializer, worker_respawns)
+                           results_timeout_s, wire_serializer, worker_respawns,
+                           self._io_options)
         self._executor = None
         self._results_iter = None
         self._buffer = []
@@ -840,7 +1080,14 @@ class Reader:
         self._start()
 
     def _start(self):
-        self._executor = make_executor(*self._pool_args)
+        (pool_type, workers_count, queue_size, timeout_s, serializer,
+         respawns, io_options) = self._pool_args
+        reopen = getattr(self._worker, "reopen", None)
+        if reopen is not None:  # reset()/restore after join() closed the IO runtime
+            reopen()
+        self._executor = make_executor(
+            pool_type, workers_count, queue_size, timeout_s, serializer,
+            respawns, io_options=io_options)
         self._executor.start(_Tagged(self._worker), self._plan)
         self._results_iter = self._executor.results()
         self.stopped = False
@@ -952,6 +1199,21 @@ class Reader:
         fn = getattr(self._executor, "wire_stats", None)
         return fn() if fn is not None else {}
 
+    def io_stats(self):
+        """Async-read-path gauges (readahead hit/miss/pending/bytes, memcache,
+        dispatch steals) — live for thread/dummy pools, where the worker shares
+        this process; a process pool reports only the parent-side dispatch
+        stats (children keep their IO counters in their own registries).
+        Exported as ``ptpu_io_*`` families by the DataLoader's collector."""
+        out = {}
+        fn = getattr(self._worker, "io_stats", None)
+        if fn is not None:
+            out.update(fn() or {})
+        fn = getattr(self._executor, "dispatch_stats", None)
+        if fn is not None:
+            out.update(fn() or {})
+        return out
+
     def register_metrics(self, registry):
         """Export this reader's wire gauges onto a
         :class:`petastorm_tpu.obs.MetricsRegistry` as live ``ptpu_wire_*``
@@ -963,8 +1225,12 @@ class Reader:
 
     def set_trace(self, tracer):
         """Attach a :class:`petastorm_tpu.trace.TraceRecorder` to the pool wire
-        (records ``shm.acquire_wait`` spans); the DataLoader wires its own."""
+        (records ``shm.acquire_wait`` spans) and the worker's readahead pool
+        (``io.readahead``/``io.wait`` spans); the DataLoader wires its own."""
         fn = getattr(self._executor, "set_trace", None)
+        if fn is not None:
+            fn(tracer)
+        fn = getattr(self._worker, "set_trace", None)
         if fn is not None:
             fn(tracer)
 
@@ -996,6 +1262,14 @@ class Reader:
         self.stopped = True
 
     def join(self):
+        # close the worker's IO runtime FIRST: a stop() mid-stream can leave
+        # executor threads blocked inside ReadaheadPool.get, and shutdown()
+        # releases those waiters (into the degradation-logged sync fallback)
+        # so the executor join below doesn't sit out its full timeout. A
+        # reset() lazily rebuilds the pool on the next prefetch.
+        close = getattr(self._worker, "close", None)
+        if close is not None:
+            close()
         if self._executor is not None:
             self._executor.join()
 
@@ -1066,6 +1340,17 @@ class Reader:
 # --------------------------------------------------------------------------------------
 
 
+def _maybe_memcache(cache, io_opts):
+    """Layer the process-wide in-memory row-group LRU in front of the configured
+    cache when ``io_options.memcache_bytes`` (or PTPU_MEMCACHE_BYTES) asks for
+    one — hot row groups then skip disk AND parse on re-epochs."""
+    if not io_opts.memcache_bytes:
+        return cache
+    from petastorm_tpu.io.memcache import MemCache
+
+    return MemCache(io_opts.memcache_bytes, inner=cache)
+
+
 def _resolve_ngram_schema(schema_fields, stored_schema, predicate):
     """Shared NGram policy for both reader factories: which options NGram forbids
     and how its read-schema view is built. Returns ``(ngram-or-None, read_schema)``."""
@@ -1114,7 +1399,8 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
                 cache_row_size_estimate=None, cache_extra_settings=None,
                 transform_spec=None, filters=None, storage_options=None, filesystem=None,
                 results_timeout_s=300.0, decode_on_device=False, wire_serializer=None,
-                io_retries=2, io_retry_backoff_s=0.1, worker_respawns=2):
+                io_retries=2, io_retry_backoff_s=0.1, worker_respawns=2,
+                io_options=None):
     """Open a petastorm(-tpu) dataset for per-row decoded reading (reference ~L60).
 
     ``schema_fields`` may be a list of names/regexes/UnischemaFields or an :class:`NGram`.
@@ -1133,6 +1419,11 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     ``worker_respawns``: the process pool's elastic-recovery budget — a child that
     dies mid-item is replaced and its row group re-dispatched up to this many times
     (0 = fail fast; the reference has no recovery).
+
+    ``io_options``: the async read path's knobs (:class:`petastorm_tpu.io.IoOptions`
+    or a dict of its fields) — row-group readahead (default on), adjacent-read
+    coalescing, the in-memory decoded-row-group LRU (``memcache_bytes``), and
+    work-stealing piece dispatch. See docs/performance.md "Read path".
     """
     fs, path = get_filesystem_and_path_or_paths(dataset_url, storage_options, filesystem)
     stored_schema = get_schema(fs, path)
@@ -1151,8 +1442,10 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     if transform_spec is not None and not transform_spec.device:
         final_schema = transform_schema(read_schema, transform_spec)
 
+    io_opts = IoOptions.normalize(io_options)
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
+    cache = _maybe_memcache(cache, io_opts)
     device_fields = _resolve_device_fields(read_schema, decode_on_device, ngram,
                                            transform_spec)
     worker = PyDictWorker(
@@ -1160,6 +1453,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         shuffle_row_drop_partitions, filters, seed if seed is not None else shard_seed,
         device_fields=device_fields, partition_info=partition_info,
         io_retries=io_retries, io_retry_backoff_s=io_retry_backoff_s,
+        io_options=io_opts,
         ngram=ngram, ngram_schema=final_schema if ngram is not None else None,
     )
     r = Reader(
@@ -1171,6 +1465,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         results_queue_size=results_queue_size, is_batched_reader=False, ngram=ngram,
         results_timeout_s=results_timeout_s,
         wire_serializer=wire_serializer or "pickle", worker_respawns=worker_respawns,
+        io_options=io_opts,
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields
@@ -1186,7 +1481,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       transform_spec=None, filters=None, storage_options=None,
                       filesystem=None, results_timeout_s=300.0, decode_on_device=False,
                       wire_serializer=None, io_retries=2, io_retry_backoff_s=0.1,
-                      worker_respawns=2):
+                      worker_respawns=2, io_options=None):
     """Open ANY Parquet store for vectorized columnar batches (reference ~L200).
 
     ``decode_on_device``: see :func:`make_reader` — device-decodable codec columns come
@@ -1194,6 +1489,9 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
 
     ``io_retries`` / ``io_retry_backoff_s``: see :func:`make_reader` (transient
     read-failure retry with backoff; 0 = reference fail-fast behavior).
+
+    ``io_options``: see :func:`make_reader` — readahead/coalesce/memcache/work
+    stealing knobs for the async read path (docs/performance.md "Read path").
 
     ``wire_serializer``: process-pool result wire format; defaults to ``"arrow"`` here
     (columnar batches ride Arrow IPC — reference ``ArrowTableSerializer`` parity) and
@@ -1227,8 +1525,10 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     if transform_spec is not None and not transform_spec.device:
         final_schema = transform_schema(read_schema, transform_spec)
 
+    io_opts = IoOptions.normalize(io_options)
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
+    cache = _maybe_memcache(cache, io_opts)
     device_fields = _resolve_device_fields(read_schema, decode_on_device, ngram,
                                            transform_spec=transform_spec)
     worker = ArrowWorker(
@@ -1236,6 +1536,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         shuffle_row_drop_partitions, filters, seed if seed is not None else shard_seed,
         device_fields=device_fields, partition_info=partition_info,
         io_retries=io_retries, io_retry_backoff_s=io_retry_backoff_s,
+        io_options=io_opts,
         ngram=ngram,
     )
     r = Reader(
@@ -1249,6 +1550,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         wire_serializer={"shm": "shm-arrow", "shm-view": "shm-arrow-view"}.get(
             wire_serializer, wire_serializer) or "arrow",
         worker_respawns=worker_respawns,
+        io_options=io_opts,
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields

@@ -6,7 +6,9 @@ dummy_pool.py ~L30, ``ConcurrentVentilator`` ventilator.py ~L60), redesigned per
 
 - No ZeroMQ and no ventilator thread. Backpressure is a bounded results queue; the "ventilator"
   is the (possibly infinite, resumable) :class:`petastorm_tpu.plan.EpochPlan` pulled lazily
-  under a lock. Threads are the default pool — Arrow IO and cv2 decode release the GIL, and the
+  through a :class:`PullDispatcher` — bounded per-worker claims (the readahead layer's
+  lookahead window, ISSUE 4) with work stealing when the plan runs dry. Threads are the
+  default pool — Arrow IO and cv2 decode release the GIL, and the
   heavy decode moves on-device anyway (Pallas), so forked processes buy little and cost pickling.
 - ``ProcessPoolExecutor`` is kept for CPU-hungry user ``TransformSpec`` functions: workers are
   initialized once per child (no per-task worker pickling) and in-flight tasks are capped for
@@ -21,12 +23,86 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+from collections import deque
 
 from petastorm_tpu.errors import TimeoutWaitingForResultError
 
 logger = logging.getLogger(__name__)
 
 _DONE = object()
+
+
+_steal_counter = None
+
+
+def _count_steal():
+    """Bump ``ptpu_io_steals_total`` (resolved once per process)."""
+    global _steal_counter
+    counter = _steal_counter
+    if counter is None:
+        from petastorm_tpu.obs.metrics import default_registry
+
+        counter = _steal_counter = default_registry().counter(
+            "ptpu_io_steals_total",
+            help="claimed plan items taken from a busy worker by an idle one")
+    counter.inc()
+
+
+class PullDispatcher:
+    """Pull-based piece dispatch over a shared plan: bounded per-worker claims
+    plus work stealing (ISSUE 4).
+
+    Each worker claims up to ``1 + lookahead`` upcoming plan items into its own
+    deque — the lookahead is what the readahead layer prefetches, so the items a
+    worker announces as "next" really are the ones it will process. When the
+    plan runs dry an idle worker steals from the TAIL of the longest peer claim
+    (the piece its owner would reach last), so a worker stuck on one slow piece
+    no longer strands the rest of its claim behind it. With ``lookahead=0`` and
+    no stealing this degenerates to exactly the old shared ``next(plan_iter)``
+    under a lock.
+
+    Plan order is preserved at dispatch: claims are filled strictly in plan
+    order and consumed FIFO; only completion order can differ (it always could
+    — workers finish out of order), which the Reader's consumed-ordinal
+    bookkeeping and the loader's checkpoint watermark already handle.
+    """
+
+    def __init__(self, plan, workers_count, lookahead=0, stealing=True):
+        self._iter = iter(plan)
+        self._lock = threading.Lock()
+        self._claims = [deque() for _ in range(max(1, workers_count))]
+        self._exhausted = False
+        self._lookahead = max(0, int(lookahead))
+        self._stealing = bool(stealing)
+        self.steals = 0
+
+    def next(self, worker_idx):
+        """Claim the next item for ``worker_idx``: ``(item, upcoming)`` where
+        ``upcoming`` is the rest of this worker's claim (the prefetch hint), or
+        ``None`` when no work is left anywhere."""
+        with self._lock:
+            claim = self._claims[worker_idx]
+            self._fill(claim, 1 + self._lookahead)
+            if not claim and self._stealing:
+                victim = max((c for c in self._claims if c), key=len, default=None)
+                if victim is not None:
+                    claim.append(victim.pop())  # tail: the victim's furthest item
+                    self.steals += 1
+                    _count_steal()
+            if not claim:
+                return None
+            item = claim.popleft()  # the fill above keeps the hint window full
+            return item, tuple(claim)
+
+    def _fill(self, claim, target):
+        while len(claim) < target and not self._exhausted:
+            try:
+                claim.append(next(self._iter))
+            except StopIteration:
+                self._exhausted = True
+
+    def stats(self):
+        return {"steals": self.steals}
 
 
 class _ExcResult:
@@ -121,12 +197,17 @@ class ExecutorBase:
 
 
 class SyncExecutor(ExecutorBase):
-    """Synchronous in-process execution (reference DummyPool): deterministic, for tests/debug."""
+    """Synchronous in-process execution (reference DummyPool): deterministic, for tests/debug.
 
-    def __init__(self, **_ignored):
+    Readahead still applies (``lookahead > 0`` and a worker with ``prefetch``):
+    the upcoming plan items come from ``plan.peek`` — the single consumer keeps
+    its deterministic order while the IO pool reads ahead of it."""
+
+    def __init__(self, lookahead=0, **_ignored):
         self._worker = None
         self._plan = None
         self._stopped = False
+        self._lookahead = max(0, int(lookahead))
 
     def start(self, worker, plan):
         self._worker = worker
@@ -134,10 +215,16 @@ class SyncExecutor(ExecutorBase):
         self.truncated = False
 
     def results(self):
+        prefetch = getattr(self._worker, "prefetch", None)
+        peek = getattr(self._plan, "peek", None)
         for item in self._plan:
             if self._stopped:
                 self.truncated = True
                 return
+            if prefetch is not None and peek is not None and self._lookahead:
+                upcoming = peek(self._lookahead)
+                if upcoming:
+                    prefetch(upcoming)
             yield self._worker(item)
 
     def stop(self):
@@ -145,17 +232,21 @@ class SyncExecutor(ExecutorBase):
 
 
 class ThreadExecutor(ExecutorBase):
-    """N threads pulling work items from the shared plan; bounded results queue = backpressure."""
+    """N threads pulling work items from the shared plan through a
+    :class:`PullDispatcher` (bounded claims + work stealing); bounded results
+    queue = backpressure."""
 
     def __init__(self, workers_count=4, results_queue_size=16, results_timeout_s=300.0,
-                 **_ignored):
+                 lookahead=0, work_stealing=True, **_ignored):
         self._workers_count = workers_count
         self._queue_size = results_queue_size
         self._timeout = results_timeout_s
+        self._lookahead = lookahead
+        self._stealing = work_stealing
         self._threads = []
         self._results = None
         self._stop_event = threading.Event()
-        self._plan_lock = threading.Lock()
+        self._dispatch = None
         self._active = 0
         self._active_lock = threading.Lock()
 
@@ -163,25 +254,29 @@ class ThreadExecutor(ExecutorBase):
         self._results = queue.Queue(maxsize=self._queue_size)
         self._stop_event.clear()
         self.truncated = False
-        plan_iter = iter(plan)
+        self._dispatch = PullDispatcher(plan, self._workers_count,
+                                        lookahead=self._lookahead,
+                                        stealing=self._stealing)
         with self._active_lock:
             self._active = self._workers_count
         for i in range(self._workers_count):
             t = threading.Thread(
-                target=self._run_worker, args=(worker, plan_iter), daemon=True,
-                name="ptpu-worker-%d" % i,
+                target=self._run_worker, args=(worker, self._dispatch, i),
+                daemon=True, name="ptpu-worker-%d" % i,
             )
             t.start()
             self._threads.append(t)
 
-    def _run_worker(self, worker, plan_iter):
+    def _run_worker(self, worker, dispatch, idx):
+        prefetch = getattr(worker, "prefetch", None)
         try:
             while not self._stop_event.is_set():
-                with self._plan_lock:
-                    try:
-                        item = next(plan_iter)
-                    except StopIteration:
-                        break
+                claim = dispatch.next(idx)
+                if claim is None:
+                    break
+                item, upcoming = claim
+                if prefetch is not None and upcoming:
+                    prefetch(upcoming)  # swallows its own failures (degradation-logged)
                 try:
                     result = worker(item)
                 except Exception as e:  # noqa: BLE001 - propagate to consumer
@@ -193,6 +288,11 @@ class ThreadExecutor(ExecutorBase):
                 self._active -= 1
                 if self._active == 0:
                     self._put(_DONE)
+
+    def dispatch_stats(self):
+        """Work-stealing gauges for ``Reader.io_stats()``."""
+        dispatch = self._dispatch
+        return dispatch.stats() if dispatch is not None else {}
 
     def _put(self, value):
         # Even the _DONE marker yields to a SET stop event: the consumer is the one
@@ -263,12 +363,15 @@ class ProcessExecutor(ExecutorBase):
 
     def __init__(self, workers_count=4, results_queue_size=16, results_timeout_s=300.0,
                  serializer="pickle", worker_respawns=2, shm_slab_bytes=None,
-                 shm_slabs=None, **_ignored):
+                 shm_slabs=None, lookahead=0, work_stealing=True, **_ignored):
         import os
 
         self._workers_count = workers_count
         self._queue_size = results_queue_size
         self._timeout = results_timeout_s
+        self._lookahead = lookahead
+        self._stealing = work_stealing
+        self._dispatch = None
         self._serializer_name = serializer
         from petastorm_tpu.serializers import make_serializer
 
@@ -289,7 +392,6 @@ class ProcessExecutor(ExecutorBase):
         self._threads = []
         self._results = None
         self._stop_event = threading.Event()
-        self._plan_lock = threading.Lock()
         self._active = 0
         self._active_lock = threading.Lock()
         self._tmpdir = None
@@ -355,11 +457,14 @@ class ProcessExecutor(ExecutorBase):
                     self._conns.append(conn)
         finally:
             listener.close()  # also unblocks the acceptor thread if we raised
-        plan_iter = iter(plan)
+        self._dispatch = PullDispatcher(plan, self._workers_count,
+                                        lookahead=self._lookahead,
+                                        stealing=self._stealing)
         with self._active_lock:
             self._active = self._workers_count
         for i, conn in enumerate(self._conns):
-            t = threading.Thread(target=self._drive_child, args=(conn, plan_iter),
+            t = threading.Thread(target=self._drive_child,
+                                 args=(conn, self._dispatch, i),
                                  daemon=True, name="ptpu-pdrv-%d" % i)
             t.start()
             self._threads.append(t)
@@ -456,6 +561,12 @@ class ProcessExecutor(ExecutorBase):
             return {"shm_unavailable": 1}
         return {}
 
+    def dispatch_stats(self):
+        """Work-stealing gauges for ``Reader.io_stats()`` (parent-side; the
+        children's readahead counters live in their own processes)."""
+        dispatch = self._dispatch
+        return dispatch.stats() if dispatch is not None else {}
+
     @property
     def wire_views(self):
         """True when deserialized payloads are zero-copy READ-ONLY slab views
@@ -551,7 +662,7 @@ class ProcessExecutor(ExecutorBase):
             "item (remaining respawn budget: %d)", err, budget_left, once=False)
         return conn
 
-    def _drive_child(self, conn, plan_iter):
+    def _drive_child(self, conn, dispatch, idx):
         from petastorm_tpu.serializers import KIND_SHM
 
         # local snapshot: join() nulls self._ring (under the respawn lock) while a
@@ -561,11 +672,14 @@ class ProcessExecutor(ExecutorBase):
         try:
             fatal = False
             while not fatal and not self._stop_event.is_set():
-                with self._plan_lock:
-                    try:
-                        item = next(plan_iter)
-                    except StopIteration:
-                        break
+                claim = dispatch.next(idx)
+                if claim is None:
+                    break
+                item, upcoming = claim
+                # readahead hint rides with the item: the child prefetches these
+                # on ITS IO pool before working the item (they are this driver's
+                # claimed pieces, so barring a steal the child reads its own future)
+                hints = list(upcoming)
                 while True:  # item attempts: survives child death via respawn
                     # slab grant per ATTEMPT: a respawned child gets a fresh grant,
                     # and a dead child's in-flight slab is reclaimed below
@@ -575,7 +689,8 @@ class ProcessExecutor(ExecutorBase):
                         if slab is None:  # ring starved: socket wire for this item
                             ring.count_fallback()
                     try:
-                        conn.send((slab, item) if ring is not None else item)
+                        conn.send((slab, item, hints) if ring is not None
+                                  else (item, hints))
                         header = conn.recv()
                         if header[0] == "exc":
                             if slab is not None:
@@ -693,7 +808,7 @@ class ProcessExecutor(ExecutorBase):
 
 def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size=16,
                   results_timeout_s=300.0, serializer="pickle", worker_respawns=2,
-                  shm_slab_bytes=None, shm_slabs=None):
+                  shm_slab_bytes=None, shm_slabs=None, io_options=None):
     """Factory matching the reference's ``reader_pool_type`` kwarg ('thread'|'process'|'dummy').
 
     ``serializer`` selects the process-pool wire format: 'pickle'|'arrow' (reference
@@ -704,15 +819,25 @@ def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size
     replaced and their item re-dispatched up to this many times; 0 = fail fast).
     ``shm_slab_bytes``/``shm_slabs`` size the slab ring (defaults: 32 MB ×
     (workers_count + 2); also tunable via the PTPU_SHM_SLAB_BYTES env var).
+    ``io_options`` (:class:`petastorm_tpu.io.IoOptions`) configures the dispatch
+    side of the async read path: the per-worker lookahead claim (= readahead
+    depth) and work stealing.
     """
+    from petastorm_tpu.io import IoOptions
+
+    io_options = IoOptions.normalize(io_options)
+    lookahead = io_options.lookahead
+    stealing = io_options.work_stealing
     if reader_pool_type in ("dummy", "sync"):
-        return SyncExecutor()
+        return SyncExecutor(lookahead=lookahead)
     if reader_pool_type == "thread":
-        return ThreadExecutor(workers_count, results_queue_size, results_timeout_s)
+        return ThreadExecutor(workers_count, results_queue_size, results_timeout_s,
+                              lookahead=lookahead, work_stealing=stealing)
     if reader_pool_type == "process":
         return ProcessExecutor(workers_count, results_queue_size, results_timeout_s,
                                serializer=serializer, worker_respawns=worker_respawns,
-                               shm_slab_bytes=shm_slab_bytes, shm_slabs=shm_slabs)
+                               shm_slab_bytes=shm_slab_bytes, shm_slabs=shm_slabs,
+                               lookahead=lookahead, work_stealing=stealing)
     raise ValueError(
         "Unknown reader_pool_type %r (expected 'thread', 'process' or 'dummy')"
         % reader_pool_type

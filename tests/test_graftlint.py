@@ -415,6 +415,72 @@ def test_lifecycle_shared_memory_clean_forms():
     assert findings == []
 
 
+_L001_READAHEAD_POSITIVE = """
+    from petastorm_tpu.io.readahead import ReadaheadPool
+
+    def leak_io_threads(read_fn):
+        pool = ReadaheadPool(read_fn)  # BUG: IO threads never shut down
+        pool.schedule([])
+"""
+
+
+def test_lifecycle_fires_on_unclosed_readahead_pool():
+    """The ISSUE-4 extension: a ReadaheadPool owns live IO threads, so leaking
+    one is a lint error like leaking an executor."""
+    findings, _ = _lint(_L001_READAHEAD_POSITIVE)
+    f = _only_rule(findings, "GL-L001")[0]
+    assert f.line == _line_of(_L001_READAHEAD_POSITIVE,
+                              "BUG: IO threads never shut down")
+
+
+_L001_MEMCACHE_POSITIVE = """
+    from petastorm_tpu.io.memcache import MemCache
+
+    def pin_process_bytes():
+        cache = MemCache(1 << 20)  # BUG: held bytes never released
+        cache.get("k", lambda: [1, 2, 3])
+"""
+
+
+def test_lifecycle_fires_on_uncleared_memcache():
+    findings, _ = _lint(_L001_MEMCACHE_POSITIVE)
+    f = _only_rule(findings, "GL-L001")[0]
+    assert f.line == _line_of(_L001_MEMCACHE_POSITIVE,
+                              "BUG: held bytes never released")
+
+
+def test_lifecycle_readahead_and_memcache_clean_forms():
+    findings, _ = _lint("""
+        from petastorm_tpu.io.memcache import MemCache
+        from petastorm_tpu.io.readahead import ReadaheadPool
+
+        def pool_try_finally(read_fn, reqs):
+            pool = ReadaheadPool(read_fn)
+            try:
+                pool.schedule(reqs)
+            finally:
+                pool.shutdown()
+
+        def memcache_cleared(fill):
+            cache = MemCache(1 << 20)
+            try:
+                return cache.get("k", fill)
+            finally:
+                cache.clear()
+
+        def owned_by_worker(read_fn):
+            class Worker:
+                pass
+            w = Worker()
+            w._readahead = ReadaheadPool(read_fn)  # attribute: lifetime escapes
+            return w
+
+        def layered_into_factory(inner):
+            return MemCache(1 << 20, inner=inner)  # ownership moves to caller
+    """)
+    assert findings == []
+
+
 # -- GL-J001/J002/J003: JAX tracing hazards ---------------------------------------------
 
 _J001_POSITIVE = """
