@@ -904,6 +904,25 @@ def main():
         "wall_s": round(time.perf_counter() - _t_main, 1),
     }
 
+    # Health trailer (ISSUE 5): degradation counts by cause + the analyzer
+    # verdict over the north-star window's stages, so BENCH_*.json artifacts
+    # record degradation/stall history ALONGSIDE the throughput they qualify
+    # (a fast number earned through readahead fallbacks is a different result).
+    def health_trailer():
+        try:
+            from petastorm_tpu.obs.analyze import analyze_snapshot
+            from petastorm_tpu.obs.log import degradation_counts
+
+            stages = full.get("overlap_train_stages") or full.get("stages")
+            verdict = analyze_snapshot(stages).verdict if stages else None
+            return {k: int(v) for k, v in degradation_counts().items()}, verdict
+        except Exception as e:  # noqa: BLE001 — the trailer must never cost
+            return {"<unavailable>": str(e)[:80]}, None  # the bench its result
+
+    degradations, health_verdict = health_trailer()
+    full["degradations"] = degradations
+    full["health_verdict"] = health_verdict
+
     # best healthy TRAIN window (falling back to fwd hostdec): the affirmative
     # north-star capture, or null when no healthy window opened this run
     def best_healthy():
@@ -951,6 +970,10 @@ def main():
         "ngram": None if ngram is None else {
             "windows_per_sec": ngram["windows_per_sec"],
             "vs_host": ngram["vs_host"], "healthy": ngram["healthy"]},
+        # degradation/stall history rides with the headline number (ISSUE 5):
+        # a throughput earned through fallbacks/stalls is a different result
+        "degradations": degradations,
+        "health_verdict": health_verdict,
         "history": "BENCH_HISTORY.jsonl",
     }))
 
@@ -972,6 +995,8 @@ if __name__ == "__main__":
                           "best_healthy": None, "train_idle": None,
                           "coeff_bytes_shipped_ratio": None, "stages": None,
                           "train_stages": None, "tabular": None,
-                          "ngram": None, "history": "BENCH_HISTORY.jsonl",
+                          "ngram": None, "degradations": None,
+                          "health_verdict": None,
+                          "history": "BENCH_HISTORY.jsonl",
                           "error": "%s: %s" % (type(e).__name__, str(e)[:300])}))
         sys.exit(1)

@@ -8,7 +8,8 @@ forkserver, which fork-bombs unguarded scripts) and never forks a threaded paren
 with ``multiprocessing.connection`` replacing ZeroMQ.
 
 Protocol: parent sends sys.path, the serializer name (an ``shm``-family name is
-followed by the slab-ring attach config — segment names + slab size), then the
+followed by the slab-ring attach config — segment names + slab size), a health
+config dict (``stack_dump_dir`` + ``ping_interval_s``, ISSUE 5), then the
 pickled worker; then items. On the socket wire each item message is
 ``(item, hints)``; on the shm wire it is ``(slab_id_or_None, item, hints)`` —
 the slab is the parent's grant for this item's result (None = ring starved,
@@ -18,6 +19,16 @@ pool reads the NEXT row groups while the current one decodes. Child answers
 ``("ok", kind, nframes, trace_blob)`` followed by ``nframes`` raw frames from the
 wire serializer (pickle-5 out-of-band buffers, Arrow IPC, or a slab descriptor — see
 petastorm_tpu/serializers.py), or ``("exc", exception)``; ``None`` item = shut down.
+
+Health piggyback (ISSUE 5): the child interleaves ``("hb", wall_ts)`` heartbeat
+messages on the same pipe — one right after receiving each item (proves the
+pipe delivered and the child is about to work) and one per ``ping_interval_s``
+while idle in ``poll()`` — and the driver drains them before every result
+header, stamping the child's heartbeat. A child hung inside ``worker(item)``
+sends nothing, so its heartbeat age grows: exactly the stall signal. On
+``stack_dump_dir`` the child registers ``faulthandler`` on ``SIGUSR1`` writing
+all-thread stacks to ``<dir>/stacks-<pid>.txt``, which the parent signals and
+collects into the flight record when the watchdog trips.
 
 ``trace_blob`` is the cross-process trace piggyback (ISSUE 3):
 ``(pid, wall_anchor, perf_anchor, [(name, t0, dur), ...])`` — the child's spans
@@ -60,12 +71,38 @@ def main():
         if shm_wire:
             slab_names, slab_bytes = conn.recv()
             serializer.bind_slabs(slab_names, slab_bytes)
+        health_cfg = conn.recv()
+        ping_s = float(health_cfg.get("ping_interval_s") or 0)
+        dump_dir = health_cfg.get("stack_dump_dir")
+        if dump_dir:
+            # stall-evidence hook: SIGUSR1 → faulthandler dumps ALL thread
+            # stacks (worker + its readahead IO threads) to a parent-readable
+            # file; registration costs nothing until the watchdog signals
+            import faulthandler
+            import signal
+
+            if hasattr(signal, "SIGUSR1"):
+                try:
+                    dump_file = open(
+                        os.path.join(dump_dir, "stacks-%d.txt" % pid), "w")
+                    faulthandler.register(signal.SIGUSR1, file=dump_file,
+                                          all_threads=True)
+                except OSError:
+                    pass  # no dump file = driver stacks only, never a crash
         worker = conn.recv()
         prefetch = getattr(worker, "prefetch", None)
         while True:
+            if ping_s:
+                # idle heartbeat: prove liveness while waiting for work (the
+                # driver drains these; they never interleave with result frames
+                # because this thread is the only sender)
+                while not conn.poll(ping_s):
+                    conn.send(("hb", time.time()))
             msg = conn.recv()
             if msg is None:
                 return
+            if ping_s:
+                conn.send(("hb", time.time()))  # item received, about to work
             if shm_wire:
                 slab_id, item, hints = msg
                 serializer.set_slab(slab_id)
@@ -101,7 +138,7 @@ def main():
             try:
                 worker.close()  # stop the readahead IO pool before exiting
             except Exception:  # noqa: BLE001 — teardown must reach conn.close
-                pass
+                pass  # graftlint: disable=GL-O002 (child exit path: nowhere left to report)
         if serializer is not None and hasattr(serializer, "close"):
             serializer.close()  # detach (never unlink) any attached slabs
         conn.close()

@@ -1,0 +1,70 @@
+"""Observability rule: broad exceptions must not be silently swallowed.
+
+``except Exception: pass`` (and the bare ``except: pass``) is the
+anti-observability pattern: whatever failed — a wire fallback, a cache write,
+a child teardown — leaves no log line, no counter, no flight-recorder event.
+In a pipeline whose production failure mode is "silently limping" (ISSUE 5),
+every swallowed broad exception is a place the degradation log
+(:func:`petastorm_tpu.obs.log.degradation`) should have fired instead: it
+costs one counter increment, warn-onces the log, and mirrors the event into
+any live flight recorder.
+
+GL-O002 flags a handler that (a) catches ``Exception``/``BaseException`` (or
+a tuple containing one, or nothing at all — the bare ``except:``) AND (b) does
+nothing but ``pass``. Narrow handlers (``except OSError: pass`` on a
+best-effort unlink) stay clean — swallowing a *specific* expected error is a
+decision; swallowing everything is a blindfold. Handlers that log, count,
+re-raise, or otherwise act are clean whatever they catch. Genuinely-silent
+teardown paths (interpreter shutdown, best-effort kills) carry an inline
+``# graftlint: disable=GL-O002`` with their justification.
+"""
+from __future__ import annotations
+
+import ast
+
+from petastorm_tpu.analysis.findings import Severity
+from petastorm_tpu.analysis.engine import Rule
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(type_node):
+    """True when the handler's exception spec includes Exception/BaseException
+    (direct name, dotted ``builtins.Exception``, or inside a tuple) — or is
+    absent entirely (bare ``except:``)."""
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(elt) for elt in type_node.elts)
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Attribute):
+        return type_node.attr in _BROAD
+    return False
+
+
+class SilentExceptionSwallowRule(Rule):
+    """GL-O002: ``except Exception: pass`` / bare ``except: pass``."""
+
+    rule_id = "GL-O002"
+    severity = Severity.WARNING
+    description = ("broad exception silently swallowed (except Exception/bare "
+                   "except whose body is only pass)")
+    fix_hint = ("route it through petastorm_tpu.obs.log.degradation(cause, ...) "
+                "so it is counted and greppable, narrow the except to the "
+                "specific expected error, or justify the silence with an "
+                "inline '# graftlint: disable=GL-O002' comment")
+
+    def check(self, tree, ctx):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node.type):
+                continue
+            if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+                what = "bare except" if node.type is None \
+                    else "except %s" % ast.unparse(node.type)
+                yield ctx.finding(
+                    self, node,
+                    "%s swallows every error silently — anti-observability "
+                    "(no log, no counter, no flight-record event)" % what)

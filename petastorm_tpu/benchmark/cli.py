@@ -68,6 +68,13 @@ def main(argv=None):
         from petastorm_tpu.benchmark import io as io_bench
 
         return io_bench.main(argv[1:])
+    if argv and argv[0] == "health":
+        # `petastorm-tpu-bench health ...`: heartbeat-instrumentation overhead
+        # (enabled vs disabled, plus beat/record primitive ns/op) — see
+        # benchmark/health.py
+        from petastorm_tpu.benchmark import health as health_bench
+
+        return health_bench.main(argv[1:])
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("dataset_url")
     parser.add_argument("--batch", action="store_true",

@@ -1,0 +1,187 @@
+"""Health-layer overhead micro-benchmark: heartbeats on vs off.
+
+The ISSUE-5 acceptance bar is that heartbeat instrumentation enabled costs
+≤1% of pipeline throughput. This benchmark measures it two ways:
+
+1. **Primitive cost** — ``Heartbeat.beat`` / ``FlightRecorder.record`` /
+   ``HealthMonitor.observe_worker`` in a tight loop (ns/op). The loader stamps
+   a handful of beats per *batch* (not per row), so even a microsecond-scale
+   beat is noise next to one row group of parquet decode.
+2. **End-to-end** — the same synthetic-parquet loader run (thread pool,
+   ``to_device=False``) with ``health=None`` vs ``health=HealthOptions(...)``,
+   alternating A/B/A/B to cancel drift; the score is the enabled/disabled
+   throughput ratio.
+
+``--smoke`` is the CI preset: tiny dataset, asserts the two modes deliver
+IDENTICAL row sets and that the enabled run produces a parseable health
+report, prints the overhead ratio without asserting it (shared CI cores make
+timing assertions flaky; the measured number lands in docs/observability.md).
+
+Run as ``petastorm-tpu-bench health`` (or ``python -m
+petastorm_tpu.benchmark.cli health``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def _write_dataset(root, files, rows_per_file):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(7)
+    for i in range(files):
+        base = i * rows_per_file
+        table = pa.table({
+            "id": np.arange(base, base + rows_per_file, dtype=np.int64),
+            "x": rng.random(rows_per_file),
+            "y": rng.integers(0, 1000, rows_per_file),
+        })
+        pq.write_table(table, os.path.join(root, "part_%03d.parquet" % i),
+                       row_group_size=max(64, rows_per_file // 8))
+
+
+def _run_epoch(root, batch_size, health):
+    """One full pass; returns (rows, seconds, id checksum, report).
+
+    Only the BATCH LOOP is timed: reader/pool construction, teardown and the
+    on-demand health report are fixed costs amortized over a training run,
+    and folding them into a sub-second benchmark epoch would report setup
+    noise as per-row overhead."""
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    reader = make_batch_reader("file://" + root, num_epochs=1,
+                               reader_pool_type="thread", workers_count=2)
+    rows = 0
+    checksum = 0
+    report = None
+    # last_batch="partial": every row is delivered, so the identity checksum
+    # is order-independent (with "drop" the dropped tail depends on worker
+    # completion order)
+    with DataLoader(reader, batch_size, to_device=False, last_batch="partial",
+                    health=health) as loader:
+        t0 = time.perf_counter()
+        for batch in loader:
+            rows += len(batch["id"])
+            checksum += int(batch["id"].sum())
+        dt = time.perf_counter() - t0
+        if health is not None:
+            report = loader.health_report()
+    return rows, dt, checksum, report
+
+
+def _bench_primitives(iters):
+    """ns/op for the three hot health primitives."""
+    from petastorm_tpu.obs.flight import FlightRecorder
+    from petastorm_tpu.obs.health import HealthMonitor, HealthOptions
+    from petastorm_tpu.obs.metrics import MetricsRegistry
+
+    monitor = HealthMonitor(HealthOptions(poll_interval_s=3600.0),
+                            registry=MetricsRegistry())
+    hb = monitor.register("bench", "worker")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        hb.beat("working")
+    beat_ns = (time.perf_counter() - t0) / iters * 1e9
+    rec = FlightRecorder(1024)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        rec.record("span", name="read", dur_s=0.001)
+    record_ns = (time.perf_counter() - t0) / iters * 1e9
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        monitor.observe_worker(0, 0.001)
+    observe_ns = (time.perf_counter() - t0) / iters * 1e9
+    return {"beat_ns": round(beat_ns, 1), "record_ns": round(record_ns, 1),
+            "observe_worker_ns": round(observe_ns, 1)}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="petastorm-tpu-bench health", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--files", type=int, default=8)
+    parser.add_argument("--rows-per-file", type=int, default=20_000)
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--repeats", type=int, default=3,
+                        help="A/B pairs per mode (alternated to cancel drift)")
+    parser.add_argument("--prim-iters", type=int, default=200_000)
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI preset: tiny dataset, identity + health-report "
+                             "assertions, no timing assertions")
+    args = parser.parse_args(argv)
+    if args.smoke:
+        args.files, args.rows_per_file, args.repeats = 4, 2_000, 2
+        args.prim_iters = 20_000
+
+    from petastorm_tpu.obs.health import HealthOptions
+
+    prims = _bench_primitives(args.prim_iters)
+    print("primitives: beat %.0fns  flight.record %.0fns  observe_worker %.0fns"
+          % (prims["beat_ns"], prims["record_ns"],
+             prims["observe_worker_ns"]))
+
+    with tempfile.TemporaryDirectory(prefix="ptpu-health-bench-") as root:
+        _write_dataset(root, args.files, args.rows_per_file)
+
+        def health_opts():
+            # generous thresholds: the benchmark measures stamping cost, not
+            # stall handling (nothing here should ever trip the watchdog)
+            return HealthOptions(stall_threshold_s=300.0, poll_interval_s=1.0,
+                                 flight_path=os.path.join(root, "flight.json"))
+
+        off_rates = []
+        on_rates = []
+        checksums = set()
+        report = None
+        # warmups, one per mode: page cache, module imports, thread spin-up
+        _run_epoch(root, args.batch_size, None)
+        _run_epoch(root, args.batch_size, health_opts())
+        for _ in range(args.repeats):
+            rows, dt, ck, _ = _run_epoch(root, args.batch_size, None)
+            off_rates.append(rows / dt)
+            checksums.add((rows, ck))
+            rows, dt, ck, report = _run_epoch(root, args.batch_size,
+                                              health_opts())
+            on_rates.append(rows / dt)
+            checksums.add((rows, ck))
+
+        # MEDIAN of per-epoch rates: on a shared/oversubscribed host (CI, this
+        # 2-core container) single epochs swing ±30%, and a mean would let one
+        # descheduled epoch report scheduler noise as instrumentation cost
+        off_rps = float(np.median(off_rates))
+        on_rps = float(np.median(on_rates))
+        overhead = (off_rps - on_rps) / off_rps if off_rps else 0.0
+        result = {
+            "metric": "health_overhead_fraction",
+            "value": round(overhead, 4),
+            "unit": "fraction",
+            "rows_per_sec_disabled": round(off_rps, 1),
+            "rows_per_sec_enabled": round(on_rps, 1),
+            **prims,
+            "smoke": bool(args.smoke),
+        }
+        if args.smoke:
+            # correctness, not timing: both modes deliver the same rows, and
+            # the enabled run can introspect itself
+            assert len(checksums) == 1, \
+                "health on/off delivered different row sets: %s" % checksums
+            assert report is not None and report["heartbeats"], report
+            assert report["stalls_total"] == 0, report["stalls_total"]
+            assert json.dumps(report, default=str)
+            print("smoke: identical rows across modes; health report "
+                  "parseable; %d heartbeat actors" % len(report["heartbeats"]))
+        print(json.dumps(result))
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

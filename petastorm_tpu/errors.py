@@ -29,3 +29,11 @@ class TimeoutWaitingForResultError(PetastormTpuError):
 
 class MetadataError(PetastormTpuError):
     """Dataset metadata missing or malformed (reference: PetastormMetadataError)."""
+
+
+class StallError(PetastormTpuError):
+    """A pipeline actor missed its heartbeat threshold and the health monitor's
+    escalation policy is ``raise`` — the training loop fails fast instead of
+    silently hanging an accelerator slice. The flight record written at
+    detection (``HealthOptions.flight_path``) carries the evidence: driver and
+    child stacks, queue depths, recent pipeline events."""

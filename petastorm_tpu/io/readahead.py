@@ -26,11 +26,72 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import OrderedDict
 
 from petastorm_tpu.io.coalesce import plan_runs
 from petastorm_tpu.obs.log import degradation
 from petastorm_tpu.obs.metrics import default_registry
+
+#: pools whose IO threads must be REAPED before interpreter finalization.
+#: ``shutdown(wait=False)`` is deliberate for Reader.join (a HUNG read must
+#: not block teardown) — but it leaves the IO threads to exit on their own,
+#: and they are daemons (they inherit daemon-ness from the executor worker
+#: thread whose lazy ``prefetch`` built the pool), so nothing joins them
+#: before ``Py_Finalize``. A daemon thread whose thread-state clear is still
+#: destroying its thread-local ``ParquetFile`` cache when finalization begins
+#: re-enters pyarrow, is force-exited mid-C++ (``PyEval_RestoreThread`` →
+#: ``pthread_exit``), and the forced unwind through a noexcept Arrow frame
+#: aborts the whole process ("terminate called without an active exception").
+#: The exit hook therefore shuts every live pool down and JOINS its threads
+#: (bounded — normal reads are milliseconds; a genuinely hung read forfeits
+#: the guarantee after the cap rather than hanging exit forever). It must run
+#: during *threading* shutdown, not module atexit: concurrent.futures joins
+#: every executor thread UNBOUNDEDLY from its own threading-shutdown hook
+#: (``_python_exit``), which fires before any ``atexit`` callback — an atexit
+#: drain would run after the threads are already dead in the normal case and
+#: after a hung read had already wedged ``_python_exit`` in the bad one.
+#: ``threading._register_atexit`` callbacks run in reverse registration
+#: order, and concurrent.futures registered its hook at import time (before
+#: any pool exists), so registering here puts the drain FIRST; threads still
+#: alive when the bounded join expires are detached from concurrent.futures'
+#: bookkeeping so its unbounded join cannot hang exit on them.
+_live_pools_lock = threading.Lock()
+_live_pools = weakref.WeakSet()
+_drain_installed = False
+
+
+def _install_exit_drain():
+    global _drain_installed
+    with _live_pools_lock:
+        if _drain_installed:
+            return
+        _drain_installed = True
+    # force concurrent.futures' own hook to register BEFORE ours — reversed
+    # callback order then runs the drain first, while threads are alive
+    import concurrent.futures.thread  # noqa: F401
+
+    register = getattr(threading, "_register_atexit", None)
+    if register is not None:
+        register(_drain_live_pools)
+    else:  # pragma: no cover - Python < 3.9
+        import atexit
+
+        atexit.register(_drain_live_pools)
+
+
+def _drain_live_pools():
+    with _live_pools_lock:
+        pools = list(_live_pools)
+    deadline = time.monotonic() + 10.0
+    for pool in pools:
+        pool.shutdown()  # cancels pending; only an executing read remains
+    for pool in pools:
+        pool.drain(max(0.1, deadline - time.monotonic()))
+    for pool in pools:
+        pool.join_threads(max(0.1, deadline - time.monotonic()))
+    for pool in pools:
+        pool.abandon_hung_threads()
 
 
 class _CancelledRead(Exception):
@@ -85,6 +146,10 @@ class ReadaheadPool:
         self._held_bytes = 0
         self._closed = False
         self._tracer = None
+        self._health = None  # optional HealthMonitor: per-IO-thread heartbeats
+        self._active_reads = 0
+        self._idle = threading.Event()  # set whenever no read task is running
+        self._idle.set()
         # per-instance tallies for stats() (the registry counters below are
         # process-wide families shared across pools — right for export, wrong
         # for one reader's io_stats())
@@ -118,11 +183,21 @@ class ReadaheadPool:
         self._wait_hist = reg.histogram(
             "ptpu_io_wait_seconds",
             help="foreground wait on an in-flight prefetched read")
+        with _live_pools_lock:
+            _live_pools.add(self)
+        _install_exit_drain()
 
     def set_trace(self, tracer):
         """Attach a :class:`petastorm_tpu.trace.TraceRecorder`: background reads
         record ``io.readahead`` spans, foreground waits ``io.wait``."""
         self._tracer = tracer
+
+    def set_health(self, monitor):
+        """Attach a :class:`petastorm_tpu.obs.health.HealthMonitor`: every IO
+        thread heartbeats per background read (busy while reading, ``wait:``
+        between tasks), so a read hung against a wedged filesystem trips the
+        stall watchdog instead of silently pinning its thread."""
+        self._health = monitor
 
     # -- scheduling ---------------------------------------------------------------------
 
@@ -178,6 +253,26 @@ class ReadaheadPool:
         return len(fresh)
 
     def _read_task(self, pieces, columns):
+        with self._lock:
+            self._active_reads += 1
+            self._idle.clear()
+        try:
+            self._read_task_body(pieces, columns)
+        finally:
+            with self._lock:
+                self._active_reads -= 1
+                if self._active_reads == 0:
+                    self._idle.set()
+
+    def _read_task_body(self, pieces, columns):
+        monitor = self._health
+        hb = None
+        if monitor is not None:
+            # registered per IO thread (names are unique per thread; register
+            # is idempotent so repeat tasks reuse the slot)
+            hb = monitor.register(
+                "io.%s" % threading.current_thread().name, "io")
+            hb.beat("read")
         t0 = time.perf_counter()
         tables = error = None
         try:
@@ -220,6 +315,8 @@ class ReadaheadPool:
             self._evict_over_budget()
             self._depth_gauge.set(self._pending)
             self._bytes_gauge.set(self._held_bytes)
+        if hb is not None:
+            hb.wait("idle")  # parked in the pool queue until the next task
 
     def _evict_over_budget(self):
         """Age out completed, unclaimed entries. Caller MUST hold ``self._lock``
@@ -298,9 +395,51 @@ class ReadaheadPool:
 
     # -- lifecycle ----------------------------------------------------------------------
 
+    def drain(self, timeout_s):
+        """Wait (bounded) until no read task is executing. Returns True when
+        idle."""
+        return self._idle.wait(timeout_s)
+
+    def join_threads(self, timeout_s):
+        """Join the IO threads (bounded) — the process-exit path. The threads
+        are daemons (see the module exit-hook comment), so this is the only
+        join they ever get; it must complete before interpreter finalization
+        or their dying thread-local ``ParquetFile`` cleanup aborts inside
+        pyarrow."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        for t in list(getattr(self._pool, "_threads", ()) or ()):
+            t.join(max(0.05, deadline - time.monotonic()))
+
+    def abandon_hung_threads(self):
+        """Detach still-alive IO threads from interpreter-exit bookkeeping
+        after a bounded join expired: a read hung against a wedged filesystem
+        forfeits the clean-teardown guarantee instead of hanging exit forever.
+        Two unbounded waits would otherwise block on such a thread —
+        concurrent.futures' ``_python_exit`` join (all executor threads,
+        daemon or not), and ``threading._shutdown``'s tstate-lock wait (IO
+        threads spawned from a non-daemon context, e.g. a pool lazily built
+        on the consumer thread)."""
+        try:
+            from concurrent.futures import thread as cf_thread
+
+            for t in list(getattr(self._pool, "_threads", ()) or ()):
+                if not t.is_alive():
+                    continue
+                cf_thread._threads_queues.pop(t, None)
+                lock = getattr(t, "_tstate_lock", None)
+                shutdown_locks = getattr(threading, "_shutdown_locks", None)
+                if lock is not None and shutdown_locks is not None:
+                    with threading._shutdown_locks_lock:
+                        shutdown_locks.discard(lock)
+        except Exception:
+            pass  # graftlint: disable=GL-O002 (best-effort private-API detach at interpreter exit)
+
     def shutdown(self):
         """Cancel pending reads, release waiters, stop the IO threads.
-        Idempotent; the worker calls it from ``close()`` (Reader.join)."""
+        Idempotent; the worker calls it from ``close()`` (Reader.join).
+        Deliberately does NOT wait for an in-flight read (a hung object-store
+        read must not block Reader.join); the module-level exit hook drains
+        in-flight reads before interpreter teardown instead."""
         with self._lock:
             if self._closed:
                 return

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import itertools
 import logging
 import queue
 import threading
@@ -38,6 +39,10 @@ from petastorm_tpu.utils import stack_as_column
 logger = logging.getLogger(__name__)
 
 _SENTINEL = object()
+
+#: per-process pipeline ids for health-scope namespacing: loaders SHARING one
+#: HealthMonitor must not share heartbeat slots (itertools.count is GIL-atomic)
+_pipeline_seq = itertools.count()
 
 
 class PipelineStats:
@@ -176,6 +181,13 @@ class _LoaderObs:
         }
         self._handles = [registry.register_collector(
             "pipeline", self._collect_pipeline)]
+        health = getattr(loader, "_health", None)
+        if health is not None:
+            # health layer (ISSUE 5): heartbeat ages + stalled flags + stall
+            # total export as ptpu_health_* (the monitor's lifetime is tied to
+            # the loader's, and close() unregisters the collector with the rest)
+            self._handles.append(registry.register_collector(
+                "health", health.collect))
         self._loader_ref = weakref.ref(loader)
         wire_stats_fn = getattr(loader.reader, "wire_stats", None)
         if wire_stats_fn is not None:
@@ -505,13 +517,29 @@ class DataLoader:
         metrics-enabled loader per registry at a time (concurrent train + eval
         loaders: one private ``MetricsRegistry`` each). Default None =
         disabled, one ``is None`` check per stage site.
+    health : True, petastorm_tpu.obs.health.HealthOptions or HealthMonitor, optional
+        Active stall monitoring (ISSUE 5): every pipeline actor (this loader's
+        producer and transfer thread, the reader's executor workers and
+        readahead IO threads, process-pool children) stamps a heartbeat, and a
+        watchdog daemon writes a structured **flight record** (driver + child
+        stacks, queue depths, recent events) when a busy actor misses its
+        threshold — backpressure waits never count as stalls. ``True`` =
+        defaults; a :class:`~petastorm_tpu.obs.health.HealthOptions` tunes
+        thresholds/escalation (escalation ``"raise"`` delivers
+        :class:`petastorm_tpu.errors.StallError` to the consumer so training
+        fails fast instead of hanging a TPU slice); an existing
+        :class:`~petastorm_tpu.obs.health.HealthMonitor` is shared (the caller
+        owns its lifecycle). ``PTPU_HEALTH=1`` enables the defaults without
+        code changes. Default None = disabled, one ``is None`` check per
+        site. ``DataLoader.health_report()`` works whenever it is on; with
+        ``metrics=`` heartbeat ages also export as ``ptpu_health_*`` families.
     """
 
     def __init__(self, reader, batch_size, sharding=None, shuffling_queue_capacity=0,
                  seed=None, last_batch="drop", device_transform=None, prefetch=2,
                  to_device=True, host_queue_size=8, pad_shapes=None,
                  device_shuffle_capacity=0, device_decode_resize=None, trace=None,
-                 metrics=None):
+                 metrics=None, health=None):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if last_batch not in ("drop", "pad", "partial"):
@@ -568,6 +596,10 @@ class DataLoader:
         self._transfer_thread = None
         self._stop = threading.Event()
         self._producer_error = None
+        #: False while a watchdog fail-fast StallError is pending but has not
+        #: reached any consumer yet — _start_producer must surface it, not
+        #: silently clear it into an empty epoch
+        self._producer_error_delivered = True
         #: bumped by every _start_producer(); a superseded iterator's finalizer
         #: compares its captured generation before calling stop() so closing/GC-ing
         #: an old iterator cannot kill the pipeline a newer __iter__ armed
@@ -590,6 +622,52 @@ class DataLoader:
         self._ckpt_log = collections.deque()
         self._ckpt_base = None
         self._rows_consumed = 0
+        #: optional petastorm_tpu.obs.health wiring (None = disabled, the
+        #: default): heartbeats on every pipeline actor + the stall watchdog +
+        #: the flight recorder. Built BEFORE _obs so the metrics wiring can
+        #: export the monitor's collector alongside the stage histograms.
+        self._health = None
+        self._health_owned = False
+        self._health_handles = ()
+        self._hb_producer = None   # set by the producer thread while it lives
+        self._hb_transfer = None   # set by the transfer thread while it lives
+        # normalized unconditionally: PTPU_HEALTH=1 must enable monitoring
+        # even when health= was not passed (normalize_health handles every
+        # shape — None + env, True, HealthOptions, a shared HealthMonitor)
+        from petastorm_tpu.obs.health import normalize_health
+
+        self._health, self._health_owned = normalize_health(health)
+        self._health_scope = None
+        if self._health is not None:
+            import weakref
+
+            monitor = self._health
+            if self._health_owned:
+                # exclusive monitor: bare actor names (loader.producer, ...)
+                self._health_scope = monitor
+                scope_prefix = None
+            else:
+                # SHARED monitor: namespace this pipeline's actors so another
+                # loader's healthy stamps cannot mask this one's stall (and
+                # per-worker latency keys stay per-executor)
+                scope_prefix = "pipe%d" % next(_pipeline_seq)
+                self._health_scope = monitor.scoped(scope_prefix)
+            ref = weakref.ref(self)
+            # weak like _LoaderObs: a shared monitor must not pin a dead loader
+            self._health_handles = (
+                monitor.add_context(
+                    "pipeline" if scope_prefix is None
+                    else "pipeline/%s" % scope_prefix,
+                    lambda: (lambda l: l._health_context() if l is not None
+                             else {})(ref())),
+                monitor.add_stall_callback(
+                    lambda err: (lambda l: l._fail_fast(err) if l is not None
+                                 else None)(ref()),
+                    prefix=scope_prefix),
+            )
+            if hasattr(reader, "set_health"):
+                reader.set_health(self._health_scope)
+            monitor.start()
         #: optional petastorm_tpu.obs wiring (None = disabled, the default):
         #: stage latency histograms + pull collectors for the stats/wire gauges
         self._obs = None
@@ -598,6 +676,11 @@ class DataLoader:
 
             registry = metrics if isinstance(metrics, MetricsRegistry) \
                 else default_registry()
+            if self._health is not None and self._health_owned:
+                # a loader-owned monitor exports its per-worker latency
+                # histograms beside the stage histograms (a SHARED monitor
+                # keeps whatever registry its owner configured)
+                self._health.set_registry(registry)
             self._obs = _LoaderObs(registry, self)
 
     # -- producer (background thread: reader → host batches) ---------------------------
@@ -624,6 +707,17 @@ class DataLoader:
         batcher = _HostBatcher(self.local_batch_size, self._shuffling_queue_capacity,
                                self._seed)
         stats = self.stats
+        # health wiring (ISSUE 5): one heartbeat for this producer thread,
+        # stamped at the existing trace/obs sites (disabled = hb is None, one
+        # check per site); the flight ring gets per-delivery span edges.
+        # Registration goes through the SCOPE (namespaced on shared monitors).
+        scope = self._health_scope
+        hb = None
+        flight = None
+        if scope is not None:
+            hb = scope.register("loader.producer", "producer")
+            flight = scope.flight
+        self._hb_producer = hb
         ckpt_cum = 0  # cumulative rows delivered by the reader this generation
         ckpt_deliveries = 0
         ckpt_next_snap = 1
@@ -638,6 +732,8 @@ class DataLoader:
         try:
             it = iter(self.reader)
             while True:
+                if hb is not None:
+                    hb.beat("read")
                 t0 = time.perf_counter()
                 item = next(it, _SENTINEL)
                 dt = time.perf_counter() - t0
@@ -646,6 +742,8 @@ class DataLoader:
                     self._trace.add("reader.next", t0, dt)
                 if self._obs is not None:
                     self._obs.observe("read", dt)
+                if flight is not None:
+                    flight.record("span", name="read", dur_s=round(dt, 6))
                 if item is _SENTINEL:
                     # final snapshot: the all-delivered state must be reachable
                     # even when the throttle skipped the tail deliveries
@@ -679,6 +777,8 @@ class DataLoader:
                     columns = _detach_slab_views(columns)
                 if wire_stats_fn is not None:
                     stats.update_wire(wire_stats_fn())
+                if hb is not None:
+                    hb.beat("batch")
                 t0 = time.perf_counter()
                 if self._pad_shapes:
                     columns = _pad_ragged_columns(columns, self._pad_shapes)
@@ -699,6 +799,9 @@ class DataLoader:
                     self._trace.add("batch.form", t0, dt)
                 if self._obs is not None:
                     self._obs.observe("batch", dt)
+                if flight is not None:
+                    flight.record("span", name="batch", dur_s=round(dt, 6),
+                                  ready=len(ready))
                 if self._ckpt_enabled:
                     ckpt_cum += _batch_row_count(columns)
                     # Snapshot at delivery boundaries (batched reader items ≈ row
@@ -721,7 +824,7 @@ class DataLoader:
                         return
                     if self.last_batch == "pad":
                         batch = self._pad(batch)
-                    if not self._put_batch(q, batch):
+                    if not self._put_batch(q, batch, hb):
                         return
             # tail flush: the same per-batch stop check as the main loop — a stop()
             # during the flush must not leave the producer blocked on an untimed put
@@ -737,17 +840,28 @@ class DataLoader:
                         continue
                 elif self.last_batch == "pad":
                     batch = self._pad(batch)
-                if not self._put_batch(q, batch):
+                if not self._put_batch(q, batch, hb):
                     return
         except Exception as e:  # noqa: BLE001 — surfaced to consumer thread
             self._producer_error = e
+            if flight is not None:
+                flight.record("producer_error", error=repr(e))
         finally:
+            if flight is not None:
+                flight.record("queue", event="producer_end_of_stream")
+            if hb is not None:
+                hb.done()
+            self._hb_producer = None
             _put_sentinel(q, self._stop)
 
-    def _put_batch(self, q, batch):
+    def _put_batch(self, q, batch, hb=None):
         """Producer put into the host queue, timed: blocking here is DOWNSTREAM
         backpressure (decode/transfer/step slower than the producer) — the
-        bottleneck analyzer's consumer-bound signal (``put_wait_s``)."""
+        bottleneck analyzer's consumer-bound signal (``put_wait_s``) and, for
+        the stall watchdog, a ``wait:`` state that must NEVER read as a stall
+        (a full queue means the consumer is the slow one, not this thread)."""
+        if hb is not None:
+            hb.wait("host_queue_put")
         t0 = time.perf_counter()
         ok = _put_with_stop(q, batch, self._stop)
         dt = time.perf_counter() - t0
@@ -756,6 +870,8 @@ class DataLoader:
             self._trace.add("wait.host_queue_put", t0, dt)
         if self._obs is not None:
             self._obs.observe("host_queue_put", dt)
+        if hb is not None:
+            hb.beat("batch")
         return ok
 
     def _pad(self, batch):
@@ -807,7 +923,20 @@ class DataLoader:
                     "long device dispatch); cannot safely start a new iteration")
         self._generation += 1
         self._stop.clear()
+        pending = self._producer_error
+        if pending is not None and not self._producer_error_delivered:
+            from petastorm_tpu.errors import StallError
+
+            if isinstance(pending, StallError):
+                # the watchdog fail-fasted while no consumer was iterating
+                # (pre-iteration or between epochs): the reader is already
+                # stopped/truncated, and the debounced watchdog will not
+                # re-report the same hang — clearing here would turn a
+                # detected stall into a silently empty epoch
+                self._producer_error_delivered = True
+                raise pending
         self._producer_error = None
+        self._producer_error_delivered = True
         self.stats.reset()
         if self._obs is not None:
             # percentiles re-anchor with the totals: bottleneck_report() must
@@ -830,6 +959,9 @@ class DataLoader:
     def _host_batches(self, q):
         stats = self.stats
         while True:
+            hb = self._hb_transfer  # live only while the transfer thread runs
+            if hb is not None:
+                hb.wait("host_queue")  # starvation = upstream's problem
             t0 = time.perf_counter()
             item = q.get()
             dt = time.perf_counter() - t0
@@ -840,6 +972,7 @@ class DataLoader:
                 self._obs.observe("host_queue_wait", dt)
             if item is _SENTINEL:
                 if self._producer_error is not None:
+                    self._producer_error_delivered = True
                     raise self._producer_error
                 return
             stats.batches += 1
@@ -949,6 +1082,9 @@ class DataLoader:
         arrays and the host-only (string/object) columns separately."""
         import jax
 
+        hb = self._hb_transfer
+        if hb is not None:
+            hb.beat("decode")
         t0 = time.perf_counter()
         batch, staged = self._decode_staged(batch)
         dt = time.perf_counter() - t0
@@ -957,6 +1093,8 @@ class DataLoader:
             self._trace.add("decode.dispatch", t0, dt)
         if self._obs is not None:
             self._obs.observe("decode", dt)
+        if hb is not None:
+            hb.beat("h2d")
         t0 = time.perf_counter()
         device = {k: v for k, v in batch.items() if _is_device_dtype(v)}
         host = {k: v for k, v in batch.items() if k not in device}
@@ -1102,15 +1240,27 @@ class DataLoader:
         transfer_error = []
 
         def _transfer():
+            scope = self._health_scope
+            hb = None
+            if scope is not None:
+                hb = scope.register("loader.transfer", "transfer")
+                self._hb_transfer = hb
             try:
                 for batch_rows in self._device_batches(host_q):
                     if self._stop.is_set():
                         return
+                    if hb is not None:
+                        # a full device queue means the TRAINING STEP is the
+                        # slow one — a wait, never a stall
+                        hb.wait("device_queue_put")
                     if not _put_with_stop(dev_q, batch_rows, self._stop):
                         return
             except Exception as e:  # noqa: BLE001 — surfaced to consumer thread
                 transfer_error.append(e)
             finally:
+                if hb is not None:
+                    hb.done()
+                self._hb_transfer = None
                 _put_sentinel(dev_q, self._stop)
 
         self._transfer_thread = threading.Thread(
@@ -1132,6 +1282,14 @@ class DataLoader:
                     finished = True
                     if transfer_error:
                         raise transfer_error[0]
+                    if self._producer_error is not None:
+                        # normally the transfer thread re-raises the producer's
+                        # error through _host_batches, but a watchdog fail-fast
+                        # (StallError) injects the sentinel DIRECTLY into this
+                        # queue — the error must still surface, not silently
+                        # end the epoch
+                        self._producer_error_delivered = True
+                        raise self._producer_error
                     return
                 batch, local_rows = item
                 self._advance_consumed(local_rows)
@@ -1146,6 +1304,65 @@ class DataLoader:
 
     # -- lifecycle ----------------------------------------------------------------------
 
+    def _fail_fast(self, err):
+        """Stall-watchdog escalation (``escalation="raise"``): surface ``err``
+        to the consumer and unwedge every queue — the training loop gets a
+        :class:`~petastorm_tpu.errors.StallError` instead of hanging. The
+        reader is stopped too (truncation semantics, same as a user ``stop()``)
+        so a producer blocked inside ``reader.next`` wakes promptly; a worker
+        thread wedged in native code stays behind as a daemon and is reported
+        by the executor's ``thread_join_timeout`` degradation at join."""
+        self._producer_error = err
+        self._producer_error_delivered = False
+        try:
+            self.reader.stop()
+        except Exception:  # noqa: BLE001 — fail-fast must not die on teardown
+            pass  # graftlint: disable=GL-O002 (the StallError itself is the signal)
+        self.stop()
+
+    def _health_context(self):
+        """Queue depths + stats + io gauges, snapshotted into flight records
+        (the watchdog's evidence of WHERE the pipeline was backed up)."""
+        q = self._queue
+        dq = self._dev_queue
+        out = {
+            "host_queue_depth": q.qsize() if q is not None else 0,
+            "host_queue_size": max(2, self._host_queue_size),
+            "device_queue_depth": dq.qsize() if dq is not None else 0,
+            "device_queue_size": max(1, self.prefetch),
+            "stats": self.stats.snapshot(),
+        }
+        for name in ("io_stats", "wire_stats"):
+            fn = getattr(self.reader, name, None)
+            if fn is not None:
+                try:
+                    polled = fn()
+                except Exception:  # noqa: BLE001 — evidence is best-effort
+                    polled = None
+                if polled:
+                    out[name.replace("_stats", "")] = polled
+        return out
+
+    def health_report(self, dump_path=None):
+        """On-demand health snapshot (requires the loader to have been built
+        with ``health=``): the full flight-record dict — heartbeat ages and
+        states, driver (and pool-child) stacks, queue depths, degradation
+        counts, per-worker latency, the recent-event ring — plus the
+        bottleneck analyzer's verdict under ``"bottleneck"``. Pass
+        ``dump_path`` to also write it as a JSON flight record."""
+        if self._health is None:
+            raise ValueError(
+                "DataLoader was built without health monitoring — pass "
+                "health=True (or a HealthOptions/HealthMonitor, or set "
+                "PTPU_HEALTH=1) to enable health_report()")
+        report = self._health.capture("on_demand")
+        report["bottleneck"] = self.bottleneck_report().to_dict()
+        if dump_path is not None:
+            from petastorm_tpu.obs.flight import write_flight_record
+
+            write_flight_record(dump_path, report)
+        return report
+
     def stop(self):
         self._stop.set()
         for q in (self._queue, self._dev_queue):
@@ -1158,7 +1375,7 @@ class DataLoader:
                     while True:
                         q.get_nowait()
                 except Exception:  # noqa: BLE001
-                    pass
+                    pass  # graftlint: disable=GL-O002 (interpreter teardown: queue globals may be None)
                 # the drain may have consumed the producer's end-of-stream sentinel
                 # while the downstream thread is blocked in an untimed get() with the
                 # producer already exited (ADVICE r2 teardown race) — re-put it so the
@@ -1168,7 +1385,7 @@ class DataLoader:
                 try:
                     q.put_nowait(_SENTINEL)
                 except Exception:  # noqa: BLE001
-                    pass
+                    pass  # graftlint: disable=GL-O002 (interpreter teardown: queue globals may be None)
 
     def join(self):
         if self._producer is not None:
@@ -1254,6 +1471,22 @@ class DataLoader:
         self.reader.join()
         if self._obs is not None:
             self._obs.close()
+        if self._health is not None:
+            monitor = self._health
+            context_handle, stall_handle = self._health_handles or (None, None)
+            if context_handle is not None:
+                monitor.remove_context(context_handle)
+            if stall_handle is not None:
+                monitor.remove_stall_callback(stall_handle)
+            self._health_handles = ()
+            if self._health_owned:
+                # a SHARED monitor (health=HealthMonitor(...)) stays running —
+                # its owner tears it down; one the loader built is retired here
+                monitor.stop()
+            elif self._health_scope is not None:
+                # shared monitor: retire this pipeline's scoped actors so
+                # closed loader generations don't accumulate on it forever
+                self._health_scope.close()
 
 
 def _put_with_stop(q, item, stop_event):
@@ -1818,7 +2051,7 @@ _UNSET = object()
 #: re-stated here).
 _LOADER_OPTS = ("last_batch", "device_transform", "prefetch", "pad_shapes",
                 "device_shuffle_capacity", "to_device", "host_queue_size",
-                "device_decode_resize", "trace", "metrics")
+                "device_decode_resize", "trace", "metrics", "health")
 
 
 def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1,
@@ -1827,7 +2060,7 @@ def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1
                     pad_shapes=_UNSET, device_shuffle_capacity=_UNSET,
                     to_device=_UNSET, host_queue_size=_UNSET,
                     device_decode_resize=_UNSET, trace=_UNSET, metrics=_UNSET,
-                    **reader_kwargs):
+                    health=_UNSET, **reader_kwargs):
     """One-call convenience: ``make_batch_reader`` + :class:`DataLoader`.
 
     ``reader_kwargs`` pass through to :func:`petastorm_tpu.reader.make_batch_reader`
@@ -1846,8 +2079,9 @@ def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1
             if jax.process_count() > 1:
                 reader_kwargs["cur_shard"] = jax.process_index()
                 reader_kwargs["shard_count"] = jax.process_count()
-        except Exception:  # noqa: BLE001 — jax optional for host-only use
-            pass
+        except Exception as e:  # noqa: BLE001 — jax optional for host-only use
+            logger.debug("jax process topology unavailable (%s); reader "
+                         "sharding left to explicit kwargs", e)
     reader = factory(dataset_url_or_urls, num_epochs=num_epochs, **reader_kwargs)
     seed = reader_kwargs.get("seed")
     if seed is None:

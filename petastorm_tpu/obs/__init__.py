@@ -14,7 +14,16 @@ Three pillars on one substrate:
 :mod:`petastorm_tpu.obs.log` routes warn-once degradation messages (shm wire
 fallbacks, worker deaths, join timeouts) through one structured logger with a
 ``ptpu_degradations_total{cause=...}`` counter per cause.
+
+The ACTIVE layer (ISSUE 5) sits on top: :mod:`petastorm_tpu.obs.health` stamps
+per-actor heartbeats through the whole pipeline and runs a backpressure-aware
+stall watchdog; :mod:`petastorm_tpu.obs.flight` keeps the bounded event ring
+dumped as a structured flight record on stall, crash, or demand
+(``DataLoader.health_report()``); ``petastorm-tpu-stats --watch`` renders it
+all as a live terminal dashboard.
 """
+from petastorm_tpu.obs.flight import FlightRecorder
+from petastorm_tpu.obs.health import HealthMonitor, HealthOptions
 from petastorm_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -23,5 +32,6 @@ from petastorm_tpu.obs.metrics import (
     default_registry,
 )
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+__all__ = ["Counter", "FlightRecorder", "Gauge", "HealthMonitor",
+           "HealthOptions", "Histogram", "MetricsRegistry",
            "default_registry"]

@@ -26,6 +26,11 @@ Verdicts (the ISSUE-3 taxonomy):
   starvation on the shm wire (``shm_acquire_wait_s`` rivals ``read_s``, or most
   items fell back to the socket): the ring, not the readers, is the limiter.
   Fix: more/bigger slabs, release batches sooner.
+- ``straggler`` — a producer-bound pipeline whose reader time is actually ONE
+  slow worker: the per-worker latency histograms (recorded when a health
+  monitor is attached, ISSUE 5) show one worker's mean item latency far above
+  its peers' — a bad disk, a hot row-group shard, a throttled child. Fix: look
+  at that worker's host/shard, enable work stealing, or drop the worker.
 - ``balanced`` — no stage dominates (utilizations within tolerance), and
   ``idle`` — not enough data to judge.
 
@@ -43,11 +48,12 @@ import dataclasses
 class BottleneckReport:
     """Analyzer output: machine-readable verdict + human-readable rendering."""
 
-    verdict: str  # producer-bound | consumer-bound | wire-bound | balanced | idle
+    verdict: str  # producer-bound | consumer-bound | wire-bound | straggler | balanced | idle
     utilization: dict  # side -> work/(work+wait) fraction
     detail: dict       # the inputs the verdict was computed from
     reason: str
     percentiles: dict | None = None  # stage -> {p50, p90, p99}, when metrics on
+    straggler: dict | None = None    # {worker, mean_s, peer_median_s, ratio}, when detected
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -72,6 +78,12 @@ class BottleneckReport:
         if d.get("device_queue_wait_s") is not None:
             lines.append("  training loop starved %.3fs on the device queue"
                          % d["device_queue_wait_s"])
+        if self.straggler:
+            s = self.straggler
+            lines.append("  straggler: worker %s mean %.1fms vs peer median "
+                         "%.1fms (%.1fx)"
+                         % (s["worker"], s["mean_s"] * 1e3,
+                            s["peer_median_s"] * 1e3, s["ratio"]))
         if self.percentiles:
             for stage in sorted(self.percentiles):
                 p = self.percentiles[stage]
@@ -90,11 +102,44 @@ _MARGIN = 0.15
 #: slab-wait share of reader time above which producer-bound refines to
 #: wire-bound (the readers are mostly waiting for slabs, not reading)
 _WIRE_SHARE = 0.5
+#: a worker whose mean item latency exceeds its peers' median by this factor
+#: (with enough samples on both sides) is a straggler
+_STRAGGLER_RATIO = 3.0
+#: minimum per-worker item count before its mean is trusted at all
+_STRAGGLER_MIN_ITEMS = 4
 
 
-def analyze_snapshot(snap, percentiles=None):
+def detect_straggler(worker_latency, ratio=_STRAGGLER_RATIO,
+                     min_items=_STRAGGLER_MIN_ITEMS):
+    """One slow worker among peers, or ``None``.
+
+    ``worker_latency`` is ``{worker key: histogram summary}`` (the
+    ``HealthMonitor.worker_latency()`` shape — needs ``count`` and ``mean``).
+    A straggler verdict needs at least two workers with ``min_items`` each:
+    the slowest worker's mean must exceed the MEDIAN of the others' means by
+    ``ratio`` (median, not mean, so one straggler cannot drag the baseline up
+    with it)."""
+    eligible = {k: s for k, s in (worker_latency or {}).items()
+                if s.get("count", 0) >= min_items and s.get("mean", 0) > 0}
+    if len(eligible) < 2:
+        return None
+    slowest = max(eligible, key=lambda k: eligible[k]["mean"])
+    peers = sorted(eligible[k]["mean"] for k in eligible if k != slowest)
+    peer_median = peers[len(peers) // 2]
+    if peer_median <= 0 or eligible[slowest]["mean"] < ratio * peer_median:
+        return None
+    return {"worker": str(slowest),
+            "mean_s": round(eligible[slowest]["mean"], 6),
+            "peer_median_s": round(peer_median, 6),
+            "ratio": round(eligible[slowest]["mean"] / peer_median, 2),
+            "items": eligible[slowest].get("count", 0)}
+
+
+def analyze_snapshot(snap, percentiles=None, worker_latency=None):
     """Analyze one ``PipelineStats.snapshot()``-shaped dict (shm gauges
-    optional) into a :class:`BottleneckReport`."""
+    optional) into a :class:`BottleneckReport`. ``worker_latency`` (the
+    per-worker histogram summaries a health monitor records) refines a
+    producer-bound verdict to ``straggler`` when one worker limits the pack."""
     read_s = snap.get("read_s", 0.0)
     batch_s = snap.get("batch_s", 0.0)
     put_wait_s = snap.get("put_wait_s", 0.0)
@@ -139,6 +184,16 @@ def analyze_snapshot(snap, percentiles=None):
                 "reader time is dominated by waiting for free shm slabs "
                 "(%.3fs slab wait vs %.3fs read) — grow the ring or release "
                 "batches sooner" % (wire_wait_s, read_s), percentiles)
+        straggler = detect_straggler(worker_latency)
+        if straggler is not None:
+            return BottleneckReport(
+                "straggler", utilization, detail,
+                "the reader side is limited by ONE slow worker: worker %s "
+                "averages %.1fms per item vs a %.1fms peer median (%.1fx) — "
+                "check its host/shard, or rely on work stealing"
+                % (straggler["worker"], straggler["mean_s"] * 1e3,
+                   straggler["peer_median_s"] * 1e3, straggler["ratio"]),
+                percentiles, straggler=straggler)
         return BottleneckReport(
             "producer-bound", utilization, detail,
             "the reader side is saturated (%.0f%% busy) while the consumer "
@@ -159,7 +214,8 @@ def analyze_snapshot(snap, percentiles=None):
 def analyze_loader(loader):
     """:func:`analyze_snapshot` over a live ``DataLoader`` — the implementation
     behind ``DataLoader.bottleneck_report()`` (stage percentiles attached when
-    the loader was built with ``metrics=``)."""
+    the loader was built with ``metrics=``, per-worker straggler detection when
+    it was built with ``health=``)."""
     snap = loader.stats.snapshot()
     percentiles = None
     obs = getattr(loader, "_obs", None)
@@ -169,4 +225,9 @@ def analyze_loader(loader):
             s = hist.snapshot()
             percentiles[stage] = {"p50": s["p50"], "p90": s["p90"],
                                   "p99": s["p99"]}
-    return analyze_snapshot(snap, percentiles=percentiles)
+    # the SCOPE (not the monitor): on a shared monitor the straggler detector
+    # must compare peers within THIS pipeline's executor only
+    scope = getattr(loader, "_health_scope", None)
+    worker_latency = scope.worker_latency() if scope is not None else None
+    return analyze_snapshot(snap, percentiles=percentiles,
+                            worker_latency=worker_latency)

@@ -18,6 +18,7 @@ import itertools
 import json
 import os
 import re
+import sys
 import threading
 import time
 
@@ -97,12 +98,53 @@ def parse_prometheus_text(text):
     return samples
 
 
+#: live Reporters flushed by the crash hooks (ISSUE 5 satellite): a run that
+#: dies mid-interval — unhandled exception or plain interpreter exit — must
+#: not lose its final JSONL/Prometheus window. start() registers, stop()
+#: removes; the hooks themselves are installed once per process.
+_live_lock = threading.Lock()
+_live_reporters = []
+_hooks_installed = False
+
+
+def _flush_live_reporters():
+    with _live_lock:
+        reporters = list(_live_reporters)
+    for reporter in reporters:
+        try:
+            reporter._write_once()
+        except OSError:
+            pass  # a dying process's disk may be the reason it is dying
+
+
+def _install_exit_hooks():
+    """atexit + sys.excepthook (chained), installed once per process."""
+    global _hooks_installed
+    with _live_lock:
+        if _hooks_installed:
+            return
+        _hooks_installed = True
+    import atexit
+
+    atexit.register(_flush_live_reporters)
+    previous = sys.excepthook
+
+    def _flushing_excepthook(exc_type, exc, tb):
+        _flush_live_reporters()
+        previous(exc_type, exc, tb)
+
+    sys.excepthook = _flushing_excepthook
+
+
 class Reporter:
     """Background snapshot thread: JSONL stream and/or Prometheus file.
 
     Daemonized and stop-event driven (never blocks interpreter exit); one
     final snapshot is flushed on :meth:`stop` so short runs still leave a
-    record. Use as a context manager around the serving loop::
+    record — and, while the reporter is live, on interpreter exit and on an
+    unhandled exception (atexit + a chained ``sys.excepthook``), so a run
+    that dies mid-interval still leaves its final window on disk. Use as a
+    context manager around the serving loop::
 
         with Reporter(jsonl_path="run_stats.jsonl", interval_s=2.0):
             for batch in loader: ...
@@ -138,6 +180,10 @@ class Reporter:
 
     def start(self):
         self._stop_event.clear()
+        _install_exit_hooks()
+        with _live_lock:
+            if self not in _live_reporters:
+                _live_reporters.append(self)
         self._thread = threading.Thread(target=self._run, name="ptpu-obs-report",
                                         daemon=True)
         self._thread.start()
@@ -145,6 +191,9 @@ class Reporter:
 
     def stop(self):
         self._stop_event.set()
+        with _live_lock:
+            if self in _live_reporters:
+                _live_reporters.remove(self)
         thread, self._thread = self._thread, None
         if thread is not None:
             thread.join(timeout=10)

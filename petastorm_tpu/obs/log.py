@@ -18,15 +18,18 @@ goes through :func:`degradation`:
 Known causes (the stable label values; see docs/observability.md):
 ``shm_unsupported``, ``shm_ring_create_failed``, ``shm_view_copyout``,
 ``worker_died``, ``respawn_failed``, ``thread_join_timeout``,
-``unsharded_decode`` — and, from the async read path (ISSUE 4),
+``unsharded_decode`` — from the async read path (ISSUE 4),
 ``readahead_unavailable``, ``readahead_fallback``, ``memcache_oversized``,
-``disk_cache``.
+``disk_cache`` — and, from the health layer (ISSUE 5), ``stall_detected`` (a
+pipeline actor missed its heartbeat threshold) and ``arrow_fallback`` (an
+Arrow-expressible batch failed IPC encode and rode the pickle wire instead).
 """
 from __future__ import annotations
 
 import logging
 import threading
 
+from petastorm_tpu.obs import flight as _flight
 from petastorm_tpu.obs.metrics import default_registry
 
 logger = logging.getLogger("petastorm_tpu.obs")
@@ -57,8 +60,15 @@ def degradation(cause, message, *args, once=True, level=logging.WARNING):
     ``once=False`` logs every time (worker deaths, where each event matters).
     Repeat calls for a known cause cost one ``Counter.inc()`` — per-item
     degradation paths (shm view copy-out) stay cheap.
+
+    When a health monitor is live (ISSUE 5), every occurrence is also mirrored
+    into its flight-recorder ring so the record written at a stall/crash shows
+    which degradations led up to it (one deque append; no monitor = one empty
+    list from :func:`petastorm_tpu.obs.flight.active_recorders`).
     """
     _counter(cause).inc()
+    for recorder in _flight.active_recorders():
+        recorder.record("degradation", cause=cause)
     if once:
         with _lock:
             if cause in _announced:

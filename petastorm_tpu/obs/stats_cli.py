@@ -1,14 +1,16 @@
-"""``petastorm-tpu-stats``: pretty-print a live run's metrics snapshot.
+"""``petastorm-tpu-stats``: live terminal dashboard for a run's metrics.
 
 Reads what a :class:`petastorm_tpu.obs.export.Reporter` writes — a JSONL
 snapshot stream (last line wins, so it works against a file another process is
-appending to) or a Prometheus text file — groups the families, summarizes the
-histograms as p50/p90/p99, and, when the pipeline stage families are present,
-prints the bottleneck analyzer's verdict.
+appending to) or a Prometheus text file — and renders one dashboard frame:
+stage latency percentiles, queue depths, heartbeat ages (with stalled actors
+flagged), per-worker latencies, degradation counts, and the bottleneck
+analyzer's verdict (``straggler`` included when per-worker data is present).
 
-    petastorm-tpu-stats run_stats.jsonl
-    petastorm-tpu-stats --watch 2 run_stats.jsonl   # redraw every 2s
-    petastorm-tpu-stats metrics.prom
+    petastorm-tpu-stats run_stats.jsonl            # one frame
+    petastorm-tpu-stats --watch run_stats.jsonl    # redraw every 2s
+    petastorm-tpu-stats --watch 0.5 metrics.prom   # redraw every 0.5s
+    petastorm-tpu-stats --watch --once stats.jsonl # render ONE watch frame (CI)
 
 Exit codes: 0 printed a snapshot, 1 no snapshot found / unreadable file.
 """
@@ -16,7 +18,9 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import sys
+import time
 
 
 def _load_snapshot(path):
@@ -32,7 +36,74 @@ def _load_snapshot(path):
         obj = read_latest_jsonl_snapshot(path)
         return None if obj is None else obj["metrics"]
     with open(path, "r") as f:
-        return parse_prometheus_text(f.read())
+        return _fold_prom_histograms(parse_prometheus_text(f.read()))
+
+
+_BUCKET_RE = re.compile(r"^(?P<name>\w+)_bucket(?P<labels>\{.*\})$")
+
+
+def _fold_prom_histograms(samples):
+    """Collapse Prometheus ``_bucket``/``_sum``/``_count`` sample triplets into
+    the same summary-dict shape JSONL snapshots carry (count/sum/mean/p50/p90/
+    p99), so the renderer has ONE histogram representation."""
+    out = {}
+    hists = {}  # base full name (labels minus le) -> [(upper, cumulative)]
+    for name, value in samples.items():
+        m = _BUCKET_RE.match(name)
+        if m:
+            # anchor `le` as a whole label name: an unanchored match would
+            # also hit inside labels merely ENDING in le (handle=, role=)
+            labels = re.sub(r',le="[^"]*"|(?<=\{)le="[^"]*",?', "",
+                            m.group("labels"))
+            labels = "" if labels == "{}" else labels
+            base = m.group("name") + labels
+            le = re.search(r'(?<=[{,])le="([^"]*)"', name).group(1)
+            upper = float("inf") if le == "+Inf" else float(le)
+            hists.setdefault(base, []).append((upper, value))
+            continue
+        out[name] = value
+    for base, buckets in hists.items():
+        count = out.pop(base + "_count", None)
+        total = out.pop(base + "_sum", 0.0)
+        # reconstruct labeled _count/_sum keys too (labels ride on base)
+        if count is None:
+            bare = re.match(r"^(\w+)(\{.*\})?$", base)
+            count = out.pop("%s_count%s" % (bare.group(1), bare.group(2) or ""),
+                            None)
+            total = out.pop("%s_sum%s" % (bare.group(1), bare.group(2) or ""),
+                            0.0)
+        buckets.sort()
+        if count is None:
+            count = buckets[-1][1] if buckets else 0
+        summary = {"count": int(count), "sum": total,
+                   "mean": (total / count) if count else 0.0}
+        for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            target = q * count
+            val = 0.0
+            prev_finite = 0.0
+            if count:
+                for upper, cum in buckets:
+                    if cum >= target:
+                        # +Inf bucket matches: report the last finite bound
+                        val = prev_finite if upper == float("inf") else upper
+                        break
+                    if upper != float("inf"):
+                        prev_finite = upper
+            summary[key] = val
+        out[base] = summary
+    return out
+
+
+def _labeled(metrics, family):
+    """``{label value: metric value}`` for one single-label family."""
+    out = {}
+    prefix = family + "{"
+    for name, value in metrics.items():
+        if name.startswith(prefix):
+            m = re.search(r'="([^"]*)"', name)
+            if m:
+                out[m.group(1)] = value
+    return out
 
 
 def _pipeline_stats_from(metrics):
@@ -47,49 +118,149 @@ def _pipeline_stats_from(metrics):
     return snap or None
 
 
-def _render(metrics):
+def _fmt_ms(v):
+    return "%8.2f" % (v * 1e3)
+
+
+def render_dashboard(metrics, title=""):
+    """One dashboard frame (a plain string — the CLI prints it, tests assert
+    on it). Sections appear only when their families are present, so the same
+    renderer serves a bare-metrics run and a full health-enabled one."""
     lines = []
-    scalars = []
-    hists = []
-    for name in sorted(metrics):
-        value = metrics[name]
-        if isinstance(value, dict):  # histogram summary from a JSONL snapshot
-            hists.append((name, value))
-        else:
-            scalars.append((name, value))
-    width = max((len(n) for n, _v in scalars), default=0)
-    for name, value in scalars:
-        if isinstance(value, float) and not value.is_integer():
-            lines.append("%-*s %12.4f" % (width, name, value))
-        else:
-            lines.append("%-*s %12d" % (width, name, int(value)))
+    if title:
+        lines.append(title)
+        lines.append("=" * min(78, max(20, len(title))))
+
+    snap = _pipeline_stats_from(metrics)
+    worker_lat = _labeled(metrics, "ptpu_worker_item_seconds")
+    worker_lat = {k: v for k, v in worker_lat.items() if isinstance(v, dict)}
+
+    # -- verdict first: the one line an operator reads under pager pressure
+    # (computed ONCE per frame; the utilization detail rides right below it)
+    if snap is not None and snap.get("batches"):
+        from petastorm_tpu.obs.analyze import analyze_snapshot
+
+        report = analyze_snapshot(snap, worker_latency=worker_lat or None)
+        lines.append("verdict: %s" % report.verdict)
+        lines.append("  %s" % report.reason)
+        if report.utilization:
+            lines.append("  utilization: " + "  ".join(
+                "%s %.0f%%" % (side, 100 * report.utilization[side])
+                for side in sorted(report.utilization)))
+
+    # -- pipeline counters + queue depths
+    if snap is not None:
+        lines.append("pipeline: rows=%d batches=%d  host_queue=%d  "
+                     "device_queue=%d"
+                     % (snap.get("rows", 0), snap.get("batches", 0),
+                        snap.get("host_queue_depth", 0),
+                        snap.get("device_queue_depth", 0)))
+
+    # -- stage latency percentiles
+    stages = _labeled(metrics, "ptpu_pipeline_stage_seconds")
+    stages = {k: v for k, v in stages.items() if isinstance(v, dict)}
+    if stages:
+        lines.append("stage latencies (ms):   %8s %8s %8s %8s"
+                     % ("p50", "p90", "p99", "count"))
+        for stage in sorted(stages):
+            s = stages[stage]
+            lines.append("  %-20s %s %s %s %8d"
+                         % (stage, _fmt_ms(s.get("p50", 0)),
+                            _fmt_ms(s.get("p90", 0)), _fmt_ms(s.get("p99", 0)),
+                            s.get("count", 0)))
+
+    # -- per-worker latency (straggler fodder)
+    if worker_lat:
+        from petastorm_tpu.obs.analyze import detect_straggler
+
+        straggler = detect_straggler(worker_lat)
+        parts = []
+        for w in sorted(worker_lat):
+            s = worker_lat[w]
+            flag = " [STRAGGLER]" if straggler \
+                and straggler["worker"] == str(w) else ""
+            parts.append("w%s %.1fms×%d%s"
+                         % (w, s.get("mean", 0) * 1e3, s.get("count", 0), flag))
+        lines.append("workers: " + "  ".join(parts))
+
+    # -- heartbeats (the health layer's export)
+    ages = {name[len("ptpu_health_hb_age_s_"):]: v
+            for name, v in metrics.items()
+            if name.startswith("ptpu_health_hb_age_s_")}
+    if ages:
+        stalled = {name[len("ptpu_health_hb_stalled_"):]: v
+                   for name, v in metrics.items()
+                   if name.startswith("ptpu_health_hb_stalled_")}
+        parts = []
+        for actor in sorted(ages, key=lambda a: -ages[a]):
+            flag = " [STALLED]" if stalled.get(actor) else ""
+            parts.append("%s %.1fs%s" % (actor, ages[actor], flag))
+        lines.append("heartbeat ages: " + "  ".join(parts))
+        stalls = metrics.get("ptpu_health_stalls_total", 0)
+        if stalls:
+            lines.append("stalls detected: %d (see the flight record)"
+                         % int(stalls))
+
+    # -- degradations by cause
+    degr = _labeled(metrics, "ptpu_degradations_total")
+    degr = {k: v for k, v in degr.items() if v}
+    if degr:
+        lines.append("degradations (ptpu_degradations_total): " + "  ".join(
+            "%s=%d" % (c, degr[c]) for c in sorted(degr)))
+
+    # -- everything else, compact (numbers only; histogram summaries as p50s)
+    shown_prefixes = ("ptpu_pipeline_", "ptpu_worker_item_seconds",
+                      "ptpu_health_", "ptpu_degradations_total")
+    rest = {n: v for n, v in metrics.items()
+            if not n.startswith(shown_prefixes)}
+    scalars = [(n, v) for n, v in sorted(rest.items())
+               if isinstance(v, (int, float))]
+    hists = [(n, v) for n, v in sorted(rest.items()) if isinstance(v, dict)]
+    if scalars:
+        width = max(len(n) for n, _v in scalars)
+        lines.append("other metrics:")
+        for name, value in scalars:
+            if isinstance(value, float) and not float(value).is_integer():
+                lines.append("  %-*s %12.4f" % (width, name, value))
+            else:
+                lines.append("  %-*s %12d" % (width, name, int(value)))
     for name, h in hists:
-        lines.append("%s  count=%d  mean=%.2fms  p50=%.2fms  p90=%.2fms  "
+        lines.append("  %s  count=%d mean=%.2fms p50=%.2fms p90=%.2fms "
                      "p99=%.2fms"
                      % (name, h.get("count", 0), h.get("mean", 0.0) * 1e3,
                         h.get("p50", 0.0) * 1e3, h.get("p90", 0.0) * 1e3,
                         h.get("p99", 0.0) * 1e3))
-    snap = _pipeline_stats_from(metrics)
-    if snap is not None and snap.get("batches"):
-        from petastorm_tpu.obs.analyze import analyze_snapshot
-
-        lines.append("")
-        lines.append(analyze_snapshot(snap).render())
     return "\n".join(lines)
 
 
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="petastorm-tpu-stats",
-        description="Pretty-print a petastorm_tpu metrics snapshot "
+        description="Live dashboard for a petastorm_tpu metrics snapshot "
                     "(Reporter JSONL stream or Prometheus text file).")
     parser.add_argument(
         "path", nargs="?",
         default=os.environ.get("PTPU_STATS_PATH", "ptpu_stats.jsonl"),
         help="snapshot file (default: $PTPU_STATS_PATH or ./ptpu_stats.jsonl)")
-    parser.add_argument("--watch", type=float, metavar="SECONDS", default=None,
-                        help="redraw every SECONDS until interrupted")
+    parser.add_argument("--watch", nargs="?", metavar="SECONDS",
+                        const=2.0, default=None,
+                        help="redraw every SECONDS (default 2) until "
+                             "interrupted")
+    parser.add_argument("--once", action="store_true",
+                        help="render exactly one frame and exit (with --watch: "
+                             "one watch-mode frame, no screen clear — the CI "
+                             "render check)")
     args = parser.parse_args(argv)
+    if isinstance(args.watch, str):
+        # `--watch FILE` (the documented default-interval form): argparse's
+        # greedy nargs="?" consumes the path as the SECONDS value — reclaim it
+        try:
+            args.watch = float(args.watch)
+        except ValueError:
+            if args.path != parser.get_default("path"):
+                parser.error("invalid --watch interval: %r" % args.watch)
+            args.path = args.watch
+            args.watch = 2.0
 
     def show():
         try:
@@ -102,16 +273,17 @@ def main(argv=None):
             print("petastorm-tpu-stats: no snapshot in %s yet" % args.path,
                   file=sys.stderr)
             return 1
-        print(_render(metrics))
+        title = "petastorm-tpu-stats · %s · %s" % (
+            args.path, time.strftime("%H:%M:%S"))
+        print(render_dashboard(metrics, title=title))
         return 0
 
-    if args.watch is None:
+    if args.watch is None or args.once:
         return show()
-    import time
-
     try:
         while True:
-            os.system("clear" if os.name == "posix" else "cls")
+            # ANSI clear+home (no os.system shell-out): redraw in place
+            sys.stdout.write("\x1b[2J\x1b[H")
             show()
             time.sleep(max(0.2, args.watch))
     except KeyboardInterrupt:

@@ -661,8 +661,18 @@ static int decode_impl(const uint8_t* data, int64_t len, PtpuJpegCoeffs* out,
           rc = PTPU_JPEG_CORRUPT;
           goto done;
         }
-        comps[found].dc_tbl = seg[2 + 2 * i] >> 4;
-        comps[found].ac_tbl = seg[2 + 2 * i] & 0xF;
+        int td = seg[2 + 2 * i] >> 4;
+        int ta = seg[2 + 2 * i] & 0xF;
+        if (td > 3 || ta > 3) {
+          // Td/Ta are 2-bit per T.81 B.2.3; huff_dc/huff_ac are 4 entries, so
+          // an unvalidated nibble from a corrupt SOS indexed out of bounds
+          // (heap OOB read, crash depending on heap layout — found by the
+          // fuzz corpus under ASan)
+          rc = PTPU_JPEG_CORRUPT;
+          goto done;
+        }
+        comps[found].dc_tbl = td;
+        comps[found].ac_tbl = ta;
         scan_comps[i] = found;
       }
       int Ss = seg[1 + 2 * ns];

@@ -90,7 +90,7 @@ def _untrack(segment):
 
         resource_tracker.unregister(segment._name, "shared_memory")
     except Exception:  # noqa: BLE001 — tracker internals vary; worst case is a
-        pass           # spurious unlink warning at child exit, not a leak
+        pass           # spurious unlink warning at child exit, not a leak  # graftlint: disable=GL-O002
 
 
 class SlabLease:
@@ -125,7 +125,7 @@ class SlabLease:
         try:
             self.release()
         except Exception:  # noqa: BLE001 — interpreter teardown
-            pass
+            pass  # graftlint: disable=GL-O002 (GC/exit path: logging may itself fail)
 
 
 class SlabRing:
@@ -240,7 +240,7 @@ class SlabRing:
             except FileNotFoundError:
                 pass
             except Exception:  # noqa: BLE001 — unlink is best-effort per segment
-                pass
+                pass  # graftlint: disable=GL-O002 (exit path; FileNotFoundError handled above)
             try:
                 seg.close()
             except BufferError:
@@ -250,7 +250,7 @@ class SlabRing:
                 # __del__ does not retry and spam "Exception ignored" at GC.
                 seg.close = _noop
             except Exception:  # noqa: BLE001
-                pass
+                pass  # graftlint: disable=GL-O002 (exit path: mapping frees at process exit)
 
 
 class SlabClient:
@@ -282,4 +282,4 @@ class SlabClient:
             try:
                 seg.close()
             except Exception:  # noqa: BLE001 — exit path
-                pass
+                pass  # graftlint: disable=GL-O002 (exit path: mapping frees at process exit)

@@ -91,6 +91,11 @@ class _Tagged:
         if fn is not None:
             fn(tracer)
 
+    def set_health(self, monitor):
+        fn = getattr(self._worker, "set_health", None)
+        if fn is not None:
+            fn(monitor)
+
 
 #: Exception-module roots of the storage client stacks fsspec-bridged filesystems
 #: raise through pyarrow (gcsfs.retry.HttpError, botocore errors, aiohttp client
@@ -121,7 +126,7 @@ def _close_quietly(pf):
     try:
         pf.close(force=True)
     except Exception:  # noqa: BLE001
-        pass
+        pass  # graftlint: disable=GL-O002 (best-effort close of an evicted handle)
 
 
 #: serializes lazy per-process IO-runtime construction (the readahead pool);
@@ -175,6 +180,7 @@ class _WorkerBase:
         self._io_closed = False  # latched by close(); reopen() re-arms (reset)
         self._readahead_unavailable = False  # this worker's pool failed to build
         self._io_tracer = None
+        self._io_health = None  # optional HealthMonitor for the IO threads
 
     def __getstate__(self):
         state = dict(self.__dict__)
@@ -183,6 +189,7 @@ class _WorkerBase:
         state["_io_closed"] = False
         state["_readahead_unavailable"] = False  # a child retries its own build
         state["_io_tracer"] = None
+        state["_io_health"] = None  # owns threads — never crosses the pickle wire
         return state
 
     def _parquet_file(self, path):
@@ -257,6 +264,8 @@ class _WorkerBase:
                         return None
                     if self._io_tracer is not None:
                         pool.set_trace(self._io_tracer)
+                    if self._io_health is not None:
+                        pool.set_health(self._io_health)
                     self._readahead = pool
         return pool
 
@@ -329,6 +338,12 @@ class _WorkerBase:
         pool = self._readahead
         if pool is not None:
             pool.set_trace(tracer)
+
+    def set_health(self, monitor):
+        self._io_health = monitor
+        pool = self._readahead
+        if pool is not None:
+            pool.set_health(monitor)
 
     # -- reads --------------------------------------------------------------------------
 
@@ -1088,6 +1103,13 @@ class Reader:
         self._executor = make_executor(
             pool_type, workers_count, queue_size, timeout_s, serializer,
             respawns, io_options=io_options)
+        monitor = getattr(self, "_health_monitor", None)
+        if monitor is not None:
+            # reset()/restore rebuilds the executor — re-attach BEFORE start so
+            # a process pool hands its children the monitor-era handshake
+            fn = getattr(self._executor, "set_health", None)
+            if fn is not None:
+                fn(monitor)
         self._executor.start(_Tagged(self._worker), self._plan)
         self._results_iter = self._executor.results()
         self.stopped = False
@@ -1233,6 +1255,21 @@ class Reader:
         fn = getattr(self._worker, "set_trace", None)
         if fn is not None:
             fn(tracer)
+
+    def set_health(self, monitor):
+        """Attach a :class:`petastorm_tpu.obs.health.HealthMonitor` (ISSUE 5):
+        executor workers / pool drivers heartbeat per work item (pool children
+        additionally gain the SIGUSR1 stack-dump hook), and the worker's
+        readahead IO threads heartbeat per background read. The DataLoader
+        wires this from ``health=``; call it directly for loader-less
+        readers."""
+        self._health_monitor = monitor  # survives reset()'s executor rebuild
+        fn = getattr(self._executor, "set_health", None)
+        if fn is not None:
+            fn(monitor)
+        fn = getattr(self._worker, "set_health", None)
+        if fn is not None:
+            fn(monitor)
 
     @property
     def wire_views(self):

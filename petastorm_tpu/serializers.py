@@ -115,8 +115,15 @@ class ArrowTableSerializer(PickleSerializer):
         ):
             try:
                 return KIND_ARROW, [self._encode(obj)]
-            except Exception:  # noqa: BLE001 - arrow can't express it: pickle instead
-                pass
+            except Exception as e:  # noqa: BLE001 - arrow can't express it:
+                # pickle instead — but COUNT it (ISSUE 5 GL-O002): a wire that
+                # silently downgrades per batch hides a real perf cliff
+                from petastorm_tpu.obs.log import degradation
+
+                degradation(
+                    "arrow_fallback",
+                    "Arrow IPC encode failed (%s); this batch rides the pickle "
+                    "wire", e)
         return super().serialize(obj)
 
     def deserialize(self, kind, frames):

@@ -75,8 +75,8 @@ def _delete_dir(url):
     fs.delete_dir_contents(path, accept_root_dir=True, missing_dir_ok=True)
     try:
         fs.delete_dir(path)
-    except Exception:  # noqa: BLE001 - already gone / root kept
-        pass
+    except Exception as e:  # noqa: BLE001 - already gone / root kept
+        logger.debug("delete_dir(%s) after contents cleanup: %s", path, e)
 
 
 class SparkDatasetConverter:
@@ -214,8 +214,14 @@ def _df_plan_string(df):
     if jdf is not None:
         try:
             return jdf.queryExecution().analyzed().toString()
-        except Exception:  # noqa: BLE001 - connect/duck-typed frames
-            pass
+        except Exception as e:  # noqa: BLE001 - connect/duck-typed frames:
+            # fall through to the weaker identities — counted (GL-O002), since
+            # a degraded cache key can silently re-materialize datasets
+            from petastorm_tpu.obs.log import degradation
+
+            degradation("spark_plan_identity",
+                        "DataFrame plan identity unavailable (%s); falling "
+                        "back to semanticHash/schema cache keying", e)
     semantic_hash = getattr(df, "semanticHash", None)
     if callable(semantic_hash):
         return "semanticHash:%s" % semantic_hash()

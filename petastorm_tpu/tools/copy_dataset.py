@@ -46,8 +46,9 @@ def copy_dataset(source_url, target_url, field_regex=None, not_null_fields=None,
 
     try:
         dst_fs.create_dir(dst_path, recursive=True)
-    except Exception:  # noqa: BLE001 - exists
-        pass
+    except Exception as e:  # noqa: BLE001 - exists
+        logger.debug("create_dir(%s): %s (continuing — existing dir is fine)",
+                     dst_path, e)
     existing = _list_parquet_files(dst_fs, dst_path)
     if existing and not overwrite_output:
         raise ValueError("Target %s is non-empty; pass overwrite_output=True" % target_url)

@@ -67,13 +67,17 @@ class PullDispatcher:
     bookkeeping and the loader's checkpoint watermark already handle.
     """
 
-    def __init__(self, plan, workers_count, lookahead=0, stealing=True):
+    def __init__(self, plan, workers_count, lookahead=0, stealing=True,
+                 recorder=None):
         self._iter = iter(plan)
         self._lock = threading.Lock()
         self._claims = [deque() for _ in range(max(1, workers_count))]
         self._exhausted = False
         self._lookahead = max(0, int(lookahead))
         self._stealing = bool(stealing)
+        #: optional petastorm_tpu.obs.flight.FlightRecorder — steal decisions
+        #: ride in the health layer's event ring (None = no recording)
+        self._recorder = recorder
         self.steals = 0
 
     def next(self, worker_idx):
@@ -89,10 +93,20 @@ class PullDispatcher:
                     claim.append(victim.pop())  # tail: the victim's furthest item
                     self.steals += 1
                     _count_steal()
+                    if self._recorder is not None:
+                        self._recorder.record("steal", thief=worker_idx,
+                                              victim_len=len(victim))
             if not claim:
                 return None
             item = claim.popleft()  # the fill above keeps the hint window full
             return item, tuple(claim)
+
+    def set_recorder(self, recorder):
+        """Attach/replace the flight recorder mid-stream (the usual order:
+        the executor starts during ``Reader.__init__``, the health monitor
+        arrives later via ``DataLoader`` → ``reader.set_health``)."""
+        with self._lock:
+            self._recorder = recorder
 
     def _fill(self, claim, target):
         while len(claim) < target and not self._exhausted:
@@ -165,6 +179,26 @@ class ExecutorBase:
     #: rather than because the plan was exhausted (consumers use it to keep
     #: completion flags like ``Reader.last_row_consumed`` truthful)
     truncated = False
+
+    #: optional petastorm_tpu.obs.health.HealthMonitor (ISSUE 5): worker
+    #: threads / pool drivers register heartbeats and per-worker latency on it
+    #: (None = disabled, one is-None check per loop iteration)
+    _health = None
+
+    def set_health(self, monitor):
+        """Attach a :class:`petastorm_tpu.obs.health.HealthMonitor`: workers
+        heartbeat per work item (busy vs backpressure-wait states), the
+        dispatcher records steal events, and — on the process pool — children
+        gain the stack-dump hook the stall watchdog collects evidence through.
+        Attachable mid-stream: workers pick it up at their next loop pass, and
+        an already-running dispatcher (the executor starts in
+        ``Reader.__init__``, before the loader can attach health) is rewired
+        to the monitor's flight ring here."""
+        self._health = monitor
+        dispatch = getattr(self, "_dispatch", None)
+        if dispatch is not None:
+            dispatch.set_recorder(monitor.flight if monitor is not None
+                                  else None)
 
     def start(self, worker, plan):
         raise NotImplementedError
@@ -254,9 +288,11 @@ class ThreadExecutor(ExecutorBase):
         self._results = queue.Queue(maxsize=self._queue_size)
         self._stop_event.clear()
         self.truncated = False
-        self._dispatch = PullDispatcher(plan, self._workers_count,
-                                        lookahead=self._lookahead,
-                                        stealing=self._stealing)
+        monitor = self._health
+        self._dispatch = PullDispatcher(
+            plan, self._workers_count, lookahead=self._lookahead,
+            stealing=self._stealing,
+            recorder=monitor.flight if monitor is not None else None)
         with self._active_lock:
             self._active = self._workers_count
         for i in range(self._workers_count):
@@ -268,22 +304,43 @@ class ThreadExecutor(ExecutorBase):
             self._threads.append(t)
 
     def _run_worker(self, worker, dispatch, idx):
+        import time
+
         prefetch = getattr(worker, "prefetch", None)
+        hb = None
         try:
             while not self._stop_event.is_set():
+                # health is resolved per pass, so a monitor attached after
+                # start() (the loader wires the reader post-construction)
+                # still instruments the rest of the stream
+                monitor = self._health
+                if monitor is not None and hb is None:
+                    hb = monitor.register("worker.thread-%d" % idx, "worker")
+                if hb is not None:
+                    hb.wait("claim")  # an exhausted plan is idleness, not a stall
                 claim = dispatch.next(idx)
                 if claim is None:
                     break
                 item, upcoming = claim
                 if prefetch is not None and upcoming:
                     prefetch(upcoming)  # swallows its own failures (degradation-logged)
+                if hb is not None:
+                    hb.beat("working")
+                t0 = time.perf_counter() if monitor is not None else 0.0
                 try:
                     result = worker(item)
                 except Exception as e:  # noqa: BLE001 - propagate to consumer
                     self._put(_ExcResult(e))
                     break
+                if monitor is not None:
+                    # per-worker latency histogram: the straggler detector's input
+                    monitor.observe_worker(idx, time.perf_counter() - t0)
+                if hb is not None:
+                    hb.wait("results_put")  # a full results queue = backpressure
                 self._put(result)
         finally:
+            if hb is not None:
+                hb.done()
             with self._active_lock:
                 self._active -= 1
                 if self._active == 0:
@@ -405,6 +462,17 @@ class ProcessExecutor(ExecutorBase):
         self._spawn_counter = 0
         self._worker = None
         self._child_env = None
+        #: health wiring (ISSUE 5): handle of the child-stack provider this
+        #: pool registered, plus the exact monitor/scope it was registered ON
+        #: (handles are per-monitor sequence numbers — removing with a handle
+        #: issued by a DIFFERENT monitor could delete an unrelated provider)
+        self._stack_provider_handle = None
+        self._stack_provider_monitor = None
+        #: idle children ping the control pipe at this cadence so a live-but-
+        #: unemployed child is distinguishable from a dead one in the evidence
+        #: (pings are drained by the driver before every result header)
+        self._ping_interval_s = float(
+            os.environ.get("PTPU_CHILD_PING_S", "") or 5.0)
 
     def start(self, worker, plan):
         import os
@@ -457,9 +525,11 @@ class ProcessExecutor(ExecutorBase):
                     self._conns.append(conn)
         finally:
             listener.close()  # also unblocks the acceptor thread if we raised
-        self._dispatch = PullDispatcher(plan, self._workers_count,
-                                        lookahead=self._lookahead,
-                                        stealing=self._stealing)
+        monitor = self._health
+        self._dispatch = PullDispatcher(
+            plan, self._workers_count, lookahead=self._lookahead,
+            stealing=self._stealing,
+            recorder=monitor.flight if monitor is not None else None)
         with self._active_lock:
             self._active = self._workers_count
         for i, conn in enumerate(self._conns):
@@ -552,6 +622,94 @@ class ProcessExecutor(ExecutorBase):
         if self._ring is not None:
             self._ring.set_trace(tracer)
 
+    def set_health(self, monitor):
+        """Attach a health monitor; additionally registers this pool's
+        child-stack provider — on a stall the watchdog signals every live
+        child (SIGUSR1 → faulthandler, see ``_child_worker.py``) and folds
+        their thread stacks into the flight record."""
+        super().set_health(monitor)
+        if monitor is self._stack_provider_monitor:
+            return
+        # re-attach/detach: move the provider to the new monitor — the old
+        # one must stop signaling this pool's children, and the handle is
+        # only meaningful to the monitor that issued it
+        old, self._stack_provider_monitor = self._stack_provider_monitor, None
+        handle, self._stack_provider_handle = self._stack_provider_handle, None
+        if old is not None and handle is not None:
+            old.remove_stack_provider(handle)
+        if monitor is not None:
+            self._stack_provider_handle = monitor.add_stack_provider(
+                self._dump_child_stacks)
+            self._stack_provider_monitor = monitor
+
+    def _dump_child_stacks(self):
+        """Signal live children to faulthandler-dump their stacks and collect
+        the files (the stall watchdog's cross-process evidence hook). Best
+        effort: a child that cannot answer within ~2s is reported as such —
+        which is itself evidence (SIGKILL'd? wedged in native code?)."""
+        import os
+        import signal
+        import time
+
+        if not hasattr(signal, "SIGUSR1"):
+            return {}  # non-POSIX: driver stacks only
+        with self._respawn_lock:
+            procs = list(self._procs)
+            tmpdir = self._tmpdir
+        if not procs or not tmpdir:
+            return {}
+        # faulthandler APPENDS to the child's open dump file, so a second
+        # stall must return only the bytes written AFTER this signal — a
+        # previous capture's stack would send the operator to the WRONG hang
+        def _size(pid):
+            try:
+                return os.path.getsize(
+                    os.path.join(tmpdir, "stacks-%d.txt" % pid))
+            except OSError:
+                return 0
+
+        alive = []
+        offsets = {}
+        for p in procs:
+            if p.poll() is None:
+                offsets[p.pid] = _size(p.pid)
+                try:
+                    p.send_signal(signal.SIGUSR1)
+                    alive.append(p)
+                except OSError:
+                    pass
+        out = {}
+        pending = {p.pid for p in alive}
+        partial = {}  # pid -> last read: accept only once the dump stops growing
+        deadline = time.monotonic() + 2.0
+        while pending and time.monotonic() < deadline:
+            time.sleep(0.05)
+            for pid in list(pending):
+                try:
+                    with open(os.path.join(tmpdir, "stacks-%d.txt" % pid)) as f:
+                        f.seek(offsets[pid])
+                        text = f.read()
+                except OSError:
+                    continue
+                if not text.strip():
+                    continue
+                # faulthandler may still be mid-write (a child has several
+                # threads): accepting the first non-empty read could cut the
+                # dump off BEFORE the hung thread's frames — require one
+                # stable re-read before shipping it as evidence
+                if partial.get(pid) == text:
+                    out["child-%d" % pid] = text
+                    pending.discard(pid)
+                else:
+                    partial[pid] = text
+        for pid in pending:
+            # still growing (or silent) at the deadline: partial evidence
+            # beats none, marked so the operator knows it may be cut off
+            out["child-%d" % pid] = (
+                partial[pid] + "\n<truncated: dump still growing at 2s>"
+                if pid in partial else "<no faulthandler dump within 2s>")
+        return out
+
     def wire_stats(self):
         """Wire-transport gauges (shm slab occupancy/bytes/fallbacks/wait), or a
         degradation marker, or {} for plain socket serializers."""
@@ -577,14 +735,24 @@ class ProcessExecutor(ExecutorBase):
                 and not self._serializer.writable)
 
     def _handshake(self, conn):
-        """Bootstrap a connected child: parent sys.path, wire serializer (plus the
-        slab-ring attach config in shm mode), worker."""
+        """Bootstrap a connected child: parent sys.path, wire serializer (plus
+        the slab-ring attach config in shm mode), health config, worker.
+
+        The health slot is ALWAYS sent (ISSUE 5): the stack-dump hook costs
+        nothing until signaled and the idle ping rides the existing control
+        pipe, so child-side evidence capture works even when the monitor is
+        attached after the pool started (the driver drains ping messages
+        unconditionally — see ``_recv_result``)."""
         import sys
 
         conn.send(list(sys.path))
         conn.send(self._serializer_name)
         if self._ring is not None:
             conn.send((self._ring.names, self._ring.slab_bytes))
+        with self._respawn_lock:
+            dump_dir = self._tmpdir
+        conn.send({"stack_dump_dir": dump_dir,
+                   "ping_interval_s": self._ping_interval_s})
         conn.send(self._worker)
 
     def _spawn_one(self):
@@ -635,7 +803,7 @@ class ProcessExecutor(ExecutorBase):
                 try:
                     p.kill()
                 except Exception:  # noqa: BLE001
-                    pass
+                    pass  # graftlint: disable=GL-O002 (best-effort kill on the raising path)
             raise
         finally:
             listener.close()
@@ -662,16 +830,39 @@ class ProcessExecutor(ExecutorBase):
             "item (remaining respawn budget: %d)", err, budget_left, once=False)
         return conn
 
+    def _recv_result(self, conn, child_hb):
+        """Receive the next result/exc header, draining child heartbeat pings
+        (``("hb", ts)`` — sent at item receipt and while idle) into the
+        child's heartbeat stamp. Children always ping; without a monitor the
+        pings are simply dropped here (one tuple check per message)."""
+        while True:
+            msg = conn.recv()
+            if isinstance(msg, tuple) and len(msg) == 2 and msg[0] == "hb":
+                if child_hb is not None:
+                    child_hb.beat("working")
+                continue
+            return msg
+
     def _drive_child(self, conn, dispatch, idx):
+        import time
+
         from petastorm_tpu.serializers import KIND_SHM
 
         # local snapshot: join() nulls self._ring (under the respawn lock) while a
         # straggling driver may still be mid-item past its 10s join timeout — the
         # ring object itself stays safe to call (close() makes release a no-op)
         ring = self._ring
+        hb = None        # this driver thread's heartbeat (all wait states)
+        child_hb = None  # the child's: stamped from pipe traffic, watchdogged
         try:
             fatal = False
             while not fatal and not self._stop_event.is_set():
+                monitor = self._health
+                if monitor is not None and hb is None:
+                    hb = monitor.register("pooldrv-%d" % idx, "worker")
+                    child_hb = monitor.register("worker.child-%d" % idx, "child")
+                if hb is not None:
+                    hb.wait("claim")
                 claim = dispatch.next(idx)
                 if claim is None:
                     break
@@ -689,9 +880,22 @@ class ProcessExecutor(ExecutorBase):
                         if slab is None:  # ring starved: socket wire for this item
                             ring.count_fallback()
                     try:
+                        if child_hb is not None:
+                            child_hb.beat("working")
+                        if hb is not None:
+                            # the driver is only WAITING here; the hang
+                            # candidate is the child, and ITS heartbeat (stamped
+                            # at send, from pings, and at the header) carries
+                            # the stall detection
+                            hb.wait("child")
+                        t0 = time.perf_counter() if monitor is not None else 0.0
                         conn.send((slab, item, hints) if ring is not None
                                   else (item, hints))
-                        header = conn.recv()
+                        header = self._recv_result(conn, child_hb)
+                        if monitor is not None:
+                            monitor.observe_worker(idx, time.perf_counter() - t0)
+                        if child_hb is not None:
+                            child_hb.wait("idle")
                         if header[0] == "exc":
                             if slab is not None:
                                 ring.release(slab)
@@ -733,6 +937,8 @@ class ProcessExecutor(ExecutorBase):
                         self._put(_ExcResult(e))  # not silently truncate the dataset
                         fatal = True
                         break
+                    if hb is not None:
+                        hb.wait("results_put")  # full results queue = backpressure
                     self._put(result)
                     break
             try:
@@ -740,6 +946,10 @@ class ProcessExecutor(ExecutorBase):
             except (BrokenPipeError, OSError):
                 pass
         finally:
+            if hb is not None:
+                hb.done()
+            if child_hb is not None:
+                child_hb.done()
             with self._active_lock:
                 self._active -= 1
                 if self._active == 0:
@@ -776,6 +986,13 @@ class ProcessExecutor(ExecutorBase):
         # respawn within ~1s (otherwise a driver stuck in the 60s connect wait would
         # outlive the 10s thread join and register a child into cleared lists)
         self._stop_event.set()
+        monitor = self._stack_provider_monitor
+        self._stack_provider_monitor = None
+        handle, self._stack_provider_handle = self._stack_provider_handle, None
+        if monitor is not None and handle is not None:
+            # a stall fired after this point must not signal reaped children;
+            # removal goes to the monitor that ISSUED the handle
+            monitor.remove_stack_provider(handle)
         for t in self._threads:
             t.join(timeout=10)
         self._threads = []

@@ -232,7 +232,7 @@ def test_decode_unsharded_fallback_counter_and_warning(jpeg_dataset, caplog):
                 for _ in loader:
                     pass
             except Exception:  # noqa: BLE001 — 6 rows cannot device_put 8-way; the
-                pass  # counter/warning must fire BEFORE that layout error
+                pass  # counter/warning must fire BEFORE that layout error  # graftlint: disable=GL-O002
     assert loader.stats.decode_unsharded_batches >= 1
     warnings = [r for r in caplog.records
                 if "SINGLE device" in r.getMessage()]

@@ -766,6 +766,90 @@ def test_wall_clock_nested_function_is_its_own_scope():
     assert findings == []
 
 
+# -- GL-O002: silent broad exception swallows -------------------------------------------
+
+_O002_POSITIVE = """
+    def teardown(conn):
+        try:
+            conn.close()
+        except Exception:  # BUG: silent broad swallow
+            pass
+"""
+
+
+def test_silent_swallow_fires_on_except_exception_pass():
+    findings, _ = _lint(_O002_POSITIVE)
+    f = _only_rule(findings, "GL-O002")[0]
+    assert f.line == _line_of(_O002_POSITIVE, "BUG: silent broad swallow")
+    assert "degradation" in f.fix_hint
+
+
+def test_silent_swallow_fires_on_bare_and_tuple_and_base():
+    src = """
+        def f(x):
+            try:
+                x()
+            except:  # BUG: bare
+                pass
+            try:
+                x()
+            except (ValueError, Exception):  # BUG: tuple hides the broad catch
+                pass
+            try:
+                x()
+            except BaseException:  # BUG: broader still
+                pass
+    """
+    findings, _ = _lint(src)
+    lines = [f.line for f in _only_rule(findings, "GL-O002")]
+    assert lines == [_line_of(src, "BUG: bare"),
+                     _line_of(src, "BUG: tuple hides the broad catch"),
+                     _line_of(src, "BUG: broader still")]
+
+
+def test_silent_swallow_clean_cases():
+    """Narrow excepts, handlers that act (log/count/re-raise), and justified
+    inline suppressions all stay clean — swallowing a SPECIFIC expected error
+    is a decision; only the silent broad catch is the anti-pattern."""
+    findings, suppressed = _lint("""
+        import logging
+
+        logger = logging.getLogger(__name__)
+
+        def f(x):
+            try:
+                x()
+            except OSError:
+                pass  # narrow: an expected, specific error
+            try:
+                x()
+            except Exception as e:
+                logger.warning("x failed: %s", e)  # acts: logged
+            try:
+                x()
+            except Exception:
+                raise  # acts: re-raised
+            try:
+                x()
+            except Exception:  # graftlint: disable=GL-O002 (interpreter teardown)
+                pass
+    """)
+    assert findings == [] and suppressed == 1
+
+
+def test_silent_swallow_degradation_log_route_is_clean():
+    findings, _ = _lint("""
+        def f(x):
+            try:
+                x()
+            except Exception as e:
+                from petastorm_tpu.obs.log import degradation
+
+                degradation("x_failed", "x failed (%s)", e)
+    """)
+    assert findings == []
+
+
 # -- engine: suppressions, baseline, CLI ------------------------------------------------
 
 

@@ -39,7 +39,7 @@ def _cleanup_urls():
             fs, path = get_filesystem_and_path_or_paths(url)
             fs.delete_dir(path)
         except Exception:  # noqa: BLE001 — cleanup failure must not fail the test
-            pass
+            pass  # graftlint: disable=GL-O002
 
 
 def _remote_url(env_var, cleanup):
