@@ -31,6 +31,11 @@ CLOSEABLE_FACTORIES = frozenset({
     # (shutdown() is its closer) and a MemCache pins process-wide bytes
     # (clear() releases them)
     "ReadaheadPool", "MemCache",
+    # ISSUE-6 lease contract: constructing a Lease IS the acquire (refcount 1
+    # over someone else's buffers — release() is its closer; leaking one
+    # strands a slab/staging slot until GC, counted ptpu_lease_leaked_total),
+    # and a PinnedStagingPool owns mlock'd host slabs (close() unpins/unmaps)
+    "Lease", "PinnedStagingPool",
 })
 
 #: calls that merely CONSUME an iterable without taking ownership of it
@@ -39,7 +44,7 @@ _CONSUMERS = frozenset({"list", "iter", "next", "enumerate", "sorted", "zip",
                         "print", "repr", "str", "isinstance", "type"})
 
 _CLOSERS = frozenset({"stop", "close", "join", "terminate", "shutdown", "unlink",
-                      "clear"})
+                      "clear", "release"})
 
 
 class ResourceLifecycleRule(Rule):
@@ -72,12 +77,128 @@ class ResourceLifecycleRule(Rule):
                 ok, tracked = self._call_context_ok(node, ctx)
                 if ok:
                     continue
-                if tracked is not None and self._name_ok(tracked, scope_nodes, ctx):
+                if tracked is not None and self._name_ok(tracked, scope_nodes, ctx,
+                                                         factory=name):
                     continue
                 yield ctx.finding(
                     self, node,
                     "`%s(...)` result is never closed: not used as a context "
                     "manager, closed in a finally, or handed off" % name)
+        yield from self._check_double_release(tree, ctx)
+
+    # -- lease release discipline (ISSUE 6) ----------------------------------------------
+
+    def _check_double_release(self, tree, ctx):
+        """Flag an UNBALANCED ``x.release()`` in one straight-line statement
+        list: each name gets one implied base reference plus one per
+        ``x.retain()`` seen earlier in the list; a release past that budget is
+        the caller bug :class:`petastorm_tpu.errors.LeaseError` catches at
+        runtime. Conservative: the scan STOPS at the first compound statement
+        in a list (a branch may retain or release — what it did to any
+        refcount is unknowable, and a wrong guess in either direction makes
+        false positives), and a rebind/del of the name resets its tracking —
+        so conditional release patterns never false-positive. Teardown blocks
+        stay covered: a ``finally:`` body is its own statement list."""
+        for stmts in self._stmt_lists(tree):
+            state = {}  # name -> [extra_refs_from_retains, base_release_lineno]
+            for stmt in stmts:
+                if self._clears_tracking(stmt):
+                    break
+                self._absorb_retains_and_rebinds(stmt, state)
+                if not (isinstance(stmt, ast.Expr)
+                        and isinstance(stmt.value, ast.Call)):
+                    continue
+                target = self._release_target(stmt.value)
+                if target is None:
+                    continue
+                entry = state.setdefault(target, [0, None])
+                if entry[0] > 0:
+                    entry[0] -= 1  # consumes a retain() seen earlier
+                elif entry[1] is None:
+                    entry[1] = stmt.lineno  # the implied base reference
+                else:
+                    yield ctx.finding(
+                        self, stmt.value,
+                        "`%s.release()` called again after the release on "
+                        "line %d with no retain() between: the lease contract "
+                        "is exactly-once release per retain (double release "
+                        "raises LeaseError at runtime)" % (target, entry[1]),
+                        fix_hint="drop the extra release(), or retain() once "
+                                 "per holder")
+
+    @staticmethod
+    def _stmt_lists(tree):
+        for node in ast.walk(tree):
+            for field in ("body", "orelse", "finalbody"):
+                stmts = getattr(node, field, None)
+                if isinstance(stmts, list) and stmts \
+                        and isinstance(stmts[0], ast.stmt):
+                    yield stmts
+
+    @staticmethod
+    def _dotted_name(expr):
+        parts = []
+        while isinstance(expr, ast.Attribute):
+            parts.append(expr.attr)
+            expr = expr.value
+        if not isinstance(expr, ast.Name):
+            return None
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+
+    @classmethod
+    def _release_target(cls, call):
+        """Dotted receiver of a bare ``<recv>.release()`` call, else None."""
+        if isinstance(call.func, ast.Attribute) and call.func.attr == "release" \
+                and not call.args and not call.keywords:
+            return cls._dotted_name(call.func.value)
+        return None
+
+    @staticmethod
+    def _clears_tracking(stmt):
+        """ANY compound statement wipes per-list tracking: its branch bodies
+        are separate lists, and what they did to a refcount is unknowable."""
+        compound = (ast.If, ast.For, ast.AsyncFor, ast.While, ast.Try,
+                    ast.With, ast.AsyncWith)
+        if hasattr(ast, "Match"):
+            compound += (ast.Match,)
+        return isinstance(stmt, compound)
+
+    @classmethod
+    def _flatten_targets(cls, targets):
+        """Expand tuple/list/starred assignment targets so a rebind inside
+        ``lease, other = make_two()`` still resets ``lease``'s tracking."""
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                yield from cls._flatten_targets(t.elts)
+            elif isinstance(t, ast.Starred):
+                yield from cls._flatten_targets([t.value])
+            else:
+                yield t
+
+    @classmethod
+    def _absorb_retains_and_rebinds(cls, stmt, state):
+        """Fold one simple statement into the release-budget state: every
+        ``x.retain()`` anywhere in it grants one extra release; any rebind or
+        ``del`` of a name drops that name's tracking (a different lease now)."""
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "retain":
+                name = cls._dotted_name(sub.func.value)
+                if name is not None:
+                    state.setdefault(name, [0, None])[0] += 1
+            elif isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                for t in cls._flatten_targets(targets):
+                    name = cls._dotted_name(t)
+                    if name is not None:
+                        state.pop(name, None)
+            elif isinstance(sub, ast.Delete):
+                for t in sub.targets:
+                    name = cls._dotted_name(t)
+                    if name is not None:
+                        state.pop(name, None)
 
     def _call_context_ok(self, call, ctx):
         """(resolved?, tracked_name): classify the constructor call by its parent.
@@ -129,10 +250,18 @@ class ResourceLifecycleRule(Rule):
             node = ctx.parent(node)
         return False
 
-    def _name_ok(self, name, scope_nodes, ctx):
+    def _name_ok(self, name, scope_nodes, ctx, factory=None):
         """True when the bound name reaches an accepted ownership outcome
         anywhere in the enclosing scope."""
         for node in scope_nodes:
+            # Lease only: a straight-line `name.release()` statement counts.
+            # Unlike readers/shm segments, a lease missed on an exception path
+            # does not leak an OS resource — the GC safety net reclaims it and
+            # counts ptpu_lease_leaked_total — so the static bar is the happy
+            # path, with the double-release check guarding the other side.
+            if factory == "Lease" and isinstance(node, ast.Call) \
+                    and self._release_target(node) == name:
+                return True
             # with name: / with wrapper(name):
             if isinstance(node, ast.withitem) and self._expr_uses_name(
                     node.context_expr, name):

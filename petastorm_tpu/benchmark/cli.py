@@ -68,6 +68,13 @@ def main(argv=None):
         from petastorm_tpu.benchmark import io as io_bench
 
         return io_bench.main(argv[1:])
+    if argv and argv[0] == "copies":
+        # `petastorm-tpu-bench copies ...`: the copy-census micro-benchmark
+        # (copying default path vs the ISSUE-6 leased path, bytes memcpy'd per
+        # delivered batch + byte-identity) — see benchmark/copies.py
+        from petastorm_tpu.benchmark import copies as copies_bench
+
+        return copies_bench.main(argv[1:])
     if argv and argv[0] == "health":
         # `petastorm-tpu-bench health ...`: heartbeat-instrumentation overhead
         # (enabled vs disabled, plus beat/record primitive ns/op) — see

@@ -31,6 +31,22 @@ class MetadataError(PetastormTpuError):
     """Dataset metadata missing or malformed (reference: PetastormMetadataError)."""
 
 
+class LeaseError(PetastormTpuError):
+    """Broken lease discipline on a :class:`petastorm_tpu.io.lease.Lease` —
+    releasing past a zero refcount (double release) or retaining a lease whose
+    buffers were already returned to their owner. Always a caller bug: the
+    lease contract is exactly-once release per retain (graftlint GL-L001
+    checks the straight-line cases statically)."""
+
+
+class LeaseRevoked(PetastormTpuError):
+    """The buffers behind a lease were invalidated by their owner — e.g. a
+    ``Reader.reset()`` rebuilt the executor whose slab ring backed a still-
+    retained batch. Raised by lease-aware accessors instead of returning
+    views into recycled memory: a consumer holding a batch across a revocation
+    gets this error, never garbage."""
+
+
 class StallError(PetastormTpuError):
     """A pipeline actor missed its heartbeat threshold and the health monitor's
     escalation policy is ``raise`` — the training loop fails fast instead of

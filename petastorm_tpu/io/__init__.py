@@ -75,15 +75,21 @@ class IoOptions:
     memcache_bytes      PTPU_MEMCACHE_BYTES        in-memory decoded-row-group
                                                    LRU budget (0 = off, the
                                                    default)
+    memcache_writable_  PTPU_MEMCACHE_WRITABLE_    legacy pre-lease contract:
+    hits                HITS                       deep-copy every memcache
+                                                   serve writable (default off:
+                                                   zero-copy read-only views)
     ==================  =========================  ==============================
     """
 
     __slots__ = ("readahead", "readahead_depth", "readahead_bytes", "io_threads",
-                 "coalesce", "coalesce_max_run", "work_stealing", "memcache_bytes")
+                 "coalesce", "coalesce_max_run", "work_stealing", "memcache_bytes",
+                 "memcache_writable_hits")
 
     def __init__(self, readahead=None, readahead_depth=None, readahead_bytes=None,
                  io_threads=None, coalesce=None, coalesce_max_run=None,
-                 work_stealing=None, memcache_bytes=None):
+                 work_stealing=None, memcache_bytes=None,
+                 memcache_writable_hits=None):
         self.readahead = _env_bool("PTPU_READAHEAD", True) \
             if readahead is None else bool(readahead)
         self.readahead_depth = max(1, _env_int("PTPU_READAHEAD_DEPTH", 3)
@@ -101,6 +107,13 @@ class IoOptions:
             if work_stealing is None else bool(work_stealing)
         self.memcache_bytes = max(0, _env_int("PTPU_MEMCACHE_BYTES", 0)
                                   if memcache_bytes is None else int(memcache_bytes))
+        # legacy pre-lease serving contract: every memcache serve is an owned
+        # writable deep copy (ISSUE 6 default is zero-copy read-only views with
+        # copy-on-write escalation) — the rollback knob, and the copying
+        # baseline `petastorm-tpu-bench copies` measures against
+        self.memcache_writable_hits = \
+            _env_bool("PTPU_MEMCACHE_WRITABLE_HITS", False) \
+            if memcache_writable_hits is None else bool(memcache_writable_hits)
 
     @classmethod
     def normalize(cls, value):
@@ -126,7 +139,9 @@ class IoOptions:
 
     def __setstate__(self, state):
         for name in self.__slots__:
-            setattr(self, name, state[name])
+            # .get: tolerate pickles from an older IoOptions missing a newer
+            # field (a child on a stale worker image keeps the new default)
+            setattr(self, name, state.get(name, getattr(type(self)(), name)))
 
     def __repr__(self):
         return "IoOptions(%s)" % ", ".join(

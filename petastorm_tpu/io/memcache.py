@@ -13,17 +13,25 @@ Layering: ``MemCache`` wraps any :class:`petastorm_tpu.cache.CacheBase` (the
 disk cache or the null cache) — a miss falls through to the inner cache's
 ``get`` and the freshly decoded value is admitted on the way back up.
 
-Hits return a **defensive copy** (fresh containers, copied ndarrays): consumers
-own their batches and may mutate them (the writable-batch contract of the
-default wires), and an aliased cache entry would corrupt every later epoch. The
-copy is a straight memcpy — the expensive parts a hit skips are the parquet
-parse and codec decode.
+Serving contract (ISSUE 6, the lease-path rewrite): entries are stored as
+READ-ONLY structures under a per-entry :class:`petastorm_tpu.io.lease.Lease`,
+and both the miss and the hit path hand out **zero-copy read-only views**
+(fresh containers, shared buffers) — no memcpy per hit, no memcpy per admit.
+A consumer that mutates a served batch gets an immediate ``ValueError:
+assignment destination is read-only`` (fail-loud, same contract as the
+``-view`` wires — never silent cache poisoning). The one consumer that
+legitimately writes — a host ``TransformSpec`` running user code — escalates
+through :meth:`MemCache.get_writable` (copy-on-write: the old defensive deep
+copy, charged to the ``memcache_cow`` census site). ``MemCache(...,
+writable_hits=True)`` restores the legacy copy-everything behavior wholesale
+(the copying baseline ``petastorm-tpu-bench copies`` measures against).
 
 The store is process-wide (module-level) so every reader in the process —
 including each pool child, which unpickles its worker into its own process —
 shares one budget; entries larger than the whole budget are skipped with a
 ``ptpu_degradations_total{cause="memcache_oversized"}`` entry (the value still
-flows to the consumer, uncached).
+flows to the consumer, uncached — and stays writable, since nothing aliases
+it).
 """
 from __future__ import annotations
 
@@ -34,6 +42,7 @@ from collections import OrderedDict
 import numpy as np
 
 from petastorm_tpu.cache import CacheBase, NullCache
+from petastorm_tpu.io.lease import Lease, count_copy, readonly_view
 from petastorm_tpu.obs.log import degradation
 from petastorm_tpu.obs.metrics import default_registry
 
@@ -59,20 +68,52 @@ def payload_nbytes(value):
     return sys.getsizeof(value)
 
 
-def _defensive_copy(value):
-    """Fresh containers + copied ndarrays so a consumer mutating its batch can
-    never corrupt the cached original (or vice versa). Immutable leaves
-    (bytes, str, numbers) pass through. Object-dtype arrays (ragged/forced
-    columns hold per-row ndarrays as ELEMENTS) recurse — ``ndarray.copy()``
-    alone would copy the outer array while the element arrays still alias."""
+def _copied_nbytes(value):
+    """Actual buffer bytes a deep copy of ``value`` memcpy's (census measure:
+    no container overhead — comparable with the wire sites' raw byte counts)."""
     if isinstance(value, np.ndarray):
         if value.dtype == object:
-            out = np.empty(value.shape, dtype=object)
-            out_flat, in_flat = out.reshape(-1), value.reshape(-1)
-            for i in range(in_flat.size):
-                out_flat[i] = _defensive_copy(in_flat[i])
-            return out
-        return value.copy()
+            return sum(_copied_nbytes(v) for v in value.flat)
+        return int(value.nbytes)
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return len(value)
+    if isinstance(value, dict):
+        return sum(_copied_nbytes(v) for v in value.values())
+    if isinstance(value, (list, tuple)):
+        return sum(_copied_nbytes(v) for v in value)
+    return 0
+
+
+#: leaf types _defensive_copy may pass through untouched (immutable, or numpy
+#: scalars which are value-semantics anyway) — resolved once, checked inline in
+#: the object-array loop so the escalation hook stays cheap (ISSUE 6 satellite)
+_IMMUTABLE_LEAVES = (bytes, str, int, float, complex, bool, type(None),
+                     np.generic)
+
+
+def _defensive_copy(value):
+    """Fresh containers + copied ndarrays so a consumer mutating its batch can
+    never corrupt the cached original (or vice versa). Since ISSUE 6 this runs
+    only as the **copy-on-write escalation hook** (``get_writable`` /
+    ``writable_hits=True``), so it must be cheap: non-object ndarrays take the
+    single-``copy()`` fast path (one memcpy, no per-element work), and the
+    object-array walk (ragged/forced columns hold per-row ndarrays as ELEMENTS
+    — an outer ``copy()`` alone would leave them aliased) dispatches each
+    element inline instead of recursing through the full type ladder."""
+    if isinstance(value, np.ndarray):
+        if value.dtype != object:
+            return value.copy()  # fast path: one memcpy for the whole column
+        out = np.empty(value.shape, dtype=object)
+        out_flat, in_flat = out.reshape(-1), value.reshape(-1)
+        for i in range(in_flat.size):
+            e = in_flat[i]
+            if type(e) is np.ndarray and e.dtype != object:
+                out_flat[i] = e.copy()  # hot leaf: ragged row tensor
+            elif isinstance(e, _IMMUTABLE_LEAVES):
+                out_flat[i] = e
+            else:
+                out_flat[i] = _defensive_copy(e)
+        return out
     if isinstance(value, dict):
         return {k: _defensive_copy(v) for k, v in value.items()}
     if isinstance(value, list):
@@ -83,11 +124,17 @@ def _defensive_copy(value):
 
 
 class _Store:
-    """The process-wide LRU: OrderedDict + byte accounting under one lock."""
+    """The process-wide LRU: OrderedDict + byte accounting under one lock.
+
+    Entries hold ``(frozen_value, nbytes, lease)``: the value's ndarrays are
+    read-only (frozen at admit), and the per-entry lease carries the
+    ``ptpu_lease_*`` accounting — acquired at admit, released at eviction/
+    ``clear()`` — so cache-held buffers are visible beside the wire's slab
+    leases."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._entries = OrderedDict()  # key -> (value, nbytes)
+        self._entries = OrderedDict()  # key -> (value, nbytes, lease)
         self._total = 0
         self._budget = 0
         reg = default_registry()
@@ -107,6 +154,8 @@ class _Store:
                 self._budget = budget
 
     def lookup(self, key):
+        """(hit?, stored_value) — the STORED read-only structure; the caller
+        picks the serve shape (zero-copy views or a CoW escalation copy)."""
         with self._lock:
             hit = self._entries.get(key)
             if hit is None:
@@ -115,18 +164,20 @@ class _Store:
             self._entries.move_to_end(key)
             self._hits.inc()
             value = hit[0]
-        return True, _defensive_copy(value)
+        return True, value
 
     def contains(self, key):
         with self._lock:
             return key in self._entries
 
     def put(self, key, value):
-        """Admit ``value``; returns True when it was stored. The caller must
-        then hand its consumer a defensive copy — the stored object must never
-        alias a batch the consumer may mutate (the miss-path twin of the
-        hit-path copy in :meth:`lookup`)."""
+        """Admit ``value`` (already frozen read-only by the caller); returns
+        True when it was stored. Because the stored arrays are read-only and
+        every serve is a read-only view, storing may SHARE buffers with what
+        the consumer receives — mutation is impossible, so the old
+        defensive-copy-per-admit is gone."""
         nbytes = payload_nbytes(value)
+        evicted = []
         with self._lock:
             if nbytes > self._budget:
                 oversized = True
@@ -135,13 +186,21 @@ class _Store:
                 old = self._entries.pop(key, None)
                 if old is not None:
                     self._total -= old[1]
-                self._entries[key] = (value, nbytes)
+                    evicted.append(old[2])
+                self._entries[key] = (value, nbytes, Lease(kind="memcache"))
                 self._total += nbytes
                 while self._total > self._budget and self._entries:
-                    _, (_, old_bytes) = self._entries.popitem(last=False)
+                    _, (_, old_bytes, old_lease) = self._entries.popitem(last=False)
                     self._total -= old_bytes
                     self._evictions.inc()
+                    evicted.append(old_lease)
                 self._bytes_gauge.set(self._total)
+        for lease in evicted:
+            # safe outside the lock: numpy refcounting keeps an evicted entry's
+            # buffers alive for any outstanding served views — the lease here
+            # is accounting (ptpu_lease_active mirrors resident entries), not
+            # lifetime enforcement
+            lease.release()
         if oversized:
             degradation(
                 "memcache_oversized",
@@ -151,9 +210,11 @@ class _Store:
 
     def clear(self):
         with self._lock:
-            self._entries.clear()
+            entries, self._entries = self._entries, OrderedDict()
             self._total = 0
             self._bytes_gauge.set(0)
+        for _value, _nbytes, lease in entries.values():
+            lease.release()
 
     def stats(self):
         with self._lock:
@@ -191,14 +252,21 @@ class MemCache(CacheBase):
     child rebuilds its own store on first use); the budget is the max any
     instance requested. ``clear()`` releases the held bytes — GL-L001 accepts
     it as this type's closer.
+
+    ``get`` serves zero-copy read-only views; ``get_writable`` is the CoW
+    escalation; ``writable_hits=True`` restores the legacy deep-copy-per-serve
+    behavior (both directions byte-identical — only mutability and memcpy
+    count differ).
     """
 
-    def __init__(self, size_limit_bytes, inner=None, store=None):
+    def __init__(self, size_limit_bytes, inner=None, store=None,
+                 writable_hits=False):
         if not size_limit_bytes or int(size_limit_bytes) <= 0:
             raise ValueError("MemCache needs a positive size_limit_bytes; use "
                              "the inner cache alone to disable it")
         self._budget = int(size_limit_bytes)
         self._inner = inner if inner is not None else NullCache()
+        self._writable_hits = bool(writable_hits)
         #: private-store escape hatch (tests/benchmarks needing isolation from
         #: the process-wide store and its raise-only budget); not picklable —
         #: dropped on pickling, the unpickled instance reverts to the shared one
@@ -216,17 +284,41 @@ class MemCache(CacheBase):
         return store
 
     def get(self, key, fill_cache_func):
+        """Zero-copy serve: hits AND the admit path hand out fresh containers
+        over the stored READ-ONLY buffers. Only an oversized (uncached) value
+        passes through writable."""
         store = self._store()
         hit, value = store.lookup(key)
-        if hit:
-            return value
-        value = self._inner.get(key, fill_cache_func)
-        if store.put(key, value):
-            # the stored object must not alias the batch we hand out: a
-            # consumer mutating it in place (writable-batch contract) would
-            # silently poison every later epoch's hit
-            return _defensive_copy(value)
-        return value
+        if not hit:
+            value = self._inner.get(key, fill_cache_func)
+            frozen = readonly_view(value)
+            if not store.put(key, frozen):
+                return value  # oversized: uncached, nothing aliases it
+            value = frozen
+        if self._writable_hits:
+            # legacy contract: every serve is an owned writable deep copy
+            copy = _defensive_copy(value)
+            count_copy("memcache_hit" if hit else "memcache_admit",
+                       _copied_nbytes(copy))
+            return copy
+        return readonly_view(value)
+
+    def get_writable(self, key, fill_cache_func):
+        """Copy-on-write escalation: a consumer that will WRITE (host
+        TransformSpec) gets an owned writable deep copy of the entry — the one
+        remaining memcpy on the memcache path, charged to ``memcache_cow``."""
+        store = self._store()
+        hit, value = store.lookup(key)
+        if not hit:
+            value = self._inner.get(key, fill_cache_func)
+            if not store.put(key, readonly_view(value)):
+                return value  # oversized: uncached and unaliased, already owned
+            # `value` still aliases the stored buffers — escalate below exactly
+            # like a hit (returning it writable would let the consumer poison
+            # the entry it just admitted)
+        copy = _defensive_copy(value)
+        count_copy("memcache_cow", _copied_nbytes(copy))
+        return copy
 
     def contains(self, key):
         return self._store().contains(key) or self._inner.contains(key)

@@ -33,6 +33,8 @@ import time
 
 import numpy as np
 
+from petastorm_tpu.io.lease import (LeasedBatch, attach_leases, count_copy,
+                                    take_leases)
 from petastorm_tpu.shuffle import BatchedRandomShufflingBuffer
 from petastorm_tpu.utils import stack_as_column
 
@@ -299,33 +301,59 @@ class _HostBatcher:
     # Batches are assembled from whole/partial chunk VIEWS; the remainder is tracked as
     # an offset into the head chunk instead of re-sliced into a fresh array every cut
     # (the previous whole[batch_size:] copy was O(rowgroup^2/batch) bytes per row group).
+    #
+    # Lease retention (ISSUE 6): a chunk delivered with a lease (zero-copy slab views
+    # from a view-mode wire) records that lease on every per-column entry; each batch
+    # cut from leased chunks RETAINS the contributing leases (a LeasedBatch rides
+    # them downstream), and the batcher's own hold drops as chunks drain — this is
+    # what replaced the per-delivery _detach_slab_views copy-out.
 
-    def _plain_add(self, columns):
+    def _plain_add(self, columns, lease=None):
         n = None
         for name, arr in columns.items():
-            self._pending.setdefault(name, []).append([arr, 0])
+            entry = [arr, 0, lease]
+            if lease is not None:
+                lease.retain()  # one hold per column entry
+            self._pending.setdefault(name, []).append(entry)
             n = len(arr)
+        if lease is not None:
+            lease.release()  # the ownership ref handed in: now held per entry
         if n is not None:
             self._pending_rows += n
 
     def _cut_one(self, take):
         merged = {}
+        batch_leases = {}
+        drained = []
         for name, chunks in self._pending.items():
             parts = []
             need = take
             while need > 0:
-                arr, off = chunks[0]
+                entry = chunks[0]
+                arr, off, lease = entry
+                if lease is not None:
+                    batch_leases[id(lease)] = lease
                 avail = len(arr) - off
                 if avail > need:
                     parts.append(arr[off:off + need])
-                    chunks[0][1] = off + need
+                    entry[1] = off + need
                     need = 0
                 else:
                     parts.append(arr[off:] if off else arr)
                     chunks.pop(0)
+                    if lease is not None:
+                        drained.append(lease)
                     need -= avail
             merged[name] = parts[0] if len(parts) == 1 else _concat(parts)
         self._pending_rows -= take
+        if batch_leases:
+            # retain for the batch BEFORE dropping the drained entries' holds:
+            # a drained entry may hold the last reference, and releasing it
+            # first would return the slab under the batch's feet
+            merged = attach_leases(
+                merged, [lease.retain() for lease in batch_leases.values()])
+        for lease in drained:
+            lease.release()
         return merged
 
     def _plain_cut(self, final=False):
@@ -338,11 +366,17 @@ class _HostBatcher:
 
     # -- public -----------------------------------------------------------------------
 
-    def add(self, columns):
-        """Feed one columnar chunk; returns list of ready full-size batches."""
+    def add(self, columns, lease=None):
+        """Feed one columnar chunk; returns list of ready full-size batches.
+        ``lease`` (ownership transferred in) marks the chunk's arrays as views
+        into lease-backed buffers — only supported on the non-shuffling path
+        (the shuffling buffer holds rows indefinitely, so its feed is detached
+        by the producer instead)."""
         if not self._shuffling:
-            self._plain_add(columns)
+            self._plain_add(columns, lease)
             return self._plain_cut()
+        if lease is not None:  # defensive: the producer never does this
+            lease.release()
         ready = []
         self._buffer.add_many(columns)
         while self._buffer.can_retrieve:
@@ -359,6 +393,18 @@ class _HostBatcher:
             ready.append(self._buffer.retrieve())
         return ready
 
+    def close(self):
+        """Drop the batcher's holds on any still-pending leased chunks (producer
+        teardown mid-epoch: rows that never formed a batch)."""
+        if self._shuffling:
+            return
+        for chunks in self._pending.values():
+            for _arr, _off, lease in chunks:
+                if lease is not None:
+                    lease.release()
+        self._pending.clear()
+        self._pending_rows = 0
+
 
 def _batch_row_count(batch):
     """Rows in a yielded batch (leading dim of the first column; 0 when empty)."""
@@ -371,22 +417,31 @@ def _detach_slab_views(columns):
     """Copy every zero-copy slab view out of a view-mode reader delivery before it
     enters a buffering stage: top-level read-only ndarrays, read-only ELEMENTS of
     object (ragged) columns, and staged payload objects exposing ``detach()`` —
-    all go stale when the Reader releases the batch's lease at its next fetch."""
+    all go stale when the Reader releases the batch's lease at its next fetch.
+
+    Since ISSUE 6 this is the FALLBACK path (shuffling buffers and per-row
+    readers, whose buffering the lease cannot ride); the plain batched path
+    retains the delivery's lease instead of copying. Bytes copied here are
+    charged to the ``loader_detach`` census site."""
     out = {}
+    copied = 0
     for name, v in columns.items():
         if isinstance(v, np.ndarray):
             if v.dtype.hasobject:
                 fresh = np.empty(v.shape, dtype=object)
                 for idx, e in np.ndenumerate(v):
                     if isinstance(e, np.ndarray) and not e.flags.writeable:
+                        copied += e.nbytes
                         e = e.copy()
                     elif hasattr(e, "detach"):
                         e = e.detach()
                     fresh[idx] = e
                 v = fresh
             elif not v.flags.writeable:
+                copied += v.nbytes
                 v = v.copy()
         out[name] = v
+    count_copy("loader_detach", copied)
     return out
 
 
@@ -416,8 +471,19 @@ def _concat(chunks):
         for c in chunks:
             out[pos:pos + len(c)] = c
             pos += len(c)
-        return out
-    return np.concatenate(chunks, axis=0)
+    else:
+        out = np.concatenate(chunks, axis=0)
+    count_copy("loader_concat", out.nbytes)
+    return out
+
+
+def _release_leases(batch):
+    """Release every lease a batch carries (no-op for plain dicts): the tidy
+    path for batches that die inside the pipeline — dropped tails, stopped
+    deliveries, queue drains — so teardown never strands a slab hold until GC
+    (which would count as ``ptpu_lease_leaked_total``)."""
+    for lease in take_leases(batch):
+        lease.release()
 
 
 def _flatten_ngram_window(window):
@@ -533,13 +599,26 @@ class DataLoader:
         code changes. Default None = disabled, one ``is None`` check per
         site. ``DataLoader.health_report()`` works whenever it is on; with
         ``metrics=`` heartbeat ages also export as ``ptpu_health_*`` families.
+    staging : None, bool or int, optional
+        Pinned-host H2D staging (ISSUE 6): the transfer thread copies each
+        batch's device-bound columns into a page-locked slab ring
+        (:class:`petastorm_tpu.io.staging.PinnedStagingPool`) and launches
+        ``device_put`` from there, so the DMA engine reads page-locked memory
+        instead of pageable numpy (no runtime-side pinning/bounce per batch).
+        Default ``None`` = auto: enabled on accelerator backends (TPU/GPU),
+        off on the CPU backend where ``device_put`` may alias host memory and
+        the extra staging copy buys nothing. ``True`` forces it on (still
+        refused, with a ``staging_aliasing`` degradation, on a backend whose
+        ``device_put`` aliases — recycled slabs would corrupt delivered
+        arrays); ``False`` disables; an ``int`` forces it on with that slab
+        size in bytes (otherwise sized from the first staged batch).
     """
 
     def __init__(self, reader, batch_size, sharding=None, shuffling_queue_capacity=0,
                  seed=None, last_batch="drop", device_transform=None, prefetch=2,
                  to_device=True, host_queue_size=8, pad_shapes=None,
                  device_shuffle_capacity=0, device_decode_resize=None, trace=None,
-                 metrics=None, health=None):
+                 metrics=None, health=None, staging=None):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if last_batch not in ("drop", "pad", "partial"):
@@ -590,6 +669,14 @@ class DataLoader:
         self._jitted_transform = None
         self._transform_takes_key = False
         self._transform_step = 0
+        #: (n) -> frozen (gather index, validity mask) for last_batch='pad'
+        self._pad_cache = {}
+        #: pinned-host staging ring (io/staging.py), built lazily on the
+        #: transfer thread from the first staged batch's size; None until then
+        #: (and forever when disabled/refused — see the `staging` parameter)
+        self._staging_arg = staging
+        self._staging = None
+        self._staging_decided = False
         self._producer = None
         self._queue = None
         self._dev_queue = None
@@ -721,14 +808,24 @@ class DataLoader:
         ckpt_cum = 0  # cumulative rows delivered by the reader this generation
         ckpt_deliveries = 0
         ckpt_next_snap = 1
-        # shm wire integration: gauges refresh per delivery, and view-mode batches
-        # (zero-copy READ-ONLY slab views, invalidated at the reader's next fetch)
-        # are detached before they enter the batcher — its chunk deque holds views
-        # across deliveries, which would otherwise read recycled slabs
+        # shm wire integration: gauges refresh per delivery
         wire_stats_fn = getattr(self.reader, "wire_stats", None)
         if wire_stats_fn is not None and not wire_stats_fn():
             wire_stats_fn = None  # thread/dummy pool or socket wire: nothing to poll
-        detach_views = bool(getattr(self.reader, "wire_views", False))
+        wire_views = bool(getattr(self.reader, "wire_views", False))
+        # Lease retention (ISSUE 6): on the plain batched path the view-mode
+        # delivery's lease is TAKEN from the reader and rides the batcher's
+        # chunk deque and every batch cut from it — the old per-delivery
+        # copy-out disappears. Shuffling buffers (rows linger indefinitely),
+        # per-row readers (rows are restacked anyway), and staged device-decode
+        # payloads (opaque objects the batcher cannot track) still detach,
+        # charged to the ``loader_detach`` census site.
+        take_lease_fn = getattr(self.reader, "take_lease", None)
+        lease_mode = (wire_views and take_lease_fn is not None
+                      and not self._shuffling_queue_capacity
+                      and bool(getattr(self.reader, "is_batched_reader", False))
+                      and not getattr(self.reader, "device_decode_fields", None))
+        detach_views = wire_views and not lease_mode
         try:
             it = iter(self.reader)
             while True:
@@ -773,6 +870,7 @@ class DataLoader:
                         [item],
                         object_fields=getattr(self.reader, "device_decode_fields", ()),
                     )
+                lease = take_lease_fn() if lease_mode else None
                 if detach_views:
                     columns = _detach_slab_views(columns)
                 if wire_stats_fn is not None:
@@ -792,7 +890,7 @@ class DataLoader:
                             for i, v in enumerate(col):
                                 if hasattr(v, "detach"):
                                     col[i] = v.detach()
-                ready = batcher.add(columns)
+                ready = batcher.add(columns, lease)
                 dt = time.perf_counter() - t0
                 stats.batch_s += dt
                 if self._trace is not None:
@@ -819,34 +917,24 @@ class DataLoader:
                         self._ckpt_record(ckpt_cum)
                         ckpt_next_snap = ckpt_deliveries \
                             + max(1, ckpt_deliveries // 512)
-                for batch in ready:
-                    if self._stop.is_set():
-                        return
-                    if self.last_batch == "pad":
-                        batch = self._pad(batch)
-                    if not self._put_batch(q, batch, hb):
-                        return
+                if not self._deliver_batches(q, ready, hb):
+                    return
             # tail flush: the same per-batch stop check as the main loop — a stop()
             # during the flush must not leave the producer blocked on an untimed put
-            # after the consumer already exited on the re-injected sentinel
-            for batch in batcher.finish():
-                if self._stop.is_set():
-                    return
-                n = len(next(iter(batch.values()))) if batch else 0
-                if self.last_batch == "drop":
-                    # the shuffling buffer can still hold whole batches at reader
-                    # exhaustion — only the short tail is dropped
-                    if n < self.local_batch_size:
-                        continue
-                elif self.last_batch == "pad":
-                    batch = self._pad(batch)
-                if not self._put_batch(q, batch, hb):
-                    return
+            # after the consumer already exited on the re-injected sentinel. Under
+            # last_batch='drop' the shuffling buffer can still hold whole batches at
+            # reader exhaustion — only the short tail is dropped.
+            if not self._deliver_batches(q, batcher.finish(), hb,
+                                         drop_short=self.last_batch == "drop"):
+                return
         except Exception as e:  # noqa: BLE001 — surfaced to consumer thread
             self._producer_error = e
             if flight is not None:
                 flight.record("producer_error", error=repr(e))
         finally:
+            # drop the batcher's holds on chunks that never formed a batch
+            # (teardown mid-epoch): their slabs go back to the ring now
+            batcher.close()
             if flight is not None:
                 flight.record("queue", event="producer_end_of_stream")
             if hb is not None:
@@ -874,6 +962,28 @@ class DataLoader:
             hb.beat("batch")
         return ok
 
+    def _deliver_batches(self, q, batches, hb, drop_short=False):
+        """Push cut batches into the host queue, padding per ``last_batch``.
+        Returns False once the loader is stopped (or the put gives up); on any
+        early exit — and for a ``drop_short`` tail — the undelivered batches'
+        leases are released so teardown never strands a slab hold until GC."""
+        for i, batch in enumerate(batches):
+            if self._stop.is_set():
+                for b in batches[i:]:
+                    _release_leases(b)
+                return False
+            if drop_short and _batch_row_count(batch) < self.local_batch_size:
+                _release_leases(batch)
+                continue
+            if self.last_batch == "pad":
+                batch = self._pad(batch)
+            if not self._put_batch(q, batch, hb):
+                _release_leases(batch)
+                for b in batches[i + 1:]:
+                    _release_leases(b)
+                return False
+        return True
+
     def _pad(self, batch):
         n = len(next(iter(batch.values()))) if batch else 0
         if n == 0 or n == self.local_batch_size:
@@ -881,14 +991,42 @@ class DataLoader:
                 batch["__valid__"] = np.ones(n, dtype=bool)
             return batch
         pad = self.local_batch_size - n
-        idx = np.concatenate([np.arange(n), np.full(pad, n - 1)])
+        # the gather index and validity mask depend only on (n, batch_size):
+        # built once per row count and frozen, instead of the old
+        # np.concatenate([arange, full]) rebuild on every partial batch
+        cached = self._pad_cache.get(n)
+        if cached is None:
+            idx = np.concatenate([np.arange(n), np.full(pad, n - 1)])
+            idx.flags.writeable = False
+            valid = np.concatenate([np.ones(n, dtype=bool),
+                                    np.zeros(pad, dtype=bool)])
+            valid.flags.writeable = False
+            cached = self._pad_cache[n] = (idx, valid)
+        idx, valid = cached
+        leases = take_leases(batch)
         out = {}
+        copied = 0
         for name, arr in batch.items():
             if isinstance(arr, np.ndarray):
-                out[name] = arr[idx]
+                gathered = arr[idx]  # fancy indexing: an owned copy...
+                if arr.dtype == object:
+                    # ...of the OUTER pointers only: ragged ELEMENTS may still
+                    # be read-only views into a leased slab the release below
+                    # recycles — copy them owned (what _detach_slab_views does
+                    # on the non-lease path)
+                    for i, e in np.ndenumerate(gathered):
+                        if isinstance(e, np.ndarray) and not e.flags.writeable:
+                            gathered[i] = e.copy()
+                            copied += e.nbytes
+                else:
+                    copied += gathered.nbytes
+                out[name] = gathered
             else:  # non-ndarray sequence: repeat the last element so every column is
                 out[name] = list(arr) + [arr[-1]] * pad  # batch_size long (ADVICE r1)
-        out["__valid__"] = np.concatenate([np.ones(n, dtype=bool), np.zeros(pad, dtype=bool)])
+        count_copy("loader_pad", copied)
+        for lease in leases:
+            lease.release()  # every column was gathered out of the leased views
+        out["__valid__"] = valid.copy()  # consumers own (and may mutate) the mask
         return out
 
     # -- consumer side ------------------------------------------------------------------
@@ -1077,6 +1215,49 @@ class DataLoader:
         arrays.update(host)
         return arrays
 
+    def _ensure_staging(self, device):
+        """Resolve (once) and return the pinned H2D staging pool, or None.
+
+        Decided lazily on the transfer thread from the first device-bound
+        batch: ``staging=None`` auto-enables on accelerator backends only;
+        ``True``/an int force it — but ANY mode is refused when this backend's
+        ``device_put`` aliases host memory (recycled slabs would corrupt
+        delivered arrays), with a ``staging_aliasing`` degradation."""
+        if self._staging_decided:
+            return self._staging
+        sizes = [v.nbytes for v in device.values() if isinstance(v, np.ndarray)]
+        if not sizes:
+            return None  # nothing stageable yet: decide on a later batch
+        self._staging_decided = True
+        arg = self._staging_arg
+        if arg is False:
+            return None
+        from petastorm_tpu.io.staging import (PinnedStagingPool, _STAGE_ALIGN,
+                                              device_put_aliases_host)
+
+        if arg is None:
+            import jax
+
+            if jax.default_backend() == "cpu" or device_put_aliases_host():
+                return None  # auto mode: pageable→pinned buys nothing on CPU
+        elif device_put_aliases_host():
+            from petastorm_tpu.obs.log import degradation
+
+            degradation(
+                "staging_aliasing",
+                "DataLoader(staging=%r) refused: this backend's device_put "
+                "ALIASES host numpy memory, so staging-slab reuse would "
+                "corrupt delivered batches; transferring from pageable "
+                "memory", arg)
+            return None
+        need = 0
+        for nbytes in sizes:
+            need = -(-need // _STAGE_ALIGN) * _STAGE_ALIGN + nbytes
+        slab_bytes = int(arg) if not isinstance(arg, bool) and arg is not None \
+            else need
+        self._staging = PinnedStagingPool(max(slab_bytes, need), num_slabs=2)
+        return self._staging
+
     def _transfer_batch(self, batch):
         """Staged decode + device_put with the configured sharding. Returns the device
         arrays and the host-only (string/object) columns separately."""
@@ -1096,6 +1277,7 @@ class DataLoader:
         if hb is not None:
             hb.beat("h2d")
         t0 = time.perf_counter()
+        leases = take_leases(batch)
         device = {k: v for k, v in batch.items() if _is_device_dtype(v)}
         host = {k: v for k, v in batch.items() if k not in device}
         for name, arr in host.items():
@@ -1109,6 +1291,29 @@ class DataLoader:
                 )
         if host:
             logger.debug("Fields kept host-side (non-device dtypes): %s", sorted(host))
+            if leases:
+                # host columns outlive this thread (they ride to the consumer
+                # past the lease release below) — copy them out of the slabs
+                host = _detach_slab_views(host)
+        staging_lease = None
+        pool = self._ensure_staging(device) if device else None
+        if pool is not None:
+            # one copy into a page-locked slab; device_put below DMAs straight
+            # from it (and the original — possibly leased — buffers are done)
+            device, staging_lease = pool.stage(device)
+        elif leases:
+            from petastorm_tpu.io.staging import device_put_aliases_host
+
+            if device_put_aliases_host():
+                # this backend's device_put ALIASES host numpy: transferring the
+                # leased slab views directly would hand the consumer arrays into
+                # memory the release below recycles — copy them owned first
+                copied = 0
+                for name, arr in list(device.items()):
+                    if isinstance(arr, np.ndarray) and not arr.flags.writeable:
+                        device[name] = arr.copy()
+                        copied += arr.nbytes
+                count_copy("h2d_owned_copy", copied)
         if self.sharding is None:
             arrays = jax.device_put(device)
         else:
@@ -1126,6 +1331,14 @@ class DataLoader:
                 else:
                     arrays[name] = jax.device_put(arr, s)
         arrays.update(staged)
+        if staging_lease is not None or leases:
+            # the H2D copy may still be reading the source buffers (device_put
+            # is async): wait for it before the slabs go back to their rings
+            jax.block_until_ready(arrays)
+            if staging_lease is not None:
+                staging_lease.release()
+            for lease in leases:
+                lease.release()
         dt = time.perf_counter() - t0
         self.stats.h2d_s += dt
         if self._trace is not None:
@@ -1169,6 +1382,7 @@ class DataLoader:
         if not self._device_shuffle_capacity:
             for batch in self._host_batches(host_q):
                 if self._stop.is_set():
+                    _release_leases(batch)
                     return
                 n = _batch_valid_rows(batch)
                 yield self._to_device(batch), n
@@ -1189,6 +1403,7 @@ class DataLoader:
                                        shardings=_ring_sharding)
         for batch in self._host_batches(host_q):
             if self._stop.is_set():
+                _release_leases(batch)
                 return
             arrays, host = self._transfer_batch(batch)
             if host:
@@ -1222,9 +1437,21 @@ class DataLoader:
                     self._advance_consumed(_batch_valid_rows(rest))
                     yield rest
             else:
-                for batch in self._host_batches(host_q):
-                    self._advance_consumed(_batch_valid_rows(batch))
-                    yield batch
+                # lease-backed batches stay valid until the consumer asks for
+                # the NEXT one (same cadence as Reader.release_batch): the
+                # previous batch's slabs return to the ring here, and the last
+                # one's at generator close
+                prev = None
+                try:
+                    for batch in self._host_batches(host_q):
+                        if prev is not None:
+                            prev.release()
+                        prev = batch if isinstance(batch, LeasedBatch) else None
+                        self._advance_consumed(_batch_valid_rows(batch))
+                        yield batch
+                finally:
+                    if prev is not None:
+                        prev.release()
             return
         if self.prefetch <= 0:  # synchronous transfer (debug)
             for batch, local_rows in self._device_batches(host_q):
@@ -1373,7 +1600,14 @@ class DataLoader:
                 # globals (incl. Empty) may already be torn down to None.
                 try:
                     while True:
-                        q.get_nowait()
+                        item = q.get_nowait()
+                        # a drained batch may still carry slab/staging leases —
+                        # return them to their rings now instead of stranding
+                        # them until GC (counted as ptpu_lease_leaked_total)
+                        try:
+                            _release_leases(item)
+                        except Exception:  # noqa: BLE001
+                            pass  # graftlint: disable=GL-O002 (teardown: lease module may be torn down)
                 except Exception:  # noqa: BLE001
                     pass  # graftlint: disable=GL-O002 (interpreter teardown: queue globals may be None)
                 # the drain may have consumed the producer's end-of-stream sentinel
@@ -1469,6 +1703,9 @@ class DataLoader:
         self.join()
         self.reader.stop()
         self.reader.join()
+        if self._staging is not None:
+            self._staging.close()
+            self._staging = None
         if self._obs is not None:
             self._obs.close()
         if self._health is not None:
@@ -2051,7 +2288,7 @@ _UNSET = object()
 #: re-stated here).
 _LOADER_OPTS = ("last_batch", "device_transform", "prefetch", "pad_shapes",
                 "device_shuffle_capacity", "to_device", "host_queue_size",
-                "device_decode_resize", "trace", "metrics", "health")
+                "device_decode_resize", "trace", "metrics", "health", "staging")
 
 
 def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1,
@@ -2060,7 +2297,7 @@ def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1
                     pad_shapes=_UNSET, device_shuffle_capacity=_UNSET,
                     to_device=_UNSET, host_queue_size=_UNSET,
                     device_decode_resize=_UNSET, trace=_UNSET, metrics=_UNSET,
-                    health=_UNSET, **reader_kwargs):
+                    health=_UNSET, staging=_UNSET, **reader_kwargs):
     """One-call convenience: ``make_batch_reader`` + :class:`DataLoader`.
 
     ``reader_kwargs`` pass through to :func:`petastorm_tpu.reader.make_batch_reader`

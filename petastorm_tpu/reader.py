@@ -26,6 +26,7 @@ import random
 import re
 import threading
 import time
+import weakref
 
 import numpy as np
 
@@ -191,6 +192,21 @@ class _WorkerBase:
         state["_io_tracer"] = None
         state["_io_health"] = None  # owns threads — never crosses the pickle wire
         return state
+
+    def _cache_get(self, key, fill):
+        """Cache read under the lease contract (ISSUE 6): a lease-aware cache
+        (``MemCache``) serves zero-copy READ-ONLY views by default, but a host
+        ``TransformSpec`` runs user code against the returned payload (pandas
+        frames / row dicts aliasing the cached arrays) that may legitimately
+        mutate in place — that is the one consumer that "actually writes", so
+        the worker escalates to the cache's copy-on-write path up front."""
+        writable = (self._transform_spec is not None
+                    and not self._transform_spec.device
+                    and self._transform_spec.func is not None)
+        get_writable = getattr(self._cache, "get_writable", None)
+        if writable and get_writable is not None:
+            return get_writable(key, fill)
+        return self._cache.get(key, fill)
 
     def _parquet_file(self, path):
         import pyarrow.parquet as pq
@@ -485,7 +501,7 @@ class PyDictWorker(_WorkerBase):
         cache_key = _cache_key(piece, self._read_schema, self._predicate, self._filters,
                                item[1], self._drop_partitions, self._seed,
                                self._device_fields)
-        rows = self._cache.get(cache_key, lambda: self._load_rows(item))
+        rows = self._cache_get(cache_key, lambda: self._load_rows(item))
         if self._transform_spec is not None and not self._transform_spec.device \
                 and self._transform_spec.func is not None:
             rows = [self._transform_spec.func(dict(r)) for r in rows]
@@ -595,7 +611,7 @@ class ArrowWorker(_WorkerBase):
         cache_key = _cache_key(piece, self._read_schema, self._predicate, self._filters,
                                item[1], self._drop_partitions, self._seed,
                                self._device_fields)
-        columns = self._cache.get(cache_key, lambda: self._load_columns(item))
+        columns = self._cache_get(cache_key, lambda: self._load_columns(item))
         if self._transform_spec is not None and not self._transform_spec.device \
                 and self._transform_spec.func is not None:
             import pandas as pd
@@ -1089,9 +1105,15 @@ class Reader:
         self._resume_epoch = 0  # every epoch below this is fully consumed
         self.last_row_consumed = False
         self.stopped = False
-        #: slab lease of the CURRENT batch/row-buffer on the shm view wire — held
-        #: until the consumer asks for the next batch (or calls release_batch())
+        #: lease of the CURRENT batch/row-buffer on a view-mode wire — held
+        #: until the consumer asks for the next batch (or calls release_batch()
+        #: / takes ownership via take_lease())
         self._held_lease = None
+        #: every lease this reader ever delivered that is possibly still
+        #: retained by a consumer — revoked wholesale when reset() rebuilds the
+        #: executor, so stale views raise LeaseRevoked instead of reading a
+        #: recycled slab (weak: released leases fall out on their own)
+        self._issued_leases = weakref.WeakSet()
         self._start()
 
     def _start(self):
@@ -1153,7 +1175,8 @@ class Reader:
                     self.last_row_consumed = True
                 raise StopIteration
             epoch, ordinal, payload = nxt
-            self._held_lease = getattr(payload, "shm_lease", None)
+            self._held_lease = self._register_lease(
+                getattr(payload, "lease", None))
             if not payload:
                 self._mark_consumed((epoch, ordinal))  # fully-filtered group
                 continue
@@ -1186,7 +1209,8 @@ class Reader:
                 raise StopIteration
             epoch, ordinal, columns = nxt
             if isinstance(columns, dict):
-                self._held_lease = columns.pop(_SHM_LEASE_KEY, None)
+                self._held_lease = self._register_lease(
+                    columns.pop(_SHM_LEASE_KEY, None))
             self._mark_consumed((epoch, ordinal))  # batch delivery is atomic
             if not columns or len(next(iter(columns.values()))) == 0:
                 self.release_batch()
@@ -1198,7 +1222,27 @@ class Reader:
             return self._row_type(**{name: columns.get(name)
                                      for name in self.schema.fields})
 
-    # -- shm wire integration -----------------------------------------------------------
+    # -- lease-backed wire integration ---------------------------------------------------
+
+    def _register_lease(self, lease):
+        """Track a delivered lease for revocation: ``reset()`` rebuilds the
+        executor (and with it the slab ring backing any outstanding views), so
+        every lease issued by the PREVIOUS executor generation must be revoked
+        there — a consumer holding one across the rebuild gets a clear
+        :class:`~petastorm_tpu.errors.LeaseRevoked`, never recycled memory."""
+        if lease is not None and hasattr(lease, "revoke"):
+            self._issued_leases.add(lease)
+        return lease
+
+    def take_lease(self):
+        """Transfer ownership of the CURRENT batch's lease to the caller (the
+        zero-copy DataLoader path): the reader will no longer release it at the
+        next fetch — the caller must ``release()`` it when the batch's buffers
+        are done (or rely on refcount GC, counted as a leak). Returns ``None``
+        when the current delivery is not lease-backed (thread/dummy pools,
+        socket wires, per-item slab fallbacks)."""
+        lease, self._held_lease = self._held_lease, None
+        return lease
 
     def release_batch(self):
         """Return the current batch's shared-memory slab to the pool's ring (shm
@@ -1280,9 +1324,17 @@ class Reader:
     # -- lifecycle ----------------------------------------------------------------------
 
     def reset(self):
-        """Restart epochs on an existing reader (reference ``Reader.reset`` ~L700)."""
+        """Restart epochs on an existing reader (reference ``Reader.reset`` ~L700).
+
+        Revokes every outstanding lease this reader issued: the executor
+        rebuild below recycles the slab ring those leases' views point into, so
+        a batch retained across the reset must fail loud
+        (:class:`~petastorm_tpu.errors.LeaseRevoked`) rather than read reused
+        memory."""
         self.stop()
         self.join()
+        for lease in list(self._issued_leases):
+            lease.revoke()
         self._plan.reset()
         self._buffer = []
         self._buffer_pos = 0
@@ -1385,7 +1437,9 @@ def _maybe_memcache(cache, io_opts):
         return cache
     from petastorm_tpu.io.memcache import MemCache
 
-    return MemCache(io_opts.memcache_bytes, inner=cache)
+    return MemCache(io_opts.memcache_bytes, inner=cache,
+                    writable_hits=getattr(io_opts, "memcache_writable_hits",
+                                          False))
 
 
 def _resolve_ngram_schema(schema_fields, stored_schema, predicate):

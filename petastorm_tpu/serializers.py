@@ -29,8 +29,8 @@ contract — arrays a consumer may mutate in place. The default (``writable=True
 copies exactly the read-only reconstructions (one payload copy, the same count the
 old socket wire paid AFTER its recv copy). ``writable=False`` ("view mode",
 serializer names ending in ``-view``) skips that copy and delivers READ-ONLY
-zero-copy views into the slab plus a :class:`~petastorm_tpu.parallel.shm_ring.
-SlabLease` riding with the batch; a consumer that mutates gets an immediate
+zero-copy views into the slab plus a :class:`petastorm_tpu.io.lease.Lease`
+riding with the batch; a consumer that mutates gets an immediate
 ``ValueError: assignment destination is read-only`` (fail-loud, never corruption),
 and the slab returns to the ring when the lease is released —
 ``Reader.release_batch()``, batch drop (refcount), or pool ``join()``.
@@ -41,15 +41,19 @@ import pickle
 
 import numpy as np
 
+from petastorm_tpu.io.lease import LEASE_KEY, Lease, count_copy
 from petastorm_tpu.obs.log import degradation
 
 KIND_PICKLE = 0
 KIND_ARROW = 1
 KIND_SHM = 2
 
-#: reserved key under which a view-mode batch's slab lease rides inside the tagged
-#: columnar payload dict — the Reader pops it before exposing the batch
-SHM_LEASE_KEY = "__shm_lease__"
+#: reserved key under which a view-mode batch's lease rides inside the tagged
+#: columnar payload dict — the Reader pops it before exposing the batch. Since
+#: ISSUE 6 this is the GENERIC :class:`petastorm_tpu.io.lease.Lease` key (the
+#: slab ring is one backend of the contract, not a special case); the old name
+#: is kept as an alias for existing imports.
+SHM_LEASE_KEY = LEASE_KEY
 
 #: frame offsets inside a slab are rounded up to this (cache-line / SIMD-friendly
 #: reconstruction of ndarray views)
@@ -62,15 +66,27 @@ def _ensure_writable(obj):
     Out-of-band pickle-5 buffers and zero-copy Arrow views reconstruct as read-only
     ndarrays; a consumer mutating batches in place (``batch['image'] /= 255``) must not
     break depending on pool type. Copies only when actually read-only — the same copy
-    count as the old monolithic-pickle wire, still saving its stream-assembly copy."""
+    count as the old monolithic-pickle wire, still saving its stream-assembly copy.
+    Every byte copied here is charged to the ``wire_writable`` copy-census site
+    (the `-view` wires exist to make this number zero)."""
+    copied = [0]
+    out = _ensure_writable_impl(obj, copied)
+    count_copy("wire_writable", copied[0])
+    return out
+
+
+def _ensure_writable_impl(obj, copied):
     if isinstance(obj, np.ndarray):
-        return obj if obj.dtype.hasobject or obj.flags.writeable else obj.copy()
+        if obj.dtype.hasobject or obj.flags.writeable:
+            return obj
+        copied[0] += obj.nbytes
+        return obj.copy()
     if isinstance(obj, dict):
-        return {k: _ensure_writable(v) for k, v in obj.items()}
+        return {k: _ensure_writable_impl(v, copied) for k, v in obj.items()}
     if isinstance(obj, list):
-        return [_ensure_writable(v) for v in obj]
+        return [_ensure_writable_impl(v, copied) for v in obj]
     if isinstance(obj, tuple):
-        return tuple(_ensure_writable(v) for v in obj)
+        return tuple(_ensure_writable_impl(v, copied) for v in obj)
     return obj
 
 
@@ -200,10 +216,10 @@ class ArrowTableSerializer(PickleSerializer):
 
 
 class _LeasedRows(list):
-    """Per-row payload list that carries its slab lease (view mode); the Reader
+    """Per-row payload list that carries its lease (view mode); the Reader
     holds the lease while it drains the buffered rows."""
 
-    shm_lease = None
+    lease = None
 
 
 class ShmSerializer:
@@ -218,8 +234,10 @@ class ShmSerializer:
     Parent side (``bind_ring``): reconstructs the inner frames as zero-copy
     memoryviews into the slab. With ``writable=True`` (default) the inner
     deserializer's writable-batch copy runs and the slab is released immediately;
-    with ``writable=False`` read-only views are delivered with a
-    :class:`~petastorm_tpu.parallel.shm_ring.SlabLease` attached to the payload.
+    with ``writable=False`` read-only views are delivered with a refcounted
+    :class:`petastorm_tpu.io.lease.Lease` (backed by the ring's
+    :class:`~petastorm_tpu.parallel.shm_ring.SlabLease`) attached to the
+    payload.
     """
 
     def __init__(self, inner_name="pickle", writable=True):
@@ -284,7 +302,14 @@ class ShmSerializer:
         inner_kind, slab, offsets = pickle.loads(frames[0])
         from petastorm_tpu.parallel.shm_ring import SlabLease
 
-        lease = SlabLease(self._ring, slab)
+        # view mode speaks the generic Lease contract over the slab backend:
+        # the ring's own SlabLease keeps the exactly-once free-list insert, the
+        # Lease adds refcounting (retain per holder), revocation, and the
+        # ptpu_lease_* accounting the loader's retention path builds on. The
+        # writable path releases before returning, so it skips the wrapper.
+        slab_lease = SlabLease(self._ring, slab)
+        lease = slab_lease if self.writable \
+            else Lease(release_cb=slab_lease.release, kind="shm_slab")
         try:
             base = self._ring.buffer(slab)
             self._ring.add_bytes(sum(length for _s, length in offsets))
@@ -336,6 +361,7 @@ class ShmSerializer:
         frames = [base[head_start:head_start + head_len].toreadonly()]
         frames += [bytearray(base[start:start + length])
                    for start, length in offsets[1:]]
+        count_copy("wire_owned", sum(length for _s, length in offsets[1:]))
         return self.inner.deserialize(inner_kind, frames)
 
     @staticmethod
@@ -349,7 +375,7 @@ class ShmSerializer:
                 return result
             if isinstance(payload, list):
                 leased = _LeasedRows(payload)
-                leased.shm_lease = lease
+                leased.lease = lease
                 return (epoch, ordinal, leased)
         return None
 

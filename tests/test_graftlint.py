@@ -481,6 +481,88 @@ def test_lifecycle_readahead_and_memcache_clean_forms():
     assert findings == []
 
 
+_L001_LEASE_LEAK_POSITIVE = """
+    from petastorm_tpu.io.lease import Lease
+
+    def leak_slab_hold(slab_cb, batch):
+        lease = Lease(release_cb=slab_cb)  # BUG: never released
+        batch.use()
+"""
+
+
+def test_lifecycle_fires_on_leaked_lease():
+    """ISSUE-6 extension: constructing a Lease IS the acquire (refcount 1 over
+    someone else's buffers); dropping it without release() strands the slab
+    until GC — the runtime counts that as ptpu_lease_leaked_total, the linter
+    catches the straight-line cases statically."""
+    findings, _ = _lint(_L001_LEASE_LEAK_POSITIVE)
+    f = _only_rule(findings, "GL-L001")[0]
+    assert f.line == _line_of(_L001_LEASE_LEAK_POSITIVE, "BUG: never released")
+
+
+_L001_LEASE_DOUBLE_RELEASE_POSITIVE = """
+    def free_twice(lease, work):
+        work(lease)
+        lease.release()
+        work.finish()
+        lease.release()  # BUG: double release
+"""
+
+
+def test_lifecycle_fires_on_double_release():
+    """The other side of the lease discipline: exactly-once release per retain.
+    A second release() on the same name in straight-line code is the caller bug
+    LeaseError raises on at runtime — flagged statically here."""
+    findings, _ = _lint(_L001_LEASE_DOUBLE_RELEASE_POSITIVE)
+    f = _only_rule(findings, "GL-L001")[0]
+    assert f.line == _line_of(_L001_LEASE_DOUBLE_RELEASE_POSITIVE,
+                              "BUG: double release")
+
+
+def test_lifecycle_lease_clean_forms():
+    findings, _ = _lint("""
+        from petastorm_tpu.io.lease import Lease, LeasedBatch
+        from petastorm_tpu.io.staging import PinnedStagingPool
+
+        def released_in_finally(slab_cb, work):
+            lease = Lease(release_cb=slab_cb)
+            try:
+                work(lease)
+            finally:
+                lease.release()
+
+        def handed_off(slab_cb, batch):
+            return LeasedBatch(batch, [Lease(release_cb=slab_cb)])
+
+        def retain_rebalances(lease, work):
+            lease.retain()
+            work(lease)
+            lease.release()
+            lease.release()  # balanced: the retain() granted a second release
+
+        def rebind_resets(make_lease):
+            lease = make_lease()
+            lease.release()
+            lease = make_lease()
+            lease.release()  # a different lease: rebind resets tracking
+
+        def tuple_rebind_resets(make_lease, make_two):
+            lease = make_lease()
+            lease.release()
+            lease, other = make_two()
+            lease.release()  # rebound inside a tuple target: still a new lease
+            other.release()
+
+        def staging_pool_closed():
+            pool = PinnedStagingPool(1 << 20, num_slabs=2)
+            try:
+                return pool.stage({})
+            finally:
+                pool.close()
+    """)
+    assert findings == []
+
+
 # -- GL-J001/J002/J003: JAX tracing hazards ---------------------------------------------
 
 _J001_POSITIVE = """

@@ -286,7 +286,11 @@ def test_readahead_coalesces_adjacent_reads():
 # -- MemCache ---------------------------------------------------------------------------
 
 
-def test_memcache_hit_skips_fill_and_is_defensive():
+def test_memcache_hit_skips_fill_and_serves_readonly_views():
+    """Lease contract (ISSUE 6): hits AND the admit-path return are zero-copy
+    READ-ONLY views of the stored entry — a mutating consumer fails loud
+    (ValueError) instead of silently poisoning later epochs' hits, and the
+    per-hit memcpy of the old defensive-copy contract is gone."""
     shared_store().clear()
     cache = MemCache(1 << 20)
     try:
@@ -300,18 +304,68 @@ def test_memcache_hit_skips_fill_and_is_defensive():
         second = cache.get("k1", fill)
         assert len(fills) == 1
         np.testing.assert_array_equal(first["x"], second["x"])
-        # mutating a served batch must not poison later hits
-        second["x"][:] = -1
+        assert not first["x"].flags.writeable
+        assert not second["x"].flags.writeable
+        with pytest.raises(ValueError):
+            second["x"][:] = -1  # fail-loud, never cache poisoning
+        # fresh CONTAINERS per serve: key removal stays consumer-local
+        second.pop("x")
         third = cache.get("k1", fill)
         np.testing.assert_array_equal(third["x"], np.arange(8))
+        assert len(fills) == 1
         assert cache.contains("k1") and not cache.contains("k2")
     finally:
         cache.clear()
 
 
-def test_memcache_object_dtype_elements_not_aliased():
+def test_memcache_get_writable_is_cow_and_never_aliases_store():
+    """get_writable is the copy-on-write escalation (host TransformSpec): an
+    owned writable deep copy on BOTH the miss and the hit path, never aliasing
+    the read-only entry other consumers' views share."""
+    shared_store().clear()
+    cache = MemCache(1 << 20)
+    try:
+        fills = []
+
+        def fill():
+            fills.append(1)
+            return {"x": np.arange(8, dtype=np.int64)}
+
+        first = cache.get_writable("k1", fill)  # miss path
+        assert first["x"].flags.writeable
+        first["x"][:] = -1
+        second = cache.get_writable("k1", fill)  # hit path
+        assert len(fills) == 1
+        assert second["x"].flags.writeable
+        np.testing.assert_array_equal(second["x"], np.arange(8))
+        second["x"][:] = -2
+        np.testing.assert_array_equal(cache.get("k1", fill)["x"], np.arange(8))
+    finally:
+        cache.clear()
+
+
+def test_memcache_writable_hits_restores_legacy_copy_contract():
+    """writable_hits=True is the copying baseline `petastorm-tpu-bench copies`
+    measures against: every serve is an owned writable deep copy."""
+    shared_store().clear()
+    cache = MemCache(1 << 20, writable_hits=True)
+    try:
+        fill = lambda: {"x": np.arange(4, dtype=np.int64)}  # noqa: E731
+        first = cache.get("k", fill)
+        assert first["x"].flags.writeable
+        first["x"][:] = -1
+        second = cache.get("k", fill)
+        assert second["x"].flags.writeable
+        np.testing.assert_array_equal(second["x"], np.arange(4))
+    finally:
+        cache.clear()
+
+
+def test_memcache_object_dtype_elements_readonly_and_cow_not_aliased():
     """Ragged columns decode to object-dtype arrays whose ELEMENTS are
-    ndarrays; a shallow outer copy would leave those aliased to the store."""
+    ndarrays. Served views freeze the elements too (an element write fails
+    loud), and the get_writable escalation deep-copies them — a shallow outer
+    copy would leave the element arrays aliased to the store."""
     shared_store().clear()
     cache = MemCache(1 << 20)
     try:
@@ -322,11 +376,15 @@ def test_memcache_object_dtype_elements_not_aliased():
             return {"ragged": col}
 
         first = cache.get("k", fill)
-        first["ragged"][0][0, 0] = 777.0  # mutate an ELEMENT array in place
-        second = cache.get("k", fill)
-        assert second["ragged"][0][0, 0] == 0.0
-        second["ragged"][1][0, 0] = -5.0
+        with pytest.raises(ValueError):
+            first["ragged"][0][0, 0] = 777.0  # ELEMENT arrays frozen too
+        # outer pointer reassignment is consumer-local (fresh outer array)
+        first["ragged"][0] = None
+        writable = cache.get_writable("k", fill)
+        writable["ragged"][0][0, 0] = 777.0  # owned deep copy: mutable
+        writable["ragged"][1][0, 0] = -5.0
         third = cache.get("k", fill)
+        assert third["ragged"][0][0, 0] == 0.0  # store never poisoned
         assert third["ragged"][1][0, 0] == 0.0
     finally:
         cache.clear()
@@ -416,14 +474,17 @@ def test_readahead_error_entries_age_out():
         pool.shutdown()
 
 
-def test_memcache_miss_path_does_not_alias_store():
-    """The FIRST consumer (miss path) gets a batch too — mutating it must not
-    poison the cached entry any more than mutating a hit-path copy would."""
+def test_memcache_miss_path_serves_readonly_too():
+    """The FIRST consumer (miss/admit path) gets the same read-only-view
+    contract as a hit — a mutation there would poison the just-admitted entry
+    exactly like a hit-path mutation, so it fails loud the same way."""
     shared_store().clear()
     cache = MemCache(1 << 20)
     try:
         first = cache.get("k", lambda: {"x": np.arange(4, dtype=np.int64)})
-        first["x"][:] = -1  # writable-batch contract: consumers may do this
+        assert not first["x"].flags.writeable
+        with pytest.raises(ValueError):
+            first["x"][:] = -1
         second = cache.get("k", lambda: {"x": np.zeros(4, np.int64)})
         np.testing.assert_array_equal(second["x"], np.arange(4))
     finally:

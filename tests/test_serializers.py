@@ -256,8 +256,11 @@ def test_shm_wire_writable_contract_default_and_view():
         assert ring.stats()["shm_slabs_in_flight"] == 1  # consumer holds the slab
         lease.release()
         assert ring.stats()["shm_slabs_in_flight"] == 0
-        lease.release()  # idempotent: double release must not double-free
-        assert ring.stats()["shm_slabs_in_flight"] == 0
+        from petastorm_tpu.errors import LeaseError
+
+        with pytest.raises(LeaseError):
+            lease.release()  # fail-loud: a double release is a caller bug that
+        assert ring.stats()["shm_slabs_in_flight"] == 0  # must never double-free
     finally:
         ring.close()
 
