@@ -16,7 +16,9 @@ from petastorm_tpu.errors import (  # noqa: F401
     MetadataError,
     NoDataAvailableError,
     PetastormTpuError,
+    StallError,
     TimeoutWaitingForResultError,
+    WorkerDiedError,
 )
 from petastorm_tpu.transform import TransformSpec, transform_schema  # noqa: F401
 from petastorm_tpu.unischema import (  # noqa: F401
@@ -49,6 +51,10 @@ def __getattr__(name):
             from petastorm_tpu.loader import InMemDataLoader
 
             return InMemDataLoader
+        if name == "RecoveryOptions":
+            from petastorm_tpu.recovery import RecoveryOptions
+
+            return RecoveryOptions
         if name == "checkpoint":
             import importlib
 

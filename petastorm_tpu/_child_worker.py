@@ -10,7 +10,9 @@ with ``multiprocessing.connection`` replacing ZeroMQ.
 Protocol: parent sends sys.path, the serializer name (an ``shm``-family name is
 followed by the slab-ring attach config — segment names + slab size), a health
 config dict (``stack_dump_dir`` + ``ping_interval_s``, ISSUE 5), then the
-pickled worker; then items. On the socket wire each item message is
+pickled worker; the child answers ``("pid", pid)`` (ISSUE 7: the parent ties
+the connection to its OS process — accept order is not spawn order — so the
+stall-heal tier can kill the right hung child); then items. On the socket wire each item message is
 ``(item, hints)``; on the shm wire it is ``(slab_id_or_None, item, hints)`` —
 the slab is the parent's grant for this item's result (None = ring starved,
 serialize over the socket). ``hints`` are the driver's remaining claimed plan
@@ -59,19 +61,22 @@ def main():
     perf_anchor = time.perf_counter()
     pid = os.getpid()
     try:
+        # Bootstrap recvs are unbounded by design (GL-R001 disables below): the
+        # parent sends every handshake message back-to-back right after accept,
+        # and if it dies instead the closed pipe raises EOFError — handled.
         # parent's sys.path first, so the worker pickle can resolve user modules
-        for entry in conn.recv():
+        for entry in conn.recv():  # graftlint: disable=GL-R001 (bootstrap; EOF on parent death)
             if entry not in sys.path:
                 sys.path.append(entry)
         from petastorm_tpu.serializers import make_serializer
 
-        serializer_name = conn.recv()
+        serializer_name = conn.recv()  # graftlint: disable=GL-R001 (bootstrap; EOF on parent death)
         serializer = make_serializer(serializer_name)
         shm_wire = serializer_name.startswith("shm")
         if shm_wire:
-            slab_names, slab_bytes = conn.recv()
+            slab_names, slab_bytes = conn.recv()  # graftlint: disable=GL-R001 (bootstrap; EOF on parent death)
             serializer.bind_slabs(slab_names, slab_bytes)
-        health_cfg = conn.recv()
+        health_cfg = conn.recv()  # graftlint: disable=GL-R001 (bootstrap; EOF on parent death)
         ping_s = float(health_cfg.get("ping_interval_s") or 0)
         dump_dir = health_cfg.get("stack_dump_dir")
         if dump_dir:
@@ -89,7 +94,19 @@ def main():
                                           all_threads=True)
                 except OSError:
                     pass  # no dump file = driver stacks only, never a crash
-        worker = conn.recv()
+        worker = conn.recv()  # graftlint: disable=GL-R001 (bootstrap; EOF on parent death)
+        # pid ack: ties this connection to its OS process in the parent's
+        # bookkeeping (accept order is not spawn order) — the heal tier kills
+        # hung children by exactly this mapping (ISSUE 7)
+        conn.send(("pid", pid))
+        # chaos bootstrap (ISSUE 7): a parent armed while spawning exports its
+        # FaultPlan as PTPU_CHAOS_SPEC; in-child hook sites (child.item, plus
+        # the worker's own reader.read/io.readahead) evaluate this process's
+        # copy. in_child=True opts into the 'kill' action — os._exit mid-item,
+        # exactly a crashed child.
+        from petastorm_tpu import chaos as _chaos
+
+        _chaos.arm_from_env(in_child=True)
         prefetch = getattr(worker, "prefetch", None)
         while True:
             if ping_s:
@@ -98,7 +115,10 @@ def main():
                 # because this thread is the only sender)
                 while not conn.poll(ping_s):
                     conn.send(("hb", time.time()))
-            msg = conn.recv()
+            # unbounded by design: waiting for the next item IS this process's
+            # job; the parent's teardown closes the pipe (EOFError, handled) and
+            # with a health config the ping loop above bounds each poll anyway
+            msg = conn.recv()  # graftlint: disable=GL-R001 (parent teardown closes the pipe)
             if msg is None:
                 return
             if ping_s:
@@ -114,6 +134,8 @@ def main():
                 prefetch(hints)
             try:
                 t0 = time.perf_counter()
+                if _chaos.ACTIVE is not None:
+                    _chaos.ACTIVE.hit("child.item", key=_chaos.item_key(item))
                 result = worker(item)
                 t1 = time.perf_counter()
                 kind, frames = serializer.serialize(result)

@@ -1,4 +1,4 @@
-"""Rule registry: the six project-specific rule families."""
+"""Rule registry: the seven project-specific rule families."""
 from petastorm_tpu.analysis.rules.concurrency import (
     BlockingTeardownRule,
     LockDisciplineRule,
@@ -7,6 +7,7 @@ from petastorm_tpu.analysis.rules.concurrency import (
 from petastorm_tpu.analysis.rules.hotpath import WallClockDurationRule
 from petastorm_tpu.analysis.rules.lifecycle import ResourceLifecycleRule
 from petastorm_tpu.analysis.rules.observability import SilentExceptionSwallowRule
+from petastorm_tpu.analysis.rules.robustness import UnboundedBlockingCallRule
 from petastorm_tpu.analysis.rules.schema import SchemaCodecContractRule
 from petastorm_tpu.analysis.rules.tracing import (
     HostIoInJitRule,
@@ -26,6 +27,7 @@ ALL_RULES = [
     SchemaCodecContractRule,
     WallClockDurationRule,
     SilentExceptionSwallowRule,
+    UnboundedBlockingCallRule,
 ]
 
 __all__ = [cls.__name__ for cls in ALL_RULES] + ["ALL_RULES"]

@@ -1,0 +1,161 @@
+"""Robustness rule family (ISSUE 7): unbounded blocking calls in pipeline code.
+
+At pod scale the failure mode that hurts most is not a crash but a *hang*: a
+thread parked forever in ``queue.get()`` / ``Connection.recv()`` /
+``Thread.join()`` / ``Event.wait()`` with no timeout pins a TPU slice until a
+human notices. Every blocking wait in pipeline code must either carry a
+timeout (and handle its expiry — degrade, retry, or re-check a stop event) or
+justify its unboundedness with an inline
+``# graftlint: disable=GL-R001`` comment (e.g. a receive that is bounded by a
+``poll(timeout)`` loop right above it, or a child process whose whole job is
+waiting for the next item and whose parent kills it on teardown).
+
+GL-R001 tracks variables assigned from the blocking-primitive constructors —
+``queue.Queue``/``SimpleQueue``/``LifoQueue``/``PriorityQueue``,
+``threading.Thread``/``Timer``/``multiprocessing.Process``,
+``threading.Event``, ``multiprocessing.connection.Client`` (and
+``Listener.accept()``) — across the whole module (including ``self.<attr>``
+assignments, so a queue built in ``__init__`` and drained in ``run`` is still
+typed), then flags:
+
+=========  ==============  ==========================================
+kind       method          flagged when
+=========  ==============  ==========================================
+queue      ``get``         no ``timeout`` (kwarg or 2nd positional)
+                           and not explicitly non-blocking
+                           (``get(False)`` / ``get(block=False)``)
+thread     ``join``        no timeout argument
+event      ``wait``        no timeout argument
+conn       ``recv``        always — ``Connection.recv`` has no timeout
+                           parameter; bound it with a ``poll(t)`` loop
+                           and carry the inline disable
+=========  ==============  ==========================================
+
+Receivers the tracker cannot type are left alone — swallowing a specific
+``dict.get(key)`` or ``", ".join(parts)`` as a false positive would drown the
+real findings.
+"""
+from __future__ import annotations
+
+import ast
+
+from petastorm_tpu.analysis.findings import Severity
+from petastorm_tpu.analysis.engine import Rule
+from petastorm_tpu.analysis.rules._astutil import (
+    attr_chain,
+    call_func_name,
+    call_kwarg,
+)
+
+#: constructor name (last dotted segment) -> tracked kind
+_CONSTRUCTORS = {
+    "Queue": "queue",
+    "SimpleQueue": "queue",
+    "LifoQueue": "queue",
+    "PriorityQueue": "queue",
+    "JoinableQueue": "queue",
+    "Thread": "thread",
+    "Timer": "thread",
+    "Process": "thread",
+    "Event": "event",
+    "Client": "conn",
+}
+
+#: kind -> method name whose unbounded form is flagged
+_BLOCKING_METHOD = {
+    "queue": "get",
+    "thread": "join",
+    "event": "wait",
+    "conn": "recv",
+}
+
+
+def _is_false_const(node):
+    return isinstance(node, ast.Constant) and node.value is False
+
+
+class UnboundedBlockingCallRule(Rule):
+    """GL-R001: ``queue.get()`` / ``Connection.recv()`` / ``Thread.join()`` /
+    ``Event.wait()`` without a timeout in pipeline code."""
+
+    rule_id = "GL-R001"
+    severity = Severity.WARNING
+    description = ("unbounded blocking call (queue.get/Connection.recv/"
+                   "Thread.join/Event.wait without a timeout) — a silent-hang "
+                   "hazard at pod scale")
+    fix_hint = ("pass a timeout and handle its expiry (re-check a stop event, "
+                "degrade, or raise), bound a Connection.recv with a poll(t) "
+                "loop, or justify the unbounded wait with an inline "
+                "'# graftlint: disable=GL-R001' comment")
+
+    def check(self, tree, ctx):
+        kinds = self._collect_kinds(tree)
+        if not kinds:
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            recv = attr_chain(node.func.value)
+            kind = kinds.get(recv)
+            if kind is None or node.func.attr != _BLOCKING_METHOD[kind]:
+                continue
+            if kind == "conn":
+                yield ctx.finding(
+                    self, node,
+                    "%s.recv() blocks forever (Connection.recv has no timeout "
+                    "parameter): a dead or wedged peer hangs this thread — "
+                    "bound it with a poll(timeout) loop" % recv)
+                continue
+            if self._has_timeout(node, kind):
+                continue
+            yield ctx.finding(
+                self, node,
+                "%s.%s() without a timeout blocks forever if the %s never "
+                "delivers — a hung pipeline instead of a diagnosable failure"
+                % (recv, node.func.attr, kind))
+
+    @staticmethod
+    def _collect_kinds(tree):
+        """Map of assigned-name chain (``q``, ``self._results``) -> kind, from
+        constructor assignments anywhere in the module."""
+        kinds = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            name = call_func_name(node.value)
+            kind = _CONSTRUCTORS.get(name)
+            if kind is None and name == "accept":
+                # conn = listener.accept() — the other way a Connection is born
+                kind = "conn"
+            if kind is None:
+                continue
+            for target in node.targets:
+                chain = attr_chain(target)
+                if chain is not None:
+                    kinds[chain] = kind
+        return kinds
+
+    @staticmethod
+    def _has_timeout(call, kind):
+        def bounded(node):
+            # an explicit None is "no timeout" spelled out — still unbounded
+            return node is not None and not (
+                isinstance(node, ast.Constant) and node.value is None)
+
+        if bounded(call_kwarg(call, "timeout")):
+            return True
+        if kind == "queue":
+            # queue.get(block, timeout): non-blocking get(False) is bounded,
+            # and a 2nd positional IS the timeout
+            if len(call.args) >= 2:
+                return bounded(call.args[1])
+            if len(call.args) == 1 and _is_false_const(call.args[0]):
+                return True
+            block = call_kwarg(call, "block")
+            if block is not None and _is_false_const(block):
+                return True
+            return False
+        # thread.join(timeout) / event.wait(timeout): 1st positional is it
+        return len(call.args) >= 1 and bounded(call.args[0])

@@ -75,6 +75,14 @@ def main(argv=None):
         from petastorm_tpu.benchmark import copies as copies_bench
 
         return copies_bench.main(argv[1:])
+    if argv and argv[0] == "chaos":
+        # `petastorm-tpu-bench chaos ...`: the chaos acceptance harness —
+        # scripted kill/transient-IO/poison/corrupt/stall-heal scenarios
+        # asserting delivered ∪ quarantined == plan with zero leaked leases
+        # — see benchmark/chaos.py
+        from petastorm_tpu.benchmark import chaos as chaos_bench
+
+        return chaos_bench.main(argv[1:])
     if argv and argv[0] == "health":
         # `petastorm-tpu-bench health ...`: heartbeat-instrumentation overhead
         # (enabled vs disabled, plus beat/record primitive ns/op) — see

@@ -1,0 +1,98 @@
+"""Chaos plane: deterministic fault injection for the read pipeline (ISSUE 7).
+
+At pod scale the input pipeline is the component that fails most often and
+matters least — a dead decode child or one corrupt row group must never take
+down a v5e-256 training job. PR 5 built *detection* (heartbeats, the stall
+watchdog) and the repo has recovery primitives scattered across layers
+(transient-IO retry, elastic child respawn, dead-child slab reclaim); this
+package is what *proves* them: a seeded :class:`FaultPlan` of
+:class:`FaultRule` evaluated at named hook sites threaded through the real
+seams (``reader._retry_io``, readahead background reads, pool dispatch, wire
+decode, the in-child work loop), injecting transient/permanent IO errors,
+latency, corrupted wire bytes, child kills, and hangs — deterministically.
+
+Usage::
+
+    from petastorm_tpu.chaos import FaultPlan, FaultRule, armed
+
+    plan = FaultPlan([
+        FaultRule("reader.read", "raise_transient", nth=3, times=2),
+        FaultRule("child.item", "kill", item_key="ordinal=5", times=1),
+    ], seed=7)
+    with armed(plan):
+        ...   # run the pipeline; recovery machinery absorbs the faults
+
+Zero overhead unarmed: every hook site is ``if chaos.ACTIVE is not None``.
+Arming also exports the plan as ``PTPU_CHAOS_SPEC`` so process-pool children
+spawned while armed inherit it (their in-child sites — ``child.item``,
+``reader.read`` inside the child — evaluate their own per-process copy).
+Every injection is counted (``ptpu_degradations_total{cause=
+"chaos_injected"}``) and recorded into any live flight recorder, so a chaos
+run's flight record reads like an incident timeline.
+
+The acceptance harness lives in ``petastorm_tpu/benchmark/chaos.py``
+(``petastorm-tpu-bench chaos``); the recovery policy it validates in
+:mod:`petastorm_tpu.recovery`. See docs/robustness.md.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+from petastorm_tpu.chaos.plan import (  # noqa: F401
+    ChaosError,
+    FaultPlan,
+    FaultRule,
+    allow_kill,
+    item_key,
+    kill_allowed,
+)
+
+#: the armed plan, or None (the default — hook sites check exactly this)
+ACTIVE = None
+
+_ENV_SPEC = "PTPU_CHAOS_SPEC"
+
+
+def arm(plan, propagate=True):
+    """Arm ``plan`` process-wide. With ``propagate`` (default) the plan is
+    also exported as ``PTPU_CHAOS_SPEC`` so pool children spawned from now on
+    arm themselves at bootstrap. Returns the plan."""
+    global ACTIVE
+    ACTIVE = plan
+    if propagate and plan is not None:
+        os.environ[_ENV_SPEC] = plan.to_json()
+    return plan
+
+
+def disarm():
+    """Disarm fault injection (and stop propagating to new children)."""
+    global ACTIVE
+    ACTIVE = None
+    os.environ.pop(_ENV_SPEC, None)
+
+
+@contextlib.contextmanager
+def armed(plan, propagate=True):
+    """``with armed(plan): ...`` — arm for the block, disarm after (even when
+    the block raises, so one failed scenario cannot poison the next)."""
+    arm(plan, propagate=propagate)
+    try:
+        yield plan
+    finally:
+        disarm()
+
+
+def arm_from_env(in_child=False):
+    """Arm from ``PTPU_CHAOS_SPEC`` when present (pool-child bootstrap; also
+    how the chaos harness arms its scenario subprocesses). ``in_child=True``
+    additionally opts this process into the ``kill`` action. Returns the
+    armed plan or None."""
+    spec = os.environ.get(_ENV_SPEC)
+    if not spec:
+        return None
+    plan = FaultPlan.from_json(spec)
+    if in_child:
+        allow_kill(True)
+    # never re-export: this process inherited the spec from its parent
+    return arm(plan, propagate=False)

@@ -47,6 +47,23 @@ class LeaseRevoked(PetastormTpuError):
     gets this error, never garbage."""
 
 
+class WorkerDiedError(PetastormTpuError, RuntimeError):
+    """A pool worker process died and the elastic-recovery budget
+    (``worker_respawns`` / ``RecoveryOptions.worker_respawns``) is exhausted.
+    Carries the ORIGINAL child failure as ``__cause__`` (and ``original``), so
+    the consumer sees what actually killed the children — not a generic pool
+    error. With ``RecoveryOptions(on_poison="quarantine")`` a single item that
+    repeatedly kills children is skipped (see
+    :class:`petastorm_tpu.recovery.QuarantineReport`) before it can exhaust
+    the budget."""
+
+    def __init__(self, message, original=None):
+        super().__init__(message)
+        self.original = original
+        if original is not None:
+            self.__cause__ = original
+
+
 class StallError(PetastormTpuError):
     """A pipeline actor missed its heartbeat threshold and the health monitor's
     escalation policy is ``raise`` — the training loop fails fast instead of

@@ -40,6 +40,16 @@ def _env_int(name, default):
         return default
 
 
+def _env_float(name, default):
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
 def _env_bool(name, default):
     raw = os.environ.get(name)
     if raw is None or raw == "":

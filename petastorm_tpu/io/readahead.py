@@ -276,6 +276,13 @@ class ReadaheadPool:
         t0 = time.perf_counter()
         tables = error = None
         try:
+            from petastorm_tpu import chaos as _chaos
+
+            if _chaos.ACTIVE is not None:
+                _chaos.ACTIVE.hit(
+                    "io.readahead",
+                    key="%s:%s" % (pieces[0].path,
+                                   ",".join(str(p.row_group) for p in pieces)))
             if len(pieces) == 1:
                 tables = [self._read_fn(pieces[0], columns)]
             else:
@@ -287,6 +294,16 @@ class ReadaheadPool:
                     self._n_coalesced_items += len(pieces)
         except Exception as e:  # noqa: BLE001 — stored, re-raised at get()
             error = e
+            # routed through the degradation log as cause=io_retry (ISSUE 7):
+            # a background read that exhausted the shared retry budget used to
+            # fail silently here and only surface at the foreground get() —
+            # retry storms are now countable in petastorm-tpu-stats and the
+            # flight record even when the consumer never claims the entry
+            degradation(
+                "io_retry",
+                "background readahead read of %s row group(s) %s failed (%s); "
+                "the foreground read will re-raise it", pieces[0].path,
+                [p.row_group for p in pieces], e)
         dur = time.perf_counter() - t0
         self._read_hist.observe(dur)
         tracer = self._tracer

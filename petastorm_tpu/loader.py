@@ -1498,7 +1498,20 @@ class DataLoader:
         try:
             while True:
                 t0 = time.perf_counter()
-                item = dev_q.get()
+                # bounded wait (GL-R001): the transfer thread's finally puts a
+                # sentinel on every exit path, but a thread that died without
+                # one (killed hard mid-put, interpreter teardown race) used to
+                # hang this consumer forever — re-check liveness each second
+                # and surface the stored error / end the epoch instead
+                while True:
+                    try:
+                        item = dev_q.get(timeout=1.0)
+                        break
+                    except queue.Empty:
+                        t_thread = self._transfer_thread
+                        if t_thread is None or not t_thread.is_alive():
+                            item = _SENTINEL
+                            break
                 dt = time.perf_counter() - t0
                 stats.device_queue_wait_s += dt
                 if self._trace is not None:
@@ -1682,6 +1695,16 @@ class DataLoader:
     def load_state_dict(self, state):
         """Restore into the underlying reader (before iterating)."""
         self.reader.load_state_dict(state)
+
+    @property
+    def quarantine_report(self):
+        """The underlying reader's poison-item
+        :class:`~petastorm_tpu.recovery.QuarantineReport` (ISSUE 7): every plan
+        item skipped under ``RecoveryOptions(on_poison="quarantine")`` with its
+        plan ordinals, file/row-group identity, and exception chain. Falsy when
+        nothing was quarantined; ``None`` for readers without the recovery
+        machinery (e.g. an ``InMemDataset`` source)."""
+        return getattr(self.reader, "quarantine_report", None)
 
     def bottleneck_report(self):
         """Name the limiting pipeline stage from the stage counters: a

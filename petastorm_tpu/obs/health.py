@@ -78,9 +78,15 @@ class HealthOptions:
         ``producer``, ``transfer``, ``worker``, ``io``, ``child``.
     poll_interval_s : float
         Watchdog wake cadence; detection latency is ``threshold + poll``.
-    escalation : {"warn", "flight", "raise"}
+    escalation : {"warn", "flight", "heal", "raise"}
         Cumulative: ``warn`` logs+counts, ``flight`` also dumps the flight
-        record, ``raise`` also delivers :class:`StallError` to the consumer.
+        record, ``heal`` additionally asks registered healers to recover the
+        stalled actors in place (the process pool's healer kills the hung
+        child so the elastic-respawn machinery re-dispatches its item —
+        ISSUE 7) and only delivers :class:`StallError` when no healer could
+        act (no registered healer for the actor, or the respawn budget is
+        exhausted), ``raise`` always delivers :class:`StallError` to the
+        consumer.
     flight_path : str
         Where the flight record lands (most recent record wins; the path is
         stable so dashboards/CI can poll it). Default
@@ -95,9 +101,10 @@ class HealthOptions:
     def __init__(self, stall_threshold_s=None, thresholds=None,
                  poll_interval_s=None, escalation="flight", flight_path=None,
                  max_events=2048):
-        if escalation not in ("warn", "flight", "raise"):
+        if escalation not in ("warn", "flight", "heal", "raise"):
             raise ValueError(
-                "escalation must be warn|flight|raise, got %r" % (escalation,))
+                "escalation must be warn|flight|heal|raise, got %r"
+                % (escalation,))
         self.stall_threshold_s = float(
             stall_threshold_s if stall_threshold_s is not None
             else _env_float("PTPU_HEALTH_THRESHOLD_S", 120.0))
@@ -202,6 +209,8 @@ class HealthMonitor:
         self._stack_providers = {}    # handle -> fn() -> {label: stack text}
         self._contexts = {}           # handle -> (name, fn() -> dict)
         self._stall_callbacks = {}    # handle -> fn(StallError)
+        self._healers = {}            # handle -> fn(stalled) -> healed names
+        self._heals = 0
         self._next_handle = 0
         self._stalls = 0
         self._last_record_path = None
@@ -328,6 +337,18 @@ class HealthMonitor:
         with self._lock:
             self._stall_callbacks.pop(handle, None)
 
+    def add_healer(self, fn):
+        """Register ``fn(stalled) -> iterable of actor names it healed`` (the
+        process pool's kill-the-hung-child hook, ISSUE 7). ``stalled`` is the
+        list of describe dicts from :meth:`check_stalls`. Under
+        ``escalation="heal"`` every healer runs; stalled actors NO healer
+        claims escalate to :class:`StallError`. Returns a removal handle."""
+        return self._add(self._healers, fn)
+
+    def remove_healer(self, handle):
+        with self._lock:
+            self._healers.pop(handle, None)
+
     # -- stall detection ----------------------------------------------------------------
 
     def check_stalls(self, now=None):
@@ -364,7 +385,7 @@ class HealthMonitor:
                               s["threshold_s"]) for s in stalled)
         self.flight.record("stall", actors=[s["actor"] for s in stalled])
         path = None
-        if self.options.escalation in ("flight", "raise"):
+        if self.options.escalation in ("flight", "heal", "raise"):
             try:
                 path = self.dump_flight_record("stall", stalled=stalled)
             except Exception as e:  # noqa: BLE001 — evidence capture must not
@@ -381,7 +402,14 @@ class HealthMonitor:
                   if self.options.escalation == "warn"
                   else "; flight-record dump FAILED (see preceding warning)"),
             once=False)
-        if self.options.escalation == "raise":
+        if self.options.escalation == "heal":
+            stalled = self._try_heal(stalled)
+            if not stalled:
+                return  # every stalled actor healed in place: no fail-fast
+            actors = ", ".join("%s (%s %.1fs > %.1fs)"
+                               % (s["actor"], s["state"], s["age_s"],
+                                  s["threshold_s"]) for s in stalled)
+        if self.options.escalation in ("heal", "raise"):
             err = StallError(
                 "pipeline stalled: %s%s" % (
                     actors, (" (flight record: %s)" % path) if path else ""))
@@ -397,6 +425,45 @@ class HealthMonitor:
                 except Exception as e:  # noqa: BLE001 — one bad callback must
                     # not stop the fail-fast delivery to the others
                     logger.warning("stall callback failed: %s", e)
+
+    def _try_heal(self, stalled):
+        """Run every registered healer against ``stalled``; returns the
+        actors nobody healed (empty = fully recovered). A healed actor's next
+        beat re-arms its debounce, so a *re*-hang after a heal is detected
+        again — and escalates again, until the healer's budget runs out and
+        the leftover stall falls through to :class:`StallError`."""
+        from petastorm_tpu.obs.log import degradation
+
+        with self._lock:
+            healers = list(self._healers.values())
+        remaining = list(stalled)
+        for fn in healers:
+            if not remaining:
+                break
+            try:
+                healed = set(fn(remaining) or ())
+            except Exception as e:  # noqa: BLE001 — a broken healer must not
+                # kill the watchdog; the stall then escalates instead
+                logger.warning("stall healer failed: %s", e)
+                continue
+            if healed:
+                remaining = [s for s in remaining if s["actor"] not in healed]
+        healed_n = len(stalled) - len(remaining)
+        if healed_n:
+            self._heals += healed_n
+            self.flight.record("heal", healed=healed_n,
+                               remaining=[s["actor"] for s in remaining])
+            degradation(
+                "stall_healed",
+                "Stall auto-heal recovered %d actor(s) in place%s", healed_n,
+                ("; %d still stalled (escalating)" % len(remaining))
+                if remaining else "", once=False)
+        return remaining
+
+    @property
+    def heal_count(self):
+        """Actors recovered in place by the ``heal`` escalation tier."""
+        return self._heals
 
     # -- flight record ------------------------------------------------------------------
 
@@ -456,7 +523,7 @@ class HealthMonitor:
         wiring as the ``ptpu_health_*`` family): per-actor heartbeat age and
         stalled flag, plus the stall total."""
         now = time.monotonic()
-        out = {"stalls_total": self._stalls}
+        out = {"stalls_total": self._stalls, "heals_total": self._heals}
         with self._lock:
             hbs = list(self._hbs.values())
         for hb in hbs:
@@ -551,6 +618,17 @@ class HealthScope:
 
     def remove_stack_provider(self, handle):
         self.monitor.remove_stack_provider(handle)
+
+    def add_healer(self, fn):
+        """Forwarded as-is: the healer receives FULL (prefixed) actor names in
+        the stalled dicts and must return the same names — the process pool's
+        healer rebuilds its own scoped names (via this scope's ``_name``) and
+        claims only exact matches, so one pipeline's healer never touches a
+        sibling's children on a shared monitor."""
+        return self.monitor.add_healer(fn)
+
+    def remove_healer(self, handle):
+        self.monitor.remove_healer(handle)
 
     def close(self):
         """Retire every actor this scope registered (loader ``__exit__`` on a

@@ -154,6 +154,15 @@ class SlabRing:
         self._free = queue.Queue()
         for i in range(num_slabs):
             self._free.put(i)
+        #: ids currently OUT of the free list — release() only accepts these, so
+        #: a double release (two owners each "returning" the same slab) can
+        #: never insert one id twice and grant one slab to two children
+        self._granted = set()
+        #: slab id -> weakref to the outstanding view-mode Lease issued over it
+        #: (registered by ShmSerializer.deserialize; entry dropped at release).
+        #: reclaim() consults this so a dead-child reclaim can never hand out a
+        #: slab a consumer-retained batch still views — it revokes instead.
+        self._leases = {}
         self._trace = trace
         # wire gauges (read via stats(); exported through PipelineStats.shm_*)
         self._grants = 0
@@ -181,15 +190,70 @@ class SlabRing:
             self._acquire_wait_s += waited
             if slab_id is not None:
                 self._grants += 1
+                self._granted.add(slab_id)
         if self._trace is not None and waited > 1e-4:
             self._trace.add("shm.acquire_wait", t0, waited)
         return slab_id
 
     def release(self, slab_id):
-        """Return a slab to the free list (no-op after close())."""
+        """Return a slab to the free list (no-op after close()). Releasing an
+        id that is not currently granted is ignored with a logged degradation —
+        a double release must never insert one slab twice (two children would
+        be granted the same memory, corrupting a consumer-retained view)."""
         if self._closed:
             return
+        with self._lock:
+            if slab_id not in self._granted:
+                from petastorm_tpu.obs.log import degradation
+
+                degradation(
+                    "shm_double_release",
+                    "slab %s released while not granted (double release "
+                    "suppressed — see docs/robustness.md)", slab_id, once=False)
+                return
+            self._granted.discard(slab_id)
+            self._leases.pop(slab_id, None)
         self._free.put(slab_id)
+
+    def register_lease(self, slab_id, lease):
+        """Record the outstanding consumer lease over a granted slab (view-mode
+        deliveries). The entry drops automatically when the lease's release
+        returns the slab; :meth:`reclaim` consults it."""
+        import weakref
+
+        with self._lock:
+            if slab_id in self._granted:
+                self._leases[slab_id] = weakref.ref(lease)
+
+    def reclaim(self, slab_id):
+        """Lease-aware slab reclaim — the dead-child path (ISSUE 7).
+
+        PR-2's reclaim blind-released the dead child's in-flight slab; since
+        the PR-6 lease contract a slab can be consumer-leased (a loader batch
+        retaining zero-copy views), and re-inserting such a slab would hand it
+        to a respawned child to overwrite under the consumer. If an outstanding
+        lease exists it is REVOKED instead — the retained batch raises
+        :class:`~petastorm_tpu.errors.LeaseRevoked` on next access, and the
+        slab returns to the free list through the holder's own release."""
+        if self._closed:
+            return
+        with self._lock:
+            ref = self._leases.pop(slab_id, None)
+        lease = ref() if ref is not None else None
+        if lease is not None:
+            revoke = getattr(lease, "revoke", None)
+            if revoke is not None:
+                revoke()
+                from petastorm_tpu.obs.log import degradation
+
+                degradation(
+                    "lease_revoked_on_reclaim",
+                    "slab %s reclaimed (dead child) while a consumer lease was "
+                    "outstanding; the lease was revoked — retained views raise "
+                    "LeaseRevoked instead of reading reused memory", slab_id,
+                    once=False)
+                return
+        self.release(slab_id)
 
     def buffer(self, slab_id):
         """Writable memoryview over one slab's full extent."""

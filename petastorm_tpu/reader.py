@@ -30,6 +30,7 @@ import weakref
 
 import numpy as np
 
+from petastorm_tpu import chaos as _chaos
 from petastorm_tpu.cache import make_cache
 from petastorm_tpu.io import IoOptions
 from petastorm_tpu.errors import (
@@ -45,6 +46,13 @@ from petastorm_tpu.metadata import (
 )
 from petastorm_tpu.ngram import NGram
 from petastorm_tpu.plan import EpochPlan, shard_indices
+from petastorm_tpu.recovery import (
+    QuarantinedItem,
+    QuarantineEntry,
+    QuarantineReport,
+    RecoveryOptions,
+    count_quarantined,
+)
 from petastorm_tpu.transform import transform_schema
 from petastorm_tpu.unischema import Unischema, UnischemaField
 from petastorm_tpu.serializers import SHM_LEASE_KEY as _SHM_LEASE_KEY
@@ -161,7 +169,8 @@ class _WorkerBase:
     def __init__(self, filesystem, read_schema, stored_schema, predicate, transform_spec,
                  cache, shuffle_row_drop_partitions, filters, seed,
                  device_fields=frozenset(), partition_info=None,
-                 io_retries=2, io_retry_backoff_s=0.1, io_options=None):
+                 io_retries=None, io_retry_backoff_s=None, io_options=None,
+                 recovery=None):
         self._fs = filesystem
         self._read_schema = read_schema  # fields to deliver (pre-transform view)
         self._stored_schema = stored_schema  # full stored schema (decode source of truth)
@@ -173,8 +182,13 @@ class _WorkerBase:
         self._seed = seed
         self._device_fields = frozenset(device_fields)  # host-stage-only decode columns
         self._partition_info = partition_info  # hive key=value layout (or None)
-        self._io_retries = io_retries  # extra attempts on transient IO errors
-        self._io_retry_backoff_s = io_retry_backoff_s
+        # unified recovery policy (ISSUE 7): the struct is the source of truth;
+        # the legacy per-kwarg knobs overlay it when a caller passes them
+        self._recovery = RecoveryOptions.resolve(
+            recovery, io_retries=io_retries,
+            io_retry_backoff_s=io_retry_backoff_s)
+        self._io_retries = self._recovery.io_retries
+        self._io_retry_backoff_s = self._recovery.io_retry_backoff_s
         self._io_options = IoOptions.normalize(io_options)
         self._local = None  # threading.local built lazily (not picklable)
         self._readahead = None  # ReadaheadPool built lazily per process (threads)
@@ -390,23 +404,51 @@ class _WorkerBase:
 
     def _retry_io(self, fn, path, what):
         """One copy of the transient-retry protocol, shared by single-row-group
-        and coalesced ranged reads (identical budget either way)."""
+        and coalesced ranged reads (identical budget either way). Policy comes
+        from :class:`~petastorm_tpu.recovery.RecoveryOptions`: ``io_retries``
+        extra attempts, jittered exponential backoff capped at
+        ``io_retry_max_backoff_s``, and an optional ``read_deadline_s`` wall
+        cap across ALL attempts of one read. Every retry is routed through the
+        degradation log as ``cause=io_retry`` (counted per occurrence,
+        warn-once logging) so a retry storm is visible in
+        ``petastorm-tpu-stats`` and the flight record instead of scrolling by
+        as ad-hoc warnings."""
+        rec = self._recovery
         attempt = 0
+        t_first = time.monotonic()
         while True:
             try:
                 return fn()
             except Exception as e:  # noqa: BLE001 — classified below
-                if not _is_transient_io_error(e) or attempt >= self._io_retries:
+                if not _is_transient_io_error(e) or attempt >= rec.io_retries:
+                    raise
+                if rec.read_deadline_s and \
+                        time.monotonic() - t_first >= rec.read_deadline_s:
+                    from petastorm_tpu.obs.log import degradation
+
+                    degradation(
+                        "io_retry",
+                        "read deadline (%.0fs) exhausted for %s after %d "
+                        "attempt(s); raising the last error", rec.read_deadline_s,
+                        what, attempt + 1)
                     raise
                 self._evict_parquet_file(path)
-                delay = self._io_retry_backoff_s * (2 ** attempt) * (0.5 + random.random())
-                logger.warning(
+                delay = min(
+                    rec.io_retry_backoff_s * (2 ** attempt) * (0.5 + random.random()),
+                    rec.io_retry_max_backoff_s)
+                from petastorm_tpu.obs.log import degradation
+
+                degradation(
+                    "io_retry",
                     "Transient IO error reading %s (%s); retry %d/%d in %.2fs",
-                    what, e, attempt + 1, self._io_retries, delay)
+                    what, e, attempt + 1, rec.io_retries, delay)
                 time.sleep(delay)
                 attempt += 1
 
     def _read_columns_once(self, piece, columns):
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.hit("reader.read",
+                              key="%s:%s" % (piece.path, piece.row_group))
         pf = self._parquet_file(piece.path)
         available = set(pf.schema_arrow.names)
         file_columns = columns
@@ -437,6 +479,11 @@ class _WorkerBase:
     def _read_run_once(self, pieces, columns):
         from petastorm_tpu.io.coalesce import split_run_table
 
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.hit(
+                "reader.read_run",
+                key="%s:%s" % (pieces[0].path,
+                               ",".join(str(p.row_group) for p in pieces)))
         pf = self._parquet_file(pieces[0].path)
         available = set(pf.schema_arrow.names)
         file_columns = columns
@@ -1060,7 +1107,8 @@ class Reader:
                  shuffle_row_drop_partitions=1,
                  reader_pool_type="thread", workers_count=4, results_queue_size=16,
                  is_batched_reader=False, ngram=None, results_timeout_s=300.0,
-                 wire_serializer="pickle", worker_respawns=2, io_options=None):
+                 wire_serializer="pickle", worker_respawns=None, io_options=None,
+                 recovery=None):
         self._fs = filesystem
         self._path = path
         self.schema = schema
@@ -1093,9 +1141,15 @@ class Reader:
                                with_epoch=True)
         self._num_items = len(items)
         self._io_options = IoOptions.normalize(io_options)
+        self._recovery = RecoveryOptions.resolve(recovery,
+                                                 worker_respawns=worker_respawns)
+        #: every plan item skipped as poison under on_poison='quarantine'
+        #: (ISSUE 7) — empty (falsy) on a healthy run
+        self.quarantine_report = QuarantineReport()
         self._pool_args = (reader_pool_type, workers_count, results_queue_size,
-                           results_timeout_s, wire_serializer, worker_respawns,
-                           self._io_options)
+                           results_timeout_s, wire_serializer,
+                           self._recovery.worker_respawns, self._io_options,
+                           self._recovery)
         self._executor = None
         self._results_iter = None
         self._buffer = []
@@ -1118,13 +1172,13 @@ class Reader:
 
     def _start(self):
         (pool_type, workers_count, queue_size, timeout_s, serializer,
-         respawns, io_options) = self._pool_args
+         respawns, io_options, recovery) = self._pool_args
         reopen = getattr(self._worker, "reopen", None)
         if reopen is not None:  # reset()/restore after join() closed the IO runtime
             reopen()
         self._executor = make_executor(
             pool_type, workers_count, queue_size, timeout_s, serializer,
-            respawns, io_options=io_options)
+            respawns, io_options=io_options, recovery=recovery)
         monitor = getattr(self, "_health_monitor", None)
         if monitor is not None:
             # reset()/restore rebuilds the executor — re-attach BEFORE start so
@@ -1145,6 +1199,35 @@ class Reader:
         while len(self._consumed.get(self._resume_epoch, ())) >= self._num_items:
             del self._consumed[self._resume_epoch]
             self._resume_epoch += 1
+
+    def _absorb_quarantine(self, marker):
+        """Absorb a :class:`~petastorm_tpu.recovery.QuarantinedItem` marker
+        (ISSUE 7): the poisoned plan item is recorded in the quarantine report,
+        counted (``ptpu_quarantined_{items,rows}_total``), and — crucially —
+        **charged against the consumed-ordinal watermark** exactly like a
+        delivered item, so a checkpoint taken after the skip resumes without
+        replaying it (and without losing anything else). The consumer never
+        sees the marker."""
+        epoch, ordinal, inner = marker.item
+        piece = inner[0] if isinstance(inner, tuple) and inner else inner
+        path = getattr(piece, "path", repr(inner))
+        row_group = getattr(piece, "row_group", -1)
+        num_rows = getattr(piece, "num_rows", None)
+        if num_rows is None:
+            num_rows = -1  # footer was never readable
+        entry = QuarantineEntry(epoch, ordinal, path, row_group, num_rows,
+                                marker.error, marker.attempts, marker.kind)
+        self.quarantine_report.add(entry)
+        count_quarantined(num_rows)
+        from petastorm_tpu.obs.log import degradation
+
+        degradation(
+            "quarantined",
+            "poison item quarantined after %d attempt(s): %s row group %s "
+            "(epoch=%s ordinal=%s, %s) — skipped, charged to the checkpoint "
+            "watermark; see Reader.quarantine_report", marker.attempts, path,
+            row_group, epoch, ordinal, marker.kind, once=False)
+        self._mark_consumed((epoch, ordinal))
 
     # -- iteration ----------------------------------------------------------------------
 
@@ -1174,6 +1257,9 @@ class Reader:
                 if not getattr(self._executor, "truncated", False):
                     self.last_row_consumed = True
                 raise StopIteration
+            if isinstance(nxt, QuarantinedItem):
+                self._absorb_quarantine(nxt)
+                continue
             epoch, ordinal, payload = nxt
             self._held_lease = self._register_lease(
                 getattr(payload, "lease", None))
@@ -1207,6 +1293,9 @@ class Reader:
                 if not getattr(self._executor, "truncated", False):
                     self.last_row_consumed = True
                 raise StopIteration
+            if isinstance(nxt, QuarantinedItem):
+                self._absorb_quarantine(nxt)
+                continue
             epoch, ordinal, columns = nxt
             if isinstance(columns, dict):
                 self._held_lease = self._register_lease(
@@ -1490,8 +1579,8 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
                 cache_row_size_estimate=None, cache_extra_settings=None,
                 transform_spec=None, filters=None, storage_options=None, filesystem=None,
                 results_timeout_s=300.0, decode_on_device=False, wire_serializer=None,
-                io_retries=2, io_retry_backoff_s=0.1, worker_respawns=2,
-                io_options=None):
+                io_retries=None, io_retry_backoff_s=None, worker_respawns=None,
+                io_options=None, recovery=None):
     """Open a petastorm(-tpu) dataset for per-row decoded reading (reference ~L60).
 
     ``schema_fields`` may be a list of names/regexes/UnischemaFields or an :class:`NGram`.
@@ -1504,12 +1593,23 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
 
     ``io_retries`` / ``io_retry_backoff_s``: transient row-group read failures
     (connection resets, timeouts against object stores) are retried that many extra
-    times with jittered exponential backoff before propagating; ``io_retries=0``
-    restores the reference's fail-fast behavior (it has no retry — SURVEY.md §6).
+    times (default 2) with jittered exponential backoff before propagating;
+    ``io_retries=0`` restores the reference's fail-fast behavior (it has no retry —
+    SURVEY.md §6).
 
     ``worker_respawns``: the process pool's elastic-recovery budget — a child that
     dies mid-item is replaced and its row group re-dispatched up to this many times
-    (0 = fail fast; the reference has no recovery).
+    (default 2; 0 = fail fast; the reference has no recovery).
+
+    ``recovery``: a :class:`petastorm_tpu.recovery.RecoveryOptions` (or a dict of
+    its fields) unifying the retry/backoff/deadline/respawn policy above — plus
+    poison-item quarantine: with ``on_poison="quarantine"`` a plan item that
+    repeatedly fails or kills workers is SKIPPED after ``poison_attempts``
+    failures instead of crashing the job, surfaced in
+    ``Reader.quarantine_report`` and charged to the checkpoint watermark so
+    resume replays nothing and loses nothing. Explicitly-passed legacy kwargs
+    (``io_retries=``/``io_retry_backoff_s=``/``worker_respawns=``) win over the
+    struct. See docs/robustness.md.
 
     ``io_options``: the async read path's knobs (:class:`petastorm_tpu.io.IoOptions`
     or a dict of its fields) — row-group readahead (default on), adjacent-read
@@ -1534,6 +1634,9 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         final_schema = transform_schema(read_schema, transform_spec)
 
     io_opts = IoOptions.normalize(io_options)
+    rec = RecoveryOptions.resolve(recovery, io_retries=io_retries,
+                                  io_retry_backoff_s=io_retry_backoff_s,
+                                  worker_respawns=worker_respawns)
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
     cache = _maybe_memcache(cache, io_opts)
@@ -1543,8 +1646,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         fs, read_schema, stored_schema, predicate, transform_spec, cache,
         shuffle_row_drop_partitions, filters, seed if seed is not None else shard_seed,
         device_fields=device_fields, partition_info=partition_info,
-        io_retries=io_retries, io_retry_backoff_s=io_retry_backoff_s,
-        io_options=io_opts,
+        recovery=rec, io_options=io_opts,
         ngram=ngram, ngram_schema=final_schema if ngram is not None else None,
     )
     r = Reader(
@@ -1555,8 +1657,8 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         reader_pool_type=reader_pool_type, workers_count=workers_count,
         results_queue_size=results_queue_size, is_batched_reader=False, ngram=ngram,
         results_timeout_s=results_timeout_s,
-        wire_serializer=wire_serializer or "pickle", worker_respawns=worker_respawns,
-        io_options=io_opts,
+        wire_serializer=wire_serializer or "pickle",
+        io_options=io_opts, recovery=rec,
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields
@@ -1571,8 +1673,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       cache_row_size_estimate=None, cache_extra_settings=None,
                       transform_spec=None, filters=None, storage_options=None,
                       filesystem=None, results_timeout_s=300.0, decode_on_device=False,
-                      wire_serializer=None, io_retries=2, io_retry_backoff_s=0.1,
-                      worker_respawns=2, io_options=None):
+                      wire_serializer=None, io_retries=None, io_retry_backoff_s=None,
+                      worker_respawns=None, io_options=None, recovery=None):
     """Open ANY Parquet store for vectorized columnar batches (reference ~L200).
 
     ``decode_on_device``: see :func:`make_reader` — device-decodable codec columns come
@@ -1580,6 +1682,10 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
 
     ``io_retries`` / ``io_retry_backoff_s``: see :func:`make_reader` (transient
     read-failure retry with backoff; 0 = reference fail-fast behavior).
+
+    ``recovery``: see :func:`make_reader` — the unified
+    :class:`petastorm_tpu.recovery.RecoveryOptions` policy (retry/backoff/
+    deadline, respawn budget, poison-item quarantine).
 
     ``io_options``: see :func:`make_reader` — readahead/coalesce/memcache/work
     stealing knobs for the async read path (docs/performance.md "Read path").
@@ -1617,6 +1723,9 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         final_schema = transform_schema(read_schema, transform_spec)
 
     io_opts = IoOptions.normalize(io_options)
+    rec = RecoveryOptions.resolve(recovery, io_retries=io_retries,
+                                  io_retry_backoff_s=io_retry_backoff_s,
+                                  worker_respawns=worker_respawns)
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
     cache = _maybe_memcache(cache, io_opts)
@@ -1626,8 +1735,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         fs, read_schema, stored_schema, predicate, transform_spec, cache,
         shuffle_row_drop_partitions, filters, seed if seed is not None else shard_seed,
         device_fields=device_fields, partition_info=partition_info,
-        io_retries=io_retries, io_retry_backoff_s=io_retry_backoff_s,
-        io_options=io_opts,
+        recovery=rec, io_options=io_opts,
         ngram=ngram,
     )
     r = Reader(
@@ -1640,8 +1748,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         results_timeout_s=results_timeout_s,
         wire_serializer={"shm": "shm-arrow", "shm-view": "shm-arrow-view"}.get(
             wire_serializer, wire_serializer) or "arrow",
-        worker_respawns=worker_respawns,
-        io_options=io_opts,
+        io_options=io_opts, recovery=rec,
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields

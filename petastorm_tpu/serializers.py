@@ -287,7 +287,15 @@ class ShmSerializer:
         buf = self._client.buffer(slab)
         for v, (start, length) in zip(views, offsets):
             buf[start:start + length] = v
-        return KIND_SHM, [pickle.dumps((kind, slab, offsets))]
+        # the descriptor carries a crc trailer: a corrupted descriptor must be
+        # DETECTED, never acted on — a byte flip could otherwise still parse
+        # into a valid pickle naming a DIFFERENT slab id, and releasing that
+        # id would free a slab some other consumer's lease still views
+        blob = pickle.dumps((kind, slab, offsets))
+        import struct
+        import zlib
+
+        return KIND_SHM, [blob + struct.pack("<I", zlib.crc32(blob))]
 
     # -- parent side --------------------------------------------------------------------
 
@@ -299,7 +307,28 @@ class ShmSerializer:
             return self.inner.deserialize(kind, frames)
         if self._ring is None:
             raise ValueError("shm descriptor received but no slab ring is bound")
-        inner_kind, slab, offsets = pickle.loads(frames[0])
+        # Slab-ownership contract with the caller (the pool driver): exceptions
+        # raised BEFORE this method takes ownership of the granted slab carry
+        # ``slab_released = False`` — the caller still owns the grant and must
+        # return it; exceptions raised AFTER carry ``slab_released = True``
+        # (the lease's failure handler below already returned it). Without the
+        # marker a decode failure either leaked the slab or double-released it.
+        try:
+            import struct
+            import zlib
+
+            desc = memoryview(frames[0]).cast("B")
+            if len(desc) < 5:
+                raise ValueError("shm descriptor truncated (%d bytes)"
+                                 % len(desc))
+            blob, (crc,) = desc[:-4], struct.unpack("<I", desc[-4:])
+            if zlib.crc32(blob) != crc:
+                raise ValueError(
+                    "shm descriptor failed its crc check (corrupt wire bytes)")
+            inner_kind, slab, offsets = pickle.loads(blob)
+        except Exception as e:
+            e.slab_released = False
+            raise
         from petastorm_tpu.parallel.shm_ring import SlabLease
 
         # view mode speaks the generic Lease contract over the slab backend:
@@ -308,8 +337,16 @@ class ShmSerializer:
         # ptpu_lease_* accounting the loader's retention path builds on. The
         # writable path releases before returning, so it skips the wrapper.
         slab_lease = SlabLease(self._ring, slab)
-        lease = slab_lease if self.writable \
-            else Lease(release_cb=slab_lease.release, kind="shm_slab")
+        if self.writable:
+            lease = slab_lease
+        else:
+            lease = Lease(release_cb=slab_lease.release, kind="shm_slab")
+            # lease-aware reclaim (ISSUE 7): the ring must know a consumer may
+            # retain views over this slab, so a dead-child reclaim REVOKES the
+            # lease instead of re-granting a still-viewed slab
+            register = getattr(self._ring, "register_lease", None)
+            if register is not None:
+                register(slab, lease)
         try:
             base = self._ring.buffer(slab)
             self._ring.add_bytes(sum(length for _s, length in offsets))
@@ -341,8 +378,9 @@ class ShmSerializer:
                     result = self._deserialize_owned(base, inner_kind, offsets)
                 else:
                     result = _ensure_writable(result)
-        except BaseException:
+        except BaseException as e:
             lease.release()
+            e.slab_released = True
             raise
         # every slab reference was either copied by the inner deserializer
         # (arrow) or backed by owned buffers (pickle) — return the slab now

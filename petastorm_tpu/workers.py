@@ -25,7 +25,9 @@ import queue
 import threading
 from collections import deque
 
-from petastorm_tpu.errors import TimeoutWaitingForResultError
+from petastorm_tpu import chaos as _chaos
+from petastorm_tpu.errors import TimeoutWaitingForResultError, WorkerDiedError
+from petastorm_tpu.recovery import QuarantinedItem, RecoveryOptions
 
 logger = logging.getLogger(__name__)
 
@@ -237,11 +239,12 @@ class SyncExecutor(ExecutorBase):
     the upcoming plan items come from ``plan.peek`` — the single consumer keeps
     its deterministic order while the IO pool reads ahead of it."""
 
-    def __init__(self, lookahead=0, **_ignored):
+    def __init__(self, lookahead=0, recovery=None, **_ignored):
         self._worker = None
         self._plan = None
         self._stopped = False
         self._lookahead = max(0, int(lookahead))
+        self._recovery = RecoveryOptions.normalize(recovery)
 
     def start(self, worker, plan):
         self._worker = worker
@@ -251,6 +254,7 @@ class SyncExecutor(ExecutorBase):
     def results(self):
         prefetch = getattr(self._worker, "prefetch", None)
         peek = getattr(self._plan, "peek", None)
+        recovery = self._recovery
         for item in self._plan:
             if self._stopped:
                 self.truncated = True
@@ -259,7 +263,22 @@ class SyncExecutor(ExecutorBase):
                 upcoming = peek(self._lookahead)
                 if upcoming:
                     prefetch(upcoming)
-            yield self._worker(item)
+            attempts = 0
+            while True:
+                try:
+                    if _chaos.ACTIVE is not None:
+                        _chaos.ACTIVE.hit("worker.item", key=_chaos.item_key(item))
+                    result = self._worker(item)
+                except Exception as e:  # noqa: BLE001 — policy-classified below
+                    attempts += 1
+                    if not recovery.quarantine:
+                        raise
+                    if attempts >= recovery.poison_attempts:
+                        yield QuarantinedItem(item, e, attempts)
+                        break
+                    continue  # retry the item in place
+                yield result
+                break
 
     def stop(self):
         self._stopped = True
@@ -271,12 +290,13 @@ class ThreadExecutor(ExecutorBase):
     queue = backpressure."""
 
     def __init__(self, workers_count=4, results_queue_size=16, results_timeout_s=300.0,
-                 lookahead=0, work_stealing=True, **_ignored):
+                 lookahead=0, work_stealing=True, recovery=None, **_ignored):
         self._workers_count = workers_count
         self._queue_size = results_queue_size
         self._timeout = results_timeout_s
         self._lookahead = lookahead
         self._stealing = work_stealing
+        self._recovery = RecoveryOptions.normalize(recovery)
         self._threads = []
         self._results = None
         self._stop_event = threading.Event()
@@ -327,10 +347,28 @@ class ThreadExecutor(ExecutorBase):
                 if hb is not None:
                     hb.beat("working")
                 t0 = time.perf_counter() if monitor is not None else 0.0
-                try:
-                    result = worker(item)
-                except Exception as e:  # noqa: BLE001 - propagate to consumer
-                    self._put(_ExcResult(e))
+                recovery = self._recovery
+                attempts = 0
+                fatal = False
+                result = None
+                while True:  # item attempts (poison-quarantine retry policy)
+                    try:
+                        if _chaos.ACTIVE is not None:
+                            _chaos.ACTIVE.hit("worker.item",
+                                              key=_chaos.item_key(item))
+                        result = worker(item)
+                    except Exception as e:  # noqa: BLE001 — policy-classified
+                        attempts += 1
+                        if not recovery.quarantine:
+                            self._put(_ExcResult(e))  # propagate to consumer
+                            fatal = True
+                            break
+                        if attempts >= recovery.poison_attempts:
+                            result = QuarantinedItem(item, e, attempts)
+                            break
+                        continue  # retry the item in place
+                    break
+                if fatal:
                     break
                 if monitor is not None:
                     # per-worker latency histogram: the straggler detector's input
@@ -419,8 +457,9 @@ class ProcessExecutor(ExecutorBase):
     """
 
     def __init__(self, workers_count=4, results_queue_size=16, results_timeout_s=300.0,
-                 serializer="pickle", worker_respawns=2, shm_slab_bytes=None,
-                 shm_slabs=None, lookahead=0, work_stealing=True, **_ignored):
+                 serializer="pickle", worker_respawns=None, shm_slab_bytes=None,
+                 shm_slabs=None, lookahead=0, work_stealing=True, recovery=None,
+                 **_ignored):
         import os
 
         self._workers_count = workers_count
@@ -455,13 +494,30 @@ class ProcessExecutor(ExecutorBase):
         #: Elastic recovery (no reference analog — SURVEY §6: a worker death kills the
         #: read there): a child that dies mid-item is replaced by a fresh clean
         #: interpreter and the in-flight item re-dispatched, up to this many times per
-        #: pool lifetime. 0 restores fail-fast. Bounded so a poison item (one that
-        #: reliably kills children, e.g. OOM) still surfaces instead of crash-looping.
-        self._respawn_budget = int(worker_respawns)
+        #: pool lifetime. 0 restores fail-fast. Bounded so a crash loop still
+        #: surfaces; a single poison item (one that reliably kills children,
+        #: e.g. OOM) can additionally be SKIPPED instead of burning the budget
+        #: via ``RecoveryOptions(on_poison="quarantine")`` (ISSUE 7) — after
+        #: ``poison_attempts`` failures of one plan item the pool emits a
+        #: :class:`~petastorm_tpu.recovery.QuarantinedItem` marker, respawns
+        #: the child WITHOUT charging the budget (the item will not be retried,
+        #: so no crash-loop risk), and moves on.
+        self._recovery = RecoveryOptions.normalize(recovery)
+        self._respawn_budget = int(worker_respawns) if worker_respawns is not None \
+            else self._recovery.worker_respawns
         self._respawn_lock = threading.Lock()
         self._spawn_counter = 0
         self._worker = None
         self._child_env = None
+        #: driver idx -> live child Popen (maintained across respawns, under
+        #: the respawn lock): the stall healer's kill target, and how a dead
+        #: child's evidence is tied to the driver that owned it
+        self._child_by_idx = {}
+        #: driver idx -> failures of the CURRENT in-flight item so far — lets
+        #: the healer predict whether a kill can be absorbed (quarantine
+        #: threshold reached) before it pulls the trigger
+        self._inflight_attempts = {}
+        self._healer_handle = None
         #: health wiring (ISSUE 5): handle of the child-stack provider this
         #: pool registered, plus the exact monitor/scope it was registered ON
         #: (handles are per-monitor sequence numbers — removing with a handle
@@ -518,11 +574,28 @@ class ProcessExecutor(ExecutorBase):
         acceptor = threading.Thread(target=_accept_loop, name="ptpu-accept", daemon=True)
         acceptor.start()
         try:
-            while len(self._conns) < self._workers_count:
+            # two phases so children bootstrap CONCURRENTLY: send every
+            # handshake payload as its connection arrives, then collect the
+            # pid acks — awaiting each ack inline would serialize the pool's
+            # startup behind every child's full import + worker unpickle
+            # (sum of bootstraps instead of the slowest one)
+            pending = []
+            while len(pending) < self._workers_count:
                 conn = self._await_accept(accepted, self._procs, "Pool child")
-                self._handshake(conn)
+                self._send_handshake(conn)
+                pending.append(conn)
+            for conn in pending:
+                pid = self._await_pid_ack(conn)
                 with self._respawn_lock:
+                    # accept order ≠ spawn order: the handshake's pid ack is
+                    # what ties this connection (→ driver idx) to its OS
+                    # process — the heal tier kills by exactly this mapping
+                    idx = len(self._conns)
                     self._conns.append(conn)
+                    for p in self._procs:
+                        if p.pid == pid:
+                            self._child_by_idx[idx] = p
+                            break
         finally:
             listener.close()  # also unblocks the acceptor thread if we raised
         monitor = self._health
@@ -635,11 +708,19 @@ class ProcessExecutor(ExecutorBase):
         # only meaningful to the monitor that issued it
         old, self._stack_provider_monitor = self._stack_provider_monitor, None
         handle, self._stack_provider_handle = self._stack_provider_handle, None
-        if old is not None and handle is not None:
-            old.remove_stack_provider(handle)
+        healer, self._healer_handle = self._healer_handle, None
+        if old is not None:
+            if handle is not None:
+                old.remove_stack_provider(handle)
+            if healer is not None:
+                old.remove_healer(healer)
         if monitor is not None:
             self._stack_provider_handle = monitor.add_stack_provider(
                 self._dump_child_stacks)
+            # heal tier (ISSUE 7, escalation="heal"): on a stalled child actor
+            # the watchdog asks this pool to kill the hung child — the driver's
+            # dead-child machinery then respawns it and re-dispatches the item
+            self._healer_handle = monitor.add_healer(self._heal_stalled)
             self._stack_provider_monitor = monitor
 
     def _dump_child_stacks(self):
@@ -710,6 +791,72 @@ class ProcessExecutor(ExecutorBase):
                 if pid in partial else "<no faulthandler dump within 2s>")
         return out
 
+    def _heal_stalled(self, stalled):
+        """The ``escalation="heal"`` hook (ISSUE 7): kill the hung pool child
+        behind each stalled ``worker.child-<idx>`` actor so the driver's
+        dead-child machinery takes over — respawn against the budget, slab
+        reclaim (lease-aware), and re-dispatch of the unfinished claim (or a
+        quarantine skip once the item hits the poison threshold). Returns the
+        actor names it acted on; actors it could NOT absorb (budget exhausted
+        and the poison policy cannot eat the kill either) are left for the
+        watchdog to escalate to :class:`StallError`.
+
+        Called from the watchdog thread. Matching is by the FULL actor name
+        this pool registered (scope-prefixed when the monitor is shared via a
+        ``HealthScope``) — a suffix-only match would let one pool's healer
+        kill ANOTHER pool's healthy child on a shared monitor, mask the real
+        hang (the reported-stall debounce never re-arms for a child that
+        never beats again), and burn a respawn for nothing."""
+        import re
+
+        healed = set()
+        if self._stop_event.is_set():
+            return healed
+        from petastorm_tpu.obs.log import degradation
+
+        for s in stalled:
+            actor = s.get("actor", "")
+            m = re.search(r"(?:^|/)worker\.child-(\d+)$", actor)
+            if m is None:
+                continue
+            idx = int(m.group(1))
+            if actor != self._child_actor_name(idx):
+                continue  # a sibling pipeline's child on a shared monitor
+            with self._respawn_lock:
+                proc = self._child_by_idx.get(idx)
+                budget = self._respawn_budget
+                attempts = self._inflight_attempts.get(idx, 0)
+            if proc is None or proc.poll() is not None:
+                continue  # already dead: the driver is mid-respawn on its own
+            # can the kill be absorbed? either the respawn budget pays for a
+            # re-dispatch, or the poison policy quarantines the item (its
+            # respawn is uncharged). If neither, do NOT pull the trigger —
+            # killing would just turn the stall into WorkerDiedError; leaving
+            # it lets the watchdog deliver StallError with the hang evidence.
+            absorbable = budget > 0 or (
+                self._recovery.quarantine
+                and attempts + 1 >= self._recovery.poison_attempts)
+            if not absorbable:
+                continue
+            try:
+                proc.kill()
+            except OSError:
+                continue
+            degradation(
+                "stall_heal_kill",
+                "Heal tier killed hung pool child pid=%s (actor %s, %.1fs past "
+                "threshold); its item will be re-dispatched or quarantined",
+                proc.pid, s["actor"], s.get("age_s", 0.0), once=False)
+            healed.add(s["actor"])
+        return healed
+
+    def _child_actor_name(self, idx):
+        """The full (scope-prefixed) actor name this pool's ``idx``-th child
+        heartbeats under — exactly what ``_drive_child`` registers."""
+        base = "worker.child-%d" % idx
+        namer = getattr(self._health, "_name", None)
+        return namer(base) if namer is not None else base
+
     def wire_stats(self):
         """Wire-transport gauges (shm slab occupancy/bytes/fallbacks/wait), or a
         degradation marker, or {} for plain socket serializers."""
@@ -734,7 +881,7 @@ class ProcessExecutor(ExecutorBase):
         return (isinstance(self._serializer, ShmSerializer)
                 and not self._serializer.writable)
 
-    def _handshake(self, conn):
+    def _send_handshake(self, conn):
         """Bootstrap a connected child: parent sys.path, wire serializer (plus
         the slab-ring attach config in shm mode), health config, worker.
 
@@ -755,10 +902,35 @@ class ProcessExecutor(ExecutorBase):
                    "ping_interval_s": self._ping_interval_s})
         conn.send(self._worker)
 
+    def _await_pid_ack(self, conn):
+        """Collect the child's ``("pid", pid)`` ack (sent right after it
+        unpickles the worker): ties the connection to its OS process (accept
+        order is not spawn order) — the heal tier and dead-child bookkeeping
+        key on it. Bounded: _await_accept already proved the process is alive
+        and connected."""
+        deadline = 120.0
+        waited = 0.0
+        while not conn.poll(1.0):
+            waited += 1.0
+            if waited > deadline:
+                raise TimeoutWaitingForResultError(
+                    "pool child connected but never sent its pid ack "
+                    "(worker unpickle wedged?)")
+        ack = conn.recv()
+        if not (isinstance(ack, tuple) and len(ack) == 2 and ack[0] == "pid"):
+            raise RuntimeError("unexpected pool-child handshake ack %r" % (ack,))
+        return ack[1]
+
+    def _handshake(self, conn):
+        """Send + collect in one call (the single-child respawn path)."""
+        self._send_handshake(conn)
+        return self._await_pid_ack(conn)
+
     def _spawn_one(self):
-        """Spawn + handshake ONE replacement child (elastic respawn). Returns its
-        connection; raises when the child cannot start/connect or the pool is
-        stopping (the replacement is then killed, never leaked)."""
+        """Spawn + handshake ONE replacement child (elastic respawn). Returns
+        ``(connection, process)``; raises when the child cannot start/connect
+        or the pool is stopping (the replacement is then killed, never
+        leaked)."""
         import os
         from multiprocessing.connection import Listener
 
@@ -792,7 +964,7 @@ class ProcessExecutor(ExecutorBase):
                     raise RuntimeError("pool stopping during respawn")
                 self._procs.append(p)
                 self._conns.append(conn)
-            return conn
+            return conn, p
         except BaseException:
             if conn is not None:
                 try:
@@ -808,35 +980,58 @@ class ProcessExecutor(ExecutorBase):
         finally:
             listener.close()
 
-    def _respawn(self, err):
-        """A replacement connection for a dead child, or None when the budget is
-        exhausted / the pool is stopping / the spawn itself fails."""
+    def _respawn(self, err, idx, charged=True):
+        """A replacement connection for a dead child (registered as driver
+        ``idx``'s child), or None when the budget is exhausted / the pool is
+        stopping / the spawn itself fails.
+
+        ``charged=False`` is the quarantine path (ISSUE 7): the dead child's
+        item reached the poison threshold and will be SKIPPED, so the respawn
+        only restores pool capacity — it cannot crash-loop, and charging it
+        would let one poison item eat the whole budget."""
         with self._respawn_lock:
-            if self._respawn_budget <= 0 or self._stop_event.is_set():
+            if self._stop_event.is_set():
                 return None
-            self._respawn_budget -= 1
+            if charged:
+                if self._respawn_budget <= 0:
+                    return None
+                self._respawn_budget -= 1
             budget_left = self._respawn_budget
         from petastorm_tpu.obs.log import degradation
 
         try:
-            conn = self._spawn_one()
+            conn, proc = self._spawn_one()
         except Exception as e:  # noqa: BLE001 — degrade to the fatal path
             degradation("respawn_failed", "Pool child respawn failed: %s", e,
                         once=False)
             return None
+        with self._respawn_lock:
+            self._child_by_idx[idx] = proc
         degradation(
             "worker_died",
-            "Pool worker died (%s); respawned a replacement and re-dispatching its "
-            "item (remaining respawn budget: %d)", err, budget_left, once=False)
+            "Pool worker died (%s); respawned a replacement and %s its "
+            "item (remaining respawn budget: %d)", err,
+            "re-dispatching" if charged else "quarantining", budget_left,
+            once=False)
         return conn
 
     def _recv_result(self, conn, child_hb):
         """Receive the next result/exc header, draining child heartbeat pings
         (``("hb", ts)`` — sent at item receipt and while idle) into the
         child's heartbeat stamp. Children always ping; without a monitor the
-        pings are simply dropped here (one tuple check per message)."""
+        pings are simply dropped here (one tuple check per message).
+
+        The receive is a bounded poll loop, not a bare ``recv()`` (GL-R001):
+        once the pool is stopping this driver abandons the wait promptly —
+        a child hung in native code used to pin its driver in ``recv`` for
+        the full 10s thread-join timeout on every teardown."""
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.hit("pool.recv")
         while True:
-            msg = conn.recv()
+            while not conn.poll(0.2):
+                if self._stop_event.is_set():
+                    raise EOFError("pool stopping while awaiting a child result")
+            msg = conn.recv()  # graftlint: disable=GL-R001 (poll above bounds it)
             if isinstance(msg, tuple) and len(msg) == 2 and msg[0] == "hb":
                 if child_hb is not None:
                     child_hb.beat("working")
@@ -871,6 +1066,11 @@ class ProcessExecutor(ExecutorBase):
                 # on ITS IO pool before working the item (they are this driver's
                 # claimed pieces, so barring a steal the child reads its own future)
                 hints = list(upcoming)
+                recovery = self._recovery
+                attempts = 0       # failures of THIS item, across respawns/heals
+                first_death = None  # the ORIGINAL child failure (ISSUE 7: budget
+                #                     exhaustion must surface it, not a wrapper)
+                self._inflight_attempts[idx] = 0
                 while True:  # item attempts: survives child death via respawn
                     # slab grant per ATTEMPT: a respawned child gets a fresh grant,
                     # and a dead child's in-flight slab is reclaimed below
@@ -889,6 +1089,9 @@ class ProcessExecutor(ExecutorBase):
                             # the stall detection
                             hb.wait("child")
                         t0 = time.perf_counter() if monitor is not None else 0.0
+                        if _chaos.ACTIVE is not None:
+                            _chaos.ACTIVE.hit("pool.dispatch",
+                                              key=_chaos.item_key(item))
                         conn.send((slab, item, hints) if ring is not None
                                   else (item, hints))
                         header = self._recv_result(conn, child_hb)
@@ -899,9 +1102,18 @@ class ProcessExecutor(ExecutorBase):
                         if header[0] == "exc":
                             if slab is not None:
                                 ring.release(slab)
-                            self._put(_ExcResult(header[1]))
-                            fatal = True
-                            break
+                            attempts += 1
+                            self._inflight_attempts[idx] = attempts
+                            if not recovery.quarantine:
+                                self._put(_ExcResult(header[1]))
+                                fatal = True
+                                break
+                            if attempts >= recovery.poison_attempts:
+                                # poison by exception: skip it, keep the pool
+                                self._put(QuarantinedItem(item, header[1],
+                                                          attempts))
+                                break  # the child is alive: next item
+                            continue  # retry on the same live child
                         _, kind, nframes, trace_blob = header
                         if self._tracer is not None and trace_blob is not None:
                             # cross-process merge: the child's per-item spans,
@@ -916,15 +1128,79 @@ class ProcessExecutor(ExecutorBase):
                             ring.count_fallback()
                             slab = None
                         # kind == KIND_SHM transfers slab ownership to deserialize
-                        # (released there, or leased to the consumer in view mode)
-                        result = self._serializer.deserialize(kind, frames)
+                        # HERE (released there on its own failure, or leased to
+                        # the consumer in view mode) — `slab` must be cleared
+                        # BEFORE the call: a decode error below must never
+                        # double-release a slab the lease contract already owns
+                        # (the free list would hand one slab to two children,
+                        # silently corrupting a consumer-retained batch). The
+                        # one exception: a failure BEFORE deserialize could
+                        # even parse the descriptor (slab_released=False on
+                        # the exception) leaves the grant with this driver.
+                        granted, slab = slab, None
+                        if _chaos.ACTIVE is not None:
+                            frames = _chaos.ACTIVE.hit(
+                                "wire.decode", key=_chaos.item_key(item),
+                                payload=frames)
+                        try:
+                            result = self._serializer.deserialize(kind, frames)
+                        except Exception as e:  # noqa: BLE001 — policy-classified
+                            if granted is not None and \
+                                    not getattr(e, "slab_released", True):
+                                ring.release(granted)
+                            # wire-decode failure (corrupt bytes, truncated
+                            # descriptor): the child is ALIVE and the pipe is
+                            # intact. An EOFError out of pickle.loads used to
+                            # masquerade as a child death here — blind slab
+                            # release (double free) plus a pointless respawn of
+                            # a live child. Classify it like a worker exception
+                            # instead: poison policy applies, the item re-runs
+                            # on the same child.
+                            attempts += 1
+                            self._inflight_attempts[idx] = attempts
+                            if not recovery.quarantine:
+                                self._put(_ExcResult(e))
+                                fatal = True
+                                break
+                            if attempts >= recovery.poison_attempts:
+                                self._put(QuarantinedItem(item, e, attempts,
+                                                          kind="wire_decode"))
+                                break
+                            continue  # re-dispatch the item on the same child
                     except (EOFError, BrokenPipeError, ConnectionResetError) as e:
-                        if slab is not None:  # dead child's in-flight slab
-                            ring.release(slab)
-                        replacement = self._respawn(e)
+                        if slab is not None:
+                            # dead child's in-flight slab: reclaim is lease-aware
+                            # (revokes any outstanding consumer lease instead of
+                            # re-inserting a still-leased slab into the free list)
+                            ring.reclaim(slab)
+                        if self._stop_event.is_set():
+                            fatal = True  # teardown abandon, not a child failure
+                            break
+                        attempts += 1
+                        self._inflight_attempts[idx] = attempts
+                        if first_death is None:
+                            first_death = e
+                        # poison policy: an item that keeps killing children is
+                        # skipped after poison_attempts; its respawn restores
+                        # pool capacity WITHOUT charging the budget (the item
+                        # will not be retried — no crash-loop risk)
+                        poison = (recovery.quarantine
+                                  and attempts >= recovery.poison_attempts)
+                        replacement = self._respawn(e, idx, charged=not poison)
+                        if poison:
+                            self._put(QuarantinedItem(item, e, attempts,
+                                                      kind="child_death"))
                         if replacement is None:
-                            self._put(_ExcResult(
-                                RuntimeError("worker process died: %s" % e)))
+                            if not self._stop_event.is_set():
+                                err = WorkerDiedError(
+                                    "worker process died%s and no replacement "
+                                    "could be spawned (respawn budget "
+                                    "exhausted, or the spawn itself failed — "
+                                    "see the respawn_failed degradation): %s"
+                                    % (" %d time(s) on one item" % attempts
+                                       if attempts > 1 else "", first_death),
+                                    original=first_death)
+                                self._put(_ExcResult(err))
                             fatal = True
                             break
                         try:
@@ -932,6 +1208,8 @@ class ProcessExecutor(ExecutorBase):
                         except OSError:
                             pass
                         conn = replacement
+                        if poison:
+                            break  # quarantined: the fresh child takes the NEXT item
                         continue  # re-dispatch the SAME item on the fresh child
                     except Exception as e:  # noqa: BLE001 — a bad frame must surface,
                         self._put(_ExcResult(e))  # not silently truncate the dataset
@@ -989,10 +1267,14 @@ class ProcessExecutor(ExecutorBase):
         monitor = self._stack_provider_monitor
         self._stack_provider_monitor = None
         handle, self._stack_provider_handle = self._stack_provider_handle, None
-        if monitor is not None and handle is not None:
-            # a stall fired after this point must not signal reaped children;
-            # removal goes to the monitor that ISSUED the handle
-            monitor.remove_stack_provider(handle)
+        healer, self._healer_handle = self._healer_handle, None
+        if monitor is not None:
+            # a stall fired after this point must not signal (or heal-kill)
+            # reaped children; removal goes to the monitor that ISSUED the handle
+            if handle is not None:
+                monitor.remove_stack_provider(handle)
+            if healer is not None:
+                monitor.remove_healer(healer)
         for t in self._threads:
             t.join(timeout=10)
         self._threads = []
@@ -1004,6 +1286,8 @@ class ProcessExecutor(ExecutorBase):
             # about to rmtree (it fails cleanly on None instead)
             tmpdir, self._tmpdir = self._tmpdir, None
             ring, self._ring = self._ring, None
+            self._child_by_idx = {}
+            self._inflight_attempts = {}
         for conn in conns:
             try:
                 conn.close()
@@ -1024,8 +1308,9 @@ class ProcessExecutor(ExecutorBase):
 
 
 def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size=16,
-                  results_timeout_s=300.0, serializer="pickle", worker_respawns=2,
-                  shm_slab_bytes=None, shm_slabs=None, io_options=None):
+                  results_timeout_s=300.0, serializer="pickle", worker_respawns=None,
+                  shm_slab_bytes=None, shm_slabs=None, io_options=None,
+                  recovery=None):
     """Factory matching the reference's ``reader_pool_type`` kwarg ('thread'|'process'|'dummy').
 
     ``serializer`` selects the process-pool wire format: 'pickle'|'arrow' (reference
@@ -1039,6 +1324,10 @@ def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size
     ``io_options`` (:class:`petastorm_tpu.io.IoOptions`) configures the dispatch
     side of the async read path: the per-worker lookahead claim (= readahead
     depth) and work stealing.
+    ``recovery`` (:class:`petastorm_tpu.recovery.RecoveryOptions`) is the unified
+    recovery policy (ISSUE 7): the process pool's respawn budget defaults from it
+    (an explicit ``worker_respawns`` still wins), and every pool applies its
+    ``on_poison``/``poison_attempts`` quarantine policy to failing items.
     """
     from petastorm_tpu.io import IoOptions
 
@@ -1046,15 +1335,17 @@ def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size
     lookahead = io_options.lookahead
     stealing = io_options.work_stealing
     if reader_pool_type in ("dummy", "sync"):
-        return SyncExecutor(lookahead=lookahead)
+        return SyncExecutor(lookahead=lookahead, recovery=recovery)
     if reader_pool_type == "thread":
         return ThreadExecutor(workers_count, results_queue_size, results_timeout_s,
-                              lookahead=lookahead, work_stealing=stealing)
+                              lookahead=lookahead, work_stealing=stealing,
+                              recovery=recovery)
     if reader_pool_type == "process":
         return ProcessExecutor(workers_count, results_queue_size, results_timeout_s,
                                serializer=serializer, worker_respawns=worker_respawns,
                                shm_slab_bytes=shm_slab_bytes, shm_slabs=shm_slabs,
-                               lookahead=lookahead, work_stealing=stealing)
+                               lookahead=lookahead, work_stealing=stealing,
+                               recovery=recovery)
     raise ValueError(
         "Unknown reader_pool_type %r (expected 'thread', 'process' or 'dummy')"
         % reader_pool_type

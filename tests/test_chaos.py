@@ -1,0 +1,727 @@
+"""Chaos plane + self-healing tests (ISSUE 7): deterministic fault injection,
+recovery-policy plumbing, poison-item quarantine with exactly-once accounting,
+the stall heal tier, and the dead-child × lease interaction."""
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import chaos
+from petastorm_tpu.chaos import ChaosError, FaultPlan, FaultRule
+from petastorm_tpu.errors import LeaseRevoked, StallError, WorkerDiedError
+from petastorm_tpu.recovery import (
+    QuarantinedItem,
+    QuarantineReport,
+    RecoveryOptions,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends disarmed — one test's plan must never leak
+    into the next (or into the pool children other tests spawn)."""
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+@pytest.fixture(scope="module")
+def chaos_dataset(tmp_path_factory):
+    """8 files × 1 row group × 16 rows: plan ordinals map 1:1 to files, so an
+    ``item_key`` of ``ordinal=k`` pins a fault to a known id range."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    root = tmp_path_factory.mktemp("chaos_ds")
+    for i in range(8):
+        pq.write_table(
+            pa.table({"id": np.arange(16, dtype=np.int64) + i * 16,
+                      "x": np.random.default_rng(i).random(16)}),
+            str(root / ("part_%02d.parquet" % i)), row_group_size=16)
+    return "file://" + str(root)
+
+
+def _collect_ids(reader):
+    return sorted(int(v) for batch in reader for v in np.asarray(batch.id))
+
+
+ALL_IDS = list(range(128))
+
+
+# -- FaultPlan / FaultRule units ---------------------------------------------------------
+
+
+def test_rule_nth_and_every_fire_at_exact_hits():
+    plan = FaultPlan([FaultRule("s", "latency", nth=2, every=3, latency_s=0)])
+    fired = []
+    for i in range(1, 12):
+        before = plan.stats()["injected_total"]
+        plan.hit("s")
+        if plan.stats()["injected_total"] > before:
+            fired.append(i)
+    assert fired == [2, 5, 8, 11]  # nth anchors, every strides
+
+
+def test_rule_times_budget_caps_fires():
+    plan = FaultPlan([FaultRule("s", "latency", every=1, times=2, latency_s=0)])
+    for _ in range(10):
+        plan.hit("s")
+    assert plan.stats()["fires"] == [2]
+
+
+def test_rule_site_pattern_and_item_key_filter():
+    plan = FaultPlan([FaultRule("reader.*", "latency", item_key="ordinal=3",
+                                latency_s=0)])
+    plan.hit("worker.item", key="ordinal=3")   # site mismatch
+    plan.hit("reader.read", key="ordinal=4")   # key mismatch
+    assert plan.stats()["hits"] == [0]         # non-matching hits don't count
+    plan.hit("reader.read", key="epoch=0 ordinal=3 f.parquet:0")
+    assert plan.stats()["fires"] == [1]
+
+
+def test_probability_is_deterministic_per_seed():
+    def pattern(seed):
+        plan = FaultPlan([FaultRule("s", "latency", probability=0.5,
+                                    latency_s=0)], seed=seed)
+        out = []
+        for _ in range(64):
+            before = plan.stats()["injected_total"]
+            plan.hit("s")
+            out.append(plan.stats()["injected_total"] > before)
+        return out
+
+    a, b, c = pattern(3), pattern(3), pattern(4)
+    assert a == b                      # same seed → identical replay
+    assert a != c                      # different seed → different pattern
+    assert 10 < sum(a) < 54            # and it is actually probabilistic
+
+
+def test_raise_actions_raise_the_documented_types():
+    plan = FaultPlan([FaultRule("t", "raise_transient", every=1),
+                      FaultRule("p", "raise_permanent", every=1)])
+    with pytest.raises(ConnectionResetError):
+        plan.hit("t")
+    with pytest.raises(FileNotFoundError):
+        plan.hit("p")
+
+
+def test_corrupt_flips_one_byte_in_a_copy():
+    plan = FaultPlan([FaultRule("wire.decode", "corrupt", every=1)], seed=5)
+    original = b"a" * 64
+    frames = [b"head", original]
+    out = plan.hit("wire.decode", payload=frames)
+    assert out[0] == b"head"                       # largest frame targeted
+    assert out[1] != original and len(out[1]) == 64
+    assert sum(x != y for x, y in zip(out[1], original)) == 1
+    assert frames[1] == b"a" * 64                  # original untouched
+    out2 = FaultPlan([FaultRule("wire.decode", "corrupt", every=1)],
+                     seed=5).hit("wire.decode", payload=[b"head", original])
+    assert out2[1] == out[1]                       # deterministic per seed
+
+
+def test_hang_ends_promptly_on_disarm():
+    plan = FaultPlan([FaultRule("s", "hang", every=1, hang_s=60.0)])
+    chaos.arm(plan, propagate=False)
+    t0 = time.monotonic()
+    import threading
+
+    done = threading.Event()
+    threading.Thread(target=lambda: (plan.hit("s"), done.set()),
+                     daemon=True).start()
+    time.sleep(0.2)
+    chaos.disarm()
+    assert done.wait(2.0), "hang did not notice disarm"
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_kill_requires_opt_in():
+    plan = FaultPlan([FaultRule("s", "kill", every=1)])
+    assert not chaos.kill_allowed()
+    with pytest.raises(ChaosError, match="did not opt in"):
+        plan.hit("s")
+
+
+def test_plan_json_roundtrip_and_env_arming(monkeypatch):
+    plan = FaultPlan([FaultRule("reader.read", "raise_transient", nth=3,
+                                times=2, item_key="ordinal=1")], seed=9)
+    clone = FaultPlan.from_json(plan.to_json())
+    assert clone.seed == 9
+    assert clone.rules[0].to_spec() == plan.rules[0].to_spec()
+    monkeypatch.setenv("PTPU_CHAOS_SPEC", plan.to_json())
+    armed = chaos.arm_from_env()
+    assert armed is chaos.ACTIVE and armed.seed == 9
+    chaos.disarm()
+    monkeypatch.delenv("PTPU_CHAOS_SPEC", raising=False)
+    assert chaos.arm_from_env() is None
+
+
+def test_armed_context_disarms_on_exception():
+    plan = FaultPlan([])
+    with pytest.raises(RuntimeError):
+        with chaos.armed(plan, propagate=False):
+            assert chaos.ACTIVE is plan
+            raise RuntimeError("scenario failed")
+    assert chaos.ACTIVE is None
+
+
+def test_unarmed_sites_cost_one_none_check():
+    assert chaos.ACTIVE is None  # the contract every hook site relies on
+
+
+# -- RecoveryOptions ---------------------------------------------------------------------
+
+
+def test_recovery_defaults_and_env(monkeypatch):
+    rec = RecoveryOptions()
+    assert (rec.io_retries, rec.worker_respawns, rec.on_poison,
+            rec.poison_attempts) == (2, 2, "raise", 2)
+    monkeypatch.setenv("PTPU_IO_RETRIES", "5")
+    monkeypatch.setenv("PTPU_ON_POISON", "quarantine")
+    rec = RecoveryOptions()
+    assert rec.io_retries == 5 and rec.quarantine
+
+
+def test_recovery_resolve_legacy_kwargs_win():
+    base = RecoveryOptions(io_retries=7, worker_respawns=9)
+    merged = RecoveryOptions.resolve(base, io_retries=1)
+    assert merged.io_retries == 1          # explicit legacy kwarg wins
+    assert merged.worker_respawns == 9     # struct fields survive
+    assert RecoveryOptions.resolve(None, worker_respawns=0).worker_respawns == 0
+
+
+def test_recovery_validation_and_pickle():
+    with pytest.raises(ValueError, match="on_poison"):
+        RecoveryOptions(on_poison="shrug")
+    rec = RecoveryOptions(on_poison="quarantine", poison_attempts=3,
+                          read_deadline_s=4.5)
+    clone = pickle.loads(pickle.dumps(rec))
+    assert clone.quarantine and clone.poison_attempts == 3
+    assert clone.read_deadline_s == 4.5
+
+
+# -- poison quarantine: every pool type --------------------------------------------------
+
+
+@pytest.mark.parametrize("pool", ["dummy", "thread"])
+def test_poison_item_quarantined_in_process_pools(pool, chaos_dataset):
+    from petastorm_tpu.reader import make_batch_reader
+
+    plan = FaultPlan([FaultRule("worker.item", "raise_permanent",
+                                item_key="ordinal=2")])
+    with chaos.armed(plan, propagate=False):
+        with make_batch_reader(chaos_dataset, num_epochs=1, workers_count=2,
+                               shuffle_row_groups=False, reader_pool_type=pool,
+                               recovery={"on_poison": "quarantine",
+                                         "poison_attempts": 2}) as reader:
+            ids = _collect_ids(reader)
+            report = reader.quarantine_report
+    assert ids == sorted(set(ALL_IDS) - set(range(32, 48)))
+    assert len(report) == 1 and report
+    entry = report.entries[0]
+    assert (entry.ordinal, entry.attempts, entry.kind) == (2, 2, "exception")
+    assert entry.num_rows == 16 and entry.row_group == 0
+    assert "FileNotFoundError" in entry.as_dict()["error"]
+    assert report.ordinals() == {(0, 2)}
+    assert "part_02" in report.render()
+
+
+def test_poison_item_raises_without_quarantine(chaos_dataset):
+    from petastorm_tpu.reader import make_batch_reader
+
+    plan = FaultPlan([FaultRule("worker.item", "raise_permanent",
+                                item_key="ordinal=2")])
+    with chaos.armed(plan, propagate=False):
+        with make_batch_reader(chaos_dataset, num_epochs=1, workers_count=2,
+                               shuffle_row_groups=False) as reader:
+            with pytest.raises(FileNotFoundError, match="chaos-injected"):
+                _collect_ids(reader)
+            assert not reader.quarantine_report
+
+
+def test_process_pool_child_exception_quarantined(chaos_dataset):
+    """An exception raised INSIDE a pool child (child.item site) rides the exc
+    header; the driver's poison policy retries then quarantines — the pool
+    stays alive for every other item."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    plan = FaultPlan([FaultRule("child.item", "raise_permanent",
+                                item_key="ordinal=5")])
+    with chaos.armed(plan):  # propagate: children must inherit the plan
+        with make_batch_reader(chaos_dataset, num_epochs=1, workers_count=2,
+                               shuffle_row_groups=False,
+                               reader_pool_type="process",
+                               results_timeout_s=120,
+                               recovery=RecoveryOptions(
+                                   on_poison="quarantine",
+                                   poison_attempts=2)) as reader:
+            ids = _collect_ids(reader)
+            report = reader.quarantine_report
+    assert ids == sorted(set(ALL_IDS) - set(range(80, 96)))
+    assert len(report) == 1 and report.entries[0].kind == "exception"
+
+
+def test_process_pool_poison_kill_quarantined_without_burning_budget(
+        chaos_dataset):
+    """An item that KILLS every child it meets is quarantined after
+    poison_attempts deaths, and its respawns are uncharged — the budget
+    survives for real (non-poison) failures."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    plan = FaultPlan([FaultRule("child.item", "kill", item_key="ordinal=3")])
+    with chaos.armed(plan):
+        with make_batch_reader(chaos_dataset, num_epochs=1, workers_count=2,
+                               shuffle_row_groups=False,
+                               reader_pool_type="process",
+                               results_timeout_s=120,
+                               recovery=RecoveryOptions(
+                                   on_poison="quarantine", poison_attempts=2,
+                                   worker_respawns=1)) as reader:
+            ids = _collect_ids(reader)
+            report = reader.quarantine_report
+            budget_left = reader._executor._respawn_budget
+    assert ids == sorted(set(ALL_IDS) - set(range(48, 64)))
+    assert len(report) == 1
+    entry = report.entries[0]
+    assert entry.kind == "child_death" and entry.attempts == 2
+    # the FIRST death charges the budget (nothing marks the item poison yet:
+    # 1 -> 0); the death that REACHES the threshold quarantines and its
+    # respawn is uncharged — so the pool survived a second death on a budget
+    # of 1, which pre-ISSUE-7 would have been WorkerDiedError
+    assert budget_left == 0
+
+
+def test_respawn_budget_exhaustion_surfaces_original_child_failure(
+        chaos_dataset):
+    """Satellite: past the budget the consumer sees WorkerDiedError carrying
+    the ORIGINAL child failure as __cause__/original — still a RuntimeError
+    matching the historical 'worker process died' contract."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    plan = FaultPlan([FaultRule("child.item", "kill", item_key="ordinal=1")])
+    with chaos.armed(plan):
+        with make_batch_reader(chaos_dataset, num_epochs=1, workers_count=1,
+                               shuffle_row_groups=False,
+                               reader_pool_type="process",
+                               results_timeout_s=120,
+                               recovery=RecoveryOptions(
+                                   worker_respawns=1)) as reader:
+            with pytest.raises(WorkerDiedError,
+                               match="worker process died") as exc_info:
+                _collect_ids(reader)
+    err = exc_info.value
+    assert isinstance(err, RuntimeError)
+    assert isinstance(err.original, (EOFError, ConnectionResetError,
+                                     BrokenPipeError))
+    assert err.__cause__ is err.original
+
+
+def test_corrupt_wire_payload_redelivered_exactly_once(chaos_dataset):
+    """A flipped byte in a wire payload is DETECTED (descriptor crc), treated
+    as a decode failure (never a child death), and the item re-dispatches on
+    the same live child — delivered exactly once, zero leaked leases."""
+    from petastorm_tpu.obs.metrics import default_registry
+    from petastorm_tpu.reader import make_batch_reader
+
+    leaked = default_registry().counter("ptpu_lease_leaked_total")
+    before = leaked.value
+    plan = FaultPlan([FaultRule("wire.decode", "corrupt", nth=2, times=1)],
+                     seed=3)
+    with chaos.armed(plan, propagate=False):  # parent-side site
+        with make_batch_reader(chaos_dataset, num_epochs=1, workers_count=2,
+                               shuffle_row_groups=False,
+                               reader_pool_type="process",
+                               wire_serializer="shm-view",
+                               results_timeout_s=120,
+                               recovery=RecoveryOptions(
+                                   on_poison="quarantine",
+                                   poison_attempts=3)) as reader:
+            ids = _collect_ids(reader)
+            assert not reader.quarantine_report
+            procs = list(reader._executor._procs)
+    assert ids == ALL_IDS
+    assert len(procs) == 2  # no respawn: the decode error stayed a decode error
+    import gc
+
+    gc.collect()
+    assert leaked.value - before == 0
+
+
+# -- retry policy under fault ------------------------------------------------------------
+
+
+def test_transient_errors_absorbed_by_retry_and_counted(chaos_dataset):
+    from petastorm_tpu.obs.log import degradation_counts
+    from petastorm_tpu.reader import make_batch_reader
+
+    before = degradation_counts().get("io_retry", 0)
+    plan = FaultPlan([FaultRule("reader.read", "raise_transient", every=4)],
+                     seed=2)
+    with chaos.armed(plan, propagate=False):
+        with make_batch_reader(chaos_dataset, num_epochs=1, workers_count=2,
+                               shuffle_row_groups=False, io_retries=3,
+                               io_retry_backoff_s=0.01) as reader:
+            assert _collect_ids(reader) == ALL_IDS
+    assert degradation_counts().get("io_retry", 0) > before
+
+
+def test_io_retries_zero_fails_fast_on_sync_readahead_and_coalesced_paths():
+    """Satellite: io_retries=0 must disable retry on EVERY read path — one
+    attempt, no sleeps, on the sync read, the coalesced run, and a background
+    readahead read (whose stored error re-raises at get())."""
+    from petastorm_tpu.cache import NullCache
+    from petastorm_tpu.reader import _WorkerBase
+
+    class _P:
+        path = "store/p.parquet"
+        row_group = 0
+
+    def bare():
+        w = _WorkerBase(None, None, None, None, None, NullCache(), 1, None,
+                        None, io_retries=0,
+                        io_options={"readahead": False})
+        state = {"attempts": 0}
+
+        def fail(*_a, **_k):
+            state["attempts"] += 1
+            raise ConnectionResetError("reset")
+
+        w._read_columns_once = fail
+        w._read_run_once = fail
+        w._evict_parquet_file = lambda path: None
+        return w, state
+
+    w, state = bare()
+    with pytest.raises(ConnectionResetError):
+        w._read_columns_sync(_P(), None)
+    assert state["attempts"] == 1  # sync: no retry
+
+    w, state = bare()
+    with pytest.raises(ConnectionResetError):
+        w._read_run([_P()], None)
+    assert state["attempts"] == 1  # coalesced run: no retry
+
+    from petastorm_tpu.io.readahead import ReadaheadPool
+
+    w, state = bare()
+    pool = ReadaheadPool(w._read_columns_sync, read_run_fn=w._read_run,
+                         depth=2, io_threads=1, coalesce=False)
+    try:
+        assert pool.schedule([(_P(), None)]) == 1
+        deadline = time.monotonic() + 5.0
+        while state["attempts"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(ConnectionResetError):
+            pool.get(_P(), None)
+        assert state["attempts"] == 1  # background read: same zero budget
+    finally:
+        pool.shutdown()
+
+
+def test_read_deadline_caps_the_retry_loop(monkeypatch):
+    from petastorm_tpu.cache import NullCache
+    from petastorm_tpu.reader import _WorkerBase
+
+    w = _WorkerBase(None, None, None, None, None, NullCache(), 1, None, None,
+                    io_options={"readahead": False},
+                    recovery=RecoveryOptions(io_retries=50,
+                                             io_retry_backoff_s=0.01,
+                                             read_deadline_s=0.2))
+    state = {"attempts": 0}
+
+    def fail(*_a, **_k):
+        state["attempts"] += 1
+        raise ConnectionResetError("reset")
+
+    w._read_columns_once = fail
+    w._evict_parquet_file = lambda path: None
+
+    class _P:
+        path = "p"
+        row_group = 0
+
+    with pytest.raises(ConnectionResetError):
+        w._read_columns_sync(_P(), None)
+    assert 1 <= state["attempts"] < 50  # the deadline, not the budget, stopped it
+
+
+# -- checkpoint exactness across a quarantine skip ---------------------------------------
+
+
+def test_checkpoint_resume_after_quarantine_replays_and_loses_nothing(
+        chaos_dataset):
+    """Satellite: a quarantine skip is charged to the consumed-ordinal
+    watermark — resume from a checkpoint taken after the skip must neither
+    replay the poisoned group nor lose any other row."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    def open_reader():
+        return make_batch_reader(chaos_dataset, num_epochs=1, workers_count=1,
+                                 shuffle_row_groups=False,
+                                 reader_pool_type="dummy",
+                                 recovery={"on_poison": "quarantine",
+                                           "poison_attempts": 2})
+
+    plan = FaultPlan([FaultRule("worker.item", "raise_permanent",
+                                item_key="ordinal=2")])
+    first_ids = []
+    with chaos.armed(plan, propagate=False):
+        with open_reader() as reader:
+            it = iter(reader)
+            # consume past the quarantined ordinal (deterministic dummy pool:
+            # ordinals 0, 1 delivered, 2 quarantined, 3, 4 delivered)
+            for _ in range(4):
+                first_ids.extend(int(v) for v in np.asarray(next(it).id))
+            assert len(reader.quarantine_report) == 1
+            state = reader.state_dict()
+
+    with open_reader() as reader:  # no chaos: the poison would now succeed
+        reader.load_state_dict(state)
+        rest_ids = [int(v) for b in reader for v in np.asarray(b.id)]
+
+    combined = sorted(first_ids + rest_ids)
+    assert combined == sorted(set(ALL_IDS) - set(range(32, 48)))
+    assert len(combined) == len(set(combined))  # nothing replayed
+    # and nothing lost: every non-quarantined id arrived exactly once
+
+
+# -- stall heal tier ---------------------------------------------------------------------
+
+
+def test_monitor_try_heal_unit(tmp_path):
+    """Healers run under escalation='heal'; actors nobody heals escalate to
+    StallError, healed ones re-arm silently; heal_count tracks recoveries."""
+    from petastorm_tpu.obs.health import HealthMonitor, HealthOptions
+
+    mon = HealthMonitor(HealthOptions(stall_threshold_s=0.05,
+                                      poll_interval_s=10.0,
+                                      escalation="heal",
+                                      flight_path=str(tmp_path / "f.json")))
+    hb_a = mon.register("worker.child-0", "worker")
+    hb_b = mon.register("worker.child-1", "worker")
+    hb_a.beat("working")
+    hb_b.beat("working")
+    healed_calls = []
+    mon.add_healer(lambda stalled: healed_calls.append(
+        [s["actor"] for s in stalled]) or {"worker.child-0"})
+    delivered = []
+    mon.add_stall_callback(delivered.append)
+    time.sleep(0.1)
+    mon._handle_stall(mon.check_stalls())  # what the watchdog poll does
+    assert healed_calls and set(healed_calls[0]) == {"worker.child-0",
+                                                     "worker.child-1"}
+    assert mon.heal_count == 1
+    assert len(delivered) == 1 and isinstance(delivered[0], StallError)
+    assert "worker.child-1" in str(delivered[0])      # the unhealed actor
+    assert "worker.child-0" not in str(delivered[0])  # the healed one
+
+
+def test_heal_escalation_recovers_live_hang_without_stallerror(chaos_dataset,
+                                                               tmp_path):
+    """Acceptance: escalation='heal' recovers an injected in-child hang — the
+    consumer sees every row and never a StallError while the budget lasts."""
+    from petastorm_tpu.obs.health import HealthMonitor, HealthOptions
+    from petastorm_tpu.reader import make_batch_reader
+
+    plan = FaultPlan([FaultRule("child.item", "hang", nth=2, times=1,
+                                hang_s=60.0)])
+    mon = HealthMonitor(HealthOptions(stall_threshold_s=1.0,
+                                      poll_interval_s=0.25,
+                                      escalation="heal",
+                                      thresholds={"child": 1.0},
+                                      flight_path=str(tmp_path / "f.json")))
+    with chaos.armed(plan):
+        with make_batch_reader(chaos_dataset, num_epochs=1, workers_count=2,
+                               shuffle_row_groups=False,
+                               reader_pool_type="process",
+                               results_timeout_s=120,
+                               recovery=RecoveryOptions(
+                                   worker_respawns=16)) as reader:
+            reader.set_health(mon)
+            mon.start()
+            try:
+                ids = _collect_ids(reader)
+            finally:
+                mon.stop()
+    assert ids == ALL_IDS
+    assert mon.heal_count >= 1
+    assert not reader.quarantine_report
+
+
+def test_heal_falls_through_to_stallerror_when_budget_exhausted(tmp_path):
+    """With no respawn budget and no quarantine absorption the healer refuses
+    to kill (it could not recover) and the stall escalates to StallError."""
+    from petastorm_tpu.obs.health import HealthMonitor, HealthOptions
+    from petastorm_tpu.workers import ProcessExecutor
+
+    mon = HealthMonitor(HealthOptions(stall_threshold_s=0.05,
+                                      poll_interval_s=10.0,
+                                      escalation="heal",
+                                      flight_path=str(tmp_path / "f.json")))
+    with ProcessExecutor(workers_count=1,
+                         recovery=RecoveryOptions(worker_respawns=0)) as ex:
+        ex._stop_event.clear()
+        delivered = []
+        mon.add_stall_callback(delivered.append)
+        ex.set_health(mon)
+
+        class _FakeProc:
+            pid = 99999
+
+            @staticmethod
+            def poll():
+                return None  # "alive"
+
+            @staticmethod
+            def kill():
+                raise AssertionError(
+                    "healer must not kill: nothing can absorb it")
+
+        ex._child_by_idx[0] = _FakeProc()
+        hb = mon.register("worker.child-0", "worker")
+        hb.beat("working")
+        time.sleep(0.1)
+        mon._handle_stall(mon.check_stalls())  # what the watchdog poll does
+        assert len(delivered) == 1 and isinstance(delivered[0], StallError)
+        assert mon.heal_count == 0
+
+
+def test_healer_ignores_sibling_scope_actors(tmp_path):
+    """On a SHARED monitor (HealthScope 'pipeN/' prefixes) a pool's healer
+    claims only its OWN scoped child actors — a suffix-only match would kill a
+    sibling pipeline's healthy child, mask the real hang (the stall debounce
+    never re-arms for a child that never beats), and burn a respawn."""
+    from petastorm_tpu.obs.health import HealthMonitor, HealthOptions
+    from petastorm_tpu.workers import ProcessExecutor
+
+    mon = HealthMonitor(HealthOptions(stall_threshold_s=0.05,
+                                      poll_interval_s=10.0,
+                                      escalation="heal",
+                                      flight_path=str(tmp_path / "f.json")))
+    scope = mon.scoped("pipe1")
+    kills = []
+    with ProcessExecutor(workers_count=1,
+                         recovery=RecoveryOptions(worker_respawns=2)) as ex:
+        ex._stop_event.clear()
+        ex.set_health(scope)
+
+        class _FakeProc:
+            pid = 12345
+
+            @staticmethod
+            def poll():
+                return None  # "alive"
+
+            @staticmethod
+            def kill():
+                kills.append(1)
+
+        ex._child_by_idx[0] = _FakeProc()
+        sibling = {"actor": "pipe2/worker.child-0", "age_s": 9.9}
+        assert ex._heal_stalled([sibling]) == set() and kills == []
+        own_name = scope._name("worker.child-0")
+        own = {"actor": own_name, "age_s": 9.9}
+        assert ex._heal_stalled([own]) == {own_name} and kills == [1]
+
+
+# -- dead-child × lease interaction (satellite) ------------------------------------------
+
+
+def test_ring_reclaim_revokes_outstanding_lease():
+    """Unit for the PR-2 → PR-6 gap: reclaiming a slab with an outstanding
+    consumer lease must REVOKE it (fail-loud LeaseRevoked), never re-insert a
+    still-viewed slab into the free list."""
+    from petastorm_tpu.io.lease import Lease
+    from petastorm_tpu.parallel.shm_ring import SlabRing, shm_supported
+
+    if not shm_supported():
+        pytest.skip("no shared memory on this platform")
+    ring = SlabRing(1024, 2)
+    try:
+        slab = ring.acquire()
+        released = []
+        lease = Lease(release_cb=lambda: (released.append(slab),
+                                          ring.release(slab)),
+                      kind="shm_slab")
+        ring.register_lease(slab, lease)
+        ring.reclaim(slab)
+        with pytest.raises(LeaseRevoked):
+            lease.check()
+        assert released == []       # revoke invalidates, holder still owns
+        assert ring.stats()["shm_slabs_in_flight"] == 1
+        lease.release()             # holder's release returns the slab
+        assert released == [slab]
+        assert ring.stats()["shm_slabs_in_flight"] == 0
+    finally:
+        ring.close()
+
+
+def test_ring_reclaim_without_lease_is_plain_release_and_double_release_guarded():
+    from petastorm_tpu.parallel.shm_ring import SlabRing, shm_supported
+
+    if not shm_supported():
+        pytest.skip("no shared memory on this platform")
+    ring = SlabRing(1024, 2)
+    try:
+        slab = ring.acquire()
+        ring.reclaim(slab)
+        assert ring.stats()["shm_slabs_in_flight"] == 0
+        free_before = ring._free.qsize()
+        ring.release(slab)  # double release: suppressed, no double insert
+        assert ring._free.qsize() == free_before
+    finally:
+        ring.close()
+
+
+def test_kill_while_batch_retained_regression(chaos_dataset):
+    """Satellite regression: a loader batch RETAINED (lease taken) across a
+    child death keeps serving byte-correct data — its slab is never re-granted
+    under the consumer — and the rest of the epoch still delivers exactly
+    once."""
+    import signal
+
+    from petastorm_tpu.reader import make_batch_reader
+
+    with make_batch_reader(chaos_dataset, num_epochs=1, workers_count=2,
+                           shuffle_row_groups=False,
+                           reader_pool_type="process",
+                           wire_serializer="shm-view",
+                           results_timeout_s=120,
+                           recovery=RecoveryOptions(
+                               worker_respawns=4)) as reader:
+        it = iter(reader)
+        first = next(it)
+        retained_ids = np.asarray(first.id).copy()  # ground truth snapshot
+        retained_view = first.id                     # zero-copy slab view
+        lease = reader.take_lease()                  # retain across the kill
+        assert lease is not None
+        try:
+            os.kill(reader._executor._procs[0].pid, signal.SIGKILL)
+            rest = []
+            for batch in it:
+                rest.extend(int(v) for v in np.asarray(batch.id))
+            # the retained batch's views never went stale or got overwritten
+            np.testing.assert_array_equal(np.asarray(retained_view),
+                                          retained_ids)
+            all_ids = sorted(rest + retained_ids.tolist())
+            assert all_ids == ALL_IDS
+        finally:
+            lease.release()
+
+
+# -- marker / report plumbing ------------------------------------------------------------
+
+
+def test_quarantine_report_is_falsy_when_empty():
+    report = QuarantineReport()
+    assert not report and len(report) == 0
+    assert report.ordinals() == set()
+    assert "empty" in report.render()
+    assert report.as_dict() == {"quarantined": []}
+
+
+def test_quarantined_item_marker_repr():
+    marker = QuarantinedItem((0, 3, None), ValueError("boom"), 2,
+                             kind="child_death")
+    assert "attempts=2" in repr(marker) and "child_death" in repr(marker)

@@ -146,7 +146,10 @@ _C002_POSITIVE = """
 
 def test_blocking_teardown_fires_on_untimed_get_and_join():
     findings, _ = _lint(_C002_POSITIVE)
-    findings = _only_rule(findings, "GL-C002")
+    # the same smell is seen through two lenses: GL-C002 (teardown context)
+    # and GL-R001 (unbounded blocking call anywhere in pipeline code)
+    assert {f.rule_id for f in findings} == {"GL-C002", "GL-R001"}, findings
+    findings = [f for f in findings if f.rule_id == "GL-C002"]
     assert {f.line for f in findings} == {
         _line_of(_C002_POSITIVE, "BUG: untimed get"),
         _line_of(_C002_POSITIVE, "BUG: untimed join"),
@@ -173,7 +176,10 @@ def test_blocking_teardown_clean_with_timeouts():
             def consume(self):
                 return self._results.get()  # not a teardown path: allowed
     """)
-    assert findings == []
+    # GL-C002 is satisfied everywhere; the consume() get is outside its
+    # teardown scope but IS an unbounded blocking call — GL-R001's beat
+    assert [f for f in findings if f.rule_id == "GL-C002"] == []
+    assert [f.rule_id for f in findings] == ["GL-R001"], findings
 
 
 def test_blocking_teardown_fires_on_explicit_blocking_get():
@@ -192,7 +198,8 @@ def test_blocking_teardown_fires_on_explicit_blocking_get():
                 c = self._results.get(True, 5)  # timeout given: fine
     """
     findings, _ = _lint(src)
-    findings = _only_rule(findings, "GL-C002")
+    findings = [f for f in findings if f.rule_id == "GL-C002"]
+    assert findings
     assert {f.line for f in findings} == {
         _line_of(src, "BUG: get(True)"),
         _line_of(src, "BUG: block=True"),
@@ -219,7 +226,8 @@ def test_blocking_teardown_knows_queue_get_signature():
                 self._worker.join(5)  # timed: fine
     """
     findings, _ = _lint(src)
-    findings = _only_rule(findings, "GL-C002")
+    findings = [f for f in findings if f.rule_id == "GL-C002"]
+    assert findings
     assert {f.line for f in findings} == {
         _line_of(src, "BUG: block=5"),
         _line_of(src, "BUG: join(None)"),
@@ -245,8 +253,9 @@ def test_blocking_teardown_fires_on_thread_list_join_loop():
                     t.join()  # BUG: untimed loop join
     """
     findings, _ = _lint(src)
-    f = _only_rule(findings, "GL-C002")[0]
-    assert f.line == _line_of(src, "BUG: untimed loop join")
+    c002 = [f for f in findings if f.rule_id == "GL-C002"]
+    assert len(c002) == 1
+    assert c002[0].line == _line_of(src, "BUG: untimed loop join")
 
 
 # -- GL-C003: thread handling -----------------------------------------------------------
@@ -930,6 +939,87 @@ def test_silent_swallow_degradation_log_route_is_clean():
                 degradation("x_failed", "x failed (%s)", e)
     """)
     assert findings == []
+
+
+# -- GL-R001: unbounded blocking calls ---------------------------------------------------
+
+_R001_POSITIVE = """
+    import queue
+    import threading
+    from multiprocessing.connection import Client
+
+    class Driver:
+        def __init__(self):
+            self._results = queue.Queue()
+            self._done = threading.Event()
+
+        def run(self, address, authkey):
+            t = threading.Thread(target=print)
+            conn = Client(address, authkey=authkey)
+            item = self._results.get()  # BUG: untimed queue get
+            msg = conn.recv()  # BUG: unbounded Connection.recv
+            t.join()  # BUG: untimed thread join
+            self._done.wait()  # BUG: untimed event wait
+            return item, msg
+"""
+
+
+def test_unbounded_blocking_fires_on_all_four_primitives():
+    findings, _ = _lint(_R001_POSITIVE)
+    findings = [f for f in findings if f.rule_id == "GL-R001"]
+    assert {f.line for f in findings} == {
+        _line_of(_R001_POSITIVE, "BUG: untimed queue get"),
+        _line_of(_R001_POSITIVE, "BUG: unbounded Connection.recv"),
+        _line_of(_R001_POSITIVE, "BUG: untimed thread join"),
+        _line_of(_R001_POSITIVE, "BUG: untimed event wait"),
+    }
+
+
+def test_unbounded_blocking_tracks_self_attrs_across_methods():
+    """A queue built in __init__ and drained in another method is still typed
+    (the tracker maps self.<attr> chains module-wide)."""
+    src = """
+        import queue
+
+        class Pool:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            def drain(self):
+                return self._q.get()  # BUG: untimed get
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-R001")[0]
+    assert f.line == _line_of(src, "BUG: untimed get")
+
+
+def test_unbounded_blocking_clean_cases():
+    """Timeouts (kwarg or positional), non-blocking gets, accept()-born
+    connections bounded by inline disables, and untyped receivers (dict.get,
+    str.join) all stay clean."""
+    findings, suppressed = _lint("""
+        import queue
+        import threading
+
+        def ok(listener, mapping, parts):
+            q = queue.Queue()
+            e = threading.Event()
+            t = threading.Thread(target=print)
+            q.get(timeout=1.0)
+            q.get(True, 2.0)
+            q.get(False)
+            q.get(block=False)
+            t.join(5.0)
+            t.join(timeout=5.0)
+            e.wait(0.5)
+            conn = listener.accept()
+            while not conn.poll(0.2):
+                pass
+            msg = conn.recv()  # graftlint: disable=GL-R001 (poll above bounds it)
+            mapping.get("key")
+            return ", ".join(parts), msg
+    """)
+    assert findings == [] and suppressed == 1
 
 
 # -- engine: suppressions, baseline, CLI ------------------------------------------------
