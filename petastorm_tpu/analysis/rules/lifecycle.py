@@ -36,6 +36,11 @@ CLOSEABLE_FACTORIES = frozenset({
     # strands a slab/staging slot until GC, counted ptpu_lease_leaked_total),
     # and a PinnedStagingPool owns mlock'd host slabs (close() unpins/unmaps)
     "Lease", "PinnedStagingPool",
+    # ISSUE-8 remote tier: a RemoteReadEngine owns the ranged-GET thread pool
+    # (shutdown() is its closer); FooterCache pins parsed-footer bytes and
+    # TieredCache pins the mem tier's process-wide bytes (clear() releases
+    # both)
+    "RemoteReadEngine", "FooterCache", "TieredCache",
 })
 
 #: calls that merely CONSUME an iterable without taking ownership of it

@@ -68,6 +68,14 @@ def main(argv=None):
         from petastorm_tpu.benchmark import io as io_bench
 
         return io_bench.main(argv[1:])
+    if argv and argv[0] == "remote":
+        # `petastorm-tpu-bench remote ...`: the object-store read-path
+        # benchmark under the CloudLatencyFS simulator (footer cache GET cut,
+        # request hedging under injected tail, tiered warm-epoch speedup,
+        # byte-identity) — see benchmark/remote.py
+        from petastorm_tpu.benchmark import remote as remote_bench
+
+        return remote_bench.main(argv[1:])
     if argv and argv[0] == "copies":
         # `petastorm-tpu-bench copies ...`: the copy-census micro-benchmark
         # (copying default path vs the ISSUE-6 leased path, bytes memcpy'd per

@@ -89,17 +89,23 @@ class IoOptions:
     hits                HITS                       deep-copy every memcache
                                                    serve writable (default off:
                                                    zero-copy read-only views)
+    remote              (see RemoteIoOptions)      the object-store tier's
+                                                   knobs (ISSUE 8): ranged-GET
+                                                   sizing, hedging, footer
+                                                   cache, tiered admission —
+                                                   a RemoteIoOptions or a dict
+                                                   of its fields
     ==================  =========================  ==============================
     """
 
     __slots__ = ("readahead", "readahead_depth", "readahead_bytes", "io_threads",
                  "coalesce", "coalesce_max_run", "work_stealing", "memcache_bytes",
-                 "memcache_writable_hits")
+                 "memcache_writable_hits", "remote")
 
     def __init__(self, readahead=None, readahead_depth=None, readahead_bytes=None,
                  io_threads=None, coalesce=None, coalesce_max_run=None,
                  work_stealing=None, memcache_bytes=None,
-                 memcache_writable_hits=None):
+                 memcache_writable_hits=None, remote=None):
         self.readahead = _env_bool("PTPU_READAHEAD", True) \
             if readahead is None else bool(readahead)
         self.readahead_depth = max(1, _env_int("PTPU_READAHEAD_DEPTH", 3)
@@ -124,6 +130,13 @@ class IoOptions:
         self.memcache_writable_hits = \
             _env_bool("PTPU_MEMCACHE_WRITABLE_HITS", False) \
             if memcache_writable_hits is None else bool(memcache_writable_hits)
+        # the remote tier's knobs (ISSUE 8): a RemoteIoOptions (or a dict of
+        # its fields) riding on the same struct so one `io_options=` kwarg
+        # still configures the whole read path; lazy import — remote.py
+        # imports this module's env helpers
+        from petastorm_tpu.io.remote import RemoteIoOptions
+
+        self.remote = RemoteIoOptions.normalize(remote)
 
     @classmethod
     def normalize(cls, value):
@@ -149,9 +162,13 @@ class IoOptions:
 
     def __setstate__(self, state):
         for name in self.__slots__:
-            # .get: tolerate pickles from an older IoOptions missing a newer
-            # field (a child on a stale worker image keeps the new default)
-            setattr(self, name, state.get(name, getattr(type(self)(), name)))
+            # .get(name, MISSING): tolerate pickles from an older IoOptions
+            # missing a newer field (a child on a stale worker image keeps the
+            # new default)
+            if name in state:
+                setattr(self, name, state[name])
+            else:
+                setattr(self, name, getattr(type(self)(), name))
 
     def __repr__(self):
         return "IoOptions(%s)" % ", ".join(
@@ -161,3 +178,6 @@ class IoOptions:
 from petastorm_tpu.io.coalesce import plan_runs, split_run_table  # noqa: E402,F401
 from petastorm_tpu.io.memcache import MemCache  # noqa: E402,F401
 from petastorm_tpu.io.readahead import ReadaheadPool  # noqa: E402,F401
+from petastorm_tpu.io.remote import RemoteIoOptions  # noqa: E402,F401
+from petastorm_tpu.io.footercache import FooterCache  # noqa: E402,F401
+from petastorm_tpu.io.tiers import TieredCache  # noqa: E402,F401

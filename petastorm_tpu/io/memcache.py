@@ -320,6 +320,13 @@ class MemCache(CacheBase):
         count_copy("memcache_cow", _copied_nbytes(copy))
         return copy
 
+    def would_admit(self, value):
+        """Will :meth:`get`'s admit path actually store ``value``? False for
+        oversized payloads (they are served uncached) — the tiered funnel's
+        admission policy must not assume the mem tier holds what it in fact
+        rejected."""
+        return payload_nbytes(value) <= self._budget
+
     def contains(self, key):
         return self._store().contains(key) or self._inner.contains(key)
 

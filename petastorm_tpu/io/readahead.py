@@ -127,11 +127,15 @@ class ReadaheadPool:
 
     def __init__(self, read_fn, read_run_fn=None, depth=3, byte_budget=256 << 20,
                  io_threads=2, coalesce=True, coalesce_max_run=4,
-                 wait_timeout_s=300.0, registry=None):
+                 wait_timeout_s=300.0, registry=None, gap_ok=None):
         from concurrent.futures import ThreadPoolExecutor
 
         self._read_fn = read_fn
         self._read_run_fn = read_run_fn
+        #: optional byte-gap predicate for non-adjacent run merging (ISSUE 8:
+        #: built from the footer cache's row-group spans when the remote tier
+        #: is active — a sub-min-gap hole is cheaper than a second GET)
+        self._gap_ok = gap_ok
         self._depth = max(1, int(depth))
         # 0/negative = unbounded ('no byte cap', matching the memcache_bytes=0
         # convention of 0 being special) — NOT 'hold zero bytes', which would
@@ -234,7 +238,8 @@ class ReadaheadPool:
             return 0
         submitted = set()
         try:
-            runs = plan_runs(fresh, self._max_run) if self._coalesce \
+            runs = plan_runs(fresh, self._max_run, gap_ok=self._gap_ok) \
+                if self._coalesce \
                 else [([piece], columns) for piece, columns in fresh]
             for pieces, columns in runs:
                 self._pool.submit(self._read_task, pieces, columns)

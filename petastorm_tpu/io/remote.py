@@ -1,0 +1,768 @@
+"""Object-store-native remote read tier: parallel ranged GETs, hedging, footer GETs.
+
+The PR 4 IO layer hid *local-file* latency (readahead overlaps decode), but
+against a cloud object store the read itself is the wrong shape: one
+``ParquetFile.read_row_group`` call issues one serial ranged request per
+column chunk, every worker thread re-reads each file's footer, and a single
+slow replica (the store's fat tail) stalls a whole row group ("Hiding
+Latencies in Network-Based Image Loading for Deep Learning", PAPERS.md). This
+module is the remote tier (ISSUE 8):
+
+- :class:`RemoteReadEngine` plans the exact column-chunk byte ranges of a
+  row-group read from the (shared, cached) footer, **gap-coalesces** them
+  (:func:`petastorm_tpu.io.coalesce.plan_byte_ranges` — a gap smaller than
+  ``min_gap_bytes`` is cheaper than a second round trip), splits merged spans
+  at ``target_request_bytes``, and issues the chunks as **parallel ranged
+  GETs** on a bounded pool. The fetched segments back a sparse in-memory
+  file; pyarrow parses from it without ever opening the object.
+- **Request hedging**: a per-(store, size-class) latency histogram (the PR 5
+  straggler/latency plumbing's log-bucketed :class:`~petastorm_tpu.obs.metrics.Histogram`)
+  learns what a GET of this size normally costs; an attempt still pending at
+  the configured quantile gets a duplicate GET, first responder wins
+  (``ptpu_io_hedges_total`` / ``ptpu_io_hedge_wins_total``). The loser is
+  drained, its buffer's accounting :class:`~petastorm_tpu.io.lease.Lease`
+  released — never delivered (exactly-once preserved; the chaos site
+  ``io.remote`` injects tail latency to pin this in tests).
+- **Footer GETs**: a cache miss reads the file *tail* (footer-length trailer
+  first, one more GET only when the footer outgrows the first window) and
+  parses metadata from those bytes alone — never a full open.
+
+Every feature degrades: engine construction failure falls back to the classic
+``ParquetFile`` path (``cause="remote_unavailable"``), a read the sparse file
+cannot serve falls through to a real ranged read against the store (counted,
+never wrong). ``petastorm-tpu-bench remote`` measures all of it under the
+:class:`~petastorm_tpu.io.latencyfs.CloudLatencyFS` simulator in CI.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from petastorm_tpu.io import _env_bool, _env_float, _env_int
+from petastorm_tpu.io.coalesce import plan_byte_ranges, slice_ranges
+from petastorm_tpu.obs.log import degradation
+from petastorm_tpu.obs.metrics import default_registry
+
+#: pyarrow filesystem type_names that are NOT object stores (auto-enable probe)
+_LOCAL_TYPE_NAMES = frozenset({"local", "localfs", "mock", "subtree", "py::fsspec+file"})
+
+#: first tail GET size: covers typical footers in one trip; a footer that
+#: outgrows it costs exactly one more ranged GET (and the footer cache makes
+#: either once-per-file-per-process, so a lean guess beats a fat one — the
+#: guessed bytes are ALL paid per miss at the store's per-byte cost)
+_FOOTER_TAIL_GUESS = 32 << 10
+
+
+class RemoteIoOptions:
+    """Knobs for the remote tier — one picklable struct riding on
+    :class:`petastorm_tpu.io.IoOptions` (``io_options=dict(remote=...)``).
+
+    ======================  ==============================  =====================
+    field                   env var                         meaning
+    ======================  ==============================  =====================
+    enabled                 PTPU_REMOTE                     ``None`` (default) =
+                                                            auto: on when the
+                                                            filesystem is not
+                                                            local; True/False
+                                                            force it
+    target_request_bytes    PTPU_REMOTE_TARGET_REQUEST_     split merged spans
+                            BYTES                           into parallel GETs of
+                                                            at most this (8 MB)
+    max_inflight            PTPU_REMOTE_MAX_INFLIGHT        ranged GETs in flight
+                                                            per process (8)
+    min_gap_bytes           PTPU_REMOTE_MIN_GAP_BYTES       merge reads whose
+                                                            byte gap is at most
+                                                            this (512 KB)
+    hedge                   PTPU_REMOTE_HEDGE               duplicate a GET past
+                                                            its deadline (on)
+    hedge_quantile          PTPU_REMOTE_HEDGE_QUANTILE      latency-histogram
+                                                            quantile that arms
+                                                            the deadline (0.95)
+    hedge_min_s             PTPU_REMOTE_HEDGE_MIN_S         deadline floor (0.05)
+    hedge_min_samples       PTPU_REMOTE_HEDGE_MIN_SAMPLES   observations per
+                                                            (store, size class)
+                                                            before hedging (20)
+    get_timeout_s           PTPU_REMOTE_GET_TIMEOUT_S       wall cap on one GET
+                                                            incl. its hedge (300)
+    footer_cache_bytes      PTPU_FOOTER_CACHE_BYTES         shared parsed-footer
+                                                            budget (64 MB; 0 =
+                                                            per-open re-reads)
+    disk_admit              PTPU_TIER_DISK_ADMIT            tiered-admission
+                                                            policy: ``always``
+                                                            (legacy) or
+                                                            ``scan-resistant``
+                                                            (skip single-epoch
+                                                            scans and values the
+                                                            memcache admitted)
+    ======================  ==============================  =====================
+    """
+
+    __slots__ = ("enabled", "target_request_bytes", "max_inflight",
+                 "min_gap_bytes", "hedge", "hedge_quantile", "hedge_min_s",
+                 "hedge_min_samples", "get_timeout_s", "footer_cache_bytes",
+                 "disk_admit")
+
+    def __init__(self, enabled=None, target_request_bytes=None, max_inflight=None,
+                 min_gap_bytes=None, hedge=None, hedge_quantile=None,
+                 hedge_min_s=None, hedge_min_samples=None, get_timeout_s=None,
+                 footer_cache_bytes=None, disk_admit=None):
+        self.enabled = _env_tristate("PTPU_REMOTE") if enabled is None \
+            else (None if enabled == "auto" else bool(enabled))
+        self.target_request_bytes = max(
+            64 << 10, _env_int("PTPU_REMOTE_TARGET_REQUEST_BYTES", 8 << 20)
+            if target_request_bytes is None else int(target_request_bytes))
+        self.max_inflight = max(1, _env_int("PTPU_REMOTE_MAX_INFLIGHT", 8)
+                                if max_inflight is None else int(max_inflight))
+        self.min_gap_bytes = max(0, _env_int("PTPU_REMOTE_MIN_GAP_BYTES", 512 << 10)
+                                 if min_gap_bytes is None else int(min_gap_bytes))
+        self.hedge = _env_bool("PTPU_REMOTE_HEDGE", True) \
+            if hedge is None else bool(hedge)
+        self.hedge_quantile = min(0.999, max(0.5, _env_float(
+            "PTPU_REMOTE_HEDGE_QUANTILE", 0.95) if hedge_quantile is None
+            else float(hedge_quantile)))
+        self.hedge_min_s = max(0.0, _env_float("PTPU_REMOTE_HEDGE_MIN_S", 0.05)
+                               if hedge_min_s is None else float(hedge_min_s))
+        self.hedge_min_samples = max(1, _env_int(
+            "PTPU_REMOTE_HEDGE_MIN_SAMPLES", 20) if hedge_min_samples is None
+            else int(hedge_min_samples))
+        self.get_timeout_s = max(1.0, _env_float("PTPU_REMOTE_GET_TIMEOUT_S", 300.0)
+                                 if get_timeout_s is None else float(get_timeout_s))
+        self.footer_cache_bytes = max(0, _env_int(
+            "PTPU_FOOTER_CACHE_BYTES", 64 << 20) if footer_cache_bytes is None
+            else int(footer_cache_bytes))
+        disk_admit = _env_str("PTPU_TIER_DISK_ADMIT", "always") \
+            if disk_admit is None else str(disk_admit)
+        if disk_admit not in ("always", "scan-resistant"):
+            raise ValueError("disk_admit must be 'always' or 'scan-resistant', "
+                             "got %r" % disk_admit)
+        self.disk_admit = disk_admit
+
+    @classmethod
+    def normalize(cls, value):
+        """``None`` → defaults (env-aware), dict → kwargs, instance → itself."""
+        if value is None:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            return cls(**value)
+        raise TypeError("remote io options must be a RemoteIoOptions, a dict of "
+                        "its fields, or None; got %r" % type(value).__name__)
+
+    def active_for(self, fs):
+        """Is the remote tier on for this filesystem? Explicit ``enabled``
+        wins; auto probes the pyarrow ``type_name`` (local stays off)."""
+        if self.enabled is not None:
+            return self.enabled
+        return fs_is_remote(fs)
+
+    def __getstate__(self):
+        return {name: getattr(self, name) for name in self.__slots__}
+
+    def __setstate__(self, state):
+        for name in self.__slots__:
+            setattr(self, name, state.get(name, getattr(type(self)(), name)))
+
+    def __repr__(self):
+        return "RemoteIoOptions(%s)" % ", ".join(
+            "%s=%r" % (name, getattr(self, name)) for name in self.__slots__)
+
+
+def _env_tristate(name):
+    import os
+
+    raw = os.environ.get(name)
+    if raw is None or raw == "" or raw.strip().lower() == "auto":
+        return None
+    return raw.strip().lower() not in ("0", "false", "no", "off")
+
+
+def _env_str(name, default):
+    import os
+
+    raw = os.environ.get(name)
+    return default if raw is None or raw == "" else raw.strip()
+
+
+def fs_is_remote(fs):
+    """Best-effort object-store probe: pyarrow filesystems expose
+    ``type_name`` ('local', 'gcs', 's3', 'hdfs', 'py::fsspec+gs', ...)."""
+    try:
+        type_name = getattr(fs, "type_name", None)
+    except Exception:  # noqa: BLE001 - exotic proxies: assume local
+        return False
+    if not isinstance(type_name, str):
+        return False
+    return type_name.lower() not in _LOCAL_TYPE_NAMES
+
+
+# --------------------------------------------------------------------------------------
+# Latency model (feeds the hedge deadline)
+# --------------------------------------------------------------------------------------
+
+_SIZE_CLASSES = ((64 << 10, "64KB"), (256 << 10, "256KB"), (1 << 20, "1MB"),
+                 (4 << 20, "4MB"), (16 << 20, "16MB"))
+
+
+def size_class(nbytes):
+    """Log-spaced request-size bucket label (hedging deadlines are per size
+    class: a 16 MB GET is not slow just because it is bigger than a 64 KB
+    one)."""
+    for bound, label in _SIZE_CLASSES:
+        if nbytes <= bound:
+            return label
+    return ">16MB"
+
+
+class LatencyModel:
+    """Per-(store, size-class) GET latency histograms + the hedge deadline.
+
+    Built on the PR 5 log-bucketed :class:`~petastorm_tpu.obs.metrics.Histogram`
+    (same primitive as the straggler detector's worker latencies), registered
+    as ``ptpu_io_remote_get_seconds{store=,size_class=}`` so the learned
+    distribution is visible in the Prometheus export next to the hedge
+    counters it drives.
+    """
+
+    def __init__(self, registry=None):
+        self._registry = registry if registry is not None else default_registry()
+        self._lock = threading.Lock()
+        self._hists = {}
+
+    def _hist(self, store, label):
+        key = (store, label)
+        hist = self._hists.get(key)
+        if hist is None:
+            with self._lock:
+                hist = self._hists.get(key)
+                if hist is None:
+                    hist = self._registry.histogram(
+                        "ptpu_io_remote_get_seconds",
+                        help="ranged GET latency by store and request size class",
+                        store=store, size_class=label)
+                    self._hists[key] = hist
+        return hist
+
+    def observe(self, store, nbytes, seconds):
+        self._hist(store, size_class(nbytes)).observe(seconds)
+
+    def deadline(self, store, nbytes, quantile, min_samples, floor_s):
+        """Seconds after which a pending GET of this size is tail-suspect, or
+        ``None`` while the class has too few observations to judge."""
+        hist = self._hist(store, size_class(nbytes))
+        if hist.count < min_samples:
+            return None
+        return max(floor_s, hist.percentile(quantile))
+
+    def reset(self):
+        """Zero every learned distribution (bench/test scenario isolation —
+        the registry families are process-wide, so a fresh model instance
+        would resolve to the SAME histograms; resetting them is the only real
+        reset)."""
+        with self._lock:
+            hists = list(self._hists.values())
+        for hist in hists:
+            hist.reset()
+
+
+_model_lock = threading.Lock()
+_model = None
+
+
+def shared_latency_model():
+    """Process-wide model: every engine (one per worker object) feeds and
+    consults the same distributions — N workers learn the store's tail N×
+    faster than any one of them would."""
+    global _model
+    with _model_lock:
+        if _model is None:
+            _model = LatencyModel()
+        return _model
+
+
+# --------------------------------------------------------------------------------------
+# Hedged GET machinery
+# --------------------------------------------------------------------------------------
+
+
+class _GetState:
+    """Coordination slot for one logical ranged GET and its possible hedge.
+
+    First completed attempt wins: it parks its payload (under an accounting
+    :class:`~petastorm_tpu.io.lease.Lease`) and sets ``done``. A later
+    attempt — the drained loser — releases its lease immediately and its
+    payload is dropped on the floor: the consumer can never see two copies.
+    ``abandoned`` is set by the waiter once the GET's outcome is decided
+    (payload taken, or error raised): an attempt landing after that is a
+    loser by definition, so even a pathologically late success cannot strand
+    a lease."""
+
+    __slots__ = ("lock", "done", "data", "lease", "winner_role", "errors",
+                 "outstanding", "hedged", "deadline_s", "abandoned",
+                 "exec_start", "exec_started")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.done = threading.Event()
+        self.data = None
+        self.lease = None
+        self.winner_role = None
+        self.errors = []
+        self.outstanding = 0
+        self.hedged = False
+        self.deadline_s = None  # hedge deadline relative to EXEC start, or None
+        self.abandoned = False
+        self.exec_start = None  # monotonic time the primary began EXECUTING
+        #: set the instant the primary starts executing (or the attempt dies
+        #: before starting) — the supervisor waits on THIS while the GET is
+        #: pool-queued, so deadline timing is exact from execution start
+        #: instead of drifting by a poll slice
+        self.exec_started = threading.Event()
+
+    def take(self):
+        """Claim the winning payload (exactly once) and abandon the slot."""
+        with self.lock:
+            data, self.data = self.data, None
+            lease, self.lease = self.lease, None
+            self.abandoned = True
+        if lease is not None:
+            lease.release()
+        return data
+
+
+class RemoteReadEngine:
+    """Per-process ranged-GET executor for one filesystem.
+
+    Owns a bounded thread pool (``max_inflight``) — graftlint GL-L001 tracks
+    it; :meth:`shutdown` is the closer (idempotent, called from the worker's
+    ``close()`` on ``Reader.join``).
+    """
+
+    def __init__(self, fs, options=None, footer_cache=None, registry=None,
+                 latency_model=None, store_key=None):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._fs = fs
+        self._opts = options if options is not None \
+            else RemoteIoOptions.normalize(None)
+        #: None = refetch the footer per call (the measurable no-cache mode)
+        self._footers = footer_cache
+        self._model = latency_model if latency_model is not None \
+            else shared_latency_model()
+        self._store = store_key or str(getattr(fs, "type_name", "remote"))
+        self._closed = False
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=self._opts.max_inflight,
+                                        thread_name_prefix="ptpu-remote")
+        reg = registry if registry is not None else default_registry()
+        self._gets = reg.counter("ptpu_io_remote_gets_total",
+                                 help="ranged GETs issued (incl. hedges)")
+        self._get_bytes = reg.counter("ptpu_io_remote_bytes_total",
+                                      help="bytes fetched by ranged GETs")
+        self._hedges = reg.counter(
+            "ptpu_io_hedges_total",
+            help="duplicate GETs issued past the latency-quantile deadline")
+        self._hedge_wins = reg.counter(
+            "ptpu_io_hedge_wins_total",
+            help="hedged GETs where the duplicate responded first")
+        self._sparse_fallbacks = reg.counter(
+            "ptpu_io_remote_sparse_fallbacks_total",
+            help="reads the prefetched segments could not serve (went to "
+                 "storage)")
+        self._footer_fetches = reg.counter(
+            "ptpu_io_remote_footer_fetches_total",
+            help="footers fetched via ranged tail GETs")
+        # per-instance tallies for Reader.io_stats() (registry families are
+        # process-wide; these are this engine's own)
+        self._n = {"gets": 0, "bytes": 0, "hedges": 0, "hedge_wins": 0,
+                   "sparse_fallbacks": 0, "footer_fetches": 0}
+
+    # -- footer plane -------------------------------------------------------------------
+
+    def footer(self, path):
+        """The parsed footer for ``path`` — cached when a footer cache is
+        attached, fetched via ranged tail GETs otherwise (never a full
+        open)."""
+        if self._footers is not None:
+            entry = self._footers.peek(path)
+            if entry is not None:
+                self._footers.count_hit()
+                return entry
+        metadata, size = self._fetch_footer(path)
+        if self._footers is not None:
+            self._footers.count_miss()
+            return self._footers.put(path, metadata, size)
+        from petastorm_tpu.io.footercache import FooterEntry
+
+        return FooterEntry(metadata, size)
+
+    def _fetch_footer(self, path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        info = self._fs.get_file_info(path)
+        size = int(info.size)
+        guess = min(size, _FOOTER_TAIL_GUESS)
+        tail = self.fetch_ranges(path, [(size - guess, guess)])[0]
+        tail = bytes(tail)
+        if len(tail) < 8 or tail[-4:] != b"PAR1":
+            raise OSError("%s: not a parquet file (bad magic in tail GET)" % path)
+        footer_len = int.from_bytes(tail[-8:-4], "little")
+        need = footer_len + 8
+        if need > len(tail):
+            if need > size:
+                raise OSError("%s: footer length %d exceeds file size %d"
+                              % (path, footer_len, size))
+            head = self.fetch_ranges(
+                path, [(size - need, need - len(tail))])[0]
+            tail = bytes(head) + tail
+        metadata = pq.read_metadata(pa.BufferReader(tail))
+        self._footer_fetches.inc()
+        with self._lock:
+            self._n["footer_fetches"] += 1
+        return metadata, size
+
+    # -- data plane ---------------------------------------------------------------------
+
+    def read_row_groups(self, path, row_groups, columns):
+        """Read ``row_groups`` of ``path`` restricted to top-level ``columns``
+        (None = all) through parallel hedged ranged GETs. Returns
+        ``(table, footer_entry)`` — the table is the row groups concatenated
+        in list order, byte-identical to a ``ParquetFile`` read.
+
+        ``columns`` not present in the file (hive partition columns, schema
+        drift) are silently dropped against the footer's arrow schema — the
+        same availability filter the classic path applies, resolved from the
+        ONE footer this call already holds (a separate ``arrow_names`` round
+        would double the metadata fetches in no-cache mode)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        entry = self.footer(path)
+        md = entry.metadata
+        if columns is not None:
+            available = set(md.schema.to_arrow_schema().names)
+            columns = [c for c in columns if c in available]
+        ranges = column_chunk_ranges(md, row_groups, columns)
+        plan = plan_byte_ranges(ranges, self._opts.min_gap_bytes,
+                                self._opts.target_request_bytes)
+        chunks = list(zip((off for off, _ in plan),
+                          self.fetch_ranges(path, plan)))
+        size = entry.size
+        if size is None:
+            size = int(self._fs.get_file_info(path).size)
+        src = _SparseFile(path, size, chunks, self)
+        pf = pq.ParquetFile(pa.PythonFile(src, mode="r"), metadata=md)
+        table = pf.read_row_groups(list(row_groups), columns=columns)
+        return table, entry
+
+    def arrow_names(self, path):
+        """Column names of ``path``'s arrow schema — from the cached footer,
+        no file open (the worker's column-availability filter)."""
+        return list(self.footer(path).metadata.schema.to_arrow_schema().names)
+
+    def fetch_ranges(self, path, ranges):
+        """Fetch ``[(offset, length), ...]`` as parallel hedged GETs; returns
+        the payloads in request order. Ranges are issued as given — callers
+        coalesce/split via :func:`plan_byte_ranges` first.
+
+        All primaries are submitted up front (they run concurrently on the
+        bounded pool); the CALLER thread then supervises hedge deadlines —
+        attempts never wait on attempts, so the pool cannot deadlock on
+        itself however large the plan is."""
+        if not ranges:
+            return []
+        t0 = time.monotonic()
+        states = []
+        for off, ln in ranges:
+            states.append(self._start_get(path, off, ln))
+        return [self._finish_get(state, path, off, ln, t0)
+                for state, (off, ln) in zip(states, ranges)]
+
+    def _start_get(self, path, offset, length):
+        """Submit the primary attempt; compute the hedge deadline now (the
+        latency model is consulted once, at issue time)."""
+        state = _GetState()
+        state.outstanding = 1
+        if self._opts.hedge:
+            state.deadline_s = self._model.deadline(
+                self._store, length, self._opts.hedge_quantile,
+                self._opts.hedge_min_samples, self._opts.hedge_min_s)
+        self._submit_attempt(state, path, offset, length, "primary")
+        return state
+
+    def _finish_get(self, state, path, offset, length, t0):
+        """Await one logical GET: hedge when its deadline passes, take the
+        first responder, raise when every attempt failed.
+
+        Sequential supervision of a fan-out is deliberate: while the caller
+        sits on an earlier range, later primaries keep running — a later
+        range found past ITS deadline on arrival is hedged immediately. Both
+        the hedge deadline and the per-range timeout are measured from the
+        attempt's **execution start** (stamped by ``_run_attempt``), not the
+        batch submit time: a GET parked in the pool queue behind a big plan
+        is waiting on US, not on a slow replica — hedging it would just
+        double-load the same saturated pool, and timing it out would fail
+        healthy work. ``t0`` only bounds the never-started case (pool died)."""
+        never_started_at = t0 + 2 * self._opts.get_timeout_s
+        while True:
+            now = time.monotonic()
+            with state.lock:
+                started = state.exec_start
+                alive = state.outstanding > 0
+            if state.done.is_set():
+                break
+            if started is None:
+                # queued, not executing: its clocks have not started; wake the
+                # instant execution begins (an Event, not a poll slice — a
+                # slice's worth of drift here would delay every hedge past
+                # short tail spikes)
+                if now >= never_started_at:
+                    break  # pool wedged/shut down: fall through to timeout
+                state.exec_started.wait(min(0.5, never_started_at - now))
+                continue
+            timeout_at = started + self._opts.get_timeout_s
+            if now >= timeout_at:
+                break
+            if state.deadline_s is not None and not state.hedged and alive \
+                    and now - started >= state.deadline_s:
+                with state.lock:
+                    fire = state.outstanding > 0 and not state.hedged
+                    if fire:
+                        state.outstanding += 1
+                        state.hedged = True
+                if fire:
+                    self._hedges.inc()
+                    with self._lock:
+                        self._n["hedges"] += 1
+                    self._submit_attempt(state, path, offset, length, "hedge")
+                continue
+            next_wake = timeout_at
+            if state.deadline_s is not None and not state.hedged:
+                next_wake = min(next_wake, started + state.deadline_s)
+            state.done.wait(max(0.0, next_wake - now))
+        # take() abandons the slot, so a pathologically late attempt can only
+        # drain — and if the winner landed in the timeout race window, we
+        # deliver it rather than strand its lease and raise
+        data = state.take()
+        if data is not None:
+            return data
+        if state.errors:
+            raise state.errors[-1]
+        raise TimeoutError(
+            "ranged GET of %s [%d, +%d) still pending after %.0fs"
+            % (path, offset, length, self._opts.get_timeout_s))
+
+    def _submit_attempt(self, state, path, offset, length, role):
+        try:
+            self._pool.submit(self._run_attempt, state, path, offset, length,
+                              role)
+        except RuntimeError:
+            # pool shut down mid-flight (Reader.join raced a straggler read):
+            # account the attempt as failed so the waiter is released
+            self._attempt_failed(state, OSError(
+                "remote engine shut down while fetching %s" % path))
+
+    def _run_attempt(self, state, path, offset, length, role):
+        from petastorm_tpu import chaos as _chaos
+        from petastorm_tpu.io.lease import Lease
+
+        if role == "primary":
+            with state.lock:
+                state.exec_start = time.monotonic()
+            state.exec_started.set()
+        try:
+            if _chaos.ACTIVE is not None:
+                _chaos.ACTIVE.hit("io.remote",
+                                  key="%s:%d+%d#%s" % (path, offset, length, role))
+            t0 = time.perf_counter()
+            data = self._fetch(path, offset, length)
+            dur = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001 — stored, re-raised at the waiter
+            self._attempt_failed(state, e)
+            return
+        self._model.observe(self._store, length, dur)
+        self._gets.inc()
+        self._get_bytes.inc(len(data))
+        with self._lock:
+            self._n["gets"] += 1
+            self._n["bytes"] += len(data)
+        lease = Lease(kind="remote_get")
+        deliver = False
+        with state.lock:
+            state.outstanding -= 1
+            if state.winner_role is None and not state.abandoned:
+                state.winner_role = role
+                state.data = data
+                state.lease = lease
+                deliver = True
+        if deliver:
+            if role == "hedge":
+                self._hedge_wins.inc()
+                with self._lock:
+                    self._n["hedge_wins"] += 1
+            state.done.set()
+        else:
+            # the drained loser: release the accounting lease, drop the bytes
+            # — the winner already delivered the one and only copy
+            lease.release()
+
+    def _attempt_failed(self, state, error):
+        with state.lock:
+            state.errors.append(error)
+            state.outstanding -= 1
+            last = state.outstanding <= 0 and state.winner_role is None
+        if last:
+            state.done.set()
+        state.exec_started.set()  # wake a supervisor parked on the queue wait
+
+    def _fetch(self, path, offset, length):
+        """One ranged GET: its own handle per request — exactly the object
+        store's request model (and what keeps attempts independently
+        retryable/hedgeable across replicas)."""
+        with self._fs.open_input_file(path) as f:
+            f.seek(offset)
+            return f.read(length)
+
+    # -- lifecycle ----------------------------------------------------------------------
+
+    def shutdown(self):
+        """Stop the GET pool (idempotent). In-flight attempts are abandoned
+        to finish on their own — their ``_GetState`` delivery keeps the lease
+        accounting exact either way."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def stats(self):
+        with self._lock:
+            out = {"remote_%s" % k: v for k, v in self._n.items()}
+        return out
+
+
+def column_chunk_ranges(metadata, row_groups, columns):
+    """The ``(offset, length)`` byte ranges of the column chunks a
+    ``read_row_groups(row_groups, columns=columns)`` call will touch
+    (``columns`` match on the top-level field of ``path_in_schema`` — the
+    arrow column names the workers select by)."""
+    wanted = None if columns is None else set(columns)
+    ranges = []
+    for rg in row_groups:
+        rgmd = metadata.row_group(rg)
+        for i in range(rgmd.num_columns):
+            col = rgmd.column(i)
+            if wanted is not None and \
+                    col.path_in_schema.split(".")[0] not in wanted:
+                continue
+            start = col.data_page_offset
+            if col.dictionary_page_offset is not None:
+                start = min(start, col.dictionary_page_offset)
+            ranges.append((start, col.total_compressed_size))
+    return ranges
+
+
+class _SparseFile:
+    """Read-only file over prefetched ``(offset, bytes)`` segments.
+
+    Serves pyarrow's column-chunk reads from memory; anything outside the
+    populated segments — pyarrow reading a structure the range planner did
+    not anticipate — falls through to one real ranged GET against the store
+    (counted ``remote_sparse_fallbacks``; correct, just slower). Wrapped in
+    ``pa.PythonFile`` by the engine."""
+
+    def __init__(self, path, size, chunks, engine):
+        self._path = path
+        self._size = int(size)
+        self._segments = sorted((int(off), memoryview(data))
+                                for off, data in chunks)
+        self._engine = engine
+        self._pos = 0
+        self._closed = False
+
+    def read(self, nbytes=None):
+        if nbytes is None:
+            nbytes = self._size - self._pos
+        nbytes = max(0, min(int(nbytes), self._size - self._pos))
+        if nbytes == 0:
+            return b""
+        pos = self._pos
+        # gather across segments: target-size splitting leaves CONTIGUOUS
+        # neighbors, so a column chunk crossing a split boundary still serves
+        # from memory (stitched), not from a fallback GET
+        parts = []
+        need = nbytes
+        p = pos
+        for start, view in self._segments:
+            if need == 0:
+                break
+            if start > p:
+                break  # gap: not covered
+            if p < start + len(view):
+                take = min(need, start + len(view) - p)
+                parts.append(view[p - start:p - start + take])
+                p += take
+                need -= take
+        if need == 0:
+            self._pos = pos + nbytes
+            if len(parts) == 1:
+                return bytes(parts[0])
+            return b"".join(bytes(v) for v in parts)
+        engine = self._engine
+        engine._sparse_fallbacks.inc()
+        with engine._lock:
+            engine._n["sparse_fallbacks"] += 1
+        data = engine._fetch(self._path, pos, nbytes)
+        self._pos = pos + len(data)
+        return data
+
+    def seek(self, pos, whence=0):
+        if whence == 0:
+            self._pos = int(pos)
+        elif whence == 1:
+            self._pos += int(pos)
+        elif whence == 2:
+            self._pos = self._size + int(pos)
+        else:
+            raise ValueError("unsupported whence %r" % (whence,))
+        self._pos = max(0, min(self._pos, self._size))
+        return self._pos
+
+    def tell(self):
+        return self._pos
+
+    def size(self):
+        return self._size
+
+    def close(self):
+        self._closed = True
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def readable(self):
+        return True
+
+    def seekable(self):
+        return True
+
+    def writable(self):
+        return False
+
+
+def build_engine(fs, remote_opts, registry=None):
+    """Construct the engine + its footer cache per policy: the shared
+    process-wide cache when ``footer_cache_bytes`` asks for one, no cache
+    (measurable per-read refetch) otherwise. Returns ``None`` when the tier
+    is off for this filesystem."""
+    if not remote_opts.active_for(fs):
+        return None
+    footer_cache = None
+    if remote_opts.footer_cache_bytes:
+        from petastorm_tpu.io.footercache import configure_budget
+
+        footer_cache = configure_budget(remote_opts.footer_cache_bytes)
+    return RemoteReadEngine(fs, options=remote_opts, footer_cache=footer_cache,
+                            registry=registry)

@@ -1,0 +1,171 @@
+"""One read funnel: MemCache → LocalDiskCache → remote, with admission policy.
+
+Before ISSUE 8 the tiers were ad-hoc: ``reader.py``'s ``_maybe_memcache``
+bolted a :class:`~petastorm_tpu.io.memcache.MemCache` in front of whatever
+``make_cache`` built, and nothing counted which tier actually served a read
+or decided what deserved admission where. :class:`TieredCache` is the one
+funnel every worker read goes through:
+
+- **Per-tier accounting**: every serve is attributed —
+  ``ptpu_io_tier_hits_total{tier="mem"|"disk"|"remote"}`` and
+  ``ptpu_io_tier_bytes_total{tier=...}`` — so "where do my bytes come from"
+  is one Prometheus query (and one ``Reader.io_stats()`` read: warm epochs
+  should be mem/disk-served; a remote-heavy steady state means the budgets
+  are wrong).
+- **Admission policy** (``disk_admit``): ``"always"`` is the legacy
+  contract — a remote fill is written to the disk tier unconditionally.
+  ``"scan-resistant"`` applies the object-store economics: a value the mem
+  tier just admitted is NOT also written to disk (it will serve from memory;
+  re-filling disk doubles the write amplification for bytes already paid
+  for), and a **single-epoch scan** — each row group read exactly once,
+  nothing ever re-read — is not admitted to disk at all (classic scan
+  resistance; an epoch-1 training sweep would otherwise evict the hot
+  validation set to cache bytes nobody will read again). Disk HITS are always
+  served either way; only admission is policed.
+
+The funnel degrades to exactly its parts: no mem budget → mem tier absent;
+``cache_type="null"`` → the disk tier is a no-op and every miss is a remote
+fill. The lease/read-only serving contract of the mem tier (ISSUE 6) is
+unchanged — this class composes :class:`MemCache`, it does not reimplement
+it.
+"""
+from __future__ import annotations
+
+from petastorm_tpu.cache import CacheBase, NullCache
+from petastorm_tpu.io.memcache import payload_nbytes
+from petastorm_tpu.obs.metrics import default_registry
+
+TIERS = ("mem", "disk", "remote")
+
+
+class TieredCache(CacheBase):
+    """The MemCache → disk-cache → remote read funnel (one per reader; thin
+    and picklable — pool children rebuild their tier counters on first use).
+
+    ``mem`` is a :class:`~petastorm_tpu.io.memcache.MemCache` or ``None``;
+    ``disk`` is any :class:`~petastorm_tpu.cache.CacheBase` (the configured
+    ``LocalDiskCache``, or :class:`NullCache` for uncached readers).
+    ``single_epoch`` is the reader's scan hint (``num_epochs == 1``) consumed
+    by the ``scan-resistant`` policy. ``clear()``/``cleanup()`` release the
+    mem tier's process-wide bytes — graftlint GL-L001 accepts them as this
+    type's closers.
+    """
+
+    def __init__(self, mem=None, disk=None, disk_admit="always",
+                 single_epoch=False):
+        if disk_admit not in ("always", "scan-resistant"):
+            raise ValueError("disk_admit must be 'always' or 'scan-resistant', "
+                             "got %r" % (disk_admit,))
+        self._mem = mem
+        self._disk = disk if disk is not None else NullCache()
+        self._disk_admit = disk_admit
+        self._single_epoch = bool(single_epoch)
+        self._metrics = None  # lazy; a registry handle must not cross pickling
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_metrics"] = None
+        return state
+
+    def _count(self, tier, value):
+        metrics = self._metrics
+        if metrics is None:
+            reg = default_registry()
+            metrics = self._metrics = {
+                t: (reg.counter("ptpu_io_tier_hits_total",
+                                help="reads served per cache tier", tier=t),
+                    reg.counter("ptpu_io_tier_bytes_total",
+                                help="payload bytes served per cache tier",
+                                tier=t),
+                    [0, 0])
+                for t in TIERS
+            }
+        hits, nbytes, local = metrics[tier]
+        hits.inc()
+        n = payload_nbytes(value)
+        nbytes.inc(n)
+        local[0] += 1
+        local[1] += n
+
+    def _admit_disk(self, value):
+        """Should this remote-filled ``value`` be written to the disk tier?
+        Decided from the VALUE, after the fill: the scan-resistant policy
+        skips disk only for what the mem tier will actually hold — a payload
+        the memcache rejects as oversized still earns its disk slot, or it
+        would be cached in no tier and refetched remotely every epoch."""
+        if self._disk_admit == "always":
+            return True
+        if self._single_epoch:
+            return False  # scan resistance: one-shot sweeps don't earn disk
+        if self._mem is not None and self._mem.would_admit(value):
+            return False  # the mem tier serves it; don't double-store
+        return True
+
+    def _through_disk(self, key, fill, served):
+        """disk tier → remote fill, honoring the admission policy."""
+        def from_remote():
+            served[0] = "remote"
+            return fill()
+
+        served[0] = "disk"
+        if isinstance(self._disk, NullCache):
+            return from_remote()
+        if self._disk_admit == "always":
+            return self._disk.get(key, from_remote)
+        # scan-resistant: serve hits; on a miss, fill remote first and admit
+        # per-value (a disk .get would write through unconditionally)
+        if self._disk.contains(key):
+            return self._disk.get(key, from_remote)
+        value = from_remote()
+        if self._admit_disk(value):
+            self._disk.get(key, lambda: value)  # miss → stores the value
+        return value
+
+    def get(self, key, fill_cache_func):
+        served = ["mem"]
+        if self._mem is not None:
+            value = self._mem.get(
+                key, lambda: self._through_disk(key, fill_cache_func, served))
+        else:
+            value = self._through_disk(key, fill_cache_func, served)
+        self._count(served[0], value)
+        return value
+
+    def get_writable(self, key, fill_cache_func):
+        """The mem tier's copy-on-write escalation, threaded through the
+        funnel (host ``TransformSpec`` consumers — see ``MemCache``)."""
+        served = ["mem"]
+        if self._mem is not None:
+            value = self._mem.get_writable(
+                key, lambda: self._through_disk(key, fill_cache_func, served))
+        else:
+            value = self._through_disk(key, fill_cache_func, served)
+        self._count(served[0], value)
+        return value
+
+    def contains(self, key):
+        if self._mem is not None and self._mem.contains(key):
+            return True
+        return self._disk.contains(key)
+
+    def clear(self):
+        if self._mem is not None:
+            self._mem.clear()
+
+    def stats(self):
+        out = {}
+        if self._mem is not None:
+            out.update(self._mem.stats())
+        stats_fn = getattr(self._disk, "stats", None)
+        if stats_fn is not None:
+            out.update(stats_fn())
+        metrics = self._metrics
+        if metrics is not None:
+            for tier, (_h, _b, local) in metrics.items():
+                out["tier_%s_hits" % tier] = local[0]
+                out["tier_%s_bytes" % tier] = local[1]
+        return out
+
+    def cleanup(self):
+        self.clear()
+        self._disk.cleanup()

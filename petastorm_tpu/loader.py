@@ -1149,19 +1149,48 @@ class DataLoader:
             rt = self._device_decode_resize
             if isinstance(rt, dict):
                 rt = rt.get(name)
-            # sharding passed only when resolved AND the codec's signature takes it:
+            # sharding passed only when resolved AND the codec takes it:
             # third-party codec subclasses predating the kwarg keep decoding
             # single-device (their output is resharded below — the old behavior)
             kwargs = {} if decode_s is None else {"sharding": decode_s}
-            if "sharding" in kwargs and not _accepts_kwarg(
-                    field.codec.device_decode_batch, "sharding"):
+            probe = False
+            if "sharding" in kwargs:
+                support = _accepts_kwarg(field.codec.device_decode_batch,
+                                         "sharding")
+                if support is False:
+                    kwargs.pop("sharding")
+                elif support is None:
+                    # uninspectable callable (C-implemented / exotic wrapper):
+                    # the old behavior ASSUMED the legacy signature and
+                    # silently degraded to unsharded decode. Probe instead —
+                    # one try-call with the kwarg; its outcome is cached per
+                    # underlying callable so the probe runs once per process
+                    # (ISSUE 8 satellite, ADVICE round-5 loader.py:1145).
+                    probe = True
+            if rt is not None:
+                kwargs["resize_to"] = tuple(rt)
+            try:
+                out = field.codec.device_decode_batch(field, staged, **kwargs)
+                if probe:
+                    _record_probed_kwarg(field.codec.device_decode_batch,
+                                         "sharding", True)
+            except TypeError as e:
+                # only the probed kwarg's rejection is absorbable; the message
+                # check keeps a TypeError raised INSIDE a sharding-aware decode
+                # from being eaten (worst case the retry below re-raises it)
+                if not (probe and "sharding" in kwargs and "sharding" in str(e)):
+                    raise
+                _record_probed_kwarg(field.codec.device_decode_batch,
+                                     "sharding", False)
                 kwargs.pop("sharding")
+                out = field.codec.device_decode_batch(field, staged, **kwargs)
             # Surface the single-device fallback (VERDICT r4 #6): the configured
-            # sharding cuts the batch axis across >1 device, but this decode will
-            # run on one (axis undivisible, local-mesh derivation failed, or the
-            # codec predates the kwarg). Correct output either way — but on a pod
+            # sharding cuts the batch axis across >1 device, but this decode ran
+            # on one (axis undivisible, local-mesh derivation failed, or the
+            # codec rejected the kwarg). Correct output either way — but on a pod
             # host it silently makes one chip decode for all of them, so count it
-            # and warn once. (Mixed-layout sub-groups smaller than the batch can
+            # and warn once. Computed from the FINAL call shape, after the probe
+            # resolved. (Mixed-layout sub-groups smaller than the batch can
             # still fall back inside the codec without being counted here; the
             # whole-batch divisibility check mirrors the codec's own.)
             want_shards = _batch_shard_count(base_s) if base_s is not None else 1
@@ -1189,9 +1218,6 @@ class DataLoader:
                         "accepts the `sharding` kwarg. (Warned once; see "
                         "PipelineStats.decode_unsharded_batches.)",
                         name, want_shards, len(staged), once=False)
-            if rt is not None:
-                kwargs["resize_to"] = tuple(rt)
-            out = field.codec.device_decode_batch(field, staged, **kwargs)
             if self.sharding is not None:
                 s = self.sharding.get(name) if isinstance(self.sharding, dict) \
                     else _matching_sharding(self.sharding, out)
@@ -1880,11 +1906,28 @@ def _batch_shard_count(sharding):
     return batch_axis_shard_count(sharding)
 
 
+#: try-call probe outcomes for uninspectable callables, keyed by the
+#: underlying function — codecs live for the process, so strong refs are fine
+_probed_kwargs = {}
+
+
+def _record_probed_kwarg(fn, name, supported):
+    """Cache a try-call probe's verdict so it runs once per process."""
+    _probed_kwargs[(getattr(fn, "__func__", fn), name)] = bool(supported)
+
+
 def _accepts_kwarg(fn, name):
-    """True when ``fn`` can be called with keyword ``name`` (or takes **kwargs).
-    Cached on the underlying function — this runs on the transfer thread per batch,
-    and a signature cannot change between batches."""
-    return _accepts_kwarg_cached(getattr(fn, "__func__", fn), name)
+    """``True``/``False`` when ``fn``'s signature answers whether keyword
+    ``name`` is accepted (or ``**kwargs`` taken); ``None`` when the callable
+    is uninspectable — the caller then probes by calling once and records the
+    outcome via :func:`_record_probed_kwarg`. Cached on the underlying
+    function — this runs on the transfer thread per batch, and a signature
+    cannot change between batches."""
+    fn = getattr(fn, "__func__", fn)
+    probed = _probed_kwargs.get((fn, name))
+    if probed is not None:
+        return probed
+    return _accepts_kwarg_cached(fn, name)
 
 
 @functools.lru_cache(maxsize=None)
@@ -1894,11 +1937,10 @@ def _accepts_kwarg_cached(fn, name):
     try:
         params = inspect.signature(fn).parameters
     except (TypeError, ValueError):
-        # Uninspectable (C-implemented / exotic wrappers): assume the OLD signature
-        # and fall back to the single-device call — the whole point of this check is
-        # to keep pre-kwarg codec subclasses working, and passing the kwarg anyway
-        # would TypeError at decode time (ADVICE r4).
-        return False
+        # Uninspectable (C-implemented / exotic wrappers): unknown — the old
+        # behavior assumed the legacy signature and silently dropped the
+        # kwarg; callers now try-call once instead (ISSUE 8 satellite)
+        return None
     return name in params or any(
         p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values())
 

@@ -347,12 +347,15 @@ def load_row_groups(fs, path, validate=False):
             for rg in range(int(counts[fname])):
                 pieces.append(RowGroupPiece(full, rg, -1, pv))
         return pieces
-    # footer scan fallback (vanilla parquet stores)
-    import pyarrow.parquet as pq
+    # footer scan fallback (vanilla parquet stores) — parses land in the
+    # shared footer cache (ISSUE 8) so the predicate-pushdown statistics read
+    # here and the workers' ParquetFile opens later share ONE footer read per
+    # file per process instead of one per planning pass plus one per thread
+    from petastorm_tpu.io.footercache import shared_footer_cache
 
+    footers = shared_footer_cache()
     for full in _list_parquet_files(fs, path):
-        with fs.open_input_file(full) as f:
-            md = pq.ParquetFile(f).metadata
+        md = footers.get(fs, full).metadata
         pv = partition_values_for_path(full, path) or None
         for rg in range(md.num_row_groups):
             rgmd = md.row_group(rg)

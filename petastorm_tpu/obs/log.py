@@ -22,7 +22,10 @@ Known causes (the stable label values; see docs/observability.md):
 ``readahead_unavailable``, ``readahead_fallback``, ``memcache_oversized``,
 ``disk_cache`` — and, from the health layer (ISSUE 5), ``stall_detected`` (a
 pipeline actor missed its heartbeat threshold) and ``arrow_fallback`` (an
-Arrow-expressible batch failed IPC encode and rode the pickle wire instead).
+Arrow-expressible batch failed IPC encode and rode the pickle wire instead)
+— and, from the remote read tier (ISSUE 8), ``remote_unavailable`` (the
+ranged-GET engine failed to build; classic reads) and ``footer_unreadable``
+(a quarantined item's skipped row count is unknown).
 """
 from __future__ import annotations
 

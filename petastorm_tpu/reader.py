@@ -196,6 +196,8 @@ class _WorkerBase:
         self._readahead_unavailable = False  # this worker's pool failed to build
         self._io_tracer = None
         self._io_health = None  # optional HealthMonitor for the IO threads
+        self._remote = None  # RemoteReadEngine built lazily per process (ISSUE 8)
+        self._remote_unavailable = False  # this worker's engine failed to build
 
     def __getstate__(self):
         state = dict(self.__dict__)
@@ -205,6 +207,8 @@ class _WorkerBase:
         state["_readahead_unavailable"] = False  # a child retries its own build
         state["_io_tracer"] = None
         state["_io_health"] = None  # owns threads — never crosses the pickle wire
+        state["_remote"] = None  # each pool child builds its own GET pool
+        state["_remote_unavailable"] = False
         return state
 
     def _cache_get(self, key, fill):
@@ -222,6 +226,18 @@ class _WorkerBase:
             return get_writable(key, fill)
         return self._cache.get(key, fill)
 
+    def _footer_cache(self):
+        """The process-wide parsed-footer cache (ISSUE 8), or ``None`` when
+        ``remote.footer_cache_bytes`` disables it. Shared by every worker
+        thread AND the planner's footer scan — each file's footer is read and
+        parsed once per process instead of once per thread."""
+        budget = self._io_options.remote.footer_cache_bytes
+        if not budget:
+            return None
+        from petastorm_tpu.io.footercache import configure_budget
+
+        return configure_budget(budget)
+
     def _parquet_file(self, path):
         import pyarrow.parquet as pq
 
@@ -234,7 +250,15 @@ class _WorkerBase:
             cache = self._local.files = OrderedDict()
         pf = cache.get(path)
         if pf is None:
-            pf = cache[path] = pq.ParquetFile(self._fs.open_input_file(path))
+            f = self._fs.open_input_file(path)
+            footers = self._footer_cache()
+            metadata = None
+            if footers is not None:
+                # hit: pq.ParquetFile(metadata=...) opens with ZERO footer
+                # reads; miss: the parse below populates the shared cache (the
+                # handle's size() doubles as the entry's validation token)
+                metadata = footers.get(self._fs, path, source=f).metadata
+            pf = cache[path] = pq.ParquetFile(f, metadata=metadata)
             while len(cache) > self.MAX_OPEN_FILES:  # LRU-evict to bound open fds
                 _, old = cache.popitem(last=False)
                 _close_quietly(old)
@@ -245,13 +269,19 @@ class _WorkerBase:
 
     def _evict_parquet_file(self, path):
         """Drop (and close) the cached handle for ``path`` — a transient IO failure may
-        leave it holding a dead connection; the retry must reopen from scratch."""
+        leave it holding a dead connection; the retry must reopen from scratch.
+        The shared footer entry is invalidated too: if the failure was the
+        file being replaced, a retry replanning ranged GETs from the stale
+        footer's offsets would fail identically forever."""
         cache = getattr(self._local, "files", None) if self._local is not None else None
         if cache is not None:
             pf = cache.pop(path, None)
             if pf is not None:
                 _close_quietly(pf)
                 _count_file_eviction()
+        footers = self._footer_cache()
+        if footers is not None:
+            footers.invalidate(path)
 
     # -- async read path (ISSUE 4) ------------------------------------------------------
 
@@ -274,12 +304,18 @@ class _WorkerBase:
 
                     opts = self._io_options
                     try:
+                        # byte-gap run merging only under object-store request
+                        # economics (remote tier active): local reads keep the
+                        # PR 4 strict-adjacency behavior
+                        gap_ok = self._rowgroup_gap_ok \
+                            if opts.remote.active_for(self._fs) else None
                         pool = ReadaheadPool(
                             self._read_columns_sync, read_run_fn=self._read_run,
                             depth=opts.readahead_depth,
                             byte_budget=opts.readahead_bytes,
                             io_threads=opts.io_threads, coalesce=opts.coalesce,
-                            coalesce_max_run=opts.coalesce_max_run)
+                            coalesce_max_run=opts.coalesce_max_run,
+                            gap_ok=gap_ok)
                     except Exception as e:  # noqa: BLE001 — degrade to sync reads
                         from petastorm_tpu.obs.log import degradation
 
@@ -298,6 +334,62 @@ class _WorkerBase:
                         pool.set_health(self._io_health)
                     self._readahead = pool
         return pool
+
+    # -- remote tier (ISSUE 8) ----------------------------------------------------------
+
+    def _remote_engine(self, create=False):
+        """The per-process ranged-GET engine, or ``None`` when the remote
+        tier is off for this filesystem (local reads keep the classic
+        ``ParquetFile`` path untouched). Built lazily like the readahead
+        pool — never pickled, each pool child constructs its own; a
+        construction failure degrades this worker to classic reads with a
+        logged ``remote_unavailable`` cause."""
+        if self._remote_unavailable:
+            return None
+        engine = self._remote
+        if engine is None and create:
+            opts = self._io_options.remote
+            if not opts.active_for(self._fs):
+                self._remote_unavailable = True  # cheap latch: probe once
+                return None
+            with _io_init_lock:
+                engine = self._remote
+                if engine is None and not self._io_closed:
+                    try:
+                        from petastorm_tpu.io.remote import build_engine
+
+                        engine = build_engine(self._fs, opts)
+                    except Exception as e:  # noqa: BLE001 — degrade to classic reads
+                        from petastorm_tpu.obs.log import degradation
+
+                        degradation(
+                            "remote_unavailable",
+                            "remote ranged-GET engine construction failed "
+                            "(%s); reads use the classic ParquetFile path", e)
+                        self._remote_unavailable = True
+                        return None
+                    if engine is None:
+                        self._remote_unavailable = True
+                        return None
+                    self._remote = engine
+        return engine
+
+    def _rowgroup_gap_ok(self, prev, piece):
+        """Byte-gap predicate for non-adjacent run coalescing: True when the
+        hole between two row groups of one file (footer-cache spans) is at
+        most the remote tier's ``min_gap_bytes`` — reading it is cheaper
+        than a second round trip. Conservative ``False`` when the footer is
+        not cached yet."""
+        footers = self._footer_cache()
+        if footers is None:
+            return False
+        entry = footers.peek(prev.path)
+        if entry is None or piece.row_group >= entry.num_row_groups \
+                or prev.row_group >= entry.num_row_groups:
+            return False  # stale/foreign footer: never index past its groups
+        gap = entry.row_group_span(piece.row_group)[0] \
+            - entry.row_group_span(prev.row_group)[1]
+        return 0 <= gap <= self._io_options.remote.min_gap_bytes
 
     def prefetch(self, items):
         """Dispatch lookahead hint: issue background reads for the upcoming plan
@@ -342,18 +434,23 @@ class _WorkerBase:
         with _io_init_lock:
             self._io_closed = True
             pool, self._readahead = self._readahead, None
+            engine, self._remote = self._remote, None
         if pool is not None:
             pool.shutdown()
+        if engine is not None:
+            engine.shutdown()
 
     def reopen(self):
-        """Re-arm lazy readahead construction after a :meth:`close` (the Reader
-        calls this from ``_start`` so ``reset()`` gets a fresh IO runtime)."""
+        """Re-arm lazy readahead/remote-engine construction after a
+        :meth:`close` (the Reader calls this from ``_start`` so ``reset()``
+        gets a fresh IO runtime)."""
         with _io_init_lock:
             self._io_closed = False
 
     def io_stats(self):
-        """Live async-IO gauges: readahead + memcache (empty dicts when off).
-        Surfaced through ``Reader.io_stats()`` for thread/dummy pools."""
+        """Live async-IO gauges: readahead + cache tiers + remote engine +
+        footer cache (empty dicts when off). Surfaced through
+        ``Reader.io_stats()`` for thread/dummy pools."""
         out = {}
         pool = self._readahead
         if pool is not None:
@@ -361,6 +458,12 @@ class _WorkerBase:
         stats_fn = getattr(self._cache, "stats", None)
         if stats_fn is not None:
             out.update(stats_fn())
+        engine = self._remote
+        if engine is not None:
+            out.update(engine.stats())
+        footers = self._footer_cache()
+        if footers is not None:
+            out.update(footers.stats())
         return out
 
     def set_trace(self, tracer):
@@ -449,6 +552,13 @@ class _WorkerBase:
         if _chaos.ACTIVE is not None:
             _chaos.ACTIVE.hit("reader.read",
                               key="%s:%s" % (piece.path, piece.row_group))
+        engine = self._remote_engine(create=True)
+        if engine is not None:
+            # the engine filters unavailable columns against the footer it
+            # already resolved — one metadata fetch per read, not two
+            table, _ = engine.read_row_groups(piece.path, [piece.row_group],
+                                              columns)
+            return self._attach_partitions(table, piece, columns)
         pf = self._parquet_file(piece.path)
         available = set(pf.schema_arrow.names)
         file_columns = columns
@@ -484,12 +594,19 @@ class _WorkerBase:
                 "reader.read_run",
                 key="%s:%s" % (pieces[0].path,
                                ",".join(str(p.row_group) for p in pieces)))
+        row_groups = [p.row_group for p in pieces]
+        engine = self._remote_engine(create=True)
+        if engine is not None:
+            table, entry = engine.read_row_groups(pieces[0].path, row_groups,
+                                                  columns)
+            sizes = [entry.row_group_rows[rg] for rg in row_groups]
+            return [self._attach_partitions(t, piece, columns)
+                    for t, piece in zip(split_run_table(table, sizes), pieces)]
         pf = self._parquet_file(pieces[0].path)
         available = set(pf.schema_arrow.names)
         file_columns = columns
         if columns is not None:
             file_columns = [c for c in columns if c in available]
-        row_groups = [p.row_group for p in pieces]
         table = pf.read_row_groups(row_groups, columns=file_columns)
         sizes = [pf.metadata.row_group(rg).num_rows for rg in row_groups]
         return [self._attach_partitions(t, piece, columns)
@@ -1146,6 +1263,9 @@ class Reader:
         #: every plan item skipped as poison under on_poison='quarantine'
         #: (ISSUE 7) — empty (falsy) on a healthy run
         self.quarantine_report = QuarantineReport()
+        #: quarantined items whose footer was never readable (row loss is
+        #: unquantifiable — ISSUE 8 satellite); surfaced in :meth:`io_stats`
+        self._footer_unreadable = 0
         self._pool_args = (reader_pool_type, workers_count, results_queue_size,
                            results_timeout_s, wire_serializer,
                            self._recovery.worker_respawns, self._io_options,
@@ -1213,8 +1333,13 @@ class Reader:
         path = getattr(piece, "path", repr(inner))
         row_group = getattr(piece, "row_group", -1)
         num_rows = getattr(piece, "num_rows", None)
-        if num_rows is None:
-            num_rows = -1  # footer was never readable
+        if num_rows is None or num_rows < 0:
+            # planning's KV fast path leaves num_rows=-1 by design (it never
+            # opens footers) — resolve the real count from the footer now so
+            # the quarantine ledger says how many rows were lost; only when
+            # that READ fails is the footer genuinely unreadable (ISSUE 8
+            # satellite: this used to collapse to -1 silently either way)
+            num_rows = self._resolve_quarantined_rows(path, row_group)
         entry = QuarantineEntry(epoch, ordinal, path, row_group, num_rows,
                                 marker.error, marker.attempts, marker.kind)
         self.quarantine_report.add(entry)
@@ -1228,6 +1353,29 @@ class Reader:
             "watermark; see Reader.quarantine_report", marker.attempts, path,
             row_group, epoch, ordinal, marker.kind, once=False)
         self._mark_consumed((epoch, ordinal))
+
+    def _resolve_quarantined_rows(self, path, row_group):
+        """The quarantined row group's row count from its footer (via the
+        shared cache — usually already parsed), or -1 with a
+        ``footer_unreadable`` degradation when the footer cannot be read or
+        does not contain the group (quarantine is rare; one bounded footer
+        read per skipped item is worth an exact loss ledger)."""
+        try:
+            from petastorm_tpu.io.footercache import shared_footer_cache
+
+            entry = shared_footer_cache().get(self._fs, path)
+            return entry.row_group_rows[row_group]
+        except Exception as e:  # noqa: BLE001 — unreadable/mismatched footer
+            self._footer_unreadable += 1
+            from petastorm_tpu.obs.log import degradation
+
+            degradation(
+                "footer_unreadable",
+                "quarantined %s row group %s has no readable footer (%s): the "
+                "skipped row count is UNKNOWN (recorded as -1 in the "
+                "quarantine report; see Reader.io_stats()['footer_unreadable'])",
+                path, row_group, e, once=False)
+            return -1
 
     # -- iteration ----------------------------------------------------------------------
 
@@ -1367,6 +1515,8 @@ class Reader:
         fn = getattr(self._executor, "dispatch_stats", None)
         if fn is not None:
             out.update(fn() or {})
+        if self._footer_unreadable:
+            out["footer_unreadable"] = self._footer_unreadable
         return out
 
     def register_metrics(self, registry):
@@ -1518,17 +1668,26 @@ class Reader:
 # --------------------------------------------------------------------------------------
 
 
-def _maybe_memcache(cache, io_opts):
-    """Layer the process-wide in-memory row-group LRU in front of the configured
-    cache when ``io_options.memcache_bytes`` (or PTPU_MEMCACHE_BYTES) asks for
-    one — hot row groups then skip disk AND parse on re-epochs."""
-    if not io_opts.memcache_bytes:
-        return cache
-    from petastorm_tpu.io.memcache import MemCache
+def _build_read_funnel(cache, io_opts, num_epochs=None):
+    """The tiered read funnel (ISSUE 8): ``MemCache → LocalDiskCache →
+    remote`` as ONE :class:`petastorm_tpu.io.tiers.TieredCache` with per-tier
+    hit/byte accounting and the ``disk_admit`` admission policy — replacing
+    the old ad-hoc ``MemCache(inner=...)`` stacking. The mem tier exists when
+    ``io_options.memcache_bytes`` (or PTPU_MEMCACHE_BYTES) asks for one;
+    ``num_epochs == 1`` is the scan hint the ``scan-resistant`` policy
+    consumes."""
+    from petastorm_tpu.io.tiers import TieredCache
 
-    return MemCache(io_opts.memcache_bytes, inner=cache,
-                    writable_hits=getattr(io_opts, "memcache_writable_hits",
-                                          False))
+    mem = None
+    if io_opts.memcache_bytes:
+        from petastorm_tpu.io.memcache import MemCache
+
+        mem = MemCache(io_opts.memcache_bytes,
+                       writable_hits=getattr(io_opts, "memcache_writable_hits",
+                                             False))
+    return TieredCache(mem=mem, disk=cache,
+                       disk_admit=io_opts.remote.disk_admit,
+                       single_epoch=num_epochs == 1)
 
 
 def _resolve_ngram_schema(schema_fields, stored_schema, predicate):
@@ -1639,7 +1798,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
                                   worker_respawns=worker_respawns)
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
-    cache = _maybe_memcache(cache, io_opts)
+    cache = _build_read_funnel(cache, io_opts, num_epochs)
     device_fields = _resolve_device_fields(read_schema, decode_on_device, ngram,
                                            transform_spec)
     worker = PyDictWorker(
@@ -1728,7 +1887,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                                   worker_respawns=worker_respawns)
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
-    cache = _maybe_memcache(cache, io_opts)
+    cache = _build_read_funnel(cache, io_opts, num_epochs)
     device_fields = _resolve_device_fields(read_schema, decode_on_device, ngram,
                                            transform_spec=transform_spec)
     worker = ArrowWorker(

@@ -490,6 +490,74 @@ def test_lifecycle_readahead_and_memcache_clean_forms():
     assert findings == []
 
 
+_L001_REMOTE_ENGINE_POSITIVE = """
+    from petastorm_tpu.io.remote import RemoteReadEngine
+
+    def leak_get_pool(fs):
+        engine = RemoteReadEngine(fs)  # BUG: GET threads never shut down
+        return engine.stats()
+"""
+
+
+def test_lifecycle_fires_on_unclosed_remote_engine():
+    """ISSUE-8 extension: a RemoteReadEngine owns the ranged-GET thread pool;
+    leaking one is a lint error like leaking a ReadaheadPool."""
+    findings, _ = _lint(_L001_REMOTE_ENGINE_POSITIVE)
+    f = _only_rule(findings, "GL-L001")[0]
+    assert f.line == _line_of(_L001_REMOTE_ENGINE_POSITIVE,
+                              "BUG: GET threads never shut down")
+
+
+_L001_FOOTER_CACHE_POSITIVE = """
+    from petastorm_tpu.io.footercache import FooterCache
+
+    def pin_footers(fs, paths):
+        cache = FooterCache()  # BUG: parsed-footer bytes never released
+        for p in paths:
+            cache.get(fs, p)
+"""
+
+
+def test_lifecycle_fires_on_uncleared_footer_cache():
+    findings, _ = _lint(_L001_FOOTER_CACHE_POSITIVE)
+    f = _only_rule(findings, "GL-L001")[0]
+    assert f.line == _line_of(_L001_FOOTER_CACHE_POSITIVE,
+                              "BUG: parsed-footer bytes never released")
+
+
+def test_lifecycle_remote_tier_clean_forms():
+    findings, _ = _lint("""
+        from petastorm_tpu.io.footercache import FooterCache
+        from petastorm_tpu.io.remote import RemoteReadEngine
+        from petastorm_tpu.io.tiers import TieredCache
+
+        def engine_try_finally(fs, path):
+            engine = RemoteReadEngine(fs)
+            try:
+                return engine.footer(path)
+            finally:
+                engine.shutdown()
+
+        def cache_cleared(fs, path):
+            cache = FooterCache()
+            try:
+                return cache.get(fs, path)
+            finally:
+                cache.clear()
+
+        def funnel_handed_off(mem, disk):
+            return TieredCache(mem=mem, disk=disk)  # ownership moves to caller
+
+        def owned_by_worker(fs):
+            class Worker:
+                pass
+            w = Worker()
+            w._remote = RemoteReadEngine(fs)  # attribute: lifetime escapes
+            return w
+    """)
+    assert findings == []
+
+
 _L001_LEASE_LEAK_POSITIVE = """
     from petastorm_tpu.io.lease import Lease
 
