@@ -55,6 +55,12 @@ def __getattr__(name):
             from petastorm_tpu.recovery import RecoveryOptions
 
             return RecoveryOptions
+        if name in ("FeaturePipeline", "Normalize", "Standardize", "Clip",
+                    "Cast", "FillNull", "Bucketize", "HashField",
+                    "VocabLookup", "FeatureCross"):
+            from petastorm_tpu.ops import tabular
+
+            return getattr(tabular, name)
         if name == "checkpoint":
             import importlib
 

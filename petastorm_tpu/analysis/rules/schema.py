@@ -38,6 +38,13 @@ _SCALAR_TAGS = {
 #: exact-integer bits representable by each float storage width
 _FLOAT_EXACT_BITS = {4: 24, 8: 53}
 
+#: declarative tabular ops whose output is integer ids by contract
+#: (petastorm_tpu/ops/tabular.py): op name -> positional index of ``out=``
+_INT_OUTPUT_OPS = {"HashField": 2, "Bucketize": 3, "VocabLookup": 3,
+                   "FeatureCross": 2}
+#: declarative ops whose output is floating by contract
+_FLOAT_OUTPUT_OPS = {"Normalize": 1, "Standardize": 1}
+
 
 def _resolve_dtype(node, numpy_aliases):
     """AST dtype expression -> np.dtype, or None when not statically literal."""
@@ -114,20 +121,83 @@ def _int_range_fits(field_dtype, storage_dtype):
 
 class SchemaCodecContractRule(Rule):
     """GL-S001: literal ``UnischemaField`` whose codec and numpy dtype are
-    incompatible per codecs.py."""
+    incompatible per codecs.py — plus declarative tabular-op dtype contracts
+    (ISSUE 9): hash/bucketize/vocab/cross ids must land in integer fields,
+    normalize/standardize outputs in floating ones. Ops are correlated with
+    literal ``UnischemaField`` declarations in the same module by output
+    field name; anything not statically resolvable is skipped, never
+    guessed."""
 
     rule_id = "GL-S001"
     severity = Severity.ERROR
     description = "UnischemaField codec cannot faithfully store the declared dtype"
     fix_hint = ("pick the codec whose storage type covers the field dtype (see "
-                "petastorm_tpu/types.py for the ScalarCodec storage map)")
+                "petastorm_tpu/types.py for the ScalarCodec storage map); "
+                "declarative op outputs must match the op's dtype contract "
+                "(ops/tabular.py)")
 
     def check(self, tree, ctx):
+        declared = {}   # field name -> resolved np.dtype (literal declarations)
+        op_calls = []
         for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call)
-                    and call_func_name(node) == "UnischemaField"):
+            if not isinstance(node, ast.Call):
                 continue
-            yield from self._check_field(node, ctx)
+            name = call_func_name(node)
+            if name == "UnischemaField":
+                yield from self._check_field(node, ctx)
+                fname, dtype_node, _shape, _codec = self._field_args(node)
+                if fname != "?" and dtype_node is not None:
+                    dtype = _resolve_dtype(dtype_node, ctx.numpy_aliases)
+                    if dtype is not None:
+                        declared[fname] = dtype
+            elif name in _INT_OUTPUT_OPS or name in _FLOAT_OUTPUT_OPS:
+                op_calls.append((name, node))
+        for name, node in op_calls:
+            yield from self._check_tabular_op(name, node, declared, ctx)
+
+    def _op_out_name(self, op_name, call):
+        """The op's EXPLICIT output field name (constant string), or None.
+
+        Only an explicit ``out=`` is correlated with field declarations:
+        when ``out`` defaults to the input field the op legitimately
+        REPLACES the stored declaration (int32 source → float32 normalize is
+        valid code), so flagging against the stored dtype would be a false
+        positive."""
+        node = call_kwarg(call, "out")
+        out_pos = (_INT_OUTPUT_OPS.get(op_name)
+                   if op_name in _INT_OUTPUT_OPS
+                   else _FLOAT_OUTPUT_OPS[op_name])
+        if node is None and len(call.args) > out_pos:
+            node = call.args[out_pos]
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        return None
+
+    def _check_tabular_op(self, op_name, call, declared, ctx):
+        integer = op_name in _INT_OUTPUT_OPS
+        want_kinds = "iu" if integer else "f"
+        contract = "integer ids" if integer else "floating values"
+        dtype_node = call_kwarg(call, "dtype")
+        if dtype_node is not None:
+            dtype = _resolve_dtype(dtype_node, ctx.numpy_aliases)
+            if dtype is not None and dtype.kind not in want_kinds:
+                yield ctx.finding(
+                    self, call,
+                    "%s produces %s but declares dtype=%s — use %s dtype"
+                    % (op_name, contract, dtype,
+                       "an integer" if integer else "a floating"))
+                return
+        out = self._op_out_name(op_name, call)
+        if out is None:
+            return
+        field_dtype = declared.get(out)
+        if field_dtype is not None and field_dtype.kind not in want_kinds:
+            yield ctx.finding(
+                self, call,
+                "%s writes %s into field %r, but that UnischemaField is "
+                "declared %s — declare %s field"
+                % (op_name, contract, out, field_dtype,
+                   "an integer" if integer else "a floating"))
 
     def _field_args(self, call):
         """(name, dtype_node, shape_node, codec_node) by position/keyword."""

@@ -83,6 +83,14 @@ def main(argv=None):
         from petastorm_tpu.benchmark import copies as copies_bench
 
         return copies_bench.main(argv[1:])
+    if argv and argv[0] == "tabular":
+        # `petastorm-tpu-bench tabular ...`: declarative tabular preprocessing
+        # vs the equivalent per-batch pandas TransformSpec callable
+        # (fused-vectorized rows/s, value identity, zero writable-copy census)
+        # — see benchmark/tabular.py
+        from petastorm_tpu.benchmark import tabular as tabular_bench
+
+        return tabular_bench.main(argv[1:])
     if argv and argv[0] == "chaos":
         # `petastorm-tpu-bench chaos ...`: the chaos acceptance harness —
         # scripted kill/transient-IO/poison/corrupt/stall-heal scenarios

@@ -651,6 +651,21 @@ class DataLoader:
         self._trace = trace
         if trace is not None and hasattr(reader, "set_trace"):
             reader.set_trace(trace)
+        if getattr(device_transform, "declarative", False):
+            if getattr(reader, "ngram", None) is not None:
+                # same mismatch the auto-wiring branch below guards: the
+                # pipeline's ops name schema fields, but NGram batches are
+                # keyed 'offset/field' — it would KeyError inside the jit
+                raise ValueError(
+                    "a declarative FeaturePipeline cannot be the "
+                    "device_transform of an NGram reader: batches are keyed "
+                    "'offset/field', not by schema field names. Pass a "
+                    "function written against the flat columns instead.")
+            # a FeaturePipeline passed directly: compile it against the
+            # reader's delivered schema and ride its jittable device function
+            # (statistics-dependent ops must have been resolved — device_fn
+            # raises with the fix otherwise)
+            device_transform = device_transform.device_fn(reader.schema)
         self._device_transform = device_transform
         if device_transform is None:
             spec = getattr(reader, "transform_spec", None)

@@ -390,6 +390,68 @@ def _rowgroup_stats(rgmd):
     return stats or None
 
 
+def aggregate_column_stats(fs, pieces, columns):
+    """Dataset-level ``{column: (min, max)}`` aggregated from parquet
+    row-group statistics over ``pieces`` — the resolution tier declarative
+    pipelines try BEFORE any data pre-pass (ISSUE 9).
+
+    Pieces that already carry ``stats`` (footer-scan planning) are consumed
+    as-is; for KV-fast-path pieces (``stats=None``) the footers are read
+    through the shared footer cache — one bounded metadata read per file,
+    never a data read. A column is returned only when EVERY piece contributes
+    valid min/max for it (a single silent gap would make the bound wrong);
+    numeric coercion failures drop the column the same way. min/max exclude
+    nulls (parquet semantics) — the right bound for normalization."""
+    wanted = [c for c in columns]
+    if not wanted or not pieces:
+        return {}
+    from petastorm_tpu.io.footercache import shared_footer_cache
+
+    footers = shared_footer_cache()
+    footer_stats = {}  # path -> [per-row-group stats dict] (lazy, cached)
+
+    def piece_stats(piece):
+        if piece.stats is not None:
+            return piece.stats
+        per_group = footer_stats.get(piece.path)
+        if per_group is None:
+            md = footers.get(fs, piece.path).metadata
+            per_group = footer_stats[piece.path] = [
+                _rowgroup_stats(md.row_group(rg)) or {}
+                for rg in range(md.num_row_groups)
+            ]
+        if piece.row_group >= len(per_group):
+            return {}
+        return per_group[piece.row_group]
+
+    out = {}
+    for piece in pieces:
+        try:
+            stats = piece_stats(piece)
+        except Exception:  # noqa: BLE001 — unreadable footer: no bounds at all
+            return {}
+        for name in list(wanted):
+            entry = (stats or {}).get(name)
+            if entry is None:
+                wanted.remove(name)
+                out.pop(name, None)
+                continue
+            try:
+                mn, mx = float(entry[0]), float(entry[1])
+            except (TypeError, ValueError):  # non-numeric stats (str/bytes)
+                wanted.remove(name)
+                out.pop(name, None)
+                continue
+            prev = out.get(name)
+            if prev is None:
+                out[name] = (mn, mx)
+            else:
+                out[name] = (min(prev[0], mn), max(prev[1], mx))
+        if not wanted:
+            break
+    return out
+
+
 def _rows_for_bytes(table, target_bytes):
     """Rows per row group so groups land near ``target_bytes`` (pre-compression estimate)."""
     if table.num_rows == 0:

@@ -54,6 +54,7 @@ class BottleneckReport:
     reason: str
     percentiles: dict | None = None  # stage -> {p50, p90, p99}, when metrics on
     straggler: dict | None = None    # {worker, mean_s, peer_median_s, ratio}, when detected
+    transform_ops: dict | None = None  # fused-op label -> histogram summary (ISSUE 9)
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -90,6 +91,16 @@ class BottleneckReport:
                 lines.append("  %-16s p50 %8.2fms  p90 %8.2fms  p99 %8.2fms"
                              % (stage, p["p50"] * 1e3, p["p90"] * 1e3,
                                 p["p99"] * 1e3))
+        if self.transform_ops:
+            lines.append("  transform stage (declarative ops, this process):")
+            for op in sorted(self.transform_ops,
+                             key=lambda o: -self.transform_ops[o]["sum"]):
+                s = self.transform_ops[op]
+                lines.append(
+                    "    %-20s total %8.3fs over %6d calls  p50 %7.2fms  "
+                    "p99 %7.2fms"
+                    % (op, s["sum"], s["count"], s["p50"] * 1e3,
+                       s["p99"] * 1e3))
         return "\n".join(lines)
 
     def __str__(self):
@@ -229,5 +240,14 @@ def analyze_loader(loader):
     # must compare peers within THIS pipeline's executor only
     scope = getattr(loader, "_health_scope", None)
     worker_latency = scope.worker_latency() if scope is not None else None
-    return analyze_snapshot(snap, percentiles=percentiles,
-                            worker_latency=worker_latency)
+    report = analyze_snapshot(snap, percentiles=percentiles,
+                              worker_latency=worker_latency)
+    # declarative-transform visibility (ISSUE 9): per-fused-op timings from
+    # the process-wide registry — live for thread/dummy pools, where the
+    # transform runs in this process (pool children keep their own registries)
+    from petastorm_tpu.ops.tabular import transform_op_stats
+
+    ops = transform_op_stats()
+    if ops:
+        report.transform_ops = ops
+    return report

@@ -16,4 +16,10 @@ def __getattr__(name):
         from petastorm_tpu.ops import jpeg
 
         return getattr(jpeg, name)
+    if name in ("FeaturePipeline", "Normalize", "Standardize", "Clip", "Cast",
+                "FillNull", "Bucketize", "HashField", "VocabLookup",
+                "FeatureCross", "PipelineValidationError"):
+        from petastorm_tpu.ops import tabular
+
+        return getattr(tabular, name)
     raise AttributeError("module 'petastorm_tpu.ops' has no attribute %r" % name)

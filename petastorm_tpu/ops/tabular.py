@@ -1,0 +1,1059 @@
+"""Declarative tabular preprocessing engine (ISSUE 9).
+
+``TransformSpec`` takes an opaque per-batch pandas callable — the framework
+cannot plan it, fuse it, cache its statistics, or offload it, and running it
+forces a writable copy of the whole batch plus an Arrow→pandas→Arrow round
+trip per row group ("Efficient Tabular Data Preprocessing of ML Pipelines",
+PAPERS.md: preprocessing dominates end-to-end time for recommender/tabular
+workloads). This module replaces the callable with a small **declarative op
+set** composed into a :class:`FeaturePipeline` that slots in wherever a
+``TransformSpec`` goes:
+
+========================  ============================================================
+op                        semantics
+========================  ============================================================
+:class:`Normalize`        ``(x - min) / (max - min)`` → float; min/max from
+                          row-group statistics when omitted
+:class:`Standardize`      ``(x - mean) / std`` → float; mean/std from one cached
+                          streaming statistics pass when omitted
+:class:`Clip`             ``clip(x, lo, hi)``, dtype preserved
+:class:`Cast`             ``astype(dtype)``
+:class:`FillNull`         NaN → ``value`` (numeric float columns)
+:class:`Bucketize`        quantile/explicit boundaries → int bucket ids
+:class:`HashField`        deterministic 32-bit FNV-1a hash → ``[0, num_buckets)``
+:class:`VocabLookup`      categorical value → vocabulary index (OOV → ``default``)
+:class:`FeatureCross`     hash-combine of N int columns → ``[0, num_buckets)``
+========================  ============================================================
+
+The **planner** (:meth:`FeaturePipeline.compile`) validates the op graph
+against the Unischema (unknown fields, dtype contracts — statically mirrored
+by graftlint GL-S001), derives the post-transform schema by populating
+``edit_fields``/``removed_fields`` so the stock
+:func:`petastorm_tpu.transform.transform_schema` applies unchanged, **fuses**
+adjacent element-wise ops on the same column into one single-materialization
+pass, and compiles to both execution targets:
+
+- **host**: vectorized numpy kernels run inside the workers — columnar in,
+  columnar out, no pandas round trip. Untouched columns pass through as the
+  original zero-copy views; a mutated column is materialized exactly once per
+  fused stage (via the PR-6 ``LeasedBatch.writable()`` CoW escalation when the
+  container supports it), so the read path never needs a whole-batch writable
+  copy (see ``reader._spec_wants_writable``).
+- **device**: one jittable ``fn(batch) -> batch`` riding the existing
+  ``TransformSpec(device=True)`` loader seam, so XLA fuses the feature math
+  into the input pipeline. Hash/cross arithmetic is fixed-width uint32 on both
+  targets so host and device produce identical ids (JAX disables 64-bit ints
+  by default).
+
+Ops that need dataset statistics resolve them through
+:mod:`petastorm_tpu.io.statscache`: min/max ride the existing row-group
+statistics plumbing (``metadata.aggregate_column_stats`` — no data pre-pass
+when the parquet footers cover them); mean/std, quantiles and vocabularies run
+one streaming pre-pass whose result is cached per (dataset, pipeline)
+fingerprint.
+
+Per-fused-stage timing lands on the PR-3 default registry as
+``ptpu_transform_seconds{op=...}`` histograms plus ``ptpu_transform_rows_total``,
+so ``DataLoader.bottleneck_report()`` finally sees inside the transform stage.
+``petastorm-tpu-bench tabular`` measures the fused-vectorized path against the
+equivalent per-batch pandas callable with value-identity and census checks.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from petastorm_tpu.transform import TransformSpec
+from petastorm_tpu.unischema import UnischemaField
+
+
+class PipelineValidationError(ValueError):
+    """An op graph that cannot run against the given Unischema (unknown field,
+    dtype contract violation, unresolvable statistic). Raised at plan time —
+    never from inside a worker."""
+
+
+# --------------------------------------------------------------------------------------
+# Statistics requirements
+# --------------------------------------------------------------------------------------
+
+
+class StatRequirement:
+    """One statistic an op needs before it can compile: ``kind`` is one of
+    ``min|max|mean|std|quantiles|vocab``, ``param`` carries the kind's knob
+    (bucket count / vocab size). ``key`` is the stable identity used both for
+    the resolved-statistics dict and the statscache fingerprint."""
+
+    __slots__ = ("field", "kind", "param")
+
+    def __init__(self, field, kind, param=None):
+        self.field = field
+        self.kind = kind
+        self.param = param
+
+    @property
+    def key(self):
+        if self.param is None:
+            return "%s:%s" % (self.kind, self.field)
+        return "%s:%s:%s" % (self.kind, self.field, self.param)
+
+    def __repr__(self):
+        return "<StatRequirement %s>" % self.key
+
+
+# --------------------------------------------------------------------------------------
+# Hashing primitive (host/device identical)
+# --------------------------------------------------------------------------------------
+
+_FNV_OFFSET = np.uint32(2166136261)
+_FNV_PRIME = np.uint32(16777619)
+
+
+def _hash_u32_host(arr, seed=0):
+    """Vectorized FNV-1a-style 32-bit hash of an integer column. All arithmetic
+    wraps in uint32 — numerically identical to :func:`_hash_u32_device` so a
+    pipeline compiled to either target yields the same ids."""
+    x = np.asarray(arr).astype(np.int64, copy=False).view(np.uint64)
+    h = np.full(x.shape, _FNV_OFFSET ^ np.uint32(seed), dtype=np.uint32)
+    for shift in (0, 8, 16, 24):
+        byte = ((x >> np.uint64(shift)) & np.uint64(0xFF)).astype(np.uint32)
+        h = (h ^ byte) * _FNV_PRIME  # uint32 multiply wraps mod 2**32
+    return h
+
+
+def _hash_u32_device(arr, seed=0):
+    import jax.numpy as jnp
+
+    x = arr.astype(jnp.int32).view(jnp.uint32)
+    h = jnp.full(x.shape, jnp.uint32(int(_FNV_OFFSET) ^ (seed & 0xFFFFFFFF)),
+                 dtype=jnp.uint32)
+    prime = jnp.uint32(int(_FNV_PRIME))
+    for shift in (0, 8, 16, 24):
+        byte = ((x >> jnp.uint32(shift)) & jnp.uint32(0xFF)).astype(jnp.uint32)
+        h = (h ^ byte) * prime
+    return h
+
+
+def _hash_strings_host(values, seed=0):
+    """Per-element crc32 for string/bytes columns (no vectorized primitive
+    exists; documented as the slow lane — prefer integer ids upstream).
+    Object columns may carry non-string scalars (decimals, big ints); those
+    hash by their repr — deterministic, never by-magnitude allocation."""
+    import zlib
+
+    out = np.empty(len(values), dtype=np.uint32)
+    for i, v in enumerate(values):
+        if isinstance(v, str):
+            data = v.encode("utf-8")
+        elif isinstance(v, (bytes, bytearray, memoryview)):
+            data = bytes(v)
+        elif v is None:
+            data = b""
+        else:
+            data = repr(v).encode("utf-8")
+        out[i] = zlib.crc32(data, seed) & 0xFFFFFFFF
+    return out
+
+
+# --------------------------------------------------------------------------------------
+# The op set
+# --------------------------------------------------------------------------------------
+
+
+class Op:
+    """Base declarative op. Subclasses declare:
+
+    - ``elementwise`` — fusable into a single-pass chain with its neighbors
+      on the same column (the fused chain materializes ONE working array and
+      every subsequent op runs in place on it).
+    - :meth:`validate` — plan-time checks against the evolving field map.
+    - :meth:`result_field` — the post-op :class:`UnischemaField` (None =
+      field unchanged, e.g. ``Clip`` fused mid-chain).
+    - :meth:`requirements` — the :class:`StatRequirement` list still
+      unresolved (empty once parameters are explicit or bound).
+    - :meth:`apply_inplace` / :meth:`apply` — host kernels; ``apply_device``
+      — the jnp expression for the device target.
+    """
+
+    elementwise = False
+    name = "op"
+
+    def __init__(self, field, out=None):
+        self.field = field
+        self.out = out or field
+
+    def input_fields(self):
+        return (self.field,)
+
+    def validate(self, fields):
+        f = fields.get(self.field)
+        if f is None:
+            raise PipelineValidationError(
+                "%s: input field %r is not in the schema (known: %s)"
+                % (type(self).__name__, self.field, sorted(fields)))
+        return f
+
+    def _require_numeric(self, f):
+        if np.dtype(f.numpy_dtype).kind not in "biuf":
+            raise PipelineValidationError(
+                "%s: field %r has non-numeric dtype %s"
+                % (type(self).__name__, f.name, np.dtype(f.numpy_dtype)))
+
+    def result_field(self, fields):
+        return None
+
+    def requirements(self):
+        return ()
+
+    def bind(self, stats):
+        """Fill statistics-derived parameters from the resolved ``stats``
+        dict (keyed by :attr:`StatRequirement.key`)."""
+
+    def __repr__(self):
+        return "%s(%r -> %r)" % (type(self).__name__, self.field, self.out)
+
+
+class _ElementwiseOp(Op):
+    """Numeric element-wise op: validated numeric, fused with neighbors."""
+
+    elementwise = True
+    #: dtype the fused chain must be working in for this op (None = keep)
+    work_dtype = None
+
+    def validate(self, fields):
+        f = super().validate(fields)
+        self._require_numeric(f)
+        return f
+
+    def apply_inplace(self, work):
+        raise NotImplementedError
+
+    def apply_device(self, x):
+        raise NotImplementedError
+
+
+class Normalize(_ElementwiseOp):
+    """Min-max scale to ``[0, 1]``: ``(x - min) / (max - min)``. ``min``/``max``
+    resolve from parquet row-group statistics when omitted (no data pre-pass
+    needed when the footers cover the column)."""
+
+    name = "normalize"
+
+    def __init__(self, field, out=None, min=None, max=None, dtype=np.float32):
+        super().__init__(field, out)
+        self.min = min
+        self.max = max
+        self.dtype = np.dtype(dtype)
+        if self.dtype.kind != "f":
+            raise PipelineValidationError(
+                "normalize(%r): output dtype must be floating, got %s"
+                % (field, self.dtype))
+        self.work_dtype = self.dtype
+
+    def requirements(self):
+        reqs = []
+        if self.min is None:
+            reqs.append(StatRequirement(self.field, "min"))
+        if self.max is None:
+            reqs.append(StatRequirement(self.field, "max"))
+        return reqs
+
+    def bind(self, stats):
+        if self.min is None:
+            self.min = stats["min:%s" % self.field]
+        if self.max is None:
+            self.max = stats["max:%s" % self.field]
+
+    def result_field(self, fields):
+        f = fields[self.field]
+        return UnischemaField(self.out, self.dtype, f.shape, None, f.nullable)
+
+    def _scale(self):
+        span = float(self.max) - float(self.min)
+        return 1.0 / span if span else 1.0
+
+    def apply_inplace(self, work):
+        work -= np.asarray(self.min, dtype=work.dtype)
+        work *= np.asarray(self._scale(), dtype=work.dtype)
+
+    def apply_device(self, x):
+        return (x - float(self.min)) * self._scale()
+
+
+class Standardize(_ElementwiseOp):
+    """Z-score: ``(x - mean) / std``. ``mean``/``std`` come from one cached
+    streaming statistics pass when omitted."""
+
+    name = "standardize"
+
+    def __init__(self, field, out=None, mean=None, std=None, dtype=np.float32):
+        super().__init__(field, out)
+        self.mean = mean
+        self.std = std
+        self.dtype = np.dtype(dtype)
+        if self.dtype.kind != "f":
+            raise PipelineValidationError(
+                "standardize(%r): output dtype must be floating, got %s"
+                % (field, self.dtype))
+        self.work_dtype = self.dtype
+
+    def requirements(self):
+        reqs = []
+        if self.mean is None:
+            reqs.append(StatRequirement(self.field, "mean"))
+        if self.std is None:
+            reqs.append(StatRequirement(self.field, "std"))
+        return reqs
+
+    def bind(self, stats):
+        if self.mean is None:
+            self.mean = stats["mean:%s" % self.field]
+        if self.std is None:
+            self.std = stats["std:%s" % self.field]
+
+    def result_field(self, fields):
+        f = fields[self.field]
+        return UnischemaField(self.out, self.dtype, f.shape, None, f.nullable)
+
+    def _inv_std(self):
+        return 1.0 / float(self.std) if float(self.std) else 1.0
+
+    def apply_inplace(self, work):
+        work -= np.asarray(float(self.mean), dtype=work.dtype)
+        work *= np.asarray(self._inv_std(), dtype=work.dtype)
+
+    def apply_device(self, x):
+        return (x - float(self.mean)) * self._inv_std()
+
+
+class Clip(_ElementwiseOp):
+    """``clip(x, lo, hi)`` — dtype preserved (fuses into whatever chain it
+    sits in)."""
+
+    name = "clip"
+
+    def __init__(self, field, lo, hi, out=None):
+        super().__init__(field, out)
+        self.lo = lo
+        self.hi = hi
+
+    def result_field(self, fields):
+        if self.out == self.field:
+            return None  # in-place: dtype/shape unchanged
+        f = fields[self.field]
+        return UnischemaField(self.out, f.numpy_dtype, f.shape, None,
+                              f.nullable)
+
+    def apply_inplace(self, work):
+        np.clip(work, self.lo, self.hi, out=work)
+
+    def apply_device(self, x):
+        import jax.numpy as jnp
+
+        return jnp.clip(x, self.lo, self.hi)
+
+
+class Cast(_ElementwiseOp):
+    """``astype(dtype)`` — folded into the fused chain's single
+    materialization when adjacent to other element-wise ops."""
+
+    name = "cast"
+
+    def __init__(self, field, dtype, out=None):
+        super().__init__(field, out)
+        self.dtype = np.dtype(dtype)
+        if self.dtype.kind not in "biuf":
+            raise PipelineValidationError(
+                "cast(%r): target dtype must be numeric/bool, got %s"
+                % (field, self.dtype))
+        self.work_dtype = self.dtype
+
+    def result_field(self, fields):
+        f = fields[self.field]
+        return UnischemaField(self.out, self.dtype, f.shape, None, f.nullable)
+
+    def apply_inplace(self, work):
+        pass  # the chain already materialized into self.dtype
+
+    def apply_device(self, x):
+        import jax.numpy as jnp
+
+        # device arrays live under JAX's 64-bit-disabled defaults
+        dt = {np.dtype(np.float64): jnp.float32,
+              np.dtype(np.int64): jnp.int32}.get(self.dtype, self.dtype)
+        return x.astype(dt)
+
+
+class FillNull(_ElementwiseOp):
+    """NaN → ``value`` on float columns; the result field drops nullability."""
+
+    name = "fill_null"
+
+    def __init__(self, field, value, out=None):
+        super().__init__(field, out)
+        self.value = value
+
+    def validate(self, fields):
+        f = super().validate(fields)
+        if np.dtype(f.numpy_dtype).kind != "f":
+            raise PipelineValidationError(
+                "fill_null(%r): only float columns carry NaN nulls on the "
+                "columnar path; field dtype is %s (use Cast first, or encode "
+                "nulls upstream)" % (self.field, np.dtype(f.numpy_dtype)))
+        return f
+
+    def result_field(self, fields):
+        f = fields[self.field]
+        return UnischemaField(self.out, f.numpy_dtype, f.shape, None, False)
+
+    def apply_inplace(self, work):
+        np.copyto(work, np.asarray(self.value, dtype=work.dtype),
+                  where=np.isnan(work))
+
+    def apply_device(self, x):
+        import jax.numpy as jnp
+
+        return jnp.where(jnp.isnan(x), jnp.asarray(self.value, x.dtype), x)
+
+
+class Bucketize(Op):
+    """Value → bucket id via ``searchsorted`` over ``boundaries`` (or
+    dataset quantiles when ``num_buckets`` is given instead). Output ids lie
+    in ``[0, len(boundaries)]`` — an **integer** field by contract (enforced
+    here and statically by graftlint GL-S001)."""
+
+    name = "bucketize"
+
+    def __init__(self, field, boundaries=None, num_buckets=None, out=None,
+                 dtype=np.int32):
+        super().__init__(field, out)
+        if (boundaries is None) == (num_buckets is None):
+            raise PipelineValidationError(
+                "bucketize(%r): pass exactly one of boundaries= or "
+                "num_buckets=" % field)
+        self.boundaries = None if boundaries is None \
+            else np.asarray(boundaries, dtype=np.float64)
+        self.num_buckets = num_buckets
+        self.dtype = np.dtype(dtype)
+        if self.dtype.kind not in "iu":
+            raise PipelineValidationError(
+                "bucketize(%r): bucket ids need an integer output dtype, got "
+                "%s" % (field, self.dtype))
+
+    def validate(self, fields):
+        f = super().validate(fields)
+        self._require_numeric(f)
+        return f
+
+    def requirements(self):
+        if self.boundaries is None:
+            return [StatRequirement(self.field, "quantiles", self.num_buckets)]
+        return ()
+
+    def bind(self, stats):
+        if self.boundaries is None:
+            self.boundaries = np.asarray(
+                stats["quantiles:%s:%s" % (self.field, self.num_buckets)],
+                dtype=np.float64)
+
+    def result_field(self, fields):
+        f = fields[self.field]
+        return UnischemaField(self.out, self.dtype, f.shape, None, False)
+
+    def apply(self, col):
+        return np.searchsorted(
+            self.boundaries, np.asarray(col, dtype=np.float64),
+            side="right").astype(self.dtype, copy=False)
+
+    def apply_device(self, x):
+        import jax.numpy as jnp
+
+        idx = jnp.searchsorted(jnp.asarray(self.boundaries, jnp.float32),
+                               x.astype(jnp.float32), side="right")
+        return idx.astype(jnp.int32)
+
+
+class HashField(Op):
+    """Deterministic 32-bit hash of a column into ``[0, num_buckets)``.
+    Integer columns hash vectorized (identical ids on host and device);
+    string/bytes columns take a per-element crc32 (host only)."""
+
+    name = "hash"
+
+    def __init__(self, field, num_buckets, out=None, seed=0, dtype=np.int64):
+        super().__init__(field, out)
+        self.num_buckets = int(num_buckets)
+        if self.num_buckets <= 0:
+            raise PipelineValidationError(
+                "hash(%r): num_buckets must be positive" % field)
+        self.seed = int(seed)
+        self.dtype = np.dtype(dtype)
+        if self.dtype.kind not in "iu":
+            raise PipelineValidationError(
+                "hash(%r): hashed ids need an integer output dtype, got %s"
+                % (field, self.dtype))
+
+    def validate(self, fields):
+        f = super().validate(fields)
+        kind = np.dtype(f.numpy_dtype).kind
+        if kind not in "biuUSO":
+            raise PipelineValidationError(
+                "hash(%r): cannot hash dtype %s (integer or string columns "
+                "only)" % (self.field, np.dtype(f.numpy_dtype)))
+        return f
+
+    def result_field(self, fields):
+        f = fields[self.field]
+        return UnischemaField(self.out, self.dtype, f.shape, None, False)
+
+    def apply(self, col):
+        arr = np.asarray(col)
+        if arr.dtype.kind in "biu":
+            h = _hash_u32_host(arr, self.seed)
+        else:
+            h = _hash_strings_host(arr.ravel().tolist(),
+                                   self.seed).reshape(arr.shape)
+        return (h % np.uint32(self.num_buckets)).astype(self.dtype, copy=False)
+
+    def apply_device(self, x):
+        import jax.numpy as jnp
+
+        h = _hash_u32_device(x, self.seed)
+        return (h % jnp.uint32(self.num_buckets)).astype(jnp.int32)
+
+
+class VocabLookup(Op):
+    """Categorical value → vocabulary index. An explicit ``vocab`` (sequence,
+    index = position) or a computed one (``max_size`` most frequent values,
+    frequency-descending, from the cached statistics pass). Out-of-vocabulary
+    values map to ``default``."""
+
+    name = "vocab"
+
+    def __init__(self, field, vocab=None, max_size=None, out=None, default=-1,
+                 dtype=np.int64):
+        super().__init__(field, out)
+        if (vocab is None) == (max_size is None):
+            raise PipelineValidationError(
+                "vocab(%r): pass exactly one of vocab= or max_size=" % field)
+        self.vocab = None if vocab is None else list(vocab)
+        self.max_size = max_size
+        self.default = int(default)
+        self.dtype = np.dtype(dtype)
+        if self.dtype.kind not in "iu":
+            raise PipelineValidationError(
+                "vocab(%r): vocabulary indices need an integer output dtype, "
+                "got %s" % (field, self.dtype))
+        self._sorted = None
+        self._order = None
+
+    def requirements(self):
+        if self.vocab is None:
+            return [StatRequirement(self.field, "vocab", self.max_size)]
+        return ()
+
+    def bind(self, stats):
+        if self.vocab is None:
+            self.vocab = list(stats["vocab:%s:%s" % (self.field,
+                                                     self.max_size)])
+
+    def result_field(self, fields):
+        f = fields[self.field]
+        return UnischemaField(self.out, self.dtype, f.shape, None, False)
+
+    def _tables(self):
+        if self._sorted is None:
+            vocab = np.asarray(self.vocab)
+            order = np.argsort(vocab, kind="stable")
+            self._sorted = vocab[order]
+            self._order = order.astype(np.int64)
+        return self._sorted, self._order
+
+    def apply(self, col):
+        arr = np.asarray(col)
+        svocab, order = self._tables()
+        if svocab.dtype.kind in "US" and arr.dtype.kind not in "US":
+            arr = arr.astype(svocab.dtype.kind)  # object str column → unicode
+        idx = np.searchsorted(svocab, arr)
+        idx = np.clip(idx, 0, len(svocab) - 1)
+        hit = svocab[idx] == arr
+        out = np.where(hit, order[idx], self.default)
+        return out.astype(self.dtype, copy=False)
+
+    def apply_device(self, x):
+        import jax.numpy as jnp
+
+        svocab, order = self._tables()
+        if svocab.dtype.kind not in "biuf":
+            raise PipelineValidationError(
+                "vocab(%r): string vocabularies cannot run on the device "
+                "target — hash the column instead, or keep the pipeline on "
+                "the host" % self.field)
+        sv = jnp.asarray(svocab)
+        idx = jnp.clip(jnp.searchsorted(sv, x), 0, len(svocab) - 1)
+        hit = sv[idx] == x
+        return jnp.where(hit, jnp.asarray(order, jnp.int32)[idx],
+                         jnp.int32(self.default)).astype(jnp.int32)
+
+
+class FeatureCross(Op):
+    """Hash-combine N integer (or previously hashed) columns into one crossed
+    id in ``[0, num_buckets)`` — uint32 arithmetic, host/device identical."""
+
+    name = "cross"
+
+    def __init__(self, fields, num_buckets, out, seed=0, dtype=np.int64):
+        if not fields or len(fields) < 2:
+            raise PipelineValidationError(
+                "cross: needs at least two input fields, got %r" % (fields,))
+        super().__init__(fields[0], out)
+        self.fields = tuple(fields)
+        self.num_buckets = int(num_buckets)
+        self.seed = int(seed)
+        self.dtype = np.dtype(dtype)
+        if self.dtype.kind not in "iu":
+            raise PipelineValidationError(
+                "cross%r: crossed ids need an integer output dtype, got %s"
+                % (tuple(fields), self.dtype))
+
+    def input_fields(self):
+        return self.fields
+
+    def validate(self, fields):
+        for name in self.fields:
+            f = fields.get(name)
+            if f is None:
+                raise PipelineValidationError(
+                    "cross: input field %r is not in the schema (known: %s)"
+                    % (name, sorted(fields)))
+            if np.dtype(f.numpy_dtype).kind not in "biu":
+                raise PipelineValidationError(
+                    "cross: field %r has dtype %s — cross integer columns "
+                    "(HashField string columns first)"
+                    % (name, np.dtype(f.numpy_dtype)))
+        return fields[self.fields[0]]
+
+    def result_field(self, fields):
+        f = fields[self.fields[0]]
+        return UnischemaField(self.out, self.dtype, f.shape, None, False)
+
+    def apply_multi(self, cols):
+        h = _hash_u32_host(cols[0], self.seed)
+        for col in cols[1:]:
+            h = (h * _FNV_PRIME) ^ _hash_u32_host(col, self.seed)
+        return (h % np.uint32(self.num_buckets)).astype(self.dtype, copy=False)
+
+    def apply_device_multi(self, cols):
+        import jax.numpy as jnp
+
+        h = _hash_u32_device(cols[0], self.seed)
+        prime = jnp.uint32(int(_FNV_PRIME))
+        for col in cols[1:]:
+            h = (h * prime) ^ _hash_u32_device(col, self.seed)
+        return (h % jnp.uint32(self.num_buckets)).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------------------
+# Per-op metrics (ptpu_transform_*)
+# --------------------------------------------------------------------------------------
+
+_metrics_lock = threading.Lock()
+_op_seconds = {}   # op label -> Histogram on the default registry
+_rows_counter = None
+
+
+def _stage_metrics(label):
+    """(seconds histogram, rows counter) for one fused-stage label — resolved
+    once per process (workers stay picklable: nothing registry-shaped lives on
+    pipeline instances). The rows counter is assigned BEFORE the histogram is
+    published, so the lock-free fast path can never observe (hist, None)."""
+    global _rows_counter
+    hist = _op_seconds.get(label)
+    if hist is None:
+        from petastorm_tpu.obs.metrics import default_registry
+
+        with _metrics_lock:
+            hist = _op_seconds.get(label)
+            if hist is None:
+                reg = default_registry()
+                if _rows_counter is None:
+                    _rows_counter = reg.counter(
+                        "ptpu_transform_rows_total",
+                        help="rows through the declarative transform stage")
+                hist = reg.histogram(
+                    "ptpu_transform_seconds",
+                    help="declarative transform time per fused stage, by op",
+                    op=label)
+                _op_seconds[label] = hist
+    return hist, _rows_counter
+
+
+def transform_op_stats():
+    """``{op label: histogram summary}`` snapshot of the per-op transform
+    timings recorded in THIS process (thread/dummy pools; process-pool
+    children keep their own registries). Consumed by the bottleneck analyzer
+    so the transform stage is no longer opaque."""
+    with _metrics_lock:
+        items = list(_op_seconds.items())
+    return {label: hist.snapshot() for label, hist in items
+            if hist.count}
+
+
+# --------------------------------------------------------------------------------------
+# Compiled plan stages
+# --------------------------------------------------------------------------------------
+
+
+class _FusedStage:
+    """A maximal run of adjacent element-wise ops on ONE column, compiled to a
+    single-materialization pass: the working array is created once (``astype``
+    — or the lease CoW escalation when the container offers ``writable`` and
+    the dtype already matches) and every op mutates it in place."""
+
+    def __init__(self, ops, source, out, out_dtype):
+        self.ops = list(ops)
+        self.source = source
+        self.out = out
+        self.out_dtype = out_dtype
+        self.label = "+".join(op.name for op in self.ops)
+
+    def inputs(self):
+        return (self.source,)
+
+    def apply(self, container):
+        col = container[self.source]
+        col = np.asarray(col)
+        if self.out == self.source and col.dtype == self.out_dtype \
+                and hasattr(container, "writable"):
+            # in-place rewrite of a leased column: ONE CoW copy (counted as
+            # lease_cow), untouched columns stay zero-copy views
+            work = container.writable(self.source)
+        else:
+            work = col.astype(self.out_dtype)  # the single materialization
+            if not work.flags.writeable or work.base is not None:
+                work = np.array(work)  # same-dtype astype may return a view
+        for op in self.ops:
+            op.apply_inplace(work)
+        return work
+
+    def apply_device(self, batch):
+        x = batch[self.source]
+        for op in self.ops:
+            x = op.apply_device(x)
+        if self.out_dtype is not None:
+            import jax.numpy as jnp
+
+            dt = {np.dtype(np.float64): jnp.float32,
+                  np.dtype(np.int64): jnp.int32}.get(np.dtype(self.out_dtype),
+                                                     self.out_dtype)
+            x = x.astype(dt)
+        return x
+
+
+class _OpStage:
+    """A non-fusable op (bucketize/hash/vocab/cross) as its own stage."""
+
+    def __init__(self, op):
+        self.op = op
+        self.out = op.out
+        self.label = op.name
+
+    def inputs(self):
+        return tuple(op_inputs(self.op))
+
+    def apply(self, container):
+        if isinstance(self.op, FeatureCross):
+            return self.op.apply_multi([np.asarray(container[n])
+                                        for n in self.op.fields])
+        return self.op.apply(container[self.op.field])
+
+    def apply_device(self, batch):
+        if isinstance(self.op, FeatureCross):
+            return self.op.apply_device_multi([batch[n]
+                                               for n in self.op.fields])
+        return self.op.apply_device(batch[self.op.field])
+
+
+def op_inputs(op):
+    return op.input_fields()
+
+
+# --------------------------------------------------------------------------------------
+# FeaturePipeline
+# --------------------------------------------------------------------------------------
+
+
+class FeaturePipeline(TransformSpec):
+    """A declarative transform: an ordered list of ops, planned and compiled
+    against the read schema. Slots in anywhere a :class:`TransformSpec` does
+    (``make_reader``/``make_batch_reader`` ``transform_spec=``); the reader
+    factories call :meth:`compile` after resolving the read schema and any
+    dataset statistics, and :func:`petastorm_tpu.transform.transform_schema`
+    then consumes the derived ``edit_fields``/``removed_fields`` unchanged.
+
+    ``device=True`` compiles the SAME op list to one jittable
+    ``fn(batch) -> batch`` riding the existing ``TransformSpec(device=True)``
+    loader seam (XLA fuses it into the input pipeline).
+    """
+
+    declarative = True  # the marker the read path branches on (transform.py)
+
+    def __init__(self, ops, selected_fields=None, removed_fields=None,
+                 device=False):
+        super().__init__(func=None, edit_fields=None,
+                         removed_fields=removed_fields,
+                         selected_fields=selected_fields, device=device)
+        self.ops = list(ops)
+        for op in self.ops:
+            if not isinstance(op, Op):
+                raise PipelineValidationError(
+                    "FeaturePipeline ops must be tabular Op instances; got %r"
+                    % (op,))
+        self.compiled = False
+        self._plan = []
+        #: requirement key -> "rowgroup-stats" | "data-pass" | "cached" —
+        #: how each statistic was resolved (observability + tests)
+        self.stats_info = {}
+
+    # -- planning -----------------------------------------------------------------------
+
+    def required_statistics(self, schema):
+        """Unresolved :class:`StatRequirement` list, validated against
+        ``schema`` — statistics are computed over STORED columns, so an op
+        whose stat input was already written by an EARLIER op (renamed or
+        transformed in place: stored-column statistics no longer describe
+        the runtime values) must carry explicit parameters."""
+        written = set()
+        reqs = []
+        for op in self.ops:
+            for req in op.requirements():
+                if req.field in written:
+                    raise PipelineValidationError(
+                        "%s(%r): statistics-dependent parameters on a field "
+                        "an earlier op already transformed cannot be computed "
+                        "from the stored dataset — pass them explicitly"
+                        % (type(op).__name__, req.field))
+                if req.field not in schema.fields:
+                    raise PipelineValidationError(
+                        "%s: input field %r is not in the schema (known: %s)"
+                        % (type(op).__name__, req.field,
+                           sorted(schema.fields)))
+                reqs.append(req)
+            written.add(op.out)
+        return reqs
+
+    def compile(self, schema, statistics=None):
+        """Validate the op graph against ``schema``, bind resolved
+        ``statistics``, derive the post-transform schema edits, and fuse the
+        plan. Idempotent; raises :class:`PipelineValidationError` on any
+        contract violation."""
+        statistics = statistics or {}
+        fields = dict(schema.fields)
+        edits = []
+        for op in self.ops:
+            missing = [r.key for r in op.requirements()
+                       if r.key not in statistics]
+            if missing:
+                raise PipelineValidationError(
+                    "%s(%r): unresolved statistics %s — compile through the "
+                    "reader factories (which run the statistics pass), or "
+                    "pass the parameters explicitly"
+                    % (type(op).__name__, op.field, missing))
+            op.bind(statistics)
+            op.validate(fields)
+            new_field = op.result_field(fields)
+            if new_field is not None:
+                fields[new_field.name] = new_field
+                edits.append(new_field)
+        for removed in self.removed_fields:
+            if removed not in fields:
+                raise PipelineValidationError(
+                    "removed_fields names %r, which is not a schema or "
+                    "derived field" % removed)
+        if self.selected_fields is not None:
+            missing = set(self.selected_fields) - set(fields)
+            if missing:
+                raise PipelineValidationError(
+                    "selected_fields %r not present after the pipeline"
+                    % sorted(missing))
+        # last edit per name wins (same contract as transform_schema's dict)
+        by_name = {f.name: f for f in edits}
+        self.edit_fields = list(by_name.values())
+        self._plan = self._fuse(schema)
+        self.func = self._device_call if self.device else self._host_call
+        self.compiled = True
+        return self
+
+    def _fuse(self, schema):
+        """Adjacent element-wise ops chained on the same column collapse into
+        one :class:`_FusedStage` (op N+1 reads op N's output) — one
+        materialization, the rest in place.
+
+        A chain runs entirely in ONE working dtype (set by its first
+        dtype-declaring op, or the column's dtype); an op that needs a
+        DIFFERENT working dtype ends the chain and starts a new one, so the
+        fused semantics always equal the unfused sequence — in particular
+        ``Standardize → Cast(int)`` must not run the float math in integer
+        arithmetic."""
+        plan = []
+        run = []           # accumulating elementwise ops
+        run_source = None
+        run_dtype = None   # the chain's working (= materialization) dtype
+        dtypes = {name: np.dtype(f.numpy_dtype)
+                  for name, f in schema.fields.items()}
+
+        def flush():
+            nonlocal run_dtype
+            if not run:
+                return
+            out_dtype = run_dtype if run_dtype is not None \
+                else np.dtype(np.float64)
+            plan.append(_FusedStage(run[:], run_source, run[-1].out, out_dtype))
+            dtypes[run[-1].out] = out_dtype
+            run.clear()
+            run_dtype = None
+
+        for op in self.ops:
+            if op.elementwise:
+                want = None if op.work_dtype is None else np.dtype(op.work_dtype)
+                # only an IN-PLACE op (out == field) may extend a chain: a
+                # mid-chain rename would fuse away an intermediate output the
+                # derived schema declares
+                if run and op.field == run[-1].out and op.out == op.field \
+                        and (want is None or want == run_dtype):
+                    run.append(op)        # extends the chain in place
+                    continue
+                flush()
+                run.append(op)
+                run_source = op.field
+                run_dtype = want if want is not None \
+                    else dtypes.get(op.field)
+            else:
+                flush()
+                plan.append(_OpStage(op))
+                dtypes[op.out] = op.dtype
+        flush()
+        return plan
+
+    # -- execution ----------------------------------------------------------------------
+
+    def _finalize(self, result):
+        if self.selected_fields is not None:
+            if hasattr(result, "writable"):
+                # lease container: subset in place so the leases stay attached
+                for name in list(result.keys()):
+                    if name not in self.selected_fields:
+                        result.pop(name)
+                return result
+            return {name: result[name] for name in self.selected_fields}
+        for removed in self.removed_fields:
+            result.pop(removed, None)
+        return result
+
+    def apply_columns(self, columns):
+        """Host target: columnar batch in, columnar batch out. Untouched
+        columns pass through as the original (possibly zero-copy read-only)
+        arrays; each fused stage materializes exactly one working array. A
+        :class:`~petastorm_tpu.io.lease.LeasedBatch` input is transformed in
+        its own container (outputs set alongside the leased views, mutated
+        columns escalated per-column via ``writable()``) so its leases keep
+        protecting the untouched columns."""
+        if not self.compiled:
+            raise PipelineValidationError(
+                "FeaturePipeline was not compiled — open it through "
+                "make_reader/make_batch_reader, or call compile(schema)")
+        result = columns if hasattr(columns, "writable") \
+            else dict(columns.items())
+        if not self._plan:
+            return self._finalize(result)
+        rows = None
+        for stage in self._plan:
+            t0 = time.perf_counter()
+            out = stage.apply(result)
+            result[stage.out] = out
+            hist, _rows_total = _stage_metrics(stage.label)
+            hist.observe(time.perf_counter() - t0)
+            if rows is None:
+                rows = len(out) if hasattr(out, "__len__") else 0
+        if rows:
+            _stage_metrics(self._plan[0].label)[1].inc(rows)
+        return self._finalize(result)
+
+    def apply_rows(self, rows):
+        """Per-row-path host target: the row dicts are columnarized ONCE, the
+        compiled columnar kernels run over the whole window, and fresh row
+        dicts are rebuilt — replacing the per-row ``func(dict(r))`` loop the
+        opaque callable forces (ISSUE 9 satellite: the NGram path applies the
+        transform once over the window's columnar form)."""
+        if not rows or not self._plan:
+            return [self._finalize(dict(r)) for r in rows]
+        available = set(rows[0].keys())
+        needed = set()
+        for stage in self._plan:
+            needed.update(n for n in stage.inputs() if n in available)
+        merged = {}
+        for name in needed:
+            values = [r.get(name) for r in rows]
+            try:
+                merged[name] = np.asarray(values)
+            except (ValueError, TypeError):
+                arr = np.empty(len(values), dtype=object)
+                arr[:] = values
+                merged[name] = arr
+        out_cols = {}
+        for stage in self._plan:
+            t0 = time.perf_counter()
+            out = stage.apply(merged)
+            merged[stage.out] = out
+            out_cols[stage.out] = out
+            hist, _rows_total = _stage_metrics(stage.label)
+            hist.observe(time.perf_counter() - t0)
+        _stage_metrics(self._plan[0].label)[1].inc(len(rows))
+        new_rows = []
+        for i, r in enumerate(rows):
+            nr = dict(r)
+            for name, col in out_cols.items():
+                nr[name] = col[i]
+            if self.selected_fields is not None:
+                nr = {name: nr[name] for name in self.selected_fields}
+            else:
+                for removed in self.removed_fields:
+                    nr.pop(removed, None)
+            new_rows.append(nr)
+        return new_rows
+
+    def _host_call(self, columns):
+        """``TransformSpec.func`` shape for the host target (bound method —
+        picklable with the pipeline, so process-pool workers carry it)."""
+        return self.apply_columns(columns)
+
+    def _device_call(self, batch):
+        """The jittable device function (``TransformSpec(device=True)`` seam):
+        every stage is jnp expressions over the batch dict, so one ``jax.jit``
+        — the loader's — fuses the whole pipeline into the input step."""
+        result = dict(batch)
+        for stage in self._plan:
+            result[stage.out] = stage.apply_device(result)
+        return self._finalize(result)
+
+    def device_fn(self, schema):
+        """Compile (if needed) and return the jittable device function —
+        the hook :class:`petastorm_tpu.loader.DataLoader` uses when a
+        pipeline is passed directly as ``device_transform=``."""
+        if not self.compiled:
+            reqs = self.required_statistics(schema)
+            if reqs:
+                raise PipelineValidationError(
+                    "device pipeline needs dataset statistics %s — open the "
+                    "reader with transform_spec=FeaturePipeline(..., "
+                    "device=True) so the factory resolves them"
+                    % [r.key for r in reqs])
+            self.compile(schema)
+        return self._device_call
+
+    def __repr__(self):
+        return "FeaturePipeline(%s%s)" % (
+            ", ".join(repr(op) for op in self.ops),
+            ", device=True" if self.device else "")

@@ -138,6 +138,14 @@ def _close_quietly(pf):
         pass  # graftlint: disable=GL-O002 (best-effort close of an evicted handle)
 
 
+def _spec_wants_writable(spec):
+    """True when the host transform is an OPAQUE callable that may mutate the
+    worker payload in place (the one consumer needing the cache's writable
+    escalation). Declarative pipelines are out-of-place by construction."""
+    return (spec is not None and not spec.device and spec.func is not None
+            and not getattr(spec, "declarative", False))
+
+
 #: serializes lazy per-process IO-runtime construction (the readahead pool);
 #: module-level because worker objects must stay picklable (no instance locks)
 _io_init_lock = threading.Lock()
@@ -213,14 +221,19 @@ class _WorkerBase:
 
     def _cache_get(self, key, fill):
         """Cache read under the lease contract (ISSUE 6): a lease-aware cache
-        (``MemCache``) serves zero-copy READ-ONLY views by default, but a host
-        ``TransformSpec`` runs user code against the returned payload (pandas
-        frames / row dicts aliasing the cached arrays) that may legitimately
-        mutate in place — that is the one consumer that "actually writes", so
-        the worker escalates to the cache's copy-on-write path up front."""
-        writable = (self._transform_spec is not None
-                    and not self._transform_spec.device
-                    and self._transform_spec.func is not None)
+        (``MemCache``) serves zero-copy READ-ONLY views by default, but an
+        OPAQUE host ``TransformSpec`` runs user code against the returned
+        payload (pandas frames / row dicts aliasing the cached arrays) that
+        may legitimately mutate in place — that consumer "actually writes",
+        so the worker escalates to the cache's copy-on-write path up front
+        and the copy is charged to the census (``memcache_cow``).
+
+        Declarative :class:`~petastorm_tpu.ops.tabular.FeaturePipeline`
+        transforms never mutate delivered payloads in place (each fused stage
+        materializes its own output column), so they keep the zero-copy
+        read-only serving contract — the ISSUE-9 narrowing of the
+        writable-batch request."""
+        writable = _spec_wants_writable(self._transform_spec)
         get_writable = getattr(self._cache, "get_writable", None)
         if writable and get_writable is not None:
             return get_writable(key, fill)
@@ -666,9 +679,15 @@ class PyDictWorker(_WorkerBase):
                                item[1], self._drop_partitions, self._seed,
                                self._device_fields)
         rows = self._cache_get(cache_key, lambda: self._load_rows(item))
-        if self._transform_spec is not None and not self._transform_spec.device \
-                and self._transform_spec.func is not None:
-            rows = [self._transform_spec.func(dict(r)) for r in rows]
+        spec = self._transform_spec
+        if spec is not None and not spec.device:
+            if getattr(spec, "declarative", False):
+                # compiled declarative pipeline: ONE columnar application over
+                # the whole row group (and thus over each NGram window's
+                # columnar form) instead of a func(dict(r)) call per row
+                rows = spec.apply_rows(rows)
+            elif spec.func is not None:
+                rows = [spec.func(dict(r)) for r in rows]
         if self._ngram is not None:
             # sort/window on decoded (and transformed) rows; plain dicts for cheap IPC
             return self._form_ngram_dicts(rows)
@@ -776,23 +795,30 @@ class ArrowWorker(_WorkerBase):
                                item[1], self._drop_partitions, self._seed,
                                self._device_fields)
         columns = self._cache_get(cache_key, lambda: self._load_columns(item))
-        if self._transform_spec is not None and not self._transform_spec.device \
-                and self._transform_spec.func is not None:
-            import pandas as pd
+        spec = self._transform_spec
+        if spec is not None and not spec.device:
+            if getattr(spec, "declarative", False):
+                # compiled declarative pipeline: fused vectorized kernels over
+                # the columnar batch — no pandas round trip, untouched columns
+                # stay the original zero-copy views
+                columns = spec.apply_columns(columns)
+            elif spec.func is not None:
+                import pandas as pd
 
-            pdf = pd.DataFrame({k: list(v) if v.ndim > 1 else v for k, v in columns.items()})
-            pdf = self._transform_spec.func(pdf)
-            from petastorm_tpu.utils import stack_as_column
+                pdf = pd.DataFrame(
+                    {k: list(v) if v.ndim > 1 else v for k, v in columns.items()})
+                pdf = spec.func(pdf)
+                from petastorm_tpu.utils import stack_as_column
 
-            columns = {}
-            for name in pdf.columns:
-                series = pdf[name]
-                if series.dtype == object:
-                    # tensor rows: one stack; scalar object columns (strings/decimals)
-                    # degrade to an object array
-                    columns[name] = stack_as_column(series.to_list())
-                else:
-                    columns[name] = series.to_numpy()  # no per-row materialization
+                columns = {}
+                for name in pdf.columns:
+                    series = pdf[name]
+                    if series.dtype == object:
+                        # tensor rows: one stack; scalar object columns
+                        # (strings/decimals) degrade to an object array
+                        columns[name] = stack_as_column(series.to_list())
+                    else:
+                        columns[name] = series.to_numpy()  # no per-row materialization
         if self._ngram is not None:
             from petastorm_tpu.ngram import form_ngram_columns
 
@@ -1690,6 +1716,28 @@ def _build_read_funnel(cache, io_opts, num_epochs=None):
                        single_epoch=num_epochs == 1)
 
 
+def _maybe_compile_pipeline(spec, schema, fs, pieces, cache):
+    """Plan a declarative :class:`~petastorm_tpu.ops.tabular.FeaturePipeline`
+    against the read schema (ISSUE 9): resolve statistics-dependent op
+    parameters — parquet row-group statistics when the footers cover them
+    (no data pre-pass), one cached streaming pass otherwise — then compile
+    to the fused host kernels (or the jittable device function for
+    ``device=True``). Opaque ``TransformSpec``\\ s pass through untouched;
+    an already-compiled pipeline (reused across readers) is kept as-is."""
+    if spec is None or not getattr(spec, "declarative", False) \
+            or getattr(spec, "compiled", False):
+        return spec
+    reqs = spec.required_statistics(schema)
+    stats, sources = {}, {}
+    if reqs:
+        from petastorm_tpu.io.statscache import resolve_statistics
+
+        stats, sources = resolve_statistics(reqs, fs, pieces, cache=cache)
+    spec.compile(schema, statistics=stats)
+    spec.stats_info = dict(sources)
+    return spec
+
+
 def _resolve_ngram_schema(schema_fields, stored_schema, predicate):
     """Shared NGram policy for both reader factories: which options NGram forbids
     and how its read-schema view is built. Returns ``(ngram-or-None, read_schema)``."""
@@ -1780,6 +1828,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
 
     pieces = load_row_groups(fs, path)
     pieces = _apply_rowgroup_selector(fs, path, pieces, rowgroup_selector)
+    stats_pieces = pieces  # pre-plan view: row-group stats still attached
     pieces, partition_info, filters = _plan_pieces(pieces, filters, predicate,
                                                    shard_count)
     if partition_info:
@@ -1788,10 +1837,6 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     ngram, read_schema = _resolve_ngram_schema(schema_fields, stored_schema,
                                                predicate)
 
-    final_schema = read_schema
-    if transform_spec is not None and not transform_spec.device:
-        final_schema = transform_schema(read_schema, transform_spec)
-
     io_opts = IoOptions.normalize(io_options)
     rec = RecoveryOptions.resolve(recovery, io_retries=io_retries,
                                   io_retry_backoff_s=io_retry_backoff_s,
@@ -1799,6 +1844,11 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
     cache = _build_read_funnel(cache, io_opts, num_epochs)
+    transform_spec = _maybe_compile_pipeline(transform_spec, read_schema, fs,
+                                             stats_pieces, cache)
+    final_schema = read_schema
+    if transform_spec is not None and not transform_spec.device:
+        final_schema = transform_schema(read_schema, transform_spec)
     device_fields = _resolve_device_fields(read_schema, decode_on_device, ngram,
                                            transform_spec)
     worker = PyDictWorker(
@@ -1866,6 +1916,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     pieces = []
     for p in paths:
         pieces.extend(load_row_groups(fs, p))
+    stats_pieces = pieces  # pre-plan view: row-group stats still attached
     pieces, partition_info, filters = _plan_pieces(pieces, filters, predicate,
                                                    shard_count)
     if partition_info:
@@ -1877,10 +1928,6 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     # namedtuple attributes)
     ngram, read_schema = _resolve_ngram_schema(schema_fields, stored_schema,
                                                predicate)
-    final_schema = read_schema
-    if transform_spec is not None and not transform_spec.device:
-        final_schema = transform_schema(read_schema, transform_spec)
-
     io_opts = IoOptions.normalize(io_options)
     rec = RecoveryOptions.resolve(recovery, io_retries=io_retries,
                                   io_retry_backoff_s=io_retry_backoff_s,
@@ -1888,6 +1935,11 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
     cache = _build_read_funnel(cache, io_opts, num_epochs)
+    transform_spec = _maybe_compile_pipeline(transform_spec, read_schema, fs,
+                                             stats_pieces, cache)
+    final_schema = read_schema
+    if transform_spec is not None and not transform_spec.device:
+        final_schema = transform_schema(read_schema, transform_spec)
     device_fields = _resolve_device_fields(read_schema, decode_on_device, ngram,
                                            transform_spec=transform_spec)
     worker = ArrowWorker(

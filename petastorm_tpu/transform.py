@@ -7,6 +7,12 @@ signatures, torch collate) see the post-transform schema.
 TPU delta: a transform may instead be *device-side* — a jittable ``fn(batch_dict) -> batch_dict``
 applied after device transfer (fused by XLA into the input pipeline). Declare it with
 ``device=True``; the host pipeline then skips it and the JAX loader applies it under jit.
+
+ISSUE-9 delta: :class:`petastorm_tpu.ops.tabular.FeaturePipeline` is the *declarative*
+subclass — instead of an opaque callable it carries a plannable op list the reader
+factories validate, fuse, and compile (``declarative = True`` below is the marker the
+read path branches on: declarative transforms run columnar with no pandas round trip
+and never request writable payloads).
 """
 from __future__ import annotations
 
@@ -14,6 +20,11 @@ from petastorm_tpu.unischema import Unischema, UnischemaField
 
 
 class TransformSpec:
+    #: True on declarative subclasses (FeaturePipeline): the transform is a
+    #: plannable op graph, not an opaque callable — workers apply it columnar
+    #: and skip the writable-payload escalation (reader._spec_wants_writable)
+    declarative = False
+
     def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None,
                  device=False):
         self.func = func

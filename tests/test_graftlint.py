@@ -850,6 +850,78 @@ def test_schema_codec_contract_accepts_compatible_fields():
     assert findings == []
 
 
+_S001_TABULAR_POSITIVE = """
+    import numpy as np
+
+    from petastorm_tpu.ops.tabular import (
+        Bucketize,
+        FeaturePipeline,
+        HashField,
+        Normalize,
+        Standardize,
+    )
+    from petastorm_tpu.unischema import UnischemaField
+
+    FIELDS = [
+        UnischemaField("xb", np.float32, (), None, False),
+        UnischemaField("z", np.int32, (), None, False),
+    ]
+    PIPE = FeaturePipeline([
+        HashField("x", 100, dtype=np.float32),  # BUG: float hash dtype
+        Bucketize("x", num_buckets=8, out="xb"),  # BUG: float out field
+        Standardize("y", out="z"),  # BUG: int out field
+        Normalize("w"),  # ok: no declared field named w
+    ])
+"""
+
+
+def test_schema_contract_fires_on_declarative_op_dtypes():
+    findings, _ = _lint(_S001_TABULAR_POSITIVE)
+    findings = _only_rule(findings, "GL-S001")
+    expected = {
+        _line_of(_S001_TABULAR_POSITIVE, "BUG: float hash dtype"),
+        _line_of(_S001_TABULAR_POSITIVE, "BUG: float out field"),
+        _line_of(_S001_TABULAR_POSITIVE, "BUG: int out field"),
+    }
+    assert {f.line for f in findings} == expected
+    by_line = {f.line: f.message for f in findings}
+    assert "integer" in by_line[_line_of(_S001_TABULAR_POSITIVE,
+                                         "BUG: float hash dtype")]
+    assert "xb" in by_line[_line_of(_S001_TABULAR_POSITIVE,
+                                    "BUG: float out field")]
+
+
+def test_schema_contract_accepts_compatible_declarative_ops():
+    findings, _ = _lint("""
+        import numpy as np
+
+        from petastorm_tpu.ops.tabular import (
+            Bucketize,
+            FeatureCross,
+            FeaturePipeline,
+            HashField,
+            Normalize,
+            VocabLookup,
+        )
+        from petastorm_tpu.unischema import UnischemaField
+
+        FIELDS = [
+            UnischemaField("xb", np.int32, (), None, False),
+            UnischemaField("xh", np.int64, (), None, False),
+            UnischemaField("xn", np.float32, (), None, False),
+            UnischemaField("xc", np.int64, (), None, False),
+        ]
+        PIPE = FeaturePipeline([
+            Normalize("x", out="xn"),
+            Bucketize("x", num_buckets=8, out="xb"),
+            HashField("x", 100, out="xh"),
+            VocabLookup("c", vocab=[1, 2, 3], out="xc", dtype=np.int64),
+            FeatureCross(("a", "b"), 64, out="xc"),
+        ])
+    """)
+    assert findings == []
+
+
 # -- GL-O001: wall-clock durations ------------------------------------------------------
 
 _O001_POSITIVE = """

@@ -1,0 +1,723 @@
+"""Declarative tabular preprocessing engine tests (ISSUE 9): op kernels,
+plan-time validation and fusion, schema derivation through transform_schema,
+statistics resolution (row-group tier vs cached streaming pass), both reader
+paths (columnar batch + per-row/NGram), the device (jit) target, and the
+narrowed writable-batch contract (copy-census pin)."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.ops.tabular import (
+    Bucketize,
+    Cast,
+    Clip,
+    FeatureCross,
+    FeaturePipeline,
+    FillNull,
+    HashField,
+    Normalize,
+    PipelineValidationError,
+    Standardize,
+    VocabLookup,
+    _hash_u32_host,
+)
+from petastorm_tpu.transform import TransformSpec, transform_schema
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+def _schema(**fields):
+    return Unischema("S", [UnischemaField(n, dt, (), None, False)
+                           for n, dt in fields.items()])
+
+
+@pytest.fixture
+def num_schema():
+    return _schema(id=np.int64, x=np.float64, y=np.float64, a=np.int64,
+                   b=np.int64)
+
+
+def _cols(n=64):
+    ids = np.arange(n, dtype=np.int64)
+    return {"id": ids, "x": ids.astype(np.float64) * 0.25,
+            "y": np.sin(ids.astype(np.float64)),
+            "a": (ids % 13).astype(np.int64), "b": (ids % 7).astype(np.int64)}
+
+
+# -- op kernels -------------------------------------------------------------------------
+
+
+def test_normalize_standardize_clip_cast_values(num_schema):
+    pipe = FeaturePipeline([
+        Normalize("x", min=0.0, max=10.0),
+        Clip("x", 0.0, 1.0),
+        Standardize("y", out="yz", mean=0.5, std=2.0),
+        Cast("a", np.float32, out="af"),
+    ]).compile(num_schema)
+    cols = _cols()
+    out = pipe.apply_columns(cols)
+    x32 = cols["x"].astype(np.float32)
+    exp = np.clip((x32 - np.float32(0.0)) * np.float32(0.1), 0.0, 1.0)
+    assert out["x"].dtype == np.float32 and np.array_equal(out["x"], exp)
+    expz = (cols["y"].astype(np.float32) - np.float32(0.5)) * np.float32(0.5)
+    assert np.allclose(out["yz"], expz)
+    assert out["af"].dtype == np.float32
+    assert np.array_equal(out["af"], cols["a"].astype(np.float32))
+    # untouched columns pass through as the SAME objects (zero-copy)
+    assert out["id"] is cols["id"]
+
+
+def test_fill_null_and_nullability(num_schema):
+    pipe = FeaturePipeline([FillNull("x", -1.0)]).compile(num_schema)
+    cols = _cols(8)
+    cols["x"] = cols["x"].copy()
+    cols["x"][3] = np.nan
+    out = pipe.apply_columns(cols)
+    assert out["x"][3] == -1.0 and not np.isnan(out["x"]).any()
+    assert transform_schema(num_schema, pipe).x.nullable is False
+
+
+def test_bucketize_hash_vocab_cross(num_schema):
+    bounds = np.array([2.0, 5.0, 9.0])
+    pipe = FeaturePipeline([
+        Bucketize("x", boundaries=bounds, out="xb"),
+        HashField("a", 32, out="ah"),
+        VocabLookup("b", vocab=[5, 3, 1], out="bv"),
+        FeatureCross(("a", "b"), 64, out="ab"),
+    ]).compile(num_schema)
+    cols = _cols()
+    out = pipe.apply_columns(cols)
+    assert np.array_equal(out["xb"],
+                          np.searchsorted(bounds, cols["x"], side="right")
+                          .astype(np.int32))
+    assert out["ah"].dtype == np.int64
+    assert ((out["ah"] >= 0) & (out["ah"] < 32)).all()
+    # same input value -> same hash (deterministic)
+    same = cols["a"] == cols["a"][0]
+    assert (out["ah"][same] == out["ah"][0]).all()
+    # vocab: index = position in the vocab list, OOV -> -1
+    expect_v = np.full(len(cols["b"]), -1, dtype=np.int64)
+    for i, v in enumerate([5, 3, 1]):
+        expect_v[cols["b"] == v] = i
+    assert np.array_equal(out["bv"], expect_v)
+    assert ((out["ab"] >= 0) & (out["ab"] < 64)).all()
+    # cross depends on BOTH inputs
+    other = FeatureCross(("a", "b"), 64, out="ab")
+    flipped = other.apply_multi([cols["b"], cols["a"]])
+    assert not np.array_equal(out["ab"], flipped)
+
+
+def test_string_vocab_and_hash():
+    schema = _schema(id=np.int64)
+    schema = Unischema("S", list(schema.fields.values()) + [
+        UnischemaField("s", np.str_, (), None, False)])
+    pipe = FeaturePipeline([VocabLookup("s", vocab=["b", "a"], out="sv"),
+                            HashField("s", 16, out="sh")]).compile(schema)
+    cols = {"id": np.arange(4), "s": np.array(["a", "b", "zz", "a"],
+                                              dtype=object)}
+    out = pipe.apply_columns(cols)
+    assert out["sv"].tolist() == [1, 0, -1, 1]
+    assert ((out["sh"] >= 0) & (out["sh"] < 16)).all()
+    assert out["sh"][0] == out["sh"][3]
+
+
+# -- plan-time validation ---------------------------------------------------------------
+
+
+def test_validation_unknown_field(num_schema):
+    with pytest.raises(PipelineValidationError, match="nope"):
+        FeaturePipeline([Normalize("nope", min=0, max=1)]).compile(num_schema)
+
+
+def test_validation_dtype_contracts(num_schema):
+    # deliberately-invalid constructions: the runtime raise mirrors what
+    # graftlint GL-S001 reports statically (hence the inline suppressions)
+    with pytest.raises(PipelineValidationError, match="integer"):
+        HashField("a", 10, dtype=np.float32)  # graftlint: disable=GL-S001
+    with pytest.raises(PipelineValidationError, match="integer"):
+        Bucketize("x", num_buckets=4, dtype=np.float64)  # graftlint: disable=GL-S001
+    with pytest.raises(PipelineValidationError, match="floating"):
+        Normalize("x", dtype=np.int32)  # graftlint: disable=GL-S001
+    with pytest.raises(PipelineValidationError, match="exactly one"):
+        Bucketize("x")
+    schema = Unischema("S", [UnischemaField("s", np.str_, (), None, False)])
+    with pytest.raises(PipelineValidationError, match="non-numeric"):
+        FeaturePipeline([Normalize("s", min=0, max=1)]).compile(schema)
+    with pytest.raises(PipelineValidationError, match="cross integer"):
+        FeaturePipeline([FeatureCross(("x", "a"), 8, out="c")]) \
+            .compile(num_schema)
+
+
+def test_validation_stats_on_derived_field(num_schema):
+    pipe = FeaturePipeline([Standardize("x", mean=0, std=1, out="xz"),
+                            Bucketize("xz", num_buckets=4, out="xb")])
+    with pytest.raises(PipelineValidationError, match="already transformed"):
+        pipe.required_statistics(num_schema)
+
+
+def test_validation_stats_on_inplace_transformed_field(num_schema):
+    """Stored-column statistics no longer describe a column an earlier op
+    rewrote IN PLACE — binding them silently mis-scales the feature."""
+    pipe = FeaturePipeline([Standardize("x", mean=0, std=1), Normalize("x")])
+    with pytest.raises(PipelineValidationError, match="already transformed"):
+        pipe.required_statistics(num_schema)
+
+
+def test_uncompiled_pipeline_refuses_to_run(num_schema):
+    pipe = FeaturePipeline([Clip("x", 0, 1)])
+    with pytest.raises(PipelineValidationError, match="not compiled"):
+        pipe.apply_columns(_cols(4))
+    with pytest.raises(PipelineValidationError, match="unresolved statistics"):
+        FeaturePipeline([Standardize("x")]).compile(num_schema)
+
+
+# -- fusion -----------------------------------------------------------------------------
+
+
+def test_adjacent_elementwise_ops_fuse_to_one_stage(num_schema):
+    pipe = FeaturePipeline([
+        Normalize("x", min=0.0, max=16.0),
+        Clip("x", 0.0, 1.0),
+        Cast("x", np.float32),
+        HashField("a", 8, out="ah"),
+        Standardize("y", mean=0.0, std=1.0),
+    ]).compile(num_schema)
+    labels = [s.label for s in pipe._plan]
+    assert labels == ["normalize+clip+cast", "hash", "standardize"]
+    # fused result == unfused sequential application
+    unfused = FeaturePipeline([Normalize("x", min=0.0, max=16.0)]) \
+        .compile(num_schema)
+    cols = _cols()
+    fused_x = pipe.apply_columns(dict(cols))["x"]
+    step = np.clip(unfused.apply_columns(dict(cols))["x"], 0.0, 1.0) \
+        .astype(np.float32)
+    assert np.array_equal(fused_x, step)
+
+
+def test_chain_breaks_when_ops_touch_different_columns(num_schema):
+    pipe = FeaturePipeline([Clip("x", 0, 1), Clip("y", 0, 1)]) \
+        .compile(num_schema)
+    assert [s.label for s in pipe._plan] == ["clip", "clip"]
+
+
+def test_mid_chain_rename_materializes_every_declared_output(num_schema):
+    """A rename must not fuse away: every output the derived schema declares
+    has to exist in the delivered batch."""
+    pipe = FeaturePipeline([Normalize("x", min=0.0, max=4.0, out="y2"),
+                            FillNull("y2", 0.0, out="z2")]).compile(num_schema)
+    out = pipe.apply_columns(_cols(8))
+    derived = transform_schema(num_schema, pipe)
+    assert {"y2", "z2"} <= set(derived.fields)
+    assert {"y2", "z2"} <= set(out)  # both materialized, not just the last
+    assert np.array_equal(out["y2"], out["z2"])
+
+
+def test_renamed_clip_lands_in_derived_schema(num_schema):
+    pipe = FeaturePipeline([Clip("x", 0.0, 1.0, out="xc")],
+                           selected_fields=["id", "xc"]).compile(num_schema)
+    derived = transform_schema(num_schema, pipe)
+    assert derived.xc.numpy_dtype == np.float64  # dtype preserved
+    out = pipe.apply_columns(_cols(8))
+    assert sorted(out) == ["id", "xc"]
+
+
+def test_hash_object_column_with_non_string_scalars():
+    from decimal import Decimal
+
+    vals = np.empty(4, dtype=object)
+    vals[:] = [Decimal("1.5"), -(10 ** 12), None, Decimal("1.5")]
+    out = HashField("f", 64, out="h").apply(vals)
+    assert ((out >= 0) & (out < 64)).all()
+    assert out[0] == out[3]  # equal values hash equal
+    assert out[0] != out[1]
+
+
+def test_chain_breaks_on_working_dtype_change(num_schema):
+    """Standardize → Cast(int) must NOT fuse into one integer-arithmetic
+    pass: the float math runs first, the integer cast is its own stage."""
+    pipe = FeaturePipeline([Standardize("x", mean=0.0, std=2.0),
+                            Cast("x", np.int64)]).compile(num_schema)
+    assert [s.label for s in pipe._plan] == ["standardize", "cast"]
+    cols = {"id": np.arange(3), "x": np.array([4.0, 6.0, -8.0])}
+    out = pipe.apply_columns(cols)
+    assert out["x"].dtype == np.int64
+    assert out["x"].tolist() == [2, 3, -4]
+    # a clip on an integer source keeps the integer working dtype
+    int_pipe = FeaturePipeline([Clip("a", 0, 5)]).compile(num_schema)
+    got = int_pipe.apply_columns(_cols(8))["a"]
+    assert got.dtype == np.int64 and got.max() <= 5
+
+
+# -- schema derivation ------------------------------------------------------------------
+
+
+def test_transform_schema_consumes_derived_edits(num_schema):
+    pipe = FeaturePipeline(
+        [Normalize("x", min=0, max=1), HashField("a", 10, out="ah")],
+        removed_fields=["y"]).compile(num_schema)
+    out = transform_schema(num_schema, pipe)
+    assert out.x.numpy_dtype == np.float32
+    assert out.ah.numpy_dtype == np.dtype(np.int64)
+    assert "y" not in out.fields
+    pipe2 = FeaturePipeline([HashField("a", 10, out="ah")],
+                            selected_fields=["id", "ah"]).compile(num_schema)
+    assert list(transform_schema(num_schema, pipe2).fields) == ["id", "ah"]
+    cols = pipe2.apply_columns(_cols())
+    assert sorted(cols) == ["ah", "id"]
+
+
+def test_selected_fields_validated_at_compile(num_schema):
+    with pytest.raises(PipelineValidationError, match="selected_fields"):
+        FeaturePipeline([Clip("x", 0, 1)], selected_fields=["ghost"]) \
+            .compile(num_schema)
+
+
+# -- reader integration -----------------------------------------------------------------
+
+
+def _write_plain_parquet(root, rows=256, row_group_size=64):
+    ids = np.arange(rows, dtype=np.int64)
+    tbl = pa.table({
+        "id": ids,
+        "x": ids.astype(np.float64) * 0.5,
+        "y": np.cos(ids.astype(np.float64)),
+        "a": (ids % 13).astype(np.int64),
+    })
+    pq.write_table(tbl, os.path.join(root, "p0.parquet"),
+                   row_group_size=row_group_size)
+    return ids
+
+
+def test_batch_reader_applies_pipeline(tmp_path):
+    from petastorm_tpu.reader import make_batch_reader
+
+    root = str(tmp_path)
+    ids = _write_plain_parquet(root)
+    pipe = FeaturePipeline([Standardize("x", mean=1.0, std=2.0),
+                            HashField("a", 100, out="ah")])
+    with make_batch_reader("file://" + root, reader_pool_type="dummy",
+                           shuffle_row_groups=False, num_epochs=1,
+                           transform_spec=pipe) as reader:
+        assert "ah" in reader.schema.fields  # post-transform schema delivered
+        got = {}
+        for batch in reader:
+            got.update(dict(zip(batch.id.tolist(), batch.ah.tolist())))
+    expect = (_hash_u32_host(ids % 13) % np.uint32(100)).astype(np.int64)
+    assert [got[i] for i in ids.tolist()] == expect.tolist()
+
+
+def test_per_row_reader_matches_equivalent_opaque_func(tmp_path):
+    """Satellite: the per-row path applies the declarative pipeline ONCE over
+    the columnar form — results must equal the per-row func(dict(r)) twin."""
+    from petastorm_tpu.metadata import write_dataset
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu import types as ptypes
+    from petastorm_tpu.codecs import ScalarCodec
+
+    schema = Unischema("R", [
+        UnischemaField("id", np.int64, (), ScalarCodec(ptypes.LongType()), False),
+        UnischemaField("x", np.float64, (), ScalarCodec(ptypes.DoubleType()), False),
+    ])
+    url = "file://" + str(tmp_path)
+    write_dataset(url, schema,
+                  ({"id": i, "x": float(i) * 0.5} for i in range(128)),
+                  rows_per_file=128)
+
+    pipe = FeaturePipeline([Standardize("x", mean=4.0, std=2.0, out="xz")])
+
+    def twin(row):
+        row["xz"] = np.float32((np.float32(row["x"]) - np.float32(4.0))
+                               * np.float32(0.5))
+        return row
+
+    spec = TransformSpec(twin, edit_fields=[("xz", np.float32, (), False)])
+    with make_reader(url, reader_pool_type="dummy", shuffle_row_groups=False,
+                     num_epochs=1, transform_spec=pipe) as r:
+        declarative = {row.id: row.xz for row in r}
+    with make_reader(url, reader_pool_type="dummy", shuffle_row_groups=False,
+                     num_epochs=1, transform_spec=spec) as r:
+        opaque = {row.id: row.xz for row in r}
+    assert sorted(declarative) == sorted(opaque)
+    for rid in declarative:
+        assert np.float32(declarative[rid]) == np.float32(opaque[rid])
+
+
+def test_process_pool_pipeline_pickles_and_matches(tmp_path):
+    from petastorm_tpu.reader import make_batch_reader
+
+    root = str(tmp_path)
+    ids = _write_plain_parquet(root)
+    pipe = FeaturePipeline([Normalize("x", min=0.0, max=127.5),
+                            FeatureCross(("id", "a"), 512, out="xc")])
+    with make_batch_reader("file://" + root, reader_pool_type="process",
+                           workers_count=2, shuffle_row_groups=False,
+                           num_epochs=1, transform_spec=pipe) as reader:
+        got = {}
+        for batch in reader:
+            got.update(dict(zip(batch.id.tolist(), batch.xc.tolist())))
+    expect = FeatureCross(("id", "a"), 512, out="xc") \
+        .apply_multi([ids, ids % 13])
+    assert [got[i] for i in ids.tolist()] == expect.tolist()
+
+
+# -- statistics resolution --------------------------------------------------------------
+
+
+def test_minmax_resolves_from_rowgroup_stats_without_data_pass(tmp_path,
+                                                               monkeypatch):
+    from petastorm_tpu.io import statscache
+    from petastorm_tpu.reader import make_batch_reader
+
+    statscache.clear_memo()
+    root = str(tmp_path)
+    ids = _write_plain_parquet(root)
+
+    def boom(*a, **k):  # the footer tier must suffice — no data reads allowed
+        raise AssertionError("data pre-pass ran for footer-covered min/max")
+
+    monkeypatch.setattr(statscache, "_column_pass", boom)
+    pipe = FeaturePipeline([Normalize("x")])
+    with make_batch_reader("file://" + root, reader_pool_type="dummy",
+                           shuffle_row_groups=False, num_epochs=1,
+                           transform_spec=pipe) as reader:
+        batches = list(reader)
+    assert pipe.stats_info == {"min:x": "rowgroup-stats",
+                               "max:x": "rowgroup-stats"}
+    assert pipe.ops[0].min == 0.0 and pipe.ops[0].max == 127.5
+    all_x = np.concatenate([np.asarray(b.x) for b in batches])
+    assert all_x.min() >= 0.0 and all_x.max() <= 1.0
+
+
+def test_streaming_pass_runs_once_and_memoizes(tmp_path, monkeypatch):
+    from petastorm_tpu.io import statscache
+    from petastorm_tpu.reader import make_batch_reader
+
+    statscache.clear_memo()
+    root = str(tmp_path)
+    ids = _write_plain_parquet(root)
+    calls = []
+    real_pass = statscache._column_pass
+
+    def counting(*a, **k):
+        calls.append(1)
+        return real_pass(*a, **k)
+
+    monkeypatch.setattr(statscache, "_column_pass", counting)
+    url = "file://" + root
+    pipe = FeaturePipeline([Standardize("x", out="xz"),
+                            Bucketize("y", num_buckets=4, out="yb"),
+                            VocabLookup("a", max_size=8, out="av")])
+    with make_batch_reader(url, reader_pool_type="dummy",
+                           shuffle_row_groups=False, num_epochs=1,
+                           transform_spec=pipe) as reader:
+        batches = list(reader)
+    assert len(calls) == 1  # ONE pass covers mean/std + quantiles + vocab
+    assert set(pipe.stats_info.values()) == {"data-pass"}
+    x = ids.astype(np.float64) * 0.5
+    expect = ((x - x.mean()) / x.std()).astype(np.float32)
+    got = np.concatenate([np.asarray(b.xz) for b in batches])
+    assert np.allclose(got, expect, atol=1e-4)
+    yb = np.concatenate([np.asarray(b.yb) for b in batches])
+    assert set(np.unique(yb)) <= {0, 1, 2, 3}
+    # quartile boundaries: roughly balanced buckets
+    counts = np.bincount(yb, minlength=4)
+    assert counts.min() > len(ids) // 8
+    # vocab: 8 most frequent of 13 categories, ids in [0, 8) or -1
+    av = np.concatenate([np.asarray(b.av) for b in batches])
+    assert ((av >= -1) & (av < 8)).all() and (av == -1).any()
+
+    # second reader over the same pieces: memoized, no second pass
+    pipe2 = FeaturePipeline([Standardize("x", out="xz"),
+                             Bucketize("y", num_buckets=4, out="yb"),
+                             VocabLookup("a", max_size=8, out="av")])
+    with make_batch_reader(url, reader_pool_type="dummy",
+                           shuffle_row_groups=False, num_epochs=1,
+                           transform_spec=pipe2) as reader:
+        list(reader)
+    assert len(calls) == 1
+    assert set(pipe2.stats_info.values()) == {"cached"}
+    assert pipe2.ops[0].mean == pipe.ops[0].mean
+
+
+# -- device target ----------------------------------------------------------------------
+
+
+def test_device_pipeline_through_loader_matches_host(tmp_path):
+    """Acceptance: the SAME pipeline compiles to a jittable device fn riding
+    the TransformSpec(device=True) loader seam (CPU jit)."""
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    root = str(tmp_path)
+    ids = _write_plain_parquet(root)
+    ops = lambda: [Standardize("x", mean=1.0, std=4.0),  # noqa: E731
+                   Clip("x", -1.0, 1.0),
+                   HashField("a", 50, out="ah"),
+                   FeatureCross(("id", "a"), 256, out="xc")]
+    host = FeaturePipeline(ops())
+    device = FeaturePipeline(ops(), device=True)
+    url = "file://" + root
+    with make_batch_reader(url, reader_pool_type="dummy",
+                           shuffle_row_groups=False, num_epochs=1,
+                           transform_spec=host) as reader:
+        host_batches = {int(np.asarray(b.id)[0]): b for b in reader}
+    with make_batch_reader(url, reader_pool_type="dummy",
+                           shuffle_row_groups=False, num_epochs=1,
+                           transform_spec=device) as reader:
+        assert reader.transform_spec.device and reader.transform_spec.compiled
+        with DataLoader(reader, 64, last_batch="drop") as loader:
+            for batch in loader:
+                key = int(np.asarray(batch["id"])[0])
+                twin = host_batches[key]
+                assert np.allclose(np.asarray(batch["x"]),
+                                   np.asarray(twin.x), atol=1e-6)
+                assert np.array_equal(np.asarray(batch["ah"]),
+                                      np.asarray(twin.ah))
+                assert np.array_equal(np.asarray(batch["xc"]),
+                                      np.asarray(twin.xc))
+
+
+def test_loader_accepts_pipeline_as_device_transform(tmp_path):
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    root = str(tmp_path)
+    _write_plain_parquet(root)
+    pipe = FeaturePipeline([Standardize("x", mean=0.0, std=1.0, out="xz")])
+    with make_batch_reader("file://" + root, reader_pool_type="dummy",
+                           shuffle_row_groups=False, num_epochs=1) as reader:
+        with DataLoader(reader, 64, last_batch="drop",
+                        device_transform=pipe) as loader:
+            batch = next(iter(loader))
+            assert "xz" in batch
+            assert np.allclose(np.asarray(batch["xz"]),
+                               np.asarray(batch["x"]).astype(np.float32))
+
+
+def test_device_fn_requires_resolved_statistics(num_schema):
+    pipe = FeaturePipeline([Standardize("x")], device=True)
+    with pytest.raises(PipelineValidationError, match="statistics"):
+        pipe.device_fn(num_schema)
+
+
+def test_ngram_reader_rejects_declarative_device_transform(tmp_path):
+    """NGram batches are keyed 'offset/field' — a pipeline written against
+    schema field names must be refused up front, not KeyError inside jit."""
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.metadata import write_dataset
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu import types as ptypes
+    from petastorm_tpu.codecs import ScalarCodec
+
+    ts = UnischemaField("ts", np.int64, (), ScalarCodec(ptypes.LongType()),
+                        False)
+    val = UnischemaField("v", np.float64, (), ScalarCodec(ptypes.DoubleType()),
+                         False)
+    url = "file://" + str(tmp_path)
+    write_dataset(url, Unischema("N", [ts, val]),
+                  ({"ts": i, "v": float(i)} for i in range(32)),
+                  rows_per_file=32)
+    ngram = NGram({0: [ts, val], 1: [ts, val]}, delta_threshold=1,
+                  timestamp_field=ts)
+    with make_batch_reader(url, schema_fields=ngram,
+                           reader_pool_type="dummy", num_epochs=1) as reader:
+        with pytest.raises(ValueError, match="offset/field"):
+            DataLoader(reader, 8, device_transform=FeaturePipeline(
+                [Standardize("v", mean=0.0, std=1.0)]))
+
+
+def test_stats_fingerprint_tracks_file_content(tmp_path):
+    """Regenerating a dataset in place (same names/layout, new values) must
+    invalidate the memoized statistics pass."""
+    from petastorm_tpu.io import statscache
+    from petastorm_tpu.reader import make_batch_reader
+
+    statscache.clear_memo()
+    root = str(tmp_path)
+    url = "file://" + root
+
+    def write(scale):
+        ids = np.arange(256, dtype=np.int64)
+        pq.write_table(pa.table({"id": ids,
+                                 "x": ids.astype(np.float64) * scale}),
+                       os.path.join(root, "p0.parquet"), row_group_size=64)
+
+    def mean_of_open(pipe):
+        with make_batch_reader(url, reader_pool_type="dummy", num_epochs=1,
+                               transform_spec=pipe) as r:
+            list(r)
+        return pipe.ops[0].mean
+
+    write(1.0)
+    m1 = mean_of_open(FeaturePipeline([Standardize("x", out="xz")]))
+    write(10.0)  # same file name, same row count, different values
+    m2 = mean_of_open(FeaturePipeline([Standardize("x", out="xz")]))
+    assert m2 == pytest.approx(m1 * 10.0)
+
+
+# -- writable contract / census (satellite 1) -------------------------------------------
+
+
+def test_declarative_pipeline_keeps_readonly_cache_contract(tmp_path):
+    """The narrowed writable-batch request: a declarative pipeline keeps the
+    zero-copy read-only memcache serving contract (zero memcache_cow bytes on
+    the warm epoch); the opaque pandas callable still escalates — and its
+    copy is charged to the census."""
+    from petastorm_tpu.io.lease import copy_census
+    from petastorm_tpu.io.memcache import shared_store
+    from petastorm_tpu.reader import make_batch_reader
+
+    root = str(tmp_path)
+    _write_plain_parquet(root)
+    url = "file://" + root
+    io_opts = {"memcache_bytes": 32 << 20}
+
+    def run(spec):
+        shared_store().clear()
+        try:
+            # cold epoch fills the cache; the warm epoch is the probe
+            for _ in range(2):
+                before = copy_census()
+                with make_batch_reader(url, reader_pool_type="dummy",
+                                       shuffle_row_groups=False, num_epochs=1,
+                                       io_options=io_opts,
+                                       transform_spec=spec) as reader:
+                    for _batch in reader:
+                        pass
+            after = copy_census()
+            return after.get("memcache_cow", 0) - before.get("memcache_cow", 0)
+        finally:
+            shared_store().clear()
+
+    declarative_cow = run(FeaturePipeline([Standardize("x", mean=0, std=1)]))
+    assert declarative_cow == 0
+
+    def twin(df):
+        df["x"] = (df["x"] - 0.0) * 1.0
+        return df
+
+    opaque_cow = run(TransformSpec(
+        twin, edit_fields=[("x", np.float64, (), False)]))
+    assert opaque_cow > 0
+
+
+def test_leased_batch_escalates_one_column_via_cow(num_schema):
+    """A LeasedBatch input is transformed inside its own container: the ONE
+    mutated column escalates through writable() (counted as a lease CoW);
+    untouched columns stay read-only zero-copy views under the lease."""
+    from petastorm_tpu.io.lease import (
+        Lease,
+        LeasedBatch,
+        lease_stats,
+        readonly_view,
+    )
+
+    pipe = FeaturePipeline([Clip("x", 0.0, 2.0)]).compile(num_schema)
+    lease = Lease(kind="test")
+    batch = LeasedBatch(readonly_view(_cols(16)), leases=(lease,))
+    cow_before = lease_stats()["cow"]
+    out = pipe.apply_columns(batch)
+    assert out is batch  # stays the lease container
+    assert lease_stats()["cow"] == cow_before + 1
+    assert out["x"].flags.writeable and out["x"].max() <= 2.0
+    assert not out["id"].flags.writeable  # untouched: still the leased view
+    batch.release()
+
+
+def test_spec_wants_writable_narrowing(num_schema):
+    from petastorm_tpu.reader import _spec_wants_writable
+
+    assert not _spec_wants_writable(None)
+    assert not _spec_wants_writable(
+        FeaturePipeline([Clip("x", 0, 1)]).compile(num_schema))
+    assert not _spec_wants_writable(TransformSpec(func=None))
+    assert not _spec_wants_writable(TransformSpec(lambda df: df, device=True))
+    assert _spec_wants_writable(TransformSpec(lambda df: df))
+
+
+# -- observability ----------------------------------------------------------------------
+
+
+def test_transform_op_metrics_recorded(num_schema):
+    from petastorm_tpu.obs.metrics import default_registry
+    from petastorm_tpu.ops.tabular import transform_op_stats
+
+    pipe = FeaturePipeline([Normalize("x", min=0, max=1), Clip("x", 0, 1),
+                            HashField("a", 8, out="ah")]).compile(num_schema)
+    pipe.apply_columns(_cols(32))
+    stats = transform_op_stats()
+    assert stats.get("normalize+clip", {}).get("count", 0) >= 1
+    assert stats.get("hash", {}).get("count", 0) >= 1
+    snap = default_registry().snapshot()
+    assert snap.get("ptpu_transform_rows_total", 0) >= 32
+    assert any(k.startswith("ptpu_transform_seconds") for k in snap)
+
+
+def test_bottleneck_report_shows_transform_ops(tmp_path):
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    root = str(tmp_path)
+    _write_plain_parquet(root)
+    pipe = FeaturePipeline([Standardize("x", mean=0.0, std=2.0)])
+    with make_batch_reader("file://" + root, reader_pool_type="dummy",
+                           shuffle_row_groups=False, num_epochs=1,
+                           transform_spec=pipe) as reader:
+        with DataLoader(reader, 64, to_device=False,
+                        last_batch="drop") as loader:
+            for _ in loader:
+                pass
+            report = loader.bottleneck_report()
+    assert report.transform_ops and "standardize" in report.transform_ops
+    assert "standardize" in report.render()
+
+
+# -- NGram columnar transform ----------------------------------------------------------
+
+
+def test_ngram_window_transform_batched_equivalence(tmp_path):
+    """Satellite: with an NGram the declarative transform runs once over the
+    window's columnar form; windows must equal the per-row opaque twin's."""
+    from petastorm_tpu.metadata import write_dataset
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.reader import make_reader
+    from petastorm_tpu import types as ptypes
+    from petastorm_tpu.codecs import ScalarCodec
+
+    ts = UnischemaField("ts", np.int64, (), ScalarCodec(ptypes.LongType()),
+                        False)
+    val = UnischemaField("v", np.float64, (), ScalarCodec(ptypes.DoubleType()),
+                         False)
+    schema = Unischema("N", [ts, val])
+    url = "file://" + str(tmp_path)
+    write_dataset(url, schema,
+                  ({"ts": i, "v": float(i)} for i in range(64)),
+                  rows_per_file=64)
+
+    def make_ngram():
+        return NGram({0: [ts, val], 1: [ts, val]}, delta_threshold=1,
+                     timestamp_field=ts)
+
+    pipe = FeaturePipeline([Standardize("v", mean=2.0, std=4.0)])
+
+    def twin(row):
+        row["v"] = np.float32((np.float32(row["v"]) - np.float32(2.0))
+                              * np.float32(0.25))
+        return row
+
+    spec = TransformSpec(twin, edit_fields=[("v", np.float32, (), False)])
+    with make_reader(url, schema_fields=make_ngram(),
+                     reader_pool_type="dummy", shuffle_row_groups=False,
+                     num_epochs=1, transform_spec=pipe) as r:
+        declarative = [{o: w[o].v for o in w} for w in r]
+    with make_reader(url, schema_fields=make_ngram(),
+                     reader_pool_type="dummy", shuffle_row_groups=False,
+                     num_epochs=1, transform_spec=spec) as r:
+        opaque = [{o: w[o].v for o in w} for w in r]
+    assert len(declarative) == len(opaque) > 0
+    for d, o in zip(declarative, opaque):
+        for offset in d:
+            assert np.float32(d[offset]) == np.float32(o[offset])

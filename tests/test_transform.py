@@ -50,3 +50,44 @@ def test_selected_fields(schema):
 def test_device_flag(schema):
     assert TransformSpec(func=lambda b: b, device=True).device
     assert not TransformSpec(func=lambda b: b).device
+
+
+def test_edit_fields_four_tuple_matches_unischema_field_form(schema):
+    """The reference 4-tuple contract (name, dtype, shape, nullable) and a
+    full UnischemaField must produce identical schema edits."""
+    tup = transform_schema(
+        schema, TransformSpec(edit_fields=[("x", np.float32, (8,), True)]))
+    field = transform_schema(
+        schema, TransformSpec(
+            edit_fields=[UnischemaField("x", np.float32, (8,), None, True)]))
+    assert tup.x == field.x
+    assert tup.x.codec is None and tup.x.nullable
+    assert list(tup.fields) == list(field.fields)
+
+
+def test_edit_fields_rejects_non_tuple_entries():
+    with pytest.raises(ValueError, match="edit_fields"):
+        TransformSpec(edit_fields=["just-a-name"])
+
+
+def test_selected_fields_missing_name_lists_every_absentee(schema):
+    with pytest.raises(ValueError) as e:
+        transform_schema(
+            schema, TransformSpec(selected_fields=["id", "ghost", "wraith"]))
+    assert "ghost" in str(e.value) and "wraith" in str(e.value)
+
+
+def test_removed_then_edited_field_precedence(schema):
+    """Removals apply BEFORE edits: a field named in both removed_fields and
+    edit_fields comes back with the edited declaration (the contract the
+    declarative planner relies on when an op replaces a removed input)."""
+    spec = TransformSpec(removed_fields=["x"],
+                         edit_fields=[("x", np.float32, (8,), False)])
+    out = transform_schema(schema, spec)
+    assert "x" in out.fields
+    assert out.x.numpy_dtype == np.float32 and out.x.shape == (8,)
+    # and the edited re-add survives selection
+    spec2 = TransformSpec(removed_fields=["x"],
+                          edit_fields=[("x", np.float32, (8,), False)],
+                          selected_fields=["x"])
+    assert list(transform_schema(schema, spec2).fields) == ["x"]
