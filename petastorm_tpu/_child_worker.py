@@ -107,6 +107,14 @@ def main():
         from petastorm_tpu import chaos as _chaos
 
         _chaos.arm_from_env(in_child=True)
+        # provenance (ISSUE 10): children always record their per-item causal
+        # spans (a handful of perf_counter pairs per row-group item — the same
+        # always-on justification as the trace piggyback above) and ship them
+        # in slot 5 of the trace blob; the parent merges them only when a
+        # ProvenanceRecorder is attached, discarding otherwise.
+        from petastorm_tpu.obs import provenance as _prov
+
+        _prov.arm_child()
         prefetch = getattr(worker, "prefetch", None)
         while True:
             if ping_s:
@@ -132,25 +140,39 @@ def main():
                 # issue the driver's claimed-next reads on this child's IO pool
                 # before working the item — the prefetch itself never raises
                 prefetch(hints)
+            _prov.begin_item(item)
+            prov_blob = None
             try:
-                t0 = time.perf_counter()
-                if _chaos.ACTIVE is not None:
-                    _chaos.ACTIVE.hit("child.item", key=_chaos.item_key(item))
-                result = worker(item)
-                t1 = time.perf_counter()
-                kind, frames = serializer.serialize(result)
-                t2 = time.perf_counter()
-            except Exception as e:  # noqa: BLE001 - ship to parent
                 try:
-                    pickle.dumps(e)
-                    conn.send(("exc", e))
-                except Exception:  # unpicklable exception: reconstruct
-                    conn.send(("exc", RuntimeError("%s: %s" % (type(e).__name__, e))))
-                continue
+                    t0 = time.perf_counter()
+                    if _chaos.ACTIVE is not None:
+                        _chaos.ACTIVE.hit("child.item", key=_chaos.item_key(item))
+                    result = worker(item)
+                    t1 = time.perf_counter()
+                    kind, frames = serializer.serialize(result)
+                    t2 = time.perf_counter()
+                    # mirrored into the provenance record so the parent's
+                    # wire.roundtrip span folds to wire overhead only (the
+                    # finer reader/transform spans nest inside child.work)
+                    _prov.add_span("child.work", t0, t1 - t0)
+                    _prov.add_span("child.serialize", t1, t2 - t1)
+                except Exception as e:  # noqa: BLE001 - ship to parent
+                    try:
+                        pickle.dumps(e)
+                        conn.send(("exc", e))
+                    except Exception:  # unpicklable exception: reconstruct
+                        conn.send(("exc", RuntimeError(
+                            "%s: %s" % (type(e).__name__, e))))
+                    continue
+            finally:
+                # end_item returns the piggyback blob (epoch, ordinal, spans,
+                # annotations) — collected on EVERY exit path so a failed
+                # attempt's context never bleeds into the next item (GL-O003)
+                prov_blob = _prov.end_item()
             spans = [("child.work", t0, t1 - t0),
                      ("child.serialize", t1, t2 - t1)]
             conn.send(("ok", kind, len(frames),
-                       (pid, wall_anchor, perf_anchor, spans)))
+                       (pid, wall_anchor, perf_anchor, spans, prov_blob)))
             for frame in frames:
                 conn.send_bytes(frame)
     except (EOFError, BrokenPipeError, ConnectionResetError):

@@ -6,7 +6,10 @@ from petastorm_tpu.analysis.rules.concurrency import (
 )
 from petastorm_tpu.analysis.rules.hotpath import WallClockDurationRule
 from petastorm_tpu.analysis.rules.lifecycle import ResourceLifecycleRule
-from petastorm_tpu.analysis.rules.observability import SilentExceptionSwallowRule
+from petastorm_tpu.analysis.rules.observability import (
+    SilentExceptionSwallowRule,
+    UnpairedSpanRule,
+)
 from petastorm_tpu.analysis.rules.robustness import UnboundedBlockingCallRule
 from petastorm_tpu.analysis.rules.schema import SchemaCodecContractRule
 from petastorm_tpu.analysis.rules.tracing import (
@@ -27,6 +30,7 @@ ALL_RULES = [
     SchemaCodecContractRule,
     WallClockDurationRule,
     SilentExceptionSwallowRule,
+    UnpairedSpanRule,
     UnboundedBlockingCallRule,
 ]
 

@@ -43,6 +43,124 @@ def _is_broad(type_node):
     return False
 
 
+#: provenance/trace span-open calls paired with their mandatory closers:
+#: ``begin_item`` arms a THREAD-GLOBAL item context — left open it
+#: misattributes every later span on that thread to the wrong item;
+#: ``open_span`` returns a handle whose ``close()`` records the span — left
+#: open the region silently never appears in any attribution report.
+_SPAN_OPENERS = {"begin_item": "end_item", "open_span": "close"}
+
+
+def _call_name(node):
+    """Trailing identifier of a call's func (``x.y.begin_item`` → begin_item)."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _finally_calls(scope_body):
+    """Every call name appearing inside ANY ``finally`` block of the scope
+    (nested function defs excluded — their finallys protect their own opens),
+    plus the receiver names of attribute calls (``h.close()`` → ``h``)."""
+    names = set()
+    receivers = set()
+    for node in _walk_scope(scope_body):
+        if not isinstance(node, ast.Try) or not node.finalbody:
+            continue
+        for stmt in node.finalbody:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    name = _call_name(sub)
+                    if name:
+                        names.add(name)
+                    if isinstance(sub.func, ast.Attribute) and \
+                            isinstance(sub.func.value, ast.Name):
+                        receivers.add((sub.func.value.id, sub.func.attr))
+    return names, receivers
+
+
+def _walk_scope(body):
+    """Walk statements of one function scope WITHOUT descending into nested
+    function/class definitions (each is its own span-pairing scope)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue  # a nested scope: its opens/finallys are its own
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class UnpairedSpanRule(Rule):
+    """GL-O003: a trace/provenance span opened without a finally-guarded close.
+
+    ``provenance.begin_item(...)`` must be paired with ``end_item()`` in a
+    ``finally`` block of the same function, and an ``open_span(...)`` handle
+    must be assigned and ``<handle>.close()``'d in a ``finally`` (or opened as
+    a ``with`` context). An exception between open and close otherwise leaks
+    the thread's item context (every later span on that thread lands on the
+    WRONG item) or silently drops the span from the attribution report — the
+    observability analog of a leaked resource, enforced statically like
+    GL-L001's closers."""
+
+    rule_id = "GL-O003"
+    severity = Severity.WARNING
+    description = ("trace/provenance span opened without a finally-guarded "
+                   "close (begin_item without end_item in a finally; "
+                   "open_span handle without .close() in a finally)")
+    fix_hint = ("wrap the region in try/finally with end_item()/"
+                "<handle>.close() in the finally (or use the `with "
+                "provenance.span(...)` context manager), or justify with an "
+                "inline '# graftlint: disable=GL-O003' comment")
+
+    def check(self, tree, ctx):
+        scopes = [tree.body]
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            yield from self._check_scope(body, ctx)
+
+    def _check_scope(self, body, ctx):
+        with_exprs = set()
+        assigned_to = {}  # open-call node -> assigned simple name (or None)
+        opens = []
+        for node in _walk_scope(body):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    with_exprs.add(id(item.context_expr))
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name):
+                    assigned_to[id(node.value)] = node.targets[0].id
+            if isinstance(node, ast.Call) and _call_name(node) in _SPAN_OPENERS:
+                opens.append(node)
+        if not opens:
+            return
+        closer_names, closer_receivers = _finally_calls(body)
+        for call in opens:
+            if id(call) in with_exprs:
+                continue  # `with open_span(...)`-style: closed by __exit__
+            opener = _call_name(call)
+            closer = _SPAN_OPENERS[opener]
+            if opener == "begin_item":
+                if closer in closer_names:
+                    continue
+            else:  # open_span: the HANDLE must be closed
+                name = assigned_to.get(id(call))
+                if name is not None and (name, closer) in closer_receivers:
+                    continue
+            yield ctx.finding(
+                self, call,
+                "%s(...) is not paired with a finally-guarded %s — an "
+                "exception here leaks the span/item context and poisons "
+                "every later attribution on this thread" % (opener, closer))
+
+
 class SilentExceptionSwallowRule(Rule):
     """GL-O002: ``except Exception: pass`` / bare ``except: pass``."""
 

@@ -106,6 +106,23 @@ def main(argv=None):
         from petastorm_tpu.benchmark import health as health_bench
 
         return health_bench.main(argv[1:])
+    if argv and argv[0] == "attribution":
+        # `petastorm-tpu-bench attribution ...`: the provenance acceptance
+        # harness — inject a known bottleneck (remote tail / slow transform /
+        # wire stall) and assert the critical-path attribution report names
+        # that culprit, with cross-pid span merge and the on/off overhead
+        # measurement — see benchmark/attribution.py
+        from petastorm_tpu.benchmark import attribution as attribution_bench
+
+        return attribution_bench.main(argv[1:])
+    if argv and argv[0] == "trend":
+        # `petastorm-tpu-bench trend ...`: the CI throughput-regression gate —
+        # median rows/s of a fixed synthetic workload appended to
+        # BENCH_HISTORY.jsonl and compared against the stored median — see
+        # benchmark/trend.py
+        from petastorm_tpu.benchmark import trend as trend_bench
+
+        return trend_bench.main(argv[1:])
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("dataset_url")
     parser.add_argument("--batch", action="store_true",

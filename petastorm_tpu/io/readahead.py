@@ -30,6 +30,7 @@ import weakref
 from collections import OrderedDict
 
 from petastorm_tpu.io.coalesce import plan_runs
+from petastorm_tpu.obs import provenance as _prov
 from petastorm_tpu.obs.log import degradation
 from petastorm_tpu.obs.metrics import default_registry
 
@@ -99,7 +100,7 @@ class _CancelledRead(Exception):
 
 
 class _Entry:
-    __slots__ = ("event", "table", "error", "nbytes", "claimed")
+    __slots__ = ("event", "table", "error", "nbytes", "claimed", "read_span")
 
     def __init__(self):
         self.event = threading.Event()
@@ -107,6 +108,10 @@ class _Entry:
         self.error = None
         self.nbytes = 0
         self.claimed = False
+        #: (t0, dur) of the background read that filled this entry — attached
+        #: to the claiming item's provenance record (ISSUE 10), so a batch's
+        #: attribution sees WHEN its bytes were actually read
+        self.read_span = None
 
 
 def request_key(piece, columns):
@@ -332,6 +337,7 @@ class ReadaheadPool:
                 else:
                     entry.table = tables[i]
                     entry.nbytes = getattr(tables[i], "nbytes", 0)
+                    entry.read_span = (t0, dur)
                     self._held_bytes += entry.nbytes
                 entry.event.set()
             self._evict_over_budget()
@@ -383,6 +389,8 @@ class ReadaheadPool:
             if entry is None or entry.claimed:
                 self._n_misses += 1
                 self._misses.inc()
+                if _prov.ACTIVE is not None:
+                    _prov.annotate("readahead", "miss")
                 return None
             entry.claimed = True
         t0 = time.perf_counter()
@@ -399,6 +407,16 @@ class ReadaheadPool:
                 self._bytes_gauge.set(self._held_bytes)
                 self._n_hits += 1
                 self._hits.inc()
+                if _prov.ACTIVE is not None:
+                    # the background read's span (overlapped with earlier
+                    # items' decode — the fold charges only serialized time)
+                    # plus the claimer's residual wait, on the claiming item
+                    _prov.annotate("readahead", "hit")
+                    if entry.read_span is not None:
+                        _prov.add_span("io.readahead", entry.read_span[0],
+                                       entry.read_span[1])
+                    if wait > 1e-6:
+                        _prov.add_span("io.readahead_wait", t0, wait)
                 return entry.table
         if not completed:
             # hung background read: abandon the entry (its late completion is

@@ -40,6 +40,7 @@ import time
 
 from petastorm_tpu.io import _env_bool, _env_float, _env_int
 from petastorm_tpu.io.coalesce import plan_byte_ranges, slice_ranges
+from petastorm_tpu.obs import provenance as _prov
 from petastorm_tpu.obs.log import degradation
 from petastorm_tpu.obs.metrics import default_registry
 
@@ -438,23 +439,24 @@ class RemoteReadEngine:
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        entry = self.footer(path)
-        md = entry.metadata
-        if columns is not None:
-            available = set(md.schema.to_arrow_schema().names)
-            columns = [c for c in columns if c in available]
-        ranges = column_chunk_ranges(md, row_groups, columns)
-        plan = plan_byte_ranges(ranges, self._opts.min_gap_bytes,
-                                self._opts.target_request_bytes)
-        chunks = list(zip((off for off, _ in plan),
-                          self.fetch_ranges(path, plan)))
-        size = entry.size
-        if size is None:
-            size = int(self._fs.get_file_info(path).size)
-        src = _SparseFile(path, size, chunks, self)
-        pf = pq.ParquetFile(pa.PythonFile(src, mode="r"), metadata=md)
-        table = pf.read_row_groups(list(row_groups), columns=columns)
-        return table, entry
+        with _prov.span("io.remote"):  # GETs + stitch, nested in reader.read
+            entry = self.footer(path)
+            md = entry.metadata
+            if columns is not None:
+                available = set(md.schema.to_arrow_schema().names)
+                columns = [c for c in columns if c in available]
+            ranges = column_chunk_ranges(md, row_groups, columns)
+            plan = plan_byte_ranges(ranges, self._opts.min_gap_bytes,
+                                    self._opts.target_request_bytes)
+            chunks = list(zip((off for off, _ in plan),
+                              self.fetch_ranges(path, plan)))
+            size = entry.size
+            if size is None:
+                size = int(self._fs.get_file_info(path).size)
+            src = _SparseFile(path, size, chunks, self)
+            pf = pq.ParquetFile(pa.PythonFile(src, mode="r"), metadata=md)
+            table = pf.read_row_groups(list(row_groups), columns=columns)
+            return table, entry
 
     def arrow_names(self, path):
         """Column names of ``path``'s arrow schema — from the cached footer,
@@ -535,6 +537,10 @@ class RemoteReadEngine:
                     self._hedges.inc()
                     with self._lock:
                         self._n["hedges"] += 1
+                    if _prov.ACTIVE is not None:
+                        # supervision runs on the item's own thread, so the
+                        # annotation binds to the right record exactly
+                        _prov.annotate_add("hedges", 1)
                     self._submit_attempt(state, path, offset, length, "hedge")
                 continue
             next_wake = timeout_at
@@ -546,6 +552,8 @@ class RemoteReadEngine:
         # deliver it rather than strand its lease and raise
         data = state.take()
         if data is not None:
+            if _prov.ACTIVE is not None and state.winner_role == "hedge":
+                _prov.annotate_add("hedge_wins", 1)
             return data
         if state.errors:
             raise state.errors[-1]

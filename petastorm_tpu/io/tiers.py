@@ -33,6 +33,7 @@ from __future__ import annotations
 
 from petastorm_tpu.cache import CacheBase, NullCache
 from petastorm_tpu.io.memcache import payload_nbytes
+from petastorm_tpu.obs import provenance as _prov
 from petastorm_tpu.obs.metrics import default_registry
 
 TIERS = ("mem", "disk", "remote")
@@ -129,6 +130,8 @@ class TieredCache(CacheBase):
         else:
             value = self._through_disk(key, fill_cache_func, served)
         self._count(served[0], value)
+        if _prov.ACTIVE is not None:  # which tier fed this item (ISSUE 10)
+            _prov.annotate("cache_tier", served[0])
         return value
 
     def get_writable(self, key, fill_cache_func):
@@ -141,6 +144,8 @@ class TieredCache(CacheBase):
         else:
             value = self._through_disk(key, fill_cache_func, served)
         self._count(served[0], value)
+        if _prov.ACTIVE is not None:
+            _prov.annotate("cache_tier", served[0])
         return value
 
     def contains(self, key):

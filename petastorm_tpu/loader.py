@@ -204,6 +204,15 @@ class _LoaderObs:
             io_ref = weakref.WeakMethod(io_stats_fn)
             self._handles.append(registry.register_collector(
                 "io", lambda: (io_ref() or dict)()))
+        prov = getattr(loader, "_prov_rec", None)
+        if prov is not None:
+            # provenance plane (ISSUE 10): item/batch counts + per-site
+            # critical-path self seconds as ptpu_prov_* (rendered by the
+            # petastorm-tpu-stats attribution panel)
+            prov_ref = weakref.ref(prov)
+            self._handles.append(registry.register_collector(
+                "prov", lambda: (lambda r: r.summary() if r is not None
+                                 else {})(prov_ref())))
 
     def observe(self, stage, dur):
         self._hists[stage].observe(dur)
@@ -612,13 +621,30 @@ class DataLoader:
         ``device_put`` aliases — recycled slabs would corrupt delivered
         arrays); ``False`` disables; an ``int`` forces it on with that slab
         size in bytes (otherwise sized from the first staged batch).
+    provenance : True or petastorm_tpu.obs.provenance.ProvenanceRecorder, optional
+        Causal per-item provenance (ISSUE 10): every dispatched row group
+        accumulates ``(site, t_start, t_end, pid)`` spans and annotations
+        (cache tier served from, hedges fired/won, retries, quarantine)
+        through the whole pipeline — pool children included, via the
+        result-header piggyback — and each delivered batch knows its
+        contributing items. ``DataLoader.batch_provenance()`` returns the
+        latest batch's record; ``DataLoader.attribution_report()`` folds the
+        window into a critical-path step-time attribution (which SITE owns
+        the p99 batch). ``True`` builds a recorder; pass an existing
+        :class:`~petastorm_tpu.obs.provenance.ProvenanceRecorder` to share
+        one. One provenance-enabled loader per process at a time (the item
+        hooks are a process-global plane, like the chaos plan).
+        ``PTPU_PROVENANCE=1`` enables it without code changes. Default None =
+        disabled, one module-global ``is None`` check per site. Batch↔item
+        attribution is unavailable under shuffling (rows decorrelate from row
+        groups); per-item records still collect.
     """
 
     def __init__(self, reader, batch_size, sharding=None, shuffling_queue_capacity=0,
                  seed=None, last_batch="drop", device_transform=None, prefetch=2,
                  to_device=True, host_queue_size=8, pad_shapes=None,
                  device_shuffle_capacity=0, device_decode_resize=None, trace=None,
-                 metrics=None, health=None, staging=None):
+                 metrics=None, health=None, staging=None, provenance=None):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if last_batch not in ("drop", "pad", "partial"):
@@ -770,6 +796,38 @@ class DataLoader:
             if hasattr(reader, "set_health"):
                 reader.set_health(self._health_scope)
             monitor.start()
+        #: optional causal provenance plane (ISSUE 10; None = disabled): one
+        #: ProvenanceRecorder collecting per-item spans across every seam —
+        #: armed process-globally (worker threads + IO hooks), attached to the
+        #: reader (delivery/quarantine notes, pool-child span merge), and fed
+        #: batch-plane spans by the producer/transfer/consumer hooks below.
+        self._prov_rec = None
+        self._prov_owned = False
+        #: a recorder the READER factory already attached (provenance= on
+        #: make_reader/make_batch_reader, or PTPU_PROVENANCE) is adopted: it
+        #: was armed BEFORE the executor started, so it saw every item — a
+        #: loader-built recorder attached now can miss items a small plan
+        #: already drained through the pool (still fine for long streams)
+        from petastorm_tpu.obs import provenance as _prov_mod
+
+        existing = getattr(reader, "_prov", None)
+        if isinstance(provenance, _prov_mod.ProvenanceRecorder):
+            rec = provenance.arm()  # caller-owned: stays armed past __exit__
+        elif existing is not None:
+            rec = existing.arm()  # reader-owned: reader.join() disarms
+        else:
+            # None/True + the PTPU_PROVENANCE env switch, one copy of the
+            # policy; a recorder built HERE is this loader's to disarm
+            rec = _prov_mod.resolve(provenance)
+            self._prov_owned = rec is not None
+        if rec is not None:
+            if trace is not None:
+                rec.set_trace(trace)  # Perfetto flow events into the dump
+            rec.set_batch_tracking(not shuffling_queue_capacity
+                                   and not self._device_shuffle_capacity)
+            if hasattr(reader, "set_provenance") and existing is not rec:
+                reader.set_provenance(rec)
+            self._prov_rec = rec
         #: optional petastorm_tpu.obs wiring (None = disabled, the default):
         #: stage latency histograms + pull collectors for the stats/wire gauges
         self._obs = None
@@ -908,6 +966,7 @@ class DataLoader:
                 ready = batcher.add(columns, lease)
                 dt = time.perf_counter() - t0
                 stats.batch_s += dt
+                collate_span = (t0, dt)
                 if self._trace is not None:
                     self._trace.add("batch.form", t0, dt)
                 if self._obs is not None:
@@ -932,7 +991,8 @@ class DataLoader:
                         self._ckpt_record(ckpt_cum)
                         ckpt_next_snap = ckpt_deliveries \
                             + max(1, ckpt_deliveries // 512)
-                if not self._deliver_batches(q, ready, hb):
+                if not self._deliver_batches(q, ready, hb,
+                                             collate_span=collate_span):
                     return
             # tail flush: the same per-batch stop check as the main loop — a stop()
             # during the flush must not leave the producer blocked on an untimed put
@@ -957,7 +1017,7 @@ class DataLoader:
             self._hb_producer = None
             _put_sentinel(q, self._stop)
 
-    def _put_batch(self, q, batch, hb=None):
+    def _put_batch(self, q, batch, hb=None, bp=None):
         """Producer put into the host queue, timed: blocking here is DOWNSTREAM
         backpressure (decode/transfer/step slower than the producer) — the
         bottleneck analyzer's consumer-bound signal (``put_wait_s``) and, for
@@ -973,26 +1033,49 @@ class DataLoader:
             self._trace.add("wait.host_queue_put", t0, dt)
         if self._obs is not None:
             self._obs.observe("host_queue_put", dt)
+        if bp is not None:
+            self._prov_rec.batch_span(bp, "loader.host_queue_put", t0, dt)
         if hb is not None:
             hb.beat("batch")
         return ok
 
-    def _deliver_batches(self, q, batches, hb, drop_short=False):
+    def _deliver_batches(self, q, batches, hb, drop_short=False,
+                         collate_span=None):
         """Push cut batches into the host queue, padding per ``last_batch``.
         Returns False once the loader is stopped (or the put gives up); on any
         early exit — and for a ``drop_short`` tail — the undelivered batches'
-        leases are released so teardown never strands a slab hold until GC."""
+        leases are released so teardown never strands a slab hold until GC.
+
+        Provenance (ISSUE 10): each batch opens its BatchProvenance here —
+        membership attributed from the delivery FIFO, the collate span split
+        across the cut set — and a batch that dies on this path is retired so
+        the transfer/delivery pointers stay aligned."""
+        rec = self._prov_rec
+        collate_t0 = collate_share = None
+        if rec is not None and collate_span is not None and batches:
+            collate_t0 = collate_span[0]
+            collate_share = collate_span[1] / len(batches)
         for i, batch in enumerate(batches):
+            bp = None
+            if rec is not None:
+                bp = rec.producer_cut(_batch_row_count(batch), collate_t0,
+                                      collate_share)
             if self._stop.is_set():
+                if bp is not None:
+                    rec.batch_dropped(bp)
                 for b in batches[i:]:
                     _release_leases(b)
                 return False
             if drop_short and _batch_row_count(batch) < self.local_batch_size:
+                if bp is not None:
+                    rec.batch_dropped(bp)
                 _release_leases(batch)
                 continue
             if self.last_batch == "pad":
                 batch = self._pad(batch)
-            if not self._put_batch(q, batch, hb):
+            if not self._put_batch(q, batch, hb, bp):
+                if bp is not None:
+                    rec.batch_dropped(bp)
                 _release_leases(batch)
                 for b in batches[i + 1:]:
                     _release_leases(b)
@@ -1305,6 +1388,11 @@ class DataLoader:
         import jax
 
         hb = self._hb_transfer
+        rec = self._prov_rec
+        if rec is not None:
+            # host batches flow to this thread strictly FIFO: advance the
+            # recorder's transfer pointer to this batch's provenance
+            rec.transfer_next()
         if hb is not None:
             hb.beat("decode")
         t0 = time.perf_counter()
@@ -1315,6 +1403,8 @@ class DataLoader:
             self._trace.add("decode.dispatch", t0, dt)
         if self._obs is not None:
             self._obs.observe("decode", dt)
+        if rec is not None:
+            rec.transfer_span("loader.decode", t0, dt)
         if hb is not None:
             hb.beat("h2d")
         t0 = time.perf_counter()
@@ -1386,6 +1476,8 @@ class DataLoader:
             self._trace.add("h2d.transfer", t0, dt)
         if self._obs is not None:
             self._obs.observe("h2d", dt)
+        if rec is not None:
+            rec.transfer_span("loader.h2d", t0, dt)
         return arrays, host
 
     def _apply_device_transform(self, arrays):
@@ -1476,6 +1568,8 @@ class DataLoader:
                     rest, staged = self._decode_staged(batch)
                     rest.update({k: np.asarray(v) for k, v in staged.items()})
                     self._advance_consumed(_batch_valid_rows(rest))
+                    if self._prov_rec is not None:
+                        self._prov_rec.batch_delivered()
                     yield rest
             else:
                 # lease-backed batches stay valid until the consumer asks for
@@ -1489,6 +1583,8 @@ class DataLoader:
                             prev.release()
                         prev = batch if isinstance(batch, LeasedBatch) else None
                         self._advance_consumed(_batch_valid_rows(batch))
+                        if self._prov_rec is not None:
+                            self._prov_rec.batch_delivered()
                         yield batch
                 finally:
                     if prev is not None:
@@ -1497,6 +1593,8 @@ class DataLoader:
         if self.prefetch <= 0:  # synchronous transfer (debug)
             for batch, local_rows in self._device_batches(host_q):
                 self._advance_consumed(local_rows)
+                if self._prov_rec is not None:
+                    self._prov_rec.batch_delivered()
                 yield batch
             return
         # Async transfer thread: host batches → decode dispatch + device_put → a small
@@ -1574,6 +1672,8 @@ class DataLoader:
                     return
                 batch, local_rows = item
                 self._advance_consumed(local_rows)
+                if self._prov_rec is not None:
+                    self._prov_rec.batch_delivered()
                 yield batch
         finally:
             if not finished and gen == self._generation:
@@ -1622,6 +1722,14 @@ class DataLoader:
                     polled = None
                 if polled:
                     out[name.replace("_stats", "")] = polled
+        rec = self._prov_rec
+        if rec is not None:
+            # attribution summary rides into the flight record on stall: the
+            # operator sees WHICH site owned the critical path when it hung
+            try:
+                out["attribution"] = rec.summary()
+            except Exception:  # noqa: BLE001 — evidence is best-effort
+                out["attribution"] = None
         return out
 
     def health_report(self, dump_path=None):
@@ -1754,10 +1862,44 @@ class DataLoader:
         and per-side utilization fractions (``print(report)`` for the
         human-readable rendering; p50/p90/p99 stage detail attached when the
         loader was built with ``metrics=``). Reads the CURRENT ``stats``
-        window — call after (or during) iteration."""
+        window — call after (or during) iteration. With ``provenance=``,
+        :meth:`attribution_report` refines this down to a concrete SITE."""
         from petastorm_tpu.obs.analyze import analyze_loader
 
         return analyze_loader(self)
+
+    @property
+    def provenance(self):
+        """The attached :class:`~petastorm_tpu.obs.provenance
+        .ProvenanceRecorder`, or None when ``provenance=`` was not passed."""
+        return self._prov_rec
+
+    def _require_provenance(self):
+        if self._prov_rec is None:
+            raise ValueError(
+                "DataLoader was built without provenance — pass "
+                "provenance=True (or a ProvenanceRecorder, or set "
+                "PTPU_PROVENANCE=1) to enable batch_provenance()/"
+                "attribution_report()")
+        return self._prov_rec
+
+    def batch_provenance(self):
+        """The most recently delivered batch's provenance (ISSUE 10): its
+        contributing item records — spans across every pipeline seam and
+        process, annotations (cache tier, hedges, retries, quarantine) — plus
+        the batch-plane spans and the step gap. ``None`` before the first
+        delivery. Requires ``provenance=``."""
+        return self._require_provenance().last_batch()
+
+    def attribution_report(self):
+        """Fold the recorded batch window into a critical-path step-time
+        attribution (:class:`~petastorm_tpu.obs.critical_path
+        .AttributionReport`): per-site self seconds and shares on the
+        critical path, step-gap p50/p99 split by cache tier and degradation
+        cause, and the "your p99 batch spent N% in <site>" verdict — the
+        refinement of :meth:`bottleneck_report` down to a concrete site.
+        Requires ``provenance=``."""
+        return self._require_provenance().report()
 
     def __enter__(self):
         return self
@@ -1772,6 +1914,12 @@ class DataLoader:
             self._staging = None
         if self._obs is not None:
             self._obs.close()
+        if self._prov_rec is not None and self._prov_owned:
+            # a loader-built recorder releases the process-global slot here
+            # (records stay readable — a post-exit attribution_report() still
+            # works over the window); reader-owned recorders were disarmed by
+            # reader.join() above, caller-supplied ones stay armed (theirs)
+            self._prov_rec.disarm()
         if self._health is not None:
             monitor = self._health
             context_handle, stall_handle = self._health_handles or (None, None)
@@ -2368,7 +2516,8 @@ _UNSET = object()
 #: re-stated here).
 _LOADER_OPTS = ("last_batch", "device_transform", "prefetch", "pad_shapes",
                 "device_shuffle_capacity", "to_device", "host_queue_size",
-                "device_decode_resize", "trace", "metrics", "health", "staging")
+                "device_decode_resize", "trace", "metrics", "health", "staging",
+                "provenance")
 
 
 def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1,
@@ -2377,7 +2526,8 @@ def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1
                     pad_shapes=_UNSET, device_shuffle_capacity=_UNSET,
                     to_device=_UNSET, host_queue_size=_UNSET,
                     device_decode_resize=_UNSET, trace=_UNSET, metrics=_UNSET,
-                    health=_UNSET, staging=_UNSET, **reader_kwargs):
+                    health=_UNSET, staging=_UNSET, provenance=_UNSET,
+                    **reader_kwargs):
     """One-call convenience: ``make_batch_reader`` + :class:`DataLoader`.
 
     ``reader_kwargs`` pass through to :func:`petastorm_tpu.reader.make_batch_reader`

@@ -152,24 +152,61 @@ class Reporter:
     """
 
     def __init__(self, registry=None, interval_s=5.0, jsonl_path=None,
-                 prom_path=None):
+                 prom_path=None, max_bytes=None, keep=3):
         if jsonl_path is None and prom_path is None:
             raise ValueError("Reporter needs jsonl_path and/or prom_path")
         self._registry = registry or default_registry()
         self._interval_s = float(interval_s)
         self._jsonl_path = jsonl_path
         self._prom_path = prom_path
+        #: size-capped rotation (ISSUE 10 satellite): when appending would
+        #: grow the JSONL stream past ``max_bytes``, the file rotates to
+        #: ``<path>.1`` (existing ``.1``→``.2``, …; at most ``keep`` rotated
+        #: files retained) BEFORE the write — a multi-day run can no longer
+        #: grow the sidecar unbounded. None (default) = never rotate. The
+        #: atexit/crash flush goes through the same path, so the final window
+        #: survives rotation too.
+        self._max_bytes = None if max_bytes is None else int(max_bytes)
+        self._keep = max(1, int(keep))
         self._stop_event = threading.Event()
         self._thread = None
+        self._rotate_lock = threading.Lock()
+
+    def _maybe_rotate(self, nbytes_next):
+        """Rotate ``jsonl_path`` when the pending append would cross the cap.
+        Serialized against the crash-hook flush (two writers, one shift
+        chain); rotation failures degrade to appending in place — losing the
+        cap beats losing the snapshot."""
+        if self._max_bytes is None:
+            return
+        with self._rotate_lock:
+            try:
+                size = os.path.getsize(self._jsonl_path)
+            except OSError:
+                return  # nothing to rotate yet
+            if size + nbytes_next <= self._max_bytes:
+                return
+            try:
+                oldest = "%s.%d" % (self._jsonl_path, self._keep)
+                if os.path.exists(oldest):
+                    os.remove(oldest)
+                for i in range(self._keep - 1, 0, -1):
+                    src = "%s.%d" % (self._jsonl_path, i)
+                    if os.path.exists(src):
+                        os.replace(src, "%s.%d" % (self._jsonl_path, i + 1))
+                os.replace(self._jsonl_path, self._jsonl_path + ".1")
+            except OSError:
+                pass  # degrade: append past the cap rather than drop data
 
     def _write_once(self):
         if self._prom_path is not None:
             write_prometheus(self._prom_path, self._registry)
         if self._jsonl_path is not None:
             line = json.dumps({"ts": time.time(),
-                               "metrics": self._registry.snapshot()})
+                               "metrics": self._registry.snapshot()}) + "\n"
+            self._maybe_rotate(len(line))
             with open(self._jsonl_path, "a") as f:
-                f.write(line + "\n")
+                f.write(line)
 
     def _run(self):
         while not self._stop_event.wait(self._interval_s):

@@ -208,9 +208,86 @@ def render_dashboard(metrics, title=""):
         lines.append("degradations (ptpu_degradations_total): " + "  ".join(
             "%s=%d" % (c, degr[c]) for c in sorted(degr)))
 
+    # -- cache-tier funnel (ISSUE 8 families — dedicated panel, not "other")
+    tier_hits = _labeled(metrics, "ptpu_io_tier_hits_total")
+    tier_bytes = _labeled(metrics, "ptpu_io_tier_bytes_total")
+    if any(tier_hits.values()):
+        lines.append("cache tiers:  " + "  ".join(
+            "%s hits=%d (%.1f MB)" % (t, int(tier_hits.get(t, 0)),
+                                      tier_bytes.get(t, 0) / 1e6)
+            for t in ("mem", "disk", "remote") if tier_hits.get(t)))
+
+    # -- remote read path (ISSUE 8): GETs, hedging, footer cache
+    r = {name: metrics[name] for name in metrics
+         if name.startswith(("ptpu_io_remote_", "ptpu_io_hedge",
+                             "ptpu_io_footer_cache_"))}
+    scalar_gets = r.get("ptpu_io_remote_gets_total", 0)
+    if scalar_gets:
+        lines.append(
+            "remote io:    gets=%d (%.1f MB)  hedges=%d (wins=%d)  "
+            "sparse_fallbacks=%d"
+            % (int(scalar_gets), r.get("ptpu_io_remote_bytes_total", 0) / 1e6,
+               int(r.get("ptpu_io_hedges_total", 0)),
+               int(r.get("ptpu_io_hedge_wins_total", 0)),
+               int(r.get("ptpu_io_remote_sparse_fallbacks_total", 0))))
+        fc_hits = r.get("ptpu_io_footer_cache_hits_total", 0)
+        fc_miss = r.get("ptpu_io_footer_cache_misses_total", 0)
+        if fc_hits or fc_miss:
+            lines.append(
+                "footer cache: hits=%d misses=%d evictions=%d "
+                "invalidations=%d (%.1f MB held)"
+                % (int(fc_hits), int(fc_miss),
+                   int(r.get("ptpu_io_footer_cache_evictions_total", 0)),
+                   int(r.get("ptpu_io_footer_cache_invalidations_total", 0)),
+                   r.get("ptpu_io_footer_cache_bytes", 0) / 1e6))
+        get_hists = [(n, v) for n, v in sorted(r.items())
+                     if n.startswith("ptpu_io_remote_get_seconds")
+                     and isinstance(v, dict)]
+        for name, h in get_hists:
+            label = name[len("ptpu_io_remote_get_seconds"):] or "{}"
+            lines.append("  GET %-28s p50 %s  p99 %s ms  ×%d"
+                         % (label, _fmt_ms(h.get("p50", 0)),
+                            _fmt_ms(h.get("p99", 0)), h.get("count", 0)))
+
+    # -- declarative transform ops (ISSUE 9): per-fused-stage timings
+    ops = _labeled(metrics, "ptpu_transform_seconds")
+    ops = {k: v for k, v in ops.items() if isinstance(v, dict)}
+    if ops:
+        lines.append("transform ops (ptpu_transform_seconds):  %8s %8s %8s"
+                     % ("p50", "p99", "count"))
+        for op in sorted(ops, key=lambda o: -ops[o].get("sum", 0)):
+            h = ops[op]
+            lines.append("  %-28s %s %s %8d"
+                         % (op, _fmt_ms(h.get("p50", 0)),
+                            _fmt_ms(h.get("p99", 0)), h.get("count", 0)))
+        rows_total = metrics.get("ptpu_transform_rows_total")
+        if rows_total:
+            lines.append("  transform rows total: %d" % int(rows_total))
+
+    # -- provenance / critical-path attribution (ISSUE 10)
+    prov_self = {name[len("ptpu_prov_self_s_"):]: v
+                 for name, v in metrics.items()
+                 if name.startswith("ptpu_prov_self_s_")}
+    if prov_self:
+        total = sum(prov_self.values()) or 1.0
+        top = sorted(prov_self.items(), key=lambda kv: -kv[1])
+        lines.append("attribution (critical-path self time, "
+                     "%d items / %d batches):"
+                     % (int(metrics.get("ptpu_prov_items", 0)),
+                        int(metrics.get("ptpu_prov_batches", 0))))
+        for site, sec in top[:8]:
+            lines.append("  %-28s %9.3fs  %5.1f%%"
+                         % (site, sec, 100.0 * sec / total))
+        quarantined = metrics.get("ptpu_prov_quarantined", 0)
+        if quarantined:
+            lines.append("  quarantined items: %d" % int(quarantined))
+
     # -- everything else, compact (numbers only; histogram summaries as p50s)
     shown_prefixes = ("ptpu_pipeline_", "ptpu_worker_item_seconds",
-                      "ptpu_health_", "ptpu_degradations_total")
+                      "ptpu_health_", "ptpu_degradations_total",
+                      "ptpu_io_tier_", "ptpu_io_remote_", "ptpu_io_hedge",
+                      "ptpu_io_footer_cache_", "ptpu_transform_",
+                      "ptpu_prov_")
     rest = {n: v for n, v in metrics.items()
             if not n.startswith(shown_prefixes)}
     scalars = [(n, v) for n, v in sorted(rest.items())

@@ -65,6 +65,7 @@ import time
 
 import numpy as np
 
+from petastorm_tpu.obs import provenance as _prov
 from petastorm_tpu.transform import TransformSpec
 from petastorm_tpu.unischema import UnischemaField
 
@@ -973,8 +974,11 @@ class FeaturePipeline(TransformSpec):
             t0 = time.perf_counter()
             out = stage.apply(result)
             result[stage.out] = out
+            dt = time.perf_counter() - t0
             hist, _rows_total = _stage_metrics(stage.label)
-            hist.observe(time.perf_counter() - t0)
+            hist.observe(dt)
+            if _prov.ACTIVE is not None:  # fused-stage timing (ISSUE 10)
+                _prov.add_span("transform.%s" % stage.label, t0, dt)
             if rows is None:
                 rows = len(out) if hasattr(out, "__len__") else 0
         if rows:
@@ -1008,8 +1012,11 @@ class FeaturePipeline(TransformSpec):
             out = stage.apply(merged)
             merged[stage.out] = out
             out_cols[stage.out] = out
+            dt = time.perf_counter() - t0
             hist, _rows_total = _stage_metrics(stage.label)
-            hist.observe(time.perf_counter() - t0)
+            hist.observe(dt)
+            if _prov.ACTIVE is not None:  # fused-stage timing (ISSUE 10)
+                _prov.add_span("transform.%s" % stage.label, t0, dt)
         _stage_metrics(self._plan[0].label)[1].inc(len(rows))
         new_rows = []
         for i, r in enumerate(rows):

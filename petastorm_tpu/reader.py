@@ -31,6 +31,7 @@ import weakref
 import numpy as np
 
 from petastorm_tpu import chaos as _chaos
+from petastorm_tpu.obs import provenance as _prov
 from petastorm_tpu.cache import make_cache
 from petastorm_tpu.io import IoOptions
 from petastorm_tpu.errors import (
@@ -549,6 +550,7 @@ class _WorkerBase:
                         what, attempt + 1)
                     raise
                 self._evict_parquet_file(path)
+                _prov.annotate_add("io_retries", 1)
                 delay = min(
                     rec.io_retry_backoff_s * (2 ** attempt) * (0.5 + random.random()),
                     rec.io_retry_max_backoff_s)
@@ -562,23 +564,24 @@ class _WorkerBase:
                 attempt += 1
 
     def _read_columns_once(self, piece, columns):
-        if _chaos.ACTIVE is not None:
-            _chaos.ACTIVE.hit("reader.read",
-                              key="%s:%s" % (piece.path, piece.row_group))
-        engine = self._remote_engine(create=True)
-        if engine is not None:
-            # the engine filters unavailable columns against the footer it
-            # already resolved — one metadata fetch per read, not two
-            table, _ = engine.read_row_groups(piece.path, [piece.row_group],
-                                              columns)
+        with _prov.span("reader.read"):
+            if _chaos.ACTIVE is not None:
+                _chaos.ACTIVE.hit("reader.read",
+                                  key="%s:%s" % (piece.path, piece.row_group))
+            engine = self._remote_engine(create=True)
+            if engine is not None:
+                # the engine filters unavailable columns against the footer it
+                # already resolved — one metadata fetch per read, not two
+                table, _ = engine.read_row_groups(piece.path,
+                                                  [piece.row_group], columns)
+                return self._attach_partitions(table, piece, columns)
+            pf = self._parquet_file(piece.path)
+            available = set(pf.schema_arrow.names)
+            file_columns = columns
+            if columns is not None:
+                file_columns = [c for c in columns if c in available]
+            table = pf.read_row_group(piece.row_group, columns=file_columns)
             return self._attach_partitions(table, piece, columns)
-        pf = self._parquet_file(piece.path)
-        available = set(pf.schema_arrow.names)
-        file_columns = columns
-        if columns is not None:
-            file_columns = [c for c in columns if c in available]
-        table = pf.read_row_group(piece.row_group, columns=file_columns)
-        return self._attach_partitions(table, piece, columns)
 
     def _attach_partitions(self, table, piece, columns):
         if self._partition_info:
@@ -602,28 +605,30 @@ class _WorkerBase:
     def _read_run_once(self, pieces, columns):
         from petastorm_tpu.io.coalesce import split_run_table
 
-        if _chaos.ACTIVE is not None:
-            _chaos.ACTIVE.hit(
-                "reader.read_run",
-                key="%s:%s" % (pieces[0].path,
-                               ",".join(str(p.row_group) for p in pieces)))
-        row_groups = [p.row_group for p in pieces]
-        engine = self._remote_engine(create=True)
-        if engine is not None:
-            table, entry = engine.read_row_groups(pieces[0].path, row_groups,
-                                                  columns)
-            sizes = [entry.row_group_rows[rg] for rg in row_groups]
+        with _prov.span("reader.read_run"):
+            if _chaos.ACTIVE is not None:
+                _chaos.ACTIVE.hit(
+                    "reader.read_run",
+                    key="%s:%s" % (pieces[0].path,
+                                   ",".join(str(p.row_group) for p in pieces)))
+            row_groups = [p.row_group for p in pieces]
+            engine = self._remote_engine(create=True)
+            if engine is not None:
+                table, entry = engine.read_row_groups(pieces[0].path,
+                                                      row_groups, columns)
+                sizes = [entry.row_group_rows[rg] for rg in row_groups]
+                return [self._attach_partitions(t, piece, columns)
+                        for t, piece in zip(split_run_table(table, sizes),
+                                            pieces)]
+            pf = self._parquet_file(pieces[0].path)
+            available = set(pf.schema_arrow.names)
+            file_columns = columns
+            if columns is not None:
+                file_columns = [c for c in columns if c in available]
+            table = pf.read_row_groups(row_groups, columns=file_columns)
+            sizes = [pf.metadata.row_group(rg).num_rows for rg in row_groups]
             return [self._attach_partitions(t, piece, columns)
                     for t, piece in zip(split_run_table(table, sizes), pieces)]
-        pf = self._parquet_file(pieces[0].path)
-        available = set(pf.schema_arrow.names)
-        file_columns = columns
-        if columns is not None:
-            file_columns = [c for c in columns if c in available]
-        table = pf.read_row_groups(row_groups, columns=file_columns)
-        sizes = [pf.metadata.row_group(rg).num_rows for rg in row_groups]
-        return [self._attach_partitions(t, piece, columns)
-                for t, piece in zip(split_run_table(table, sizes), pieces)]
 
     def _row_mask(self, table):
         """Boolean keep-mask from filters + predicate over a row-group table (or None)."""
@@ -681,13 +686,14 @@ class PyDictWorker(_WorkerBase):
         rows = self._cache_get(cache_key, lambda: self._load_rows(item))
         spec = self._transform_spec
         if spec is not None and not spec.device:
-            if getattr(spec, "declarative", False):
-                # compiled declarative pipeline: ONE columnar application over
-                # the whole row group (and thus over each NGram window's
-                # columnar form) instead of a func(dict(r)) call per row
-                rows = spec.apply_rows(rows)
-            elif spec.func is not None:
-                rows = [spec.func(dict(r)) for r in rows]
+            with _prov.span("transform"):
+                if getattr(spec, "declarative", False):
+                    # compiled declarative pipeline: ONE columnar application
+                    # over the whole row group (and thus over each NGram
+                    # window's columnar form) instead of a func(dict(r)) per row
+                    rows = spec.apply_rows(rows)
+                elif spec.func is not None:
+                    rows = [spec.func(dict(r)) for r in rows]
         if self._ngram is not None:
             # sort/window on decoded (and transformed) rows; plain dicts for cheap IPC
             return self._form_ngram_dicts(rows)
@@ -797,28 +803,31 @@ class ArrowWorker(_WorkerBase):
         columns = self._cache_get(cache_key, lambda: self._load_columns(item))
         spec = self._transform_spec
         if spec is not None and not spec.device:
-            if getattr(spec, "declarative", False):
-                # compiled declarative pipeline: fused vectorized kernels over
-                # the columnar batch — no pandas round trip, untouched columns
-                # stay the original zero-copy views
-                columns = spec.apply_columns(columns)
-            elif spec.func is not None:
-                import pandas as pd
+            with _prov.span("transform"):
+                if getattr(spec, "declarative", False):
+                    # compiled declarative pipeline: fused vectorized kernels
+                    # over the columnar batch — no pandas round trip, untouched
+                    # columns stay the original zero-copy views
+                    columns = spec.apply_columns(columns)
+                elif spec.func is not None:
+                    import pandas as pd
 
-                pdf = pd.DataFrame(
-                    {k: list(v) if v.ndim > 1 else v for k, v in columns.items()})
-                pdf = spec.func(pdf)
-                from petastorm_tpu.utils import stack_as_column
+                    pdf = pd.DataFrame(
+                        {k: list(v) if v.ndim > 1 else v
+                         for k, v in columns.items()})
+                    pdf = spec.func(pdf)
+                    from petastorm_tpu.utils import stack_as_column
 
-                columns = {}
-                for name in pdf.columns:
-                    series = pdf[name]
-                    if series.dtype == object:
-                        # tensor rows: one stack; scalar object columns
-                        # (strings/decimals) degrade to an object array
-                        columns[name] = stack_as_column(series.to_list())
-                    else:
-                        columns[name] = series.to_numpy()  # no per-row materialization
+                    columns = {}
+                    for name in pdf.columns:
+                        series = pdf[name]
+                        if series.dtype == object:
+                            # tensor rows: one stack; scalar object columns
+                            # (strings/decimals) degrade to an object array
+                            columns[name] = stack_as_column(series.to_list())
+                        else:
+                            # no per-row materialization
+                            columns[name] = series.to_numpy()
         if self._ngram is not None:
             from petastorm_tpu.ngram import form_ngram_columns
 
@@ -1251,7 +1260,7 @@ class Reader:
                  reader_pool_type="thread", workers_count=4, results_queue_size=16,
                  is_batched_reader=False, ngram=None, results_timeout_s=300.0,
                  wire_serializer="pickle", worker_respawns=None, io_options=None,
-                 recovery=None):
+                 recovery=None, provenance=None):
         self._fs = filesystem
         self._path = path
         self.schema = schema
@@ -1314,6 +1323,12 @@ class Reader:
         #: executor, so stale views raise LeaseRevoked instead of reading a
         #: recycled slab (weak: released leases fall out on their own)
         self._issued_leases = weakref.WeakSet()
+        #: optional obs.provenance.ProvenanceRecorder (ISSUE 10): per-item
+        #: causal records — deliveries noted here feed batch attribution.
+        #: Set BEFORE _start(): the executor begins claiming plan items the
+        #: moment it starts, and a recorder attached later (the DataLoader's
+        #: set_provenance) misses every item a small plan already drained.
+        self._prov = provenance
         self._start()
 
     def _start(self):
@@ -1332,6 +1347,14 @@ class Reader:
             fn = getattr(self._executor, "set_health", None)
             if fn is not None:
                 fn(monitor)
+        if self._prov is not None:
+            # provenance survives reset()'s executor rebuild like health does
+            # (join() disarmed an auto-owned recorder; re-arm is idempotent
+            # and fails loud if a DIFFERENT recorder took the slot meanwhile)
+            self._prov.arm()
+            fn = getattr(self._executor, "set_provenance", None)
+            if fn is not None:
+                fn(self._prov)
         self._executor.start(_Tagged(self._worker), self._plan)
         self._results_iter = self._executor.results()
         self.stopped = False
@@ -1378,6 +1401,11 @@ class Reader:
             "(epoch=%s ordinal=%s, %s) — skipped, charged to the checkpoint "
             "watermark; see Reader.quarantine_report", marker.attempts, path,
             row_group, epoch, ordinal, marker.kind, once=False)
+        if self._prov is not None:
+            # exactly-once beside delivery: a quarantined item never enters
+            # the delivery FIFO, so the ledgers stay disjoint
+            self._prov.note_quarantined(epoch, ordinal, marker.attempts,
+                                        marker.kind)
         self._mark_consumed((epoch, ordinal))
 
     def _resolve_quarantined_rows(self, path, row_group):
@@ -1440,6 +1468,8 @@ class Reader:
             if not payload:
                 self._mark_consumed((epoch, ordinal))  # fully-filtered group
                 continue
+            if self._prov is not None:
+                self._prov.note_delivery(epoch, ordinal, len(payload))
             self._buffer = payload
             self._buffer_pos = 0
             self._buffer_tag = (epoch, ordinal)
@@ -1478,6 +1508,9 @@ class Reader:
             if not columns or len(next(iter(columns.values()))) == 0:
                 self.release_batch()
                 continue  # fully-filtered (or windowless) row group: skip
+            if self._prov is not None:
+                self._prov.note_delivery(
+                    epoch, ordinal, len(next(iter(columns.values()))))
             if self.ngram is not None:
                 # flat 'offset/field' window columns cannot be namedtuple
                 # attributes — batched NGram delivers plain dicts
@@ -1565,6 +1598,18 @@ class Reader:
         if fn is not None:
             fn(tracer)
 
+    def set_provenance(self, recorder):
+        """Attach a :class:`petastorm_tpu.obs.provenance.ProvenanceRecorder`
+        (ISSUE 10): per-item deliveries/quarantines are noted here and the
+        executor records wire spans + merges pool-child item spans onto it.
+        Survives ``reset()``'s executor rebuild. The DataLoader wires this
+        from ``provenance=``; call it directly for loader-less readers (pair
+        with ``recorder.arm()`` so worker-thread spans are captured)."""
+        self._prov = recorder
+        fn = getattr(self._executor, "set_provenance", None)
+        if fn is not None:
+            fn(recorder)
+
     def set_health(self, monitor):
         """Attach a :class:`petastorm_tpu.obs.health.HealthMonitor` (ISSUE 5):
         executor workers / pool drivers heartbeat per work item (pool children
@@ -1626,6 +1671,14 @@ class Reader:
             close()
         if self._executor is not None:
             self._executor.join()
+        if self._prov is not None and getattr(self._prov, "_auto_disarm",
+                                              False):
+            # a recorder the factory built for THIS reader releases the
+            # process-global slot here (records stay readable; reset()'s
+            # _start re-arms) — without this, a stopped reader would pin
+            # ACTIVE forever and the next provenance-enabled reader would
+            # refuse to arm. Caller-supplied recorders stay armed.
+            self._prov.disarm()
 
     def __enter__(self):
         return self
@@ -1787,7 +1840,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
                 transform_spec=None, filters=None, storage_options=None, filesystem=None,
                 results_timeout_s=300.0, decode_on_device=False, wire_serializer=None,
                 io_retries=None, io_retry_backoff_s=None, worker_respawns=None,
-                io_options=None, recovery=None):
+                io_options=None, recovery=None, provenance=None):
     """Open a petastorm(-tpu) dataset for per-row decoded reading (reference ~L60).
 
     ``schema_fields`` may be a list of names/regexes/UnischemaFields or an :class:`NGram`.
@@ -1868,6 +1921,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         results_timeout_s=results_timeout_s,
         wire_serializer=wire_serializer or "pickle",
         io_options=io_opts, recovery=rec,
+        provenance=_prov.resolve(provenance),
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields
@@ -1883,7 +1937,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       transform_spec=None, filters=None, storage_options=None,
                       filesystem=None, results_timeout_s=300.0, decode_on_device=False,
                       wire_serializer=None, io_retries=None, io_retry_backoff_s=None,
-                      worker_respawns=None, io_options=None, recovery=None):
+                      worker_respawns=None, io_options=None, recovery=None,
+                      provenance=None):
     """Open ANY Parquet store for vectorized columnar batches (reference ~L200).
 
     ``decode_on_device``: see :func:`make_reader` — device-decodable codec columns come
@@ -1960,6 +2015,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         wire_serializer={"shm": "shm-arrow", "shm-view": "shm-arrow-view"}.get(
             wire_serializer, wire_serializer) or "arrow",
         io_options=io_opts, recovery=rec,
+        provenance=_prov.resolve(provenance),
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields

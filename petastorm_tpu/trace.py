@@ -52,6 +52,10 @@ class TraceRecorder:
 
         # (name, lane key (tname, tid), t0_s, dur_s, pid-or-None (None = local))
         self._events = deque(maxlen=max_events)
+        #: provenance flow points (ISSUE 10): (flow_id, lane, pid, t, name,
+        #: terminate) — rendered as Perfetto flow events ("s"/"t"/"f") linking
+        #: one item's spans across pid lanes in the dump
+        self._flows = deque(maxlen=max_events)
         self._lock = threading.Lock()
         self._origin = time.perf_counter()
         #: wall-clock instant matching ``_origin`` — the cross-process alignment
@@ -84,6 +88,18 @@ class TraceRecorder:
             for name, t0, dur in spans:
                 self._events.append((name, (lane, pid), t0 + base, dur, pid))
 
+    def add_flow_point(self, flow_id, lane, pid, t, name="item",
+                       terminate=False):
+        """Record one point of a Perfetto flow (ISSUE 10: the provenance
+        plane's item linkage). ``t`` is a value from THIS recorder's timeline
+        (``perf_counter``; child spans are pre-aligned by the provenance
+        merge); points sharing ``flow_id`` render as one arrow chain across
+        the ``(pid, lane)`` tracks. ``terminate=True`` marks the chain's
+        explicit end (the batch delivery point)."""
+        with self._lock:
+            self._flows.append((int(flow_id), lane, int(pid), t, name,
+                                bool(terminate)))
+
     @contextlib.contextmanager
     def span(self, name):
         """Context manager recording the enclosed block as one span."""
@@ -115,16 +131,25 @@ class TraceRecorder:
         metadata row per child process — one timeline, distinct pid lanes."""
         with self._lock:
             evs = list(self._events)
+            flows = list(self._flows)
         local_pid = os.getpid()
         lanes = {}  # (pid, lane key) -> (tid, lane display name)
         for _n, tkey, _t0, _d, p in evs:
             key = (p if p is not None else local_pid, tkey)
             if key not in lanes:
                 lanes[key] = tkey[0]
+        for _fid, lane, fpid, _t, _n, _term in flows:
+            # flow points land on (lane, pid)-keyed tracks like child spans do;
+            # a point naming a lane no slice lives on still gets its own track
+            key = (fpid, (lane, fpid))
+            if key not in lanes:
+                lanes[key] = lane
         trace_events = []
         tids = {}
         child_pids = sorted({p for _n, _t, _t0, _d, p in evs if p is not None
-                             and p != local_pid})
+                             and p != local_pid}
+                            | {fpid for _fid, _l, fpid, _t, _n, _term in flows
+                               if fpid != local_pid})
         if child_pids:  # pid lanes only exist on merged multi-process dumps
             for pid in [local_pid] + child_pids:
                 trace_events.append({
@@ -141,6 +166,29 @@ class TraceRecorder:
             trace_events.append({
                 "ph": "X", "pid": pid, "tid": tids[(pid, tkey)], "name": name,
                 "ts": (t0 - self._origin) * 1e6, "dur": dur * 1e6, "cat": "pipeline"})
+        # provenance flows (ISSUE 10): chain each flow id's points in time
+        # order — "s" start, "t" steps, "f" finish — so Perfetto draws arrows
+        # linking one item's spans across pid lanes
+        by_flow = {}
+        for fid, lane, fpid, t, name, term in flows:
+            by_flow.setdefault(fid, []).append((t, lane, fpid, name, term))
+        for fid, points in by_flow.items():
+            points.sort(key=lambda p: p[0])
+            for i, (t, lane, fpid, name, term) in enumerate(points):
+                if i == 0:
+                    ph = "s"
+                elif i == len(points) - 1 or term:
+                    ph = "f"
+                else:
+                    ph = "t"
+                ev = {"ph": ph, "id": fid, "pid": fpid,
+                      "tid": tids[(fpid, (lane, fpid))], "name": name,
+                      "ts": (t - self._origin) * 1e6, "cat": "prov"}
+                if ph == "f":
+                    ev["bp"] = "e"  # bind to the enclosing slice
+                trace_events.append(ev)
+                if ph == "f":
+                    break
         with open(path, "w") as f:
             json.dump({"traceEvents": trace_events,
                        "displayTimeUnit": "ms"}, f)

@@ -26,6 +26,7 @@ import threading
 from collections import deque
 
 from petastorm_tpu import chaos as _chaos
+from petastorm_tpu.obs import provenance as _prov
 from petastorm_tpu.errors import TimeoutWaitingForResultError, WorkerDiedError
 from petastorm_tpu.recovery import QuarantinedItem, RecoveryOptions
 
@@ -187,6 +188,17 @@ class ExecutorBase:
     #: (None = disabled, one is-None check per loop iteration)
     _health = None
 
+    #: optional petastorm_tpu.obs.provenance.ProvenanceRecorder (ISSUE 10):
+    #: pool drivers record per-item wire spans and merge child-piggybacked
+    #: item spans onto it (thread/dummy pools need no executor-side state —
+    #: their worker threads feed the armed module-level collector directly)
+    _prov = None
+
+    def set_provenance(self, recorder):
+        """Attach a provenance recorder (the Reader wires this; attachable
+        mid-stream like ``set_health`` — drivers resolve it per item)."""
+        self._prov = recorder
+
     def set_health(self, monitor):
         """Attach a :class:`petastorm_tpu.obs.health.HealthMonitor`: workers
         heartbeat per work item (busy vs backpressure-wait states), the
@@ -264,21 +276,32 @@ class SyncExecutor(ExecutorBase):
                 if upcoming:
                     prefetch(upcoming)
             attempts = 0
-            while True:
-                try:
-                    if _chaos.ACTIVE is not None:
-                        _chaos.ACTIVE.hit("worker.item", key=_chaos.item_key(item))
-                    result = self._worker(item)
-                except Exception as e:  # noqa: BLE001 — policy-classified below
-                    attempts += 1
-                    if not recovery.quarantine:
-                        raise
-                    if attempts >= recovery.poison_attempts:
-                        yield QuarantinedItem(item, e, attempts)
-                        break
-                    continue  # retry the item in place
-                yield result
-                break
+            result = None
+            if _prov.ACTIVE is not None:
+                _prov.begin_item(item)
+            # end_item runs BEFORE the yield below: a generator suspends at
+            # yield, and holding the item context open across the consumer's
+            # turn would misattribute its spans to this item
+            try:
+                while True:
+                    try:
+                        if _chaos.ACTIVE is not None:
+                            _chaos.ACTIVE.hit("worker.item",
+                                              key=_chaos.item_key(item))
+                        result = self._worker(item)
+                    except Exception as e:  # noqa: BLE001 — policy-classified
+                        attempts += 1
+                        if not recovery.quarantine:
+                            raise
+                        if attempts >= recovery.poison_attempts:
+                            result = QuarantinedItem(item, e, attempts)
+                            break
+                        continue  # retry the item in place
+                    break
+            finally:
+                if _prov.ACTIVE is not None:
+                    _prov.end_item()
+            yield result
 
     def stop(self):
         self._stopped = True
@@ -351,23 +374,29 @@ class ThreadExecutor(ExecutorBase):
                 attempts = 0
                 fatal = False
                 result = None
-                while True:  # item attempts (poison-quarantine retry policy)
-                    try:
-                        if _chaos.ACTIVE is not None:
-                            _chaos.ACTIVE.hit("worker.item",
-                                              key=_chaos.item_key(item))
-                        result = worker(item)
-                    except Exception as e:  # noqa: BLE001 — policy-classified
-                        attempts += 1
-                        if not recovery.quarantine:
-                            self._put(_ExcResult(e))  # propagate to consumer
-                            fatal = True
-                            break
-                        if attempts >= recovery.poison_attempts:
-                            result = QuarantinedItem(item, e, attempts)
-                            break
-                        continue  # retry the item in place
-                    break
+                if _prov.ACTIVE is not None:
+                    _prov.begin_item(item)
+                try:
+                    while True:  # item attempts (poison-quarantine policy)
+                        try:
+                            if _chaos.ACTIVE is not None:
+                                _chaos.ACTIVE.hit("worker.item",
+                                                  key=_chaos.item_key(item))
+                            result = worker(item)
+                        except Exception as e:  # noqa: BLE001 — classified
+                            attempts += 1
+                            if not recovery.quarantine:
+                                self._put(_ExcResult(e))  # to the consumer
+                                fatal = True
+                                break
+                            if attempts >= recovery.poison_attempts:
+                                result = QuarantinedItem(item, e, attempts)
+                                break
+                            continue  # retry the item in place
+                        break
+                finally:
+                    if _prov.ACTIVE is not None:
+                        _prov.end_item()
                 if fatal:
                     break
                 if monitor is not None:
@@ -1066,6 +1095,9 @@ class ProcessExecutor(ExecutorBase):
                 # on ITS IO pool before working the item (they are this driver's
                 # claimed pieces, so barring a steal the child reads its own future)
                 hints = list(upcoming)
+                prov = self._prov  # resolved per item, attachable mid-stream
+                prov_id = _prov.item_identity(item) if prov is not None \
+                    else None
                 recovery = self._recovery
                 attempts = 0       # failures of THIS item, across respawns/heals
                 first_death = None  # the ORIGINAL child failure (ISSUE 7: budget
@@ -1076,7 +1108,13 @@ class ProcessExecutor(ExecutorBase):
                     # and a dead child's in-flight slab is reclaimed below
                     slab = None
                     if ring is not None:
+                        t_slab = time.perf_counter() if prov is not None else 0.0
                         slab = ring.acquire()
+                        if prov is not None:
+                            prov.add_item_span(prov_id[0], prov_id[1],
+                                               "wire.slab_wait", t_slab,
+                                               time.perf_counter(),
+                                               key=prov_id[2])
                         if slab is None:  # ring starved: socket wire for this item
                             ring.count_fallback()
                     try:
@@ -1092,9 +1130,18 @@ class ProcessExecutor(ExecutorBase):
                         if _chaos.ACTIVE is not None:
                             _chaos.ACTIVE.hit("pool.dispatch",
                                               key=_chaos.item_key(item))
+                        t_send = time.perf_counter() if prov is not None else 0.0
                         conn.send((slab, item, hints) if ring is not None
                                   else (item, hints))
                         header = self._recv_result(conn, child_hb)
+                        if prov is not None:
+                            # the child's own spans nest INSIDE this roundtrip
+                            # once merged — the flame fold charges the wire the
+                            # residual, not the child's work
+                            prov.add_item_span(prov_id[0], prov_id[1],
+                                               "wire.roundtrip", t_send,
+                                               time.perf_counter(),
+                                               key=prov_id[2])
                         if monitor is not None:
                             monitor.observe_worker(idx, time.perf_counter() - t0)
                         if child_hb is not None:
@@ -1115,11 +1162,19 @@ class ProcessExecutor(ExecutorBase):
                                 break  # the child is alive: next item
                             continue  # retry on the same live child
                         _, kind, nframes, trace_blob = header
-                        if self._tracer is not None and trace_blob is not None:
+                        if trace_blob is not None:
                             # cross-process merge: the child's per-item spans,
-                            # clock-aligned onto the parent recorder's timeline
-                            child_pid, wall0, perf0, spans = trace_blob
-                            self._tracer.add_child(child_pid, spans, wall0, perf0)
+                            # clock-aligned onto the parent recorder's timeline.
+                            # Slot 5 (when present) is the provenance piggyback
+                            # (ISSUE 10) riding the same anchors.
+                            child_pid, wall0, perf0, spans = trace_blob[:4]
+                            if self._tracer is not None:
+                                self._tracer.add_child(child_pid, spans,
+                                                       wall0, perf0)
+                            if prov is not None and len(trace_blob) > 4 \
+                                    and trace_blob[4] is not None:
+                                prov.absorb_child(trace_blob[4], child_pid,
+                                                  wall0, perf0)
                         frames = [conn.recv_bytes() for _ in range(nframes)]
                         if slab is not None and kind != KIND_SHM:
                             # granted but unused (oversized payload): reclaim first
@@ -1138,12 +1193,21 @@ class ProcessExecutor(ExecutorBase):
                         # even parse the descriptor (slab_released=False on
                         # the exception) leaves the grant with this driver.
                         granted, slab = slab, None
+                        t_dec = time.perf_counter() if prov is not None else 0.0
                         if _chaos.ACTIVE is not None:
                             frames = _chaos.ACTIVE.hit(
                                 "wire.decode", key=_chaos.item_key(item),
                                 payload=frames)
                         try:
                             result = self._serializer.deserialize(kind, frames)
+                            if prov is not None:
+                                # covers the chaos wire.decode injection site
+                                # too, so an injected wire stall lands in this
+                                # span's self time
+                                prov.add_item_span(prov_id[0], prov_id[1],
+                                                   "wire.decode", t_dec,
+                                                   time.perf_counter(),
+                                                   key=prov_id[2])
                         except Exception as e:  # noqa: BLE001 — policy-classified
                             if granted is not None and \
                                     not getattr(e, "slab_released", True):

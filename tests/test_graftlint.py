@@ -1431,3 +1431,105 @@ def test_cli_json_format(tmp_path, capsys):
     assert lint_main([str(dirty), "--no-baseline", "--format", "json"]) == 1
     payload = json.loads(capsys.readouterr().out)
     assert payload["findings"][0]["rule"] == "GL-J002"
+
+
+# -- GL-O003: unpaired trace/provenance spans -------------------------------------------
+
+_O003_BEGIN_POSITIVE = """
+    from petastorm_tpu.obs import provenance as _prov
+
+    def work(items):
+        for item in items:
+            _prov.begin_item(item)  # BUG: no finally-guarded end_item
+            process(item)
+            _prov.end_item()
+"""
+
+
+def test_unpaired_begin_item_fires():
+    findings, _ = _lint(_O003_BEGIN_POSITIVE)
+    f = _only_rule(findings, "GL-O003")[0]
+    assert f.line == _line_of(_O003_BEGIN_POSITIVE, "BUG: no finally")
+    assert "begin_item" in f.message and "end_item" in f.message
+
+
+_O003_HANDLE_POSITIVE = """
+    from petastorm_tpu.obs import provenance as _prov
+
+    def region():
+        handle = _prov.open_span("io.remote")  # BUG: close not in a finally
+        fetch()
+        handle.close()
+"""
+
+
+def test_unpaired_open_span_handle_fires():
+    findings, _ = _lint(_O003_HANDLE_POSITIVE)
+    f = _only_rule(findings, "GL-O003")[0]
+    assert f.line == _line_of(_O003_HANDLE_POSITIVE, "BUG: close not in")
+
+
+def test_begin_item_with_finally_end_item_is_clean():
+    findings, _ = _lint("""
+        from petastorm_tpu.obs import provenance as _prov
+
+        def work(items):
+            for item in items:
+                if _prov.ACTIVE is not None:
+                    _prov.begin_item(item)
+                try:
+                    process(item)
+                finally:
+                    if _prov.ACTIVE is not None:
+                        _prov.end_item()
+    """)
+    assert findings == []
+
+
+def test_open_span_closed_in_finally_or_with_is_clean():
+    findings, _ = _lint("""
+        from petastorm_tpu.obs import provenance as _prov
+
+        def closed_in_finally():
+            handle = _prov.open_span("wire.decode")
+            try:
+                decode()
+            finally:
+                handle.close()
+
+        def opened_as_context(recorder):
+            with recorder.open_span("reader.read"):
+                read()
+    """)
+    assert findings == []
+
+
+def test_nested_function_finally_does_not_cover_outer_open():
+    """A finally inside a NESTED def is that scope's own pairing — it must
+    not launder an unpaired open in the enclosing function."""
+    findings, _ = _lint("""
+        from petastorm_tpu.obs import provenance as _prov
+
+        def outer(item):
+            _prov.begin_item(item)  # BUG: the inner finally is not ours
+
+            def inner():
+                try:
+                    pass
+                finally:
+                    _prov.end_item()
+
+            return inner
+    """)
+    assert [f.rule_id for f in findings] == ["GL-O003"]
+
+
+def test_o003_inline_disable_respected():
+    findings, suppressed = _lint("""
+        from petastorm_tpu.obs import provenance as _prov
+
+        def fire_and_forget(item):
+            _prov.begin_item(item)  # graftlint: disable=GL-O003 (thread dies with the item)
+    """)
+    assert findings == []
+    assert suppressed == 1

@@ -1,0 +1,621 @@
+"""Causal batch provenance & critical-path attribution (ISSUE 10).
+
+Unit contracts for the span fold and the recorder, end-to-end item/batch
+attribution on every pool type (process pools prove the cross-pid merge),
+the tiered-remote and quarantine-heavy acceptance scenarios (verdict stable,
+ids exactly-once, zero leaked leases), Perfetto flow events, and the
+Reporter rotation satellite."""
+import json
+import os
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.loader import DataLoader
+from petastorm_tpu.obs import provenance as prov
+from petastorm_tpu.obs.critical_path import analyze_batches, fold_self_times
+from petastorm_tpu.obs.provenance import ItemProvenance, ProvenanceRecorder
+from petastorm_tpu.reader import make_batch_reader
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts and ends with the process-global plane disarmed."""
+    prov.ACTIVE = None
+    prov._tls.item = None
+    yield
+    prov.ACTIVE = None
+    prov._tls.item = None
+
+
+@pytest.fixture
+def store(tmp_path):
+    root = str(tmp_path / "data")
+    os.makedirs(root)
+    for i in range(3):
+        pq.write_table(
+            pa.table({"id": np.arange(64, dtype=np.int64) + i * 64,
+                      "x": np.random.default_rng(i).random(64)}),
+            os.path.join(root, "p%d.parquet" % i))
+    return root
+
+
+def _leaked_total():
+    from petastorm_tpu.obs.metrics import default_registry
+
+    return default_registry().counter("ptpu_lease_leaked_total").value
+
+
+def _assert_exactly_once(loader, expected_rows):
+    per_item = {}
+    for b in loader.provenance.batches():
+        for e, o, r in (b["items"] or ()):
+            per_item[(e, o)] = per_item.get((e, o), 0) + r
+    assert sum(per_item.values()) == expected_rows, per_item
+    quarantined = {(e, o) for e, o, _a, _k in loader.provenance.quarantined()}
+    assert not (quarantined & set(per_item))
+    assert loader.provenance.duplicate_absorbs == 0
+    return per_item
+
+
+# -- critical-path fold -----------------------------------------------------------------
+
+
+def test_fold_charges_nested_spans_to_the_child():
+    spans = [("outer", 0.0, 10.0, 1),
+             ("inner", 2.0, 8.0, 1),
+             ("leaf", 3.0, 4.0, 2)]
+    out = fold_self_times(spans)
+    assert out["leaf"] == pytest.approx(1.0)
+    assert out["inner"] == pytest.approx(5.0)   # 6 - 1 nested
+    assert out["outer"] == pytest.approx(4.0)   # 10 - 6 nested
+
+
+def test_fold_partial_overlap_is_siblings():
+    out = fold_self_times([("a", 0.0, 5.0, 1), ("b", 3.0, 9.0, 1)])
+    assert out["a"] == pytest.approx(5.0)
+    assert out["b"] == pytest.approx(6.0)
+
+
+def test_fold_same_site_accumulates():
+    out = fold_self_times([("a", 0.0, 1.0, 1), ("a", 2.0, 3.5, 1)])
+    assert out["a"] == pytest.approx(2.5)
+
+
+def test_analyze_batches_names_the_culprit_and_splits_by_tier():
+    views = []
+    for i in range(10):
+        slow = i == 9
+        views.append({
+            "seq": i, "rows": 8, "step_gap_s": 1.0 if slow else 0.01,
+            "spans": [{"site": "loader.collate", "t0": 0.0, "t1": 0.002,
+                       "pid": 1}],
+            "items": [(0, i, 8)],
+            "item_records": [{
+                "annotations": {"cache_tier": "remote" if slow else "mem"},
+                "attempts": 1,
+                "spans": [{"site": "io.remote", "t0": 0.0,
+                           "t1": 0.9 if slow else 0.004, "pid": 1}],
+            }],
+        })
+    report = analyze_batches(views)
+    assert report.batches == 10
+    assert report.top_stage == "io.remote"
+    assert report.slow_top == "io.remote"
+    assert "io.remote" in report.verdict
+    assert report.by_tier["remote"]["p99_s"] >= report.by_tier["mem"]["p99_s"]
+    d = report.to_dict()
+    assert d["slow_top"] == "io.remote"
+    assert "io.remote" in report.render()
+
+
+# -- recorder units ---------------------------------------------------------------------
+
+
+def test_item_key_is_the_chaos_stable_key():
+    class Piece:
+        path = "/d/p.parquet"
+        row_group = 3
+
+    tagged = (1, 7, (Piece(), 0))
+    assert prov.item_key(tagged) == "epoch=1 ordinal=7 /d/p.parquet:3"
+    rec_a = ItemProvenance(*prov.item_identity(tagged))
+    rec_b = ItemProvenance(*prov.item_identity(tagged))
+    assert rec_a.trace_id == rec_b.trace_id  # stable across processes
+
+
+def test_hooks_are_noops_when_disarmed():
+    assert prov.begin_item((0, 0, "x")) is None  # graftlint: disable=GL-O003 (disarmed no-op)
+    prov.add_span("site", 0.0, 1.0)
+    prov.annotate("k", "v")
+    with prov.span("site"):
+        pass
+    assert prov.end_item() is None
+
+
+def test_recorder_spans_annotations_and_retry_attempts():
+    rec = ProvenanceRecorder().arm()
+    try:
+        tagged = (0, 1, "item")
+        prov.begin_item(tagged)  # graftlint: disable=GL-O003 (unit test drives the raw API)
+        with prov.span("reader.read"):
+            time.sleep(0.002)
+        prov.annotate("cache_tier", "mem")
+        prov.annotate_add("io_retries", 2)
+        prov.end_item()
+        # a retry of the same (epoch, ordinal) reuses the record
+        prov.begin_item(tagged)  # graftlint: disable=GL-O003 (unit test drives the raw API)
+        prov.end_item()
+        items = rec.items()
+        assert len(items) == 1
+        record = next(iter(items.values()))
+        assert record["attempts"] == 2
+        assert record["annotations"] == {"cache_tier": "mem", "io_retries": 2}
+        assert record["spans"][0]["site"] == "reader.read"
+        assert record["spans"][0]["t1"] > record["spans"][0]["t0"]
+    finally:
+        rec.disarm()
+
+
+def test_second_recorder_arm_raises_but_rearm_is_idempotent():
+    rec = ProvenanceRecorder().arm()
+    try:
+        rec.arm()  # same recorder: fine
+        with pytest.raises(RuntimeError):
+            ProvenanceRecorder().arm()
+    finally:
+        rec.disarm()
+    other = ProvenanceRecorder().arm()  # after disarm: fine
+    other.disarm()
+
+
+def test_absorb_child_aligns_clocks_and_learns_the_key():
+    rec = ProvenanceRecorder()
+    # the delivery note arrives first, with only (epoch, ordinal)
+    rec.note_delivery(0, 4, 64)
+    wall = time.time() + 100.0      # a "child" whose anchors are shifted
+    perf = 5000.0
+    blob = (0, 4, "epoch=0 ordinal=4 /d/p.parquet:1",
+            [("child.work", 5000.0, 5000.5, 4242)], {"hedges": 1})
+    rec.absorb_child(blob, 4242, wall, perf)
+    items = rec.items()
+    key = "epoch=0 ordinal=4 /d/p.parquet:1"
+    assert key in items
+    span = items[key]["spans"][0]
+    assert span["pid"] == 4242
+    assert span["t1"] - span["t0"] == pytest.approx(0.5)
+    # aligned onto the parent timeline: ~100s ahead of the recorder origin
+    assert span["t0"] - rec._origin == pytest.approx(100.0, abs=5.0)
+    assert items[key]["annotations"]["hedges"] == 1
+
+
+def test_item_registry_is_bounded():
+    rec = ProvenanceRecorder(max_items=4)
+    for i in range(10):
+        rec.note_delivery(0, i, 1)
+    assert len(rec.items()) == 4
+
+
+def test_batch_cut_consumes_the_delivery_fifo_in_order():
+    rec = ProvenanceRecorder()
+    rec.note_delivery(0, 0, 10)
+    rec.note_delivery(0, 1, 6)
+    bp1 = rec.producer_cut(8)
+    bp2 = rec.producer_cut(8)
+    assert bp1.items == [(0, 0, 8)]
+    assert bp2.items == [(0, 0, 2), (0, 1, 6)]
+    rec.transfer_next()
+    rec.transfer_span("loader.h2d", 0.0, 0.001)
+    assert rec.batch_delivered() is not None
+    assert rec.batch_delivered() is not None
+    batches = rec.batches()
+    assert [b["seq"] for b in batches] == [1, 2]
+    assert batches[0]["spans"][0]["site"] == "loader.h2d"
+    assert batches[1]["step_gap_s"] is not None
+
+
+def test_dropped_batches_keep_pointers_aligned():
+    rec = ProvenanceRecorder()
+    rec.note_delivery(0, 0, 16)
+    bp1 = rec.producer_cut(8)
+    bp2 = rec.producer_cut(8)
+    rec.batch_dropped(bp1)
+    delivered = rec.batch_delivered()
+    assert delivered is bp2
+
+
+# -- loader end-to-end ------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pool", ["dummy", "thread"])
+def test_loader_attribution_end_to_end(store, pool):
+    leaked0 = _leaked_total()
+    reader = make_batch_reader("file://" + store, num_epochs=2,
+                               workers_count=2, reader_pool_type=pool,
+                               provenance=True)
+    with DataLoader(reader, 32, to_device=False) as loader:
+        rows = sum(len(b["id"]) for b in loader)
+    assert rows == 384
+    assert _leaked_total() - leaked0 == 0
+    per_item = _assert_exactly_once(loader, rows)
+    assert len(per_item) == 6  # 3 files x 2 epochs
+    items = loader.provenance.items()
+    assert all(".parquet:" in k for k in items)
+    assert all(rec["spans"] for rec in items.values())
+    bp = loader.batch_provenance()
+    assert bp["item_records"] and bp["rows"] == 32
+    report = loader.attribution_report()
+    assert report.batches == 12
+    assert report.stage_self_s
+    assert "critical path" in report.render() or report.verdict
+    # module plane disarmed at __exit__
+    assert prov.ACTIVE is None
+
+
+def test_loader_without_provenance_refuses():
+    loader = DataLoader.__new__(DataLoader)
+    loader._prov_rec = None
+    with pytest.raises(ValueError, match="provenance"):
+        loader._require_provenance()
+
+
+def test_process_pool_merges_child_spans_and_keys(store):
+    reader = make_batch_reader("file://" + store, num_epochs=1,
+                               workers_count=2, reader_pool_type="process",
+                               wire_serializer="shm-view", provenance=True)
+    with DataLoader(reader, 32, to_device=False) as loader:
+        rows = sum(len(b["id"]) for b in loader)
+    assert rows == 192
+    _assert_exactly_once(loader, rows)
+    items = loader.provenance.items()
+    assert all(".parquet:" in k for k in items)
+    local = os.getpid()
+    pids = {sp["pid"] for rec in items.values() for sp in rec["spans"]}
+    assert any(p != local for p in pids), "child spans did not merge"
+    sites = {sp["site"] for rec in items.values() for sp in rec["spans"]}
+    assert {"wire.roundtrip", "wire.decode", "child.work"} <= sites
+    report = loader.attribution_report()
+    assert report.batches == 6
+
+
+def test_perfetto_flow_events_link_item_spans_across_pids(store, tmp_path):
+    from petastorm_tpu.trace import TraceRecorder
+
+    tracer = TraceRecorder()
+    reader = make_batch_reader("file://" + store, num_epochs=1,
+                               workers_count=2, reader_pool_type="process",
+                               wire_serializer="shm-view", provenance=True)
+    with DataLoader(reader, 64, to_device=False, trace=tracer) as loader:
+        rows = sum(len(b["id"]) for b in loader)
+    assert rows == 192
+    path = str(tmp_path / "trace.json")
+    tracer.dump(path)
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    flows = [e for e in events if e["ph"] in ("s", "t", "f")]
+    assert flows, "no flow events in the dump"
+    by_id = {}
+    for e in flows:
+        by_id.setdefault(e["id"], []).append(e)
+    # one flow per delivered item, each spanning >= 2 pid lanes and properly
+    # terminated
+    assert len(by_id) == 3
+    for chain in by_id.values():
+        phases = [e["ph"] for e in sorted(chain, key=lambda e: e["ts"])]
+        assert phases[0] == "s" and phases[-1] == "f"
+        assert len({e["pid"] for e in chain}) >= 2
+
+
+def test_shuffling_disables_batch_membership_but_items_still_collect(store):
+    reader = make_batch_reader("file://" + store, num_epochs=1,
+                               workers_count=2, provenance=True)
+    with DataLoader(reader, 32, to_device=False,
+                    shuffling_queue_capacity=128, seed=1) as loader:
+        rows = sum(len(b["id"]) for b in loader)
+    assert rows == 192
+    rec = loader.provenance
+    assert len(rec._delivery_fifo) == 0  # never grows while disabled
+    for b in rec.batches():
+        assert b["items"] is None
+    assert len(rec.items()) == 3  # item records still collected
+
+
+# -- acceptance scenarios (satellite) ---------------------------------------------------
+
+
+def test_attribution_under_tiered_remote_path(store):
+    """CloudLatencyFS + mem tier: verdict stable across runs, tier
+    annotations present, zero leaked leases, ids exactly-once — and
+    bottleneck_report() keeps working beside it."""
+    import pyarrow.fs as pafs
+
+    from petastorm_tpu.io.latencyfs import CloudLatencyFS
+
+    def run():
+        fs = CloudLatencyFS(pafs.LocalFileSystem(), seed=3,
+                            base_latency_s=0.01, tail_fraction=0.2,
+                            tail_multiplier=5.0)
+        leaked0 = _leaked_total()
+        reader = make_batch_reader(
+            "file://" + store, filesystem=fs, num_epochs=2, workers_count=2,
+            provenance=True,
+            io_options=dict(readahead=False, memcache_bytes=64 << 20,
+                            remote=dict(enabled=True, hedge=False)))
+        with DataLoader(reader, 32, to_device=False) as loader:
+            rows = sum(len(b["id"]) for b in loader)
+        assert rows == 384
+        assert _leaked_total() - leaked0 == 0
+        _assert_exactly_once(loader, rows)
+        tiers = {rec["annotations"].get("cache_tier")
+                 for rec in loader.provenance.items().values()}
+        report = loader.attribution_report()
+        assert loader.bottleneck_report().verdict  # coexists
+        return report, tiers, loader.provenance
+
+    # COLD run: epoch 1 pays the injected remote latency, epoch 2 serves
+    # from the (process-wide) mem tier — the totals blame the remote plane
+    first, tiers, recorder = run()
+    assert "remote" in tiers and "mem" in tiers
+    assert first.top_stage == "io.remote"
+    # the verdict is STABLE: re-folding the same recorded window gives the
+    # same attribution, byte for byte
+    assert recorder.report().to_dict() == first.to_dict()
+    # WARM run: the process-wide mem tier now serves everything — the
+    # attribution must NOT keep blaming a remote plane that never ran
+    second, tiers2, _rec2 = run()
+    assert tiers2 == {"mem"}
+    assert second.top_stage != "io.remote"
+    assert second.stage_self_s.get("io.remote", 0.0) == 0.0
+
+
+def test_attribution_under_quarantine_heavy_chaos(store):
+    """A poison-heavy chaos plan: quarantined ids land in the provenance
+    ledger exactly once, disjoint from deliveries; attempts are recorded;
+    zero leaked leases; the report stays computable."""
+    from petastorm_tpu import chaos
+    from petastorm_tpu.chaos.plan import FaultPlan, FaultRule
+
+    leaked0 = _leaked_total()
+    plan = FaultPlan([FaultRule("worker.item", "raise_transient",
+                                item_key="p1.parquet")], seed=9)
+    with chaos.armed(plan):
+        reader = make_batch_reader(
+            "file://" + store, num_epochs=1, workers_count=2,
+            provenance=True,
+            recovery=dict(on_poison="quarantine", poison_attempts=2))
+        with DataLoader(reader, 32, to_device=False) as loader:
+            rows = sum(len(b["id"]) for b in loader)
+    assert rows == 128  # p1's 64 rows quarantined away
+    assert _leaked_total() - leaked0 == 0
+    per_item = _assert_exactly_once(loader, rows)
+    quarantined = loader.provenance.quarantined()
+    assert len(quarantined) == 1
+    epoch, ordinal, attempts, kind = quarantined[0]
+    assert attempts == 2
+    assert (epoch, ordinal) not in per_item
+    items = loader.provenance.items()
+    poisoned = [r for r in items.values()
+                if r["annotations"].get("quarantined")]
+    assert len(poisoned) == 1
+    report = loader.attribution_report()
+    assert report.batches == 4
+    assert "quarantined" not in report.by_cause or \
+        report.by_cause["quarantined"]["batches"] >= 0
+
+
+# -- reader-level (loader-less) ---------------------------------------------------------
+
+
+def test_loader_less_reader_records_items(store):
+    reader = make_batch_reader("file://" + store, num_epochs=1,
+                               workers_count=1, provenance=True)
+    rec = reader._prov
+    try:
+        rows = 0
+        for batch in reader:
+            rows += len(batch.id)
+        assert rows == 192
+        items = rec.items()
+        assert len(items) == 3
+        assert all(r["rows"] == 64 for r in items.values())
+    finally:
+        reader.stop()
+        reader.join()
+        rec.disarm()
+
+
+# -- Reporter rotation (satellite) ------------------------------------------------------
+
+
+def test_reporter_jsonl_rotation_caps_growth(tmp_path):
+    from petastorm_tpu.obs.export import Reporter
+    from petastorm_tpu.obs.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
+    registry.counter("ptpu_test_total").inc()
+    path = str(tmp_path / "stats.jsonl")
+    reporter = Reporter(registry=registry, interval_s=600.0, jsonl_path=path,
+                        max_bytes=200, keep=2)
+    for _ in range(12):
+        reporter._write_once()
+    size = os.path.getsize(path)
+    assert size <= 200 + 120  # cap + at most one line of slack
+    rotated = sorted(p.name for p in tmp_path.iterdir())
+    assert "stats.jsonl.1" in rotated and "stats.jsonl.2" in rotated
+    assert "stats.jsonl.3" not in rotated  # keep=2 bounds the chain
+    # every surviving file holds well-formed snapshot lines
+    for name in ("stats.jsonl", "stats.jsonl.1", "stats.jsonl.2"):
+        with open(str(tmp_path / name)) as f:
+            for line in f:
+                assert "metrics" in json.loads(line)
+
+
+def test_reporter_rotation_preserves_stop_flush(tmp_path):
+    from petastorm_tpu.obs.export import Reporter
+    from petastorm_tpu.obs.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
+    path = str(tmp_path / "stats.jsonl")
+    with Reporter(registry=registry, interval_s=600.0, jsonl_path=path,
+                  max_bytes=10_000, keep=1):
+        pass  # stop() writes the final snapshot through the rotation path
+    with open(path) as f:
+        assert "metrics" in json.loads(f.readline())
+
+
+def test_reporter_without_cap_never_rotates(tmp_path):
+    from petastorm_tpu.obs.export import Reporter
+    from petastorm_tpu.obs.metrics import MetricsRegistry
+
+    path = str(tmp_path / "stats.jsonl")
+    reporter = Reporter(registry=MetricsRegistry(), interval_s=600.0,
+                        jsonl_path=path)
+    for _ in range(5):
+        reporter._write_once()
+    assert [p.name for p in tmp_path.iterdir()] == ["stats.jsonl"]
+
+
+# -- stats dashboard panels (satellite) -------------------------------------------------
+
+
+def test_dashboard_renders_remote_tier_transform_and_prov_panels():
+    from petastorm_tpu.obs.stats_cli import render_dashboard
+
+    metrics = {
+        "ptpu_io_tier_hits_total{tier=\"mem\"}": 5,
+        "ptpu_io_tier_hits_total{tier=\"remote\"}": 2,
+        "ptpu_io_tier_bytes_total{tier=\"mem\"}": 1e6,
+        "ptpu_io_tier_bytes_total{tier=\"remote\"}": 2e6,
+        "ptpu_io_remote_gets_total": 12,
+        "ptpu_io_remote_bytes_total": 3.2e7,
+        "ptpu_io_hedges_total": 4,
+        "ptpu_io_hedge_wins_total": 3,
+        "ptpu_io_remote_sparse_fallbacks_total": 0,
+        "ptpu_io_footer_cache_hits_total": 9,
+        "ptpu_io_footer_cache_misses_total": 1,
+        "ptpu_io_remote_get_seconds{size_class=\"20\",store=\"s\"}":
+            {"count": 12, "sum": 0.6, "mean": 0.05, "p50": 0.04, "p90": 0.09,
+             "p99": 0.2},
+        "ptpu_transform_seconds{op=\"normalize(x)\"}":
+            {"count": 6, "sum": 0.3, "mean": 0.05, "p50": 0.04, "p90": 0.08,
+             "p99": 0.1},
+        "ptpu_transform_rows_total": 384,
+        "ptpu_prov_items": 6,
+        "ptpu_prov_batches": 12,
+        "ptpu_prov_quarantined": 1,
+        "ptpu_prov_self_s_io_remote": 1.25,
+        "ptpu_prov_self_s_transform": 0.25,
+    }
+    out = render_dashboard(metrics, title="t")
+    assert "cache tiers:" in out and "remote hits=2" in out
+    assert "remote io:" in out and "hedges=4 (wins=3)" in out
+    assert "footer cache: hits=9" in out
+    assert "transform ops" in out and "normalize(x)" in out
+    assert "attribution" in out and "io_remote" in out
+    assert "quarantined items: 1" in out
+    # the new families no longer spill into the catch-all section
+    assert "other metrics:" not in out
+
+
+# -- post-review regressions ------------------------------------------------------------
+
+
+def test_fold_sibling_pop_preserves_grandparent():
+    """A partial-overlap sibling pops only the top of the stack — enclosing
+    ancestors that still contain the new span keep their parenthood."""
+    out = fold_self_times([("gp", 0.0, 10.0, 1), ("a", 1.0, 4.0, 1),
+                           ("b", 3.0, 9.0, 1)])
+    assert out["a"] == pytest.approx(3.0)
+    assert out["b"] == pytest.approx(6.0)
+    assert out["gp"] == pytest.approx(1.0)  # 10 - 3 - 6: both nested
+
+
+def test_concurrent_items_fold_per_record_not_merged():
+    """Two items' interleaved timelines must not double-charge outer spans
+    (the review repro): each record folds alone, nesting intact."""
+    views = [{"seq": 1, "rows": 8, "step_gap_s": 0.1, "spans": [],
+              "items": [(0, 0, 4), (0, 1, 4)],
+              "item_records": [
+                  {"annotations": {}, "attempts": 1, "spans": [
+                      {"site": "reader.read", "t0": 0, "t1": 10, "pid": 1},
+                      {"site": "io.remote", "t0": 1, "t1": 9, "pid": 1}]},
+                  {"annotations": {}, "attempts": 1, "spans": [
+                      {"site": "reader.read", "t0": 0.5, "t1": 10.5,
+                       "pid": 2}]}]}]
+    rep = analyze_batches(views)
+    # chain A: read self 10-8=2s + remote 8s; chain B: read self 10s —
+    # summing to each chain's own wall, never the merged-timeline 20s
+    assert rep.stage_self_s["io.remote"] == pytest.approx(8.0)
+    assert rep.stage_self_s["reader.read"] == pytest.approx(12.0)
+
+
+def test_factory_recorder_released_at_reader_teardown(store):
+    """A factory-built recorder must release the process-global slot at
+    reader join (the review lifecycle leak): a SECOND provenance reader in
+    the same process works after the first is torn down — and stays refused
+    while the first is live."""
+    r1 = make_batch_reader("file://" + store, num_epochs=1, provenance=True)
+    try:
+        with pytest.raises(RuntimeError, match="armed"):
+            make_batch_reader("file://" + store, num_epochs=1,
+                              provenance=True)
+    finally:
+        r1.stop()
+        r1.join()
+    assert prov.ACTIVE is None  # join released the slot
+    r2 = make_batch_reader("file://" + store, num_epochs=1, provenance=True)
+    try:
+        rows = sum(len(b.id) for b in r2)
+        assert rows == 192
+        assert len(r2._prov.items()) == 3
+    finally:
+        r2.stop()
+        r2.join()
+    assert prov.ACTIVE is None
+
+
+def test_reset_rearms_the_recorder(store):
+    """reset() goes through join() (disarm) then _start (re-arm): the second
+    pass must keep recording."""
+    reader = make_batch_reader("file://" + store, num_epochs=1,
+                               provenance=True)
+    try:
+        assert sum(len(b.id) for b in reader) == 192
+        reader.reset()
+        assert prov.ACTIVE is reader._prov
+        assert sum(len(b.id) for b in reader) == 192
+        items = reader._prov.items()
+        assert all(r["rows"] == 128 for r in items.values())  # both passes
+    finally:
+        reader.stop()
+        reader.join()
+
+
+def test_caller_supplied_recorder_stays_armed_past_teardown(store):
+    """A recorder the CALLER passed in is the caller's to disarm — loader
+    __exit__ / reader join must not release it."""
+    rec = ProvenanceRecorder()
+    reader = make_batch_reader("file://" + store, num_epochs=1,
+                               provenance=rec)
+    with DataLoader(reader, 32, to_device=False, provenance=rec) as loader:
+        assert sum(len(b["id"]) for b in loader) == 192
+    assert prov.ACTIVE is rec  # still armed: caller-owned
+    rec.disarm()
+
+
+def test_summary_is_cached_until_the_window_moves():
+    rec = ProvenanceRecorder()
+    rec.note_delivery(0, 0, 8)
+    rec.producer_cut(8)
+    rec.batch_delivered()
+    first = rec.summary()
+    assert rec._summary_cache is not None
+    assert rec.summary() == first  # served from cache, equal content
+    rec.note_delivery(0, 1, 8)
+    rec.producer_cut(8)
+    rec.batch_delivered()
+    second = rec.summary()
+    assert second["batches"] == 2  # cache invalidated by the new batch
