@@ -55,6 +55,10 @@ def __getattr__(name):
             from petastorm_tpu.recovery import RecoveryOptions
 
             return RecoveryOptions
+        if name in ("WatchOptions", "DatasetWatcher"):
+            from petastorm_tpu.dataset import watch
+
+            return getattr(watch, name)
         if name in ("FeaturePipeline", "Normalize", "Standardize", "Clip",
                     "Cast", "FillNull", "Bucketize", "HashField",
                     "VocabLookup", "FeatureCross"):

@@ -1,4 +1,4 @@
-"""Rule registry: the seven project-specific rule families."""
+"""Rule registry: the project-specific rule families."""
 from petastorm_tpu.analysis.rules.concurrency import (
     BlockingTeardownRule,
     LockDisciplineRule,
@@ -10,7 +10,10 @@ from petastorm_tpu.analysis.rules.observability import (
     SilentExceptionSwallowRule,
     UnpairedSpanRule,
 )
-from petastorm_tpu.analysis.rules.robustness import UnboundedBlockingCallRule
+from petastorm_tpu.analysis.rules.robustness import (
+    StatThenOpenRule,
+    UnboundedBlockingCallRule,
+)
 from petastorm_tpu.analysis.rules.schema import SchemaCodecContractRule
 from petastorm_tpu.analysis.rules.tracing import (
     HostIoInJitRule,
@@ -32,6 +35,7 @@ ALL_RULES = [
     SilentExceptionSwallowRule,
     UnpairedSpanRule,
     UnboundedBlockingCallRule,
+    StatThenOpenRule,
 ]
 
 __all__ = [cls.__name__ for cls in ALL_RULES] + ["ALL_RULES"]

@@ -1,4 +1,9 @@
-"""Robustness rule family (ISSUE 7): unbounded blocking calls in pipeline code.
+"""Robustness rule family (ISSUE 7, extended by ISSUE 11).
+
+GL-R001: unbounded blocking calls in pipeline code.
+GL-R002: stat-then-open TOCTOU windows — validating a path via
+``os.stat``/``os.path.getsize``/``os.path.getmtime`` and later ``open()``-ing
+it without re-checking a validation token.
 
 At pod scale the failure mode that hurts most is not a crash but a *hang*: a
 thread parked forever in ``queue.get()`` / ``Connection.recv()`` /
@@ -159,3 +164,93 @@ class UnboundedBlockingCallRule(Rule):
             return False
         # thread.join(timeout) / event.wait(timeout): 1st positional is it
         return len(call.args) >= 1 and bounded(call.args[0])
+
+
+#: callables whose dotted name (or bare from-import name) marks their first
+#: argument as a stat-VALIDATED path
+_STAT_CALLS = frozenset((
+    "os.stat", "os.path.getsize", "os.path.getmtime",
+    "stat", "getsize", "getmtime",
+))
+
+#: callables that OPEN their first argument (builtin + the common stdlib/pyarrow
+#: spellings pipeline code uses)
+_OPEN_CALLS = frozenset(("open", "os.open", "io.open"))
+
+#: method names (last attribute segment) that open their first argument on a
+#: filesystem object (pyarrow fs / fsspec)
+_OPEN_METHODS = frozenset(("open_input_file", "open_input_stream",
+                           "open_output_stream"))
+
+
+class StatThenOpenRule(Rule):
+    """GL-R002 (ISSUE 11): a path validated via ``os.stat``/``os.path.getsize``/
+    ``os.path.getmtime`` and later ``open()``-ed in the same function without
+    re-checking a validation token.
+
+    The gap between the stat and the open is a TOCTOU window: under a mutable
+    dataset the file can be rewritten (or replaced) in between, so whatever
+    the stat "validated" — a cache entry, a size-derived read plan, a
+    generation check — no longer describes the bytes the open returns. The
+    mutable-dataset plane exists precisely because this window is real
+    (docs/robustness.md "Mutable datasets"); code that must live with it
+    re-validates AFTER the open (``fstat``/``source.size()``/a
+    generation-token check à la ``FooterCache.get(..., stat_token=)``) or
+    carries an inline ``# graftlint: disable=GL-R002`` naming why the window
+    is benign.
+
+    Tracking is deliberately narrow — a variable (or ``self.<attr>``) passed
+    as the stat call's first argument, later passed as the first argument of
+    an open call in the SAME function scope — so findings are real: untyped
+    receivers and computed path expressions are left alone.
+    """
+
+    rule_id = "GL-R002"
+    severity = Severity.WARNING
+    description = ("stat-then-open TOCTOU: path validated by os.stat/getsize/"
+                   "getmtime, then open()ed without re-checking a validation "
+                   "token — under a mutable dataset the bytes opened may not "
+                   "be the bytes validated")
+    fix_hint = ("re-validate AFTER the open (fstat the handle / compare the "
+                "open source's size / a generation-token check), or justify "
+                "the window with an inline '# graftlint: disable=GL-R002' "
+                "comment")
+
+    def check(self, tree, ctx):
+        scopes = [tree] + [n for n in ast.walk(tree)
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))]
+        for scope in scopes:
+            yield from self._check_scope(scope, ctx)
+
+    def _check_scope(self, scope, ctx):
+        from petastorm_tpu.analysis.rules._astutil import walk_scope
+
+        statted = {}  # arg chain -> stat call line
+        opens = []    # (node, chain, line)
+        for node in walk_scope(scope):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            callee = attr_chain(node.func) or call_func_name(node)
+            if callee is None:
+                continue
+            target = attr_chain(node.args[0])
+            if target is None:
+                continue
+            line = getattr(node, "lineno", 0)
+            if callee in _STAT_CALLS:
+                prev = statted.get(target)
+                statted[target] = min(prev, line) if prev is not None else line
+            elif callee in _OPEN_CALLS or \
+                    (isinstance(node.func, ast.Attribute)
+                     and node.func.attr in _OPEN_METHODS):
+                opens.append((node, target, line))
+        for node, target, line in opens:
+            stat_line = statted.get(target)
+            if stat_line is not None and stat_line < line:
+                yield ctx.finding(
+                    self, node,
+                    "%r is opened here after being validated by a stat-family "
+                    "call on line %d — a TOCTOU window: the file can change "
+                    "between the two (re-validate after the open, or disable "
+                    "with a justification)" % (target, stat_line))

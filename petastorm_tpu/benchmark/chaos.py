@@ -25,8 +25,14 @@ readahead paths, absorbed by the shared retry budget), ``kills`` (children
 SIGKILL-equivalent mid-item — re-dispatch on respawn — plus one poison item
 that kills every child it meets and must be quarantined), ``poison`` (an item
 that deterministically raises in the worker), ``corrupt`` (a flipped byte in
-a wire payload — absorbed by re-dispatch, never delivered corrupt), and
-``stall-heal`` (an injected in-child hang healed in place).
+a wire payload — absorbed by re-dispatch, never delivered corrupt),
+``stall-heal`` (an injected in-child hang healed in place), and
+``mutating-dataset`` (ISSUE 11: seeded ``append_piece``/``remove_file``/
+``rewrite_file`` actions fired at the watcher's ``dataset.mutate`` hook while
+an epoch runs on dummy, thread AND process pools — asserting delivered ∪
+quarantined == final plan, disjoint and duplicate-free, no batch mixing two
+generations of one file, zero leaked leases; plus a ``num_epochs=None`` run
+that must observe an appended piece through the live watch thread).
 
 ``--smoke`` is the CI preset: tiny dataset, every scenario on BOTH the thread
 and process pools (where the fault applies to that pool), hard asserts on the
@@ -208,6 +214,185 @@ def _scenarios(files, smoke):
     ]
 
 
+# -- mutating-dataset scenario (ISSUE 11) ------------------------------------------------
+
+#: the id range rewritten generations start at — far above any planned id, so
+#: "a new-generation row leaked into the epoch" is one integer comparison
+_REWRITE_BASE = 10_000_000
+
+
+def _expected_ids_for_entry(entry, rows_per_file, files):
+    """A quarantined entry's planned ids by NAME CONVENTION (the file may be
+    removed or rewritten — reading it back is impossible or wrong)."""
+    name = os.path.basename(entry.path)
+    if name.startswith("part_zz"):
+        return list(range(files * rows_per_file,
+                          (files + 1) * rows_per_file))
+    index = int(name.split("_")[1].split(".")[0])
+    return list(range(index * rows_per_file, (index + 1) * rows_per_file))
+
+
+def _run_mutating_dataset(pool, files, rows, timeout_s=180.0):
+    """One epoch under seeded dataset mutations driven through the
+    ``dataset.mutate`` chaos hook: append at tick 1, remove + rewrite (of the
+    two LAST files, still pending behind the throttled consumer) at tick 2.
+    Asserts the exactly-once-or-quarantined invariant over the FINAL plan
+    (initial ∪ appended ids), no mixed generations, zero leaked leases."""
+    import time as _time
+
+    from petastorm_tpu import chaos
+    from petastorm_tpu.chaos import FaultPlan, FaultRule
+    from petastorm_tpu.dataset.mutate import LocalDatasetMutator
+    from petastorm_tpu.reader import make_batch_reader
+    from petastorm_tpu.recovery import RecoveryOptions
+
+    root = tempfile.mkdtemp(prefix="ptpu-chaos-mut-")
+    try:
+        _write_dataset(root, files, rows)
+        remove_name = "part_%03d.parquet" % (files - 1)
+        rewrite_name = "part_%03d.parquet" % (files - 2)
+        plan = FaultPlan([
+            FaultRule("dataset.mutate", "append_piece", nth=1, times=1,
+                      target={"name": "part_zz0.parquet",
+                              "start": files * rows, "rows": rows}),
+            FaultRule("dataset.mutate", "remove_file", nth=2, times=1,
+                      target={"name": remove_name}),
+            FaultRule("dataset.mutate", "rewrite_file", nth=2, times=1,
+                      target={"name": rewrite_name, "start": _REWRITE_BASE,
+                              "rows": rows}),
+        ], seed=11)
+        recovery = RecoveryOptions(on_poison="quarantine", poison_attempts=2,
+                                   io_retries=1, io_retry_backoff_s=0.01,
+                                   worker_respawns=4 * files)
+        leaked_before = _leaked_total()
+        t0 = time.perf_counter()
+        with chaos.armed(plan):
+            reader = make_batch_reader(
+                "file://" + root, num_epochs=1, shuffle_row_groups=False,
+                reader_pool_type=pool, workers_count=2, results_queue_size=2,
+                results_timeout_s=timeout_s,
+                wire_serializer="shm-view" if pool == "process" else None,
+                recovery=recovery, watch={"interval_s": 0.1})
+            mutator = LocalDatasetMutator(root)
+            reader.dataset_watcher.set_mutator(mutator)
+            delivered = []
+            wire_stats = {}
+            try:
+                for batch in reader:
+                    delivered.extend(int(v) for v in np.asarray(batch.id))
+                    # throttle until the seeded mutations have all fired AND
+                    # the watcher has applied the resulting deltas — the
+                    # bounded results queue holds the plan open meanwhile, so
+                    # the appended piece joins THIS epoch deterministically
+                    deadline = _time.monotonic() + 60.0
+                    while (plan.stats()["injected_total"] < 3
+                           or reader.io_stats().get("watch_deltas", 0) < 1) \
+                            and _time.monotonic() < deadline:
+                        _time.sleep(0.02)
+                report = reader.quarantine_report
+                wire_stats = reader.wire_stats()
+            finally:
+                reader.stop()
+                reader.join()
+        duration = time.perf_counter() - t0
+        import gc
+
+        gc.collect()
+        leak_delta = _leaked_total() - leaked_before
+
+        assert plan.stats()["injected_total"] == 3, plan.stats()
+        assert len(mutator.applied) == 3, mutator.applied
+        quarantined = []
+        for entry in report:
+            quarantined.extend(_expected_ids_for_entry(entry, rows, files))
+        expected = list(range((files + 1) * rows))  # initial ∪ appended
+        # -- the invariant (ISSUE 11 flavor) --------------------------------------------
+        new_gen = [i for i in delivered if i >= _REWRITE_BASE]
+        assert not new_gen, \
+            "mutating-dataset(%s): rewritten generation leaked into the " \
+            "epoch (%d rows)" % (pool, len(new_gen))
+        assert len(delivered) == len(set(delivered)), \
+            "mutating-dataset(%s): duplicate rows delivered" % pool
+        assert not (set(delivered) & set(quarantined)), \
+            "mutating-dataset(%s): rows both delivered AND quarantined" % pool
+        assert sorted(delivered + quarantined) == expected, \
+            "mutating-dataset(%s): delivered ∪ quarantined != final plan " \
+            "(%d + %d vs %d)" % (pool, len(delivered), len(quarantined),
+                                 len(expected))
+        assert leak_delta == 0, \
+            "mutating-dataset(%s): ptpu_lease_leaked_total moved by %d" \
+            % (pool, leak_delta)
+        in_flight = wire_stats.get("shm_slabs_in_flight")
+        assert not in_flight, \
+            "mutating-dataset(%s): %s slabs still in flight" % (pool, in_flight)
+        return {
+            "scenario": "mutating-dataset", "pool": pool,
+            "wire": "shm-view" if pool == "process" else "default",
+            "delivered": len(delivered), "quarantined_items": len(report),
+            "quarantined_rows": len(quarantined), "injected": 3,
+            "lease_leak_delta": leak_delta, "seconds": round(duration, 3),
+            "heals": 0,
+        }
+    finally:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _run_infinite_watch(files, rows, timeout_s=60.0):
+    """num_epochs=None acceptance: the LIVE watch thread (no manual polling)
+    must observe a chaos-appended piece and feed it to the consumer within
+    the run's deadline (~a handful of 0.1s watch intervals)."""
+    import time as _time
+
+    from petastorm_tpu import chaos
+    from petastorm_tpu.chaos import FaultPlan, FaultRule
+    from petastorm_tpu.dataset.mutate import LocalDatasetMutator
+    from petastorm_tpu.reader import make_batch_reader
+
+    root = tempfile.mkdtemp(prefix="ptpu-chaos-watch-")
+    try:
+        _write_dataset(root, files, rows)
+        appended = set(range(files * rows, (files + 1) * rows))
+        plan = FaultPlan([
+            FaultRule("dataset.mutate", "append_piece", nth=2, times=1,
+                      target={"name": "part_zz0.parquet",
+                              "start": files * rows, "rows": rows}),
+        ], seed=13)
+        t0 = time.perf_counter()
+        seen = False
+        with chaos.armed(plan):
+            reader = make_batch_reader(
+                "file://" + root, num_epochs=None, shuffle_row_groups=False,
+                reader_pool_type="thread", workers_count=2,
+                results_queue_size=2, results_timeout_s=timeout_s,
+                watch={"interval_s": 0.1})
+            reader.dataset_watcher.set_mutator(LocalDatasetMutator(root))
+            deadline = _time.monotonic() + timeout_s
+            try:
+                for batch in reader:
+                    if {int(v) for v in np.asarray(batch.id)} & appended:
+                        seen = True
+                        break
+                    if _time.monotonic() > deadline:
+                        break
+            finally:
+                reader.stop()
+                reader.join()
+        assert seen, \
+            "infinite-watch: the appended piece never reached the consumer " \
+            "within %.0fs" % timeout_s
+        return {"scenario": "infinite-watch", "pool": "thread",
+                "wire": "default", "delivered": len(appended),
+                "quarantined_items": 0, "quarantined_rows": 0, "injected": 1,
+                "lease_leak_delta": 0,
+                "seconds": round(time.perf_counter() - t0, 3), "heals": 0}
+    finally:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description=__doc__,
@@ -259,11 +444,31 @@ def main(argv=None):
                          result["seconds"]))
                 results.append(result)
 
+    # mutating-dataset (ISSUE 11) runs against its own per-run dataset dirs
+    # (the mutations destroy them); at least 16 files so the pools' claimed/
+    # prefetched window never covers the remove/rewrite targets
+    if not args.scenario or args.scenario == "mutating-dataset":
+        mut_files = max(files, 16)
+        for pool in ("dummy", "thread", "process"):
+            result = _run_mutating_dataset(pool, mut_files, rows)
+            print("chaos %-13s %-8s delivered=%-6d quarantined=%-3d "
+                  "injected=%-3d heals=%d leak_delta=%d %.2fs"
+                  % (result["scenario"], pool, result["delivered"],
+                     result["quarantined_rows"], result["injected"],
+                     result["heals"], result["lease_leak_delta"],
+                     result["seconds"]))
+            results.append(result)
+        result = _run_infinite_watch(4, rows)
+        print("chaos %-13s %-8s appended piece observed live in %.2fs"
+              % (result["scenario"], result["pool"], result["seconds"]))
+        results.append(result)
+
     summary = {
         "chaos_summary": {
             "scenarios": results,
             "invariant": "delivered ∪ quarantined == plan; no duplicates; "
-                         "zero leaked leases/slabs; no hangs",
+                         "zero leaked leases/slabs; no hangs; no batch mixes "
+                         "two generations of one file",
             "ok": True,
         }
     }

@@ -28,6 +28,11 @@ class CacheBase:
         a safe answer."""
         return False
 
+    def invalidate(self, key):
+        """Drop the entry for ``key`` if present (ISSUE 11: a rewritten or
+        removed source file's decoded payloads must not linger). A no-op
+        default — caches that hold nothing have nothing to drop."""
+
     def cleanup(self):
         pass
 
@@ -61,6 +66,21 @@ class LocalDiskCache(CacheBase):
 
     def contains(self, key):
         return os.path.exists(self._key_path(key))
+
+    def invalidate(self, key):
+        """Unlink the entry for ``key`` (keyed invalidation, ISSUE 11).
+
+        The cache has no wholesale validation of its own — entries are only as
+        fresh as their keys. With dataset watching on, the reader embeds each
+        piece's generation token (size+mtime+footer-crc) in the cache key, so
+        a rewritten source file — even one colliding on size AND mtime — maps
+        to a NEW key and can never serve the old generation's decoded
+        payloads; this method lets the watcher reclaim the orphaned old-token
+        entries the moment the rewrite is detected."""
+        try:
+            os.unlink(self._key_path(key))
+        except OSError:
+            pass  # absent (or concurrently evicted) is the goal state
 
     def get(self, key, fill_cache_func):
         from petastorm_tpu.obs.log import degradation

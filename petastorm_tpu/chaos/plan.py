@@ -32,6 +32,10 @@ wire.decode        ``serializers.py`` — one hit per wire payload decode
                    (the only site where ``corrupt`` mutates real bytes)
 child.item         ``_child_worker`` loop, in-child around ``worker(item)``
                    (the only site where ``kill`` takes the process down)
+dataset.mutate     ``DatasetWatcher.poll_once`` (ISSUE 11) — once per watch
+                   tick when a mutator is attached; the only site where the
+                   ``remove_file``/``rewrite_file``/``append_piece`` actions
+                   mutate a real dataset
 =================  ====================================================
 
 Every injected fault is recorded: a ``ptpu_degradations_total{cause=
@@ -48,7 +52,13 @@ import time
 import zlib
 
 _ACTIONS = ("raise_transient", "raise_permanent", "latency", "corrupt",
-            "kill", "hang")
+            "kill", "hang", "remove_file", "rewrite_file", "append_piece")
+
+#: dataset-mutation actions (ISSUE 11): evaluated at the ``dataset.mutate``
+#: hook site, where the payload is a mutator object (e.g.
+#: :class:`petastorm_tpu.dataset.mutate.LocalDatasetMutator`) exposing a
+#: method per action; ``rule.target`` is the JSON spec handed to it
+_MUTATE_ACTIONS = ("remove_file", "rewrite_file", "append_piece")
 
 #: process-role flag: ``kill`` only ever takes down a pool child (or a process
 #: that explicitly opted in, e.g. the chaos harness's subprocesses) — firing
@@ -100,14 +110,18 @@ class FaultRule:
         Total-fire budget (None = unlimited).
     latency_s / hang_s / message :
         Action parameters.
+    target : optional
+        Dataset-mutation action spec (JSON-serializable; see
+        :mod:`petastorm_tpu.dataset.mutate` for the shapes the
+        ``remove_file``/``rewrite_file``/``append_piece`` actions take).
     """
 
     __slots__ = ("site", "action", "nth", "every", "probability", "item_key",
-                 "times", "latency_s", "hang_s", "message")
+                 "times", "latency_s", "hang_s", "message", "target")
 
     def __init__(self, site, action, nth=None, every=None, probability=None,
                  item_key=None, times=None, latency_s=0.05, hang_s=3600.0,
-                 message=None):
+                 message=None, target=None):
         if action not in _ACTIONS:
             raise ValueError("action must be one of %s, got %r"
                              % (_ACTIONS, action))
@@ -125,6 +139,7 @@ class FaultRule:
         self.latency_s = float(latency_s)
         self.hang_s = float(hang_s)
         self.message = message
+        self.target = target
 
     def to_spec(self):
         return {name: getattr(self, name) for name in self.__slots__}
@@ -261,6 +276,18 @@ class FaultPlan:
                 % (site, key))
         if action == "corrupt":
             return _corrupt_payload(payload, self.seed, idx)
+        if action in _MUTATE_ACTIONS:
+            # the dataset.mutate hook site passes a mutator object as the
+            # payload; the action is a method call on it with the rule's spec
+            method = getattr(payload, action, None)
+            if method is None:
+                raise ChaosError(
+                    "chaos %r fired at %s without a dataset mutator payload; "
+                    "mutation rules target the 'dataset.mutate' site of a "
+                    "watcher with a mutator attached (DatasetWatcher."
+                    "set_mutator)" % (action, site))
+            method(rule.target)
+            return payload
         if action == "kill":
             if not _kill_allowed:
                 raise ChaosError(

@@ -1,0 +1,22 @@
+"""Dataset-mutation plane (ISSUE 11): generation tokens, the watch thread
+that diffs the piece set of a LIVE dataset, and deterministic mutation
+helpers for the chaos harness."""
+from petastorm_tpu.dataset.watch import (  # noqa: F401
+    DatasetWatcher,
+    PlanDelta,
+    WatchOptions,
+    current_stat_token,
+    generation_token,
+    stamp_generation_tokens,
+    tokens_match,
+)
+
+__all__ = [
+    "DatasetWatcher",
+    "PlanDelta",
+    "WatchOptions",
+    "current_stat_token",
+    "generation_token",
+    "stamp_generation_tokens",
+    "tokens_match",
+]

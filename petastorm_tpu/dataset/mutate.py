@@ -1,0 +1,82 @@
+"""Deterministic local-dataset mutations for chaos scenarios (ISSUE 11).
+
+:class:`LocalDatasetMutator` is the payload object the ``dataset.mutate``
+chaos hook site hands to the ``remove_file`` / ``rewrite_file`` /
+``append_piece`` :class:`~petastorm_tpu.chaos.FaultRule` actions: each action
+calls the method of the same name with the rule's JSON ``target`` spec, so a
+seeded :class:`~petastorm_tpu.chaos.FaultPlan` replays the exact same
+mutation sequence at the exact same watch ticks every run.
+
+Targets are plain dicts (they cross the FaultRule JSON round trip):
+
+- ``remove_file``:  ``{"name": "part_003.parquet"}``
+- ``rewrite_file``: ``{"name": "part_003.parquet", "start": 10**6,
+  "rows": 64}`` — atomically replaces the file (write-temp + ``os.replace``)
+  with a fresh generation whose ``id`` column covers ``[start, start+rows)``
+- ``append_piece``: same spec, but the name must be new; by convention
+  scenario files sort AFTER the initial ``part_*`` names (e.g.
+  ``part_zz0.parquet``) so ordinal identity survives a plan rebuild on resume
+
+The default table builder writes the chaos harness's ``{id: int64, x:
+float64}`` schema with a seeded rng; pass ``table_fn(start, rows)`` for other
+schemas. Local filesystems only — this is a test/bench utility, not a data
+tool.
+"""
+from __future__ import annotations
+
+import os
+
+
+class LocalDatasetMutator:
+    """Applies deterministic file mutations under a local dataset root."""
+
+    def __init__(self, root, seed=0, table_fn=None):
+        self._root = str(root)
+        self._seed = int(seed)
+        self._table_fn = table_fn
+        self._applied = []  # (action, name) in application order
+
+    def _build_table(self, start, rows):
+        if self._table_fn is not None:
+            return self._table_fn(start, rows)
+        import numpy as np
+        import pyarrow as pa
+
+        rng = np.random.default_rng(self._seed + int(start))
+        return pa.table({
+            "id": np.arange(start, start + rows, dtype=np.int64),
+            "x": rng.random(int(rows)),
+        })
+
+    def _write(self, name, start, rows):
+        import pyarrow.parquet as pq
+
+        table = self._build_table(int(start), int(rows))
+        full = os.path.join(self._root, name)
+        tmp = full + ".tmp-mutate"
+        pq.write_table(table, tmp, row_group_size=table.num_rows)
+        os.replace(tmp, full)  # atomic: readers see old bytes or new, never half
+
+    # -- the chaos action surface -------------------------------------------------------
+
+    def remove_file(self, target):
+        name = target["name"] if isinstance(target, dict) else str(target)
+        os.remove(os.path.join(self._root, name))
+        self._applied.append(("remove_file", name))
+
+    def rewrite_file(self, target):
+        self._write(target["name"], target["start"], target["rows"])
+        self._applied.append(("rewrite_file", target["name"]))
+
+    def append_piece(self, target):
+        full = os.path.join(self._root, target["name"])
+        if os.path.exists(full):
+            raise FileExistsError(
+                "append_piece target already exists: %s" % full)
+        self._write(target["name"], target["start"], target["rows"])
+        self._applied.append(("append_piece", target["name"]))
+
+    @property
+    def applied(self):
+        """``[(action, name), ...]`` in application order (scenario asserts)."""
+        return list(self._applied)

@@ -1,0 +1,486 @@
+"""Dataset-watch plane: generation tokens + mutation detection (ISSUE 11).
+
+Every layer built before this module assumed a frozen dataset: plans from a
+one-shot footer scan, caches validated wholesale by file size, closed epochs.
+The production workload ROADMAP item 5 names is append-heavy — new Parquet
+files land (and old ones get rewritten or deleted) while training runs
+("Optimizing High-Throughput Distributed Data Pipelines for Reproducible Deep
+Learning at Scale", PAPERS.md). This module makes mutation a first-class,
+*accounted* event instead of a stale-cache hazard or an unclassified crash:
+
+- **Generation tokens**: every file gets an identity string
+  ``"<size>.<mtime_ns>.<footer-crc>"`` stamped into its plan items
+  (:func:`stamp_generation_tokens` → ``RowGroupPiece.generation``). The token
+  rides into every read (validated per attempt), every cache key
+  (mem/disk/readahead — a rewritten file maps to NEW keys, so stale decoded
+  payloads are unreachable even on a size+mtime collision), the footer cache
+  (``FooterEntry.stat_token``), and the stats-cache fingerprint.
+- **:class:`DatasetWatcher`**: a per-reader thread that re-enumerates the
+  dataset every ``interval_s``, diffs against its snapshot, and emits a
+  :class:`PlanDelta` (added / removed / rewritten). The reader extends its
+  :class:`~petastorm_tpu.plan.EpochPlan` with added pieces (current epoch),
+  defers a rewritten file's new generation to the NEXT epoch (the
+  no-mixed-generations invariant), and invalidates the removed/rewritten
+  pieces' cache entries. Deltas are counted
+  (``ptpu_dataset_{pieces_added,pieces_removed,pieces_rewritten,
+  plan_extensions,generation_conflicts}_total``) and mirrored into any live
+  flight recorder so a stall record shows the mutation timeline.
+- **Chaos hook**: each watch tick evaluates the ``dataset.mutate`` site when a
+  mutator is attached, so seeded ``FaultPlan`` actions
+  (``remove_file``/``rewrite_file``/``append_piece`` — see
+  :mod:`petastorm_tpu.dataset.mutate`) drive replayable mutation scenarios in
+  CI (``petastorm-tpu-bench chaos``, the ``mutating-dataset`` scenario).
+
+Read-time enforcement lives in :mod:`petastorm_tpu.reader`
+(``_WorkerBase._verify_generation``): a deleted file raises
+:class:`~petastorm_tpu.errors.PieceRemovedError`, a token mismatch raises
+:class:`~petastorm_tpu.errors.PieceRewrittenError` after invalidating the
+piece's footer/mem/disk entries — both quarantine under the PR-7 policy with
+their own causes (``piece_removed`` / ``piece_rewritten``) charged to the
+checkpoint watermark, preserving exactly-once-or-quarantined under churn.
+
+See docs/robustness.md "Mutable datasets".
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from petastorm_tpu import chaos as _chaos
+from petastorm_tpu.io import _env_float
+
+#: token part separator; a token is "<size>.<mtime_ns>.<crc8hex-or-->"
+_SEP = "."
+
+
+def _with_crc(stat, crc=None):
+    """Full token from a stat half + optional crc — the ONE encoding point
+    (the stamping path, the watcher's scan, and the read-time verifier all
+    compare tokens built here)."""
+    return "%s%s%s" % (stat, _SEP, ("%08x" % crc) if crc is not None else "-")
+
+
+def _format_token(size, mtime_ns, crc=None):
+    return _with_crc("%s%s%s" % (size, _SEP, mtime_ns), crc)
+
+
+def _split(token):
+    """(stat_part, crc_part) of a token string (crc_part may be '-')."""
+    stat, _, crc = token.rpartition(_SEP)
+    return stat, crc
+
+
+def stat_token_of(token):
+    """The "<size>.<mtime_ns>" half of a full generation token."""
+    return _split(token)[0]
+
+
+def current_stat_token(fs, path, info=None):
+    """The file's CURRENT stat identity, or raises
+    :class:`~petastorm_tpu.errors.PieceRemovedError` when it is gone."""
+    import pyarrow.fs as pafs
+
+    from petastorm_tpu.errors import PieceRemovedError
+
+    if info is None:
+        info = fs.get_file_info(path)
+    if info.type == pafs.FileType.NotFound:
+        raise PieceRemovedError(
+            "dataset file removed under a running reader: %s" % path)
+    mtime = getattr(info, "mtime_ns", None)
+    if mtime is None:  # filesystems without ns stamps: the datetime second
+        dt = getattr(info, "mtime", None)
+        mtime = int(dt.timestamp() * 1e9) if dt is not None else 0
+    return "%s%s%s" % (info.size, _SEP, mtime)
+
+
+def generation_token(fs, path, footer_crc=True, info=None, fresh=False):
+    """The file's full generation token: stat identity plus (optionally) the
+    footer-metadata crc, resolved through the shared footer cache pinned to
+    exactly this stat identity — a stale same-size parse can never leak in.
+
+    ``fresh=True`` drops any cached footer first: the one hole stat-pinning
+    cannot close is a rewrite that collides on size AND mtime while a parse
+    of the predecessor is still resident — reader construction pays one
+    footer re-read per file to stamp tokens that describe the bytes as they
+    are NOW."""
+    stat = current_stat_token(fs, path, info=info)
+    if not footer_crc:
+        return _format_token(*stat.split(_SEP))
+    from petastorm_tpu.io.footercache import shared_footer_cache
+
+    footers = shared_footer_cache()
+    if fresh:
+        footers.invalidate(path)
+    entry = footers.get(fs, path, stat_token=stat)
+    return _with_crc(stat, entry.crc)
+
+
+def tokens_match(stamped, observed):
+    """Do two generation tokens identify the same file generation?
+
+    ``None`` on either side means "unknown" and matches (no enforcement
+    possible); a ``'-'`` crc half matches any crc (stat-only tokens)."""
+    if stamped is None or observed is None:
+        return True
+    if stamped == observed:
+        return True
+    a_stat, a_crc = _split(stamped)
+    b_stat, b_crc = _split(observed)
+    if a_stat != b_stat:
+        return False
+    return a_crc == "-" or b_crc == "-" or a_crc == b_crc
+
+
+def stamp_generation_tokens(fs, pieces, footer_crc=True):
+    """Return ``pieces`` with each one's ``generation`` field stamped (one
+    stat + one FRESH footer parse per unique path — a resident parse of a
+    stat-colliding predecessor must not vouch for the current bytes). A path
+    that cannot be tokenized (vanished mid-stamp, unreadable footer) keeps
+    ``generation=None`` — its reads proceed unvalidated and fail on their own
+    terms."""
+    tokens = {}
+    out = []
+    for piece in pieces:
+        tok = tokens.get(piece.path)
+        if tok is None and piece.path not in tokens:
+            try:
+                tok = generation_token(fs, piece.path, footer_crc=footer_crc,
+                                       fresh=True)
+            except Exception as e:  # noqa: BLE001 — stamping is best-effort
+                from petastorm_tpu.obs.log import degradation
+
+                degradation(
+                    "watch_error",
+                    "could not stamp a generation token for %s (%s); reads of "
+                    "it proceed unvalidated", piece.path, e)
+                tok = None
+            tokens[piece.path] = tok
+        out.append(piece._replace(generation=tok) if tok is not None
+                   else piece)
+    return out
+
+
+class WatchOptions:
+    """Knobs for the dataset-watch plane (``watch=`` on the reader factories:
+    ``True``/dict/instance — same normalize contract as ``IoOptions``).
+
+    ==============  ========================  ===============================
+    field           env var                   meaning
+    ==============  ========================  ===============================
+    interval_s      PTPU_WATCH_INTERVAL_S     seconds between watch ticks
+                                              (default 5.0)
+    footer_crc      PTPU_WATCH_FOOTER_CRC     include the footer-metadata crc
+                                              in generation tokens (default
+                                              on; off = stat-only tokens, one
+                                              less footer read per file)
+    ==============  ========================  ===============================
+    """
+
+    __slots__ = ("interval_s", "footer_crc")
+
+    def __init__(self, interval_s=None, footer_crc=None):
+        self.interval_s = max(0.05, _env_float("PTPU_WATCH_INTERVAL_S", 5.0)
+                              if interval_s is None else float(interval_s))
+        if footer_crc is None:
+            footer_crc = (os.environ.get("PTPU_WATCH_FOOTER_CRC", "1")
+                          not in ("0", "false", "no"))
+        self.footer_crc = bool(footer_crc)
+
+    @classmethod
+    def normalize(cls, value):
+        """``None``/``False`` → None (watching off), ``True`` → defaults,
+        dict → kwargs, instance → itself."""
+        if value is None or value is False:
+            return None
+        if value is True:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            return cls(**value)
+        raise TypeError("watch must be a WatchOptions, a dict of its fields, "
+                        "True/False, or None; got %r" % type(value).__name__)
+
+
+class PlanDelta:
+    """One watch tick's observed mutations.
+
+    ``added``: new files' pieces (stamped). ``removed``: ``(path,
+    old_pieces)``. ``rewritten``: ``(path, old_pieces, new_pieces)`` — the old
+    generation's pieces (for invalidation) and the new generation's stamped
+    replacements (for deferred re-planning)."""
+
+    __slots__ = ("added", "removed", "rewritten")
+
+    def __init__(self, added=(), removed=(), rewritten=()):
+        self.added = list(added)
+        self.removed = list(removed)
+        self.rewritten = list(rewritten)
+
+    def __bool__(self):
+        return bool(self.added or self.removed or self.rewritten)
+
+    def __repr__(self):
+        return "<PlanDelta +%d pieces, -%d files, ~%d files>" % (
+            len(self.added), len(self.removed), len(self.rewritten))
+
+
+_metrics_lock = threading.Lock()
+_metrics = None
+
+
+def watch_metrics():
+    """The ``ptpu_dataset_*`` counter family (resolved once per process)."""
+    global _metrics
+    if _metrics is None:
+        with _metrics_lock:
+            if _metrics is None:
+                from petastorm_tpu.obs.metrics import default_registry
+
+                reg = default_registry()
+                _metrics = {
+                    "pieces_added": reg.counter(
+                        "ptpu_dataset_pieces_added_total",
+                        help="row-group pieces discovered by the dataset "
+                             "watcher and appended to a live plan"),
+                    "pieces_removed": reg.counter(
+                        "ptpu_dataset_pieces_removed_total",
+                        help="row-group pieces whose file disappeared under "
+                             "a running reader"),
+                    "pieces_rewritten": reg.counter(
+                        "ptpu_dataset_pieces_rewritten_total",
+                        help="row-group pieces whose file changed generation "
+                             "under a running reader"),
+                    "plan_extensions": reg.counter(
+                        "ptpu_dataset_plan_extensions_total",
+                        help="EpochPlan.extend calls applied by the watcher"),
+                    "generation_conflicts": reg.counter(
+                        "ptpu_dataset_generation_conflicts_total",
+                        help="reads that found a generation-token mismatch "
+                             "(file rewritten between plan and read)"),
+                }
+    return _metrics
+
+
+class DatasetWatcher:
+    """Polls a dataset for piece-set mutations and reports :class:`PlanDelta`\\ s.
+
+    One per watching :class:`~petastorm_tpu.reader.Reader` (the reader primes
+    it with the factory's stamped pieces and wires ``on_delta`` to its
+    plan-extension seam). The scan enumerates REAL files — not the write-time
+    KV row-group counts, which never learn about appended files — and reads
+    footers only for new/changed paths (unchanged stat identities reuse the
+    previous tick's pieces), so a quiet tick costs one listing plus one stat
+    per file.
+
+    The ``dataset.mutate`` chaos hook site is evaluated at the top of each
+    tick **when a mutator is attached** (:meth:`set_mutator` — the chaos
+    harness's seam; see :mod:`petastorm_tpu.dataset.mutate`), so seeded
+    mutation scenarios count ticks deterministically from the moment the
+    harness arms them.
+    """
+
+    def __init__(self, fs, path, options=None, on_delta=None):
+        if isinstance(path, list):
+            raise ValueError("dataset watching supports a single dataset "
+                             "path, got a list of %d" % len(path))
+        self._fs = fs
+        self._path = path
+        self._opts = options if options is not None else WatchOptions()
+        self._on_delta = on_delta
+        self._snapshot = None  # path -> (token, [pieces])
+        self._mutator = None
+        self._thread = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._ticks = 0
+        self._deltas = 0
+        self._errors = 0
+
+    # -- lifecycle ----------------------------------------------------------------------
+
+    def start(self):
+        """Start (or restart after :meth:`stop`) the watch thread."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="ptpu-dataset-watch")
+            self._thread.start()
+
+    def stop(self):
+        with self._lock:
+            thread, self._thread = self._thread, None
+        self._stop.set()
+        if thread is not None:
+            thread.join(timeout=30.0)
+
+    def _run(self):
+        while not self._stop.wait(self._opts.interval_s):
+            self.poll_once()
+
+    # -- wiring -------------------------------------------------------------------------
+
+    def set_mutator(self, mutator):
+        """Attach the chaos harness's dataset mutator: from the next tick on,
+        the ``dataset.mutate`` hook site is evaluated with it as the payload
+        (seeded ``remove_file``/``rewrite_file``/``append_piece`` actions)."""
+        self._mutator = mutator
+
+    def prime(self, pieces, known_paths=None):
+        """Seed the snapshot from already-stamped plan pieces (the factory's
+        initial scan) so the first tick diffs against the plan, not a rescan.
+
+        ``known_paths``: every dataset file that existed at plan time —
+        including ones plan-time pruning (filters / predicate / hive
+        partitions / row-group selectors) kept OUT of the plan. Those enter
+        the snapshot as inert sentinels, so the first tick does not
+        misclassify them as appended and re-add what the user's selection
+        excluded; they stay unwatched (a rewrite of an unplanned file is not
+        this reader's business)."""
+        snapshot = {}
+        for piece in pieces:
+            tok, existing = snapshot.get(piece.path, (piece.generation, []))
+            existing.append(piece)
+            snapshot[piece.path] = (tok, existing)
+        for path in known_paths or ():
+            snapshot.setdefault(path, (None, []))
+        self._snapshot = snapshot
+
+    # -- one tick -----------------------------------------------------------------------
+
+    def poll_once(self):
+        """One watch tick: chaos hook, rescan, diff, account, notify.
+        Returns the :class:`PlanDelta` (empty on a quiet tick) or ``None``
+        when the tick failed (counted + logged as ``watch_error``)."""
+        self._ticks += 1
+        if _chaos.ACTIVE is not None and self._mutator is not None:
+            try:
+                _chaos.ACTIVE.hit("dataset.mutate", key="tick=%d" % self._ticks,
+                                  payload=self._mutator)
+            except Exception as e:  # noqa: BLE001 — a bad mutate rule must not
+                # kill the watch thread; the scenario sees it in the log
+                self._errors += 1
+                from petastorm_tpu.obs.log import degradation
+
+                degradation("watch_error",
+                            "dataset.mutate chaos action failed: %s", e,
+                            once=False)
+        try:
+            current = self._scan()
+        except Exception as e:  # noqa: BLE001 — a failed listing is a tick
+            # lost, not a dead watcher: object-store listings flake
+            self._errors += 1
+            from petastorm_tpu.obs.log import degradation
+
+            degradation("watch_error",
+                        "dataset watch scan of %s failed (%s); retrying next "
+                        "tick", self._path, e, once=False)
+            return None
+        previous, self._snapshot = self._snapshot, current
+        if previous is None:
+            return PlanDelta()
+        delta = self._diff(previous, current)
+        if delta:
+            self._deltas += 1
+            self._account(delta)
+            if self._on_delta is not None:
+                try:
+                    self._on_delta(delta)
+                except Exception as e:  # noqa: BLE001 — the reader seam must
+                    # not kill the watch thread; surfaced like a scan failure
+                    self._errors += 1
+                    from petastorm_tpu.obs.log import degradation
+
+                    degradation("watch_error",
+                                "applying a dataset PlanDelta failed: %s", e,
+                                once=False)
+        return delta
+
+    def _scan(self):
+        """``{path: (token, [pieces])}`` of the dataset as it exists NOW."""
+        import pyarrow.fs as pafs
+
+        from petastorm_tpu.metadata import RowGroupPiece, _list_parquet_files
+        from petastorm_tpu.partitions import partition_values_for_path
+
+        out = {}
+        snapshot = self._snapshot or {}
+        for full in _list_parquet_files(self._fs, self._path):
+            prev = snapshot.get(full)
+            if prev is not None and prev[0] is None:
+                # plan-time-pruned sentinel: the user's selection excluded
+                # this file — stays inert (no stat, no footer, no deltas)
+                out[full] = prev
+                continue
+            info = self._fs.get_file_info(full)
+            if info.type == pafs.FileType.NotFound:
+                continue  # raced a deletion between listing and stat
+            stat = current_stat_token(self._fs, full, info=info)
+            if prev is not None and stat_token_of(prev[0] or "") == stat:
+                out[full] = prev  # unchanged: reuse last tick's pieces
+                continue
+            from petastorm_tpu.io.footercache import shared_footer_cache
+
+            footers = shared_footer_cache()
+            entry = footers.get(self._fs, full, stat_token=stat)
+            tok = _with_crc(stat, entry.crc if self._opts.footer_crc else None)
+            pv = partition_values_for_path(full, self._path) or None
+            pieces = [RowGroupPiece(full, rg, entry.row_group_rows[rg], pv,
+                                    None, tok)
+                      for rg in range(entry.num_row_groups)]
+            out[full] = (tok, pieces)
+        return out
+
+    @staticmethod
+    def _diff(previous, current):
+        added, removed, rewritten = [], [], []
+        for path, (tok, pieces) in current.items():
+            prev = previous.get(path)
+            if prev is None:
+                added.extend(pieces)
+            elif not tokens_match(prev[0], tok):
+                rewritten.append((path, prev[1], pieces))
+        for path, (tok, pieces) in previous.items():
+            if path not in current:
+                removed.append((path, pieces))
+        return PlanDelta(added, removed, rewritten)
+
+    def _account(self, delta):
+        metrics = watch_metrics()
+        if delta.added:
+            metrics["pieces_added"].inc(len(delta.added))
+        removed = sum(len(pieces) for _p, pieces in delta.removed)
+        if removed:
+            metrics["pieces_removed"].inc(removed)
+        rewritten = sum(len(old) for _p, old, _new in delta.rewritten)
+        if rewritten:
+            metrics["pieces_rewritten"].inc(rewritten)
+        from petastorm_tpu.obs import flight as _flight
+
+        for recorder in _flight.active_recorders():
+            recorder.record(
+                "dataset_watch", tick=self._ticks, added=len(delta.added),
+                removed=[p for p, _ in delta.removed],
+                rewritten=[p for p, _o, _n in delta.rewritten])
+        from petastorm_tpu.obs.log import degradation
+
+        if delta.removed or delta.rewritten:
+            # informational but countable: the mutation itself is not a
+            # failure — the per-piece consequences surface as their own
+            # piece_removed/piece_rewritten causes at read time
+            degradation(
+                "dataset_mutated",
+                "dataset watch observed +%d piece(s), -%d file(s), ~%d "
+                "rewritten file(s) under a running reader", len(delta.added),
+                len(delta.removed), len(delta.rewritten), once=False)
+
+    def stats(self):
+        """Live gauges for ``Reader.io_stats()`` / the bench harness."""
+        return {
+            "watch_ticks": self._ticks,
+            "watch_deltas": self._deltas,
+            "watch_errors": self._errors,
+        }

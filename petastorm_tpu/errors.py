@@ -64,6 +64,26 @@ class WorkerDiedError(PetastormTpuError, RuntimeError):
             self.__cause__ = original
 
 
+class PieceRemovedError(FileNotFoundError):
+    """A planned row-group's file disappeared between planning and read (the
+    dataset mutated under a running reader — ISSUE 11). Subclasses
+    ``FileNotFoundError`` so it is classified PERMANENT by the IO-retry
+    machinery; under ``RecoveryOptions(on_poison="quarantine")`` the item is
+    quarantined with ``cause="piece_removed"`` and charged to the checkpoint
+    watermark like any other skip."""
+
+
+class PieceRewrittenError(PetastormTpuError):
+    """A planned row-group's file no longer matches the generation token
+    stamped into its plan item (size/mtime/footer-crc mismatch — the file was
+    rewritten under a running reader, ISSUE 11). Never retried as transient:
+    the stamped generation is gone and re-reading would deliver rows from a
+    DIFFERENT generation than the rest of the epoch. The read path invalidates
+    the piece's footer/mem/disk cache entries before raising; under the
+    quarantine policy the item surfaces as ``cause="piece_rewritten"``, and
+    the dataset watcher re-plans the new generation into a later epoch."""
+
+
 class StallError(PetastormTpuError):
     """A pipeline actor missed its heartbeat threshold and the health monitor's
     escalation policy is ``raise`` — the training loop fails fast instead of

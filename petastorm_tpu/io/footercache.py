@@ -32,9 +32,40 @@ stores can even express non-atomically — are documented as unseen.
 from __future__ import annotations
 
 import threading
+import zlib
 from collections import OrderedDict
 
 from petastorm_tpu.obs.metrics import default_registry
+
+
+def metadata_crc(metadata):
+    """crc32 fingerprint of a parsed footer's layout AND statistics facts (row
+    counts, byte sizes, per-column chunk offsets/sizes, recorded min/max) —
+    the content half of a piece's generation token (ISSUE 11). Catches the
+    rewrite that size+mtime cannot: a file regenerated to the same length
+    with a colliding mtime still moves its column-chunk offsets/sizes or its
+    recorded statistics (the statistics matter for the adversarial case —
+    two constant-valued columns compress to byte-identical layouts, but
+    their min/max differ)."""
+    h = zlib.crc32(("%s|%s|%s" % (metadata.num_rows, metadata.num_row_groups,
+                                  metadata.serialized_size)).encode("ascii"))
+    for i in range(metadata.num_row_groups):
+        rgmd = metadata.row_group(i)
+        h = zlib.crc32(("%s|%s" % (rgmd.num_rows,
+                                   rgmd.total_byte_size)).encode("ascii"), h)
+        for c in range(rgmd.num_columns):
+            col = rgmd.column(c)
+            h = zlib.crc32(("%s|%s|%s" % (
+                col.data_page_offset, col.dictionary_page_offset,
+                col.total_compressed_size)).encode("ascii"), h)
+            try:
+                st = col.statistics
+                if st is not None and st.has_min_max:
+                    h = zlib.crc32(("%r|%r" % (st.min, st.max)).encode(
+                        "utf-8", "replace"), h)
+            except Exception:  # noqa: BLE001 — exotic logical types: layout
+                pass  # graftlint: disable=GL-O002 (facts above still fold in)
+    return h & 0xFFFFFFFF
 
 #: parsed FileMetaData are a few KB to a few hundred KB (wide schemas); the
 #: default budget holds ~1k typical ImageNet-Parquet footers
@@ -45,11 +76,16 @@ class FooterEntry:
     """One cached footer: the parsed metadata plus derived planning facts."""
 
     __slots__ = ("metadata", "size", "nbytes", "num_row_groups",
-                 "row_group_rows", "_spans")
+                 "row_group_rows", "_spans", "stat_token", "_crc")
 
-    def __init__(self, metadata, size):
+    def __init__(self, metadata, size, stat_token=None):
         self.metadata = metadata
         self.size = int(size) if size is not None else None
+        #: the file's stat identity ("<size>.<mtime_ns>") observed when this
+        #: footer was parsed — generation-token validation (ISSUE 11); None
+        #: when the caller had no stat to offer (size-only validation applies)
+        self.stat_token = stat_token
+        self._crc = None
         # serialized thrift size ~ resident parse size (cheap, stable proxy)
         try:
             self.nbytes = int(metadata.serialized_size) or 4096
@@ -79,6 +115,13 @@ class FooterEntry:
                 spans.append((start or 0, end))
             self._spans = tuple(spans)
         return self._spans[rg]
+
+    @property
+    def crc(self):
+        """Lazy :func:`metadata_crc` of this entry's footer (computed once)."""
+        if self._crc is None:
+            self._crc = metadata_crc(self.metadata)
+        return self._crc
 
 
 class FooterCache:
@@ -110,16 +153,25 @@ class FooterCache:
         self._bytes_gauge = reg.gauge(
             "ptpu_io_footer_cache_bytes", help="parsed footer bytes held")
 
-    def lookup(self, path, size=None):
+    def lookup(self, path, size=None, stat_token=None):
         """The cached :class:`FooterEntry` for ``path``, or ``None``.
 
         ``size`` (when the caller knows the file's current length — free from
         an open pyarrow handle) validates the entry; a mismatch invalidates
-        and misses."""
+        and misses. ``stat_token`` (the "<size>.<mtime_ns>" half of a
+        generation token, ISSUE 11) validates harder: an entry parsed under a
+        different stat identity — or under none at all — misses, so a
+        same-size rewrite can never serve its predecessor's parsed footer."""
         with self._lock:
             entry = self._entries.get(path)
+            stale = False
             if entry is not None and size is not None \
                     and entry.size is not None and entry.size != int(size):
+                stale = True
+            if entry is not None and stat_token is not None \
+                    and entry.stat_token != stat_token:
+                stale = True
+            if stale:
                 del self._entries[path]
                 self._total -= entry.nbytes
                 self._bytes_gauge.set(self._total)
@@ -162,9 +214,9 @@ class FooterCache:
                 self._bytes_gauge.set(self._total)
                 self._invalidations.inc()
 
-    def put(self, path, metadata, size=None):
+    def put(self, path, metadata, size=None, stat_token=None):
         """Admit a parsed footer; returns its :class:`FooterEntry`."""
-        entry = FooterEntry(metadata, size)
+        entry = FooterEntry(metadata, size, stat_token=stat_token)
         with self._lock:
             old = self._entries.pop(path, None)
             if old is not None:
@@ -184,17 +236,19 @@ class FooterCache:
             self._bytes_gauge.set(self._total)
         return entry
 
-    def get(self, fs, path, source=None):
+    def get(self, fs, path, source=None, stat_token=None):
         """The footer for ``path``: cached, or read+parsed from ``source``
         (an open pyarrow input file — its ``size()`` doubles as the
-        validation token) or from a fresh ``fs.open_input_file``."""
+        validation token) or from a fresh ``fs.open_input_file``.
+        ``stat_token`` additionally pins the entry to a stat identity
+        (generation-token reads, ISSUE 11)."""
         size = None
         if source is not None:
             try:
                 size = source.size()
             except Exception:  # noqa: BLE001 - validation token is best-effort
                 size = None
-        entry = self.lookup(path, size)
+        entry = self.lookup(path, size, stat_token=stat_token)
         if entry is not None:
             return entry
         import pyarrow.parquet as pq
@@ -207,7 +261,7 @@ class FooterCache:
             with fs.open_input_file(path) as f:
                 size = f.size()
                 metadata = pq.read_metadata(f)
-        return self.put(path, metadata, size)
+        return self.put(path, metadata, size, stat_token=stat_token)
 
     def contains(self, path):
         with self._lock:

@@ -170,6 +170,19 @@ class _Store:
         with self._lock:
             return key in self._entries
 
+    def invalidate(self, key):
+        """Drop one entry by key (ISSUE 11: a rewritten source file's decoded
+        payload must not outlive its generation). Outstanding served views
+        stay valid — numpy refcounting keeps the buffers alive; the lease is
+        accounting, released like an eviction."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self._total -= entry[1]
+                self._bytes_gauge.set(self._total)
+        if entry is not None:
+            entry[2].release()
+
     def put(self, key, value):
         """Admit ``value`` (already frozen read-only by the caller); returns
         True when it was stored. Because the stored arrays are read-only and
@@ -329,6 +342,11 @@ class MemCache(CacheBase):
 
     def contains(self, key):
         return self._store().contains(key) or self._inner.contains(key)
+
+    def invalidate(self, key):
+        """Keyed invalidation through both layers (ISSUE 11)."""
+        self._store().invalidate(key)
+        self._inner.invalidate(key)
 
     def clear(self):
         """Release the process-wide store's entries (shared across instances)."""

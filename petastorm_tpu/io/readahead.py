@@ -115,9 +115,11 @@ class _Entry:
 
 
 def request_key(piece, columns):
-    """Identity of one background read: file, row group, and the exact column
-    selection (``None`` = all columns)."""
-    return (piece.path, piece.row_group,
+    """Identity of one background read: file, row group, the piece's
+    generation token (ISSUE 11: two generations of one file — e.g. an old-gen
+    item and its deferred rewrite replacement — must never share a prefetched
+    table), and the exact column selection (``None`` = all columns)."""
+    return (piece.path, piece.row_group, getattr(piece, "generation", None),
             None if columns is None else tuple(columns))
 
 

@@ -43,12 +43,16 @@ _memo = {}  # fingerprint -> {req key: value}
 
 def dataset_fingerprint(fs, pieces, req_keys):
     """Stable identity of (scheduled data, requested statistics): the sorted
-    ``(path, row_group, num_rows)`` piece set, each file's size/mtime (so a
-    dataset regenerated IN PLACE — same names, new values — invalidates the
-    cached pass; the footer cache keys by size for the same reason), plus the
-    requirement keys. Two readers over the same pieces share one pass."""
+    ``(path, row_group, num_rows, generation)`` piece set, each file's
+    size/mtime (so a dataset regenerated IN PLACE — same names, new values —
+    invalidates the cached pass; the footer cache keys by size for the same
+    reason), plus the requirement keys. Watch-stamped generation tokens
+    (ISSUE 11) ride in the piece tuple, so a rewrite that collides on
+    size/mtime still changes the fingerprint through the footer crc. Two
+    readers over the same pieces share one pass."""
     h = hashlib.sha256()
-    for p in sorted((p.path, p.row_group, p.num_rows) for p in pieces):
+    for p in sorted((p.path, p.row_group, p.num_rows,
+                     p.generation or "") for p in pieces):
         h.update(repr(p).encode("utf-8"))
     for path in sorted({p.path for p in pieces}):
         try:

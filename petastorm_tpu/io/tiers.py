@@ -153,6 +153,15 @@ class TieredCache(CacheBase):
             return True
         return self._disk.contains(key)
 
+    def invalidate(self, key):
+        """Keyed invalidation through every tier (ISSUE 11: the dataset-watch
+        plane drops a rewritten piece's decoded payloads from mem AND disk —
+        generation-scoped keys already make them unreachable; this reclaims
+        the bytes)."""
+        if self._mem is not None:
+            self._mem.invalidate(key)
+        self._disk.invalidate(key)
+
     def clear(self):
         if self._mem is not None:
             self._mem.clear()

@@ -38,9 +38,14 @@ _METADATA_FILES = ("_common_metadata", "_metadata")
 #: ``stats``: ``{column: (min, max)}`` from the parquet row-group statistics when the
 #: footer was read (None on the KV fast path) — lets ``filters`` skip whole row groups
 #: before scheduling (reference: ``pq.ParquetDataset`` statistics filtering).
+#: ``generation``: the file's generation token (size.mtime.footer-crc — see
+#: :mod:`petastorm_tpu.dataset.watch`) stamped when dataset watching is on
+#: (None otherwise): reads validate it, cache keys embed it, and a mismatch at
+#: read time means the file was rewritten under the running reader.
 RowGroupPiece = namedtuple("RowGroupPiece", ["path", "row_group", "num_rows",
-                                             "partition_values", "stats"],
-                           defaults=(None, None))
+                                             "partition_values", "stats",
+                                             "generation"],
+                           defaults=(None, None, None))
 
 
 # --------------------------------------------------------------------------------------

@@ -25,7 +25,12 @@ pipeline actor missed its heartbeat threshold) and ``arrow_fallback`` (an
 Arrow-expressible batch failed IPC encode and rode the pickle wire instead)
 — and, from the remote read tier (ISSUE 8), ``remote_unavailable`` (the
 ranged-GET engine failed to build; classic reads) and ``footer_unreadable``
-(a quarantined item's skipped row count is unknown).
+(a quarantined item's skipped row count is unknown) — and, from the
+dataset-watch plane (ISSUE 11), ``dataset_mutated`` (the watcher observed a
+removal/rewrite under a running reader), ``piece_removed`` /
+``piece_rewritten`` (a plan item quarantined because its file vanished /
+changed generation mid-run), and ``watch_error`` (a watch tick failed —
+scan, mutate hook, or delta application).
 """
 from __future__ import annotations
 

@@ -249,6 +249,19 @@ def render_dashboard(metrics, title=""):
                          % (label, _fmt_ms(h.get("p50", 0)),
                             _fmt_ms(h.get("p99", 0)), h.get("count", 0)))
 
+    # -- dataset watch (ISSUE 11): mutation counters, excluded from "other"
+    ds = {name[len("ptpu_dataset_"):]: v for name, v in metrics.items()
+          if name.startswith("ptpu_dataset_") and isinstance(v, (int, float))}
+    if any(ds.values()):
+        lines.append(
+            "dataset watch: added=%d removed=%d rewritten=%d extensions=%d "
+            "generation_conflicts=%d"
+            % (int(ds.get("pieces_added_total", 0)),
+               int(ds.get("pieces_removed_total", 0)),
+               int(ds.get("pieces_rewritten_total", 0)),
+               int(ds.get("plan_extensions_total", 0)),
+               int(ds.get("generation_conflicts_total", 0))))
+
     # -- declarative transform ops (ISSUE 9): per-fused-stage timings
     ops = _labeled(metrics, "ptpu_transform_seconds")
     ops = {k: v for k, v in ops.items() if isinstance(v, dict)}
@@ -287,7 +300,7 @@ def render_dashboard(metrics, title=""):
                       "ptpu_health_", "ptpu_degradations_total",
                       "ptpu_io_tier_", "ptpu_io_remote_", "ptpu_io_hedge",
                       "ptpu_io_footer_cache_", "ptpu_transform_",
-                      "ptpu_prov_")
+                      "ptpu_prov_", "ptpu_dataset_")
     rest = {n: v for n, v in metrics.items()
             if not n.startswith(shown_prefixes)}
     scalars = [(n, v) for n, v in sorted(rest.items())

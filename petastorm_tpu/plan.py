@@ -10,6 +10,8 @@ the checkpoint/resume upgrade SURVEY.md §6 calls for (the reference has none).
 """
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 
@@ -48,6 +50,15 @@ class EpochPlan:
 
     ``num_epochs=None`` means infinite (reference ``num_epochs=None`` contract). State is
     (epoch, position); :meth:`state_dict`/:meth:`load_state_dict` checkpoint it exactly.
+
+    The plan is **extensible** (ISSUE 11): :meth:`extend` appends newly
+    discovered items mid-run — either into the CURRENT epoch (appended files)
+    or deferred to the NEXT epoch (``defer=True``, rewritten files whose new
+    generation must not mix with the old one inside an epoch). Extension is
+    thread-safe against iteration (the dataset watcher extends from its own
+    thread), and :meth:`items_in_epoch` reports how many items belong to each
+    epoch so the reader's consumed-ordinal watermark stays exact across
+    extensions.
     """
 
     def __init__(self, items, num_epochs=1, shuffle=False, seed=None, with_epoch=False,
@@ -67,6 +78,20 @@ class EpochPlan:
         self._epoch = 0
         self._pos = 0
         self._perm = epoch_permutation(len(self._items), 0, seed, shuffle)
+        #: cumulative extension ledger: ``(birth_epoch, item_count)`` — the
+        #: initial items are born at epoch 0; each extend() appends one entry.
+        #: Drives items_in_epoch() (the reader's per-epoch watermark size).
+        self._births = [(0, len(self._items))]
+        self._lock = threading.Lock()
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_lock"] = None  # not picklable; recreated on setstate
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
 
     @property
     def items(self):
@@ -88,7 +113,11 @@ class EpochPlan:
         yield_epoch = epoch
         ordinal = int(perm[pos])
         pos += 1
-        if pos >= len(self._items):
+        # rollover checks the PERMUTATION length, not the item count: a
+        # deferred extension (ISSUE 11) grows _items without touching the
+        # current epoch's perm — those items first appear in the next epoch's
+        # full permutation
+        if pos >= len(perm):
             pos = 0
             epoch += 1
             if self._num_epochs is None or epoch < self._num_epochs:
@@ -98,19 +127,20 @@ class EpochPlan:
         return yield_epoch, ordinal, epoch, pos, perm
 
     def __next__(self):
-        while True:
-            if not self._items:
-                raise StopIteration
-            if self._num_epochs is not None and self._epoch >= self._num_epochs:
-                raise StopIteration
-            epoch, ordinal, self._epoch, self._pos, self._perm = \
-                self._step(self._epoch, self._pos, self._perm)
-            if self._skip and ordinal in self._skip.get(epoch, ()):
-                continue
-            item = self._items[ordinal]
-            if self._with_epoch:
-                return (epoch, ordinal, item)
-            return item
+        with self._lock:
+            while True:
+                if not self._items:
+                    raise StopIteration
+                if self._num_epochs is not None and self._epoch >= self._num_epochs:
+                    raise StopIteration
+                epoch, ordinal, self._epoch, self._pos, self._perm = \
+                    self._step(self._epoch, self._pos, self._perm)
+                if self._skip and ordinal in self._skip.get(epoch, ()):
+                    continue
+                item = self._items[ordinal]
+                if self._with_epoch:
+                    return (epoch, ordinal, item)
+                return item
 
     def peek(self, n):
         """The next ``n`` yields of :meth:`__next__` WITHOUT advancing the
@@ -120,21 +150,62 @@ class EpochPlan:
         roll-over, per-epoch reshuffle); returns fewer than ``n`` items when
         the plan is nearly exhausted."""
         out = []
-        if not self._items:
-            return out
-        epoch, pos, perm = self._epoch, self._pos, self._perm
-        while len(out) < n:
-            if self._num_epochs is not None and epoch >= self._num_epochs:
-                break
-            yield_epoch, ordinal, epoch, pos, perm = self._step(epoch, pos, perm)
-            if self._skip and ordinal in self._skip.get(yield_epoch, ()):
-                continue
-            item = self._items[ordinal]
-            out.append((yield_epoch, ordinal, item) if self._with_epoch else item)
+        with self._lock:
+            if not self._items:
+                return out
+            epoch, pos, perm = self._epoch, self._pos, self._perm
+            while len(out) < n:
+                if self._num_epochs is not None and epoch >= self._num_epochs:
+                    break
+                yield_epoch, ordinal, epoch, pos, perm = self._step(epoch, pos, perm)
+                if self._skip and ordinal in self._skip.get(yield_epoch, ()):
+                    continue
+                item = self._items[ordinal]
+                out.append((yield_epoch, ordinal, item) if self._with_epoch
+                           else item)
         return out
 
+    def extend(self, new_items, defer=False):
+        """Append newly discovered ``new_items`` to a live plan (ISSUE 11).
+
+        ``defer=False`` places them in the CURRENT epoch (appended to the tail
+        of the running permutation — positions already consumed are
+        untouched, so nothing replays); ``defer=True`` places them in the NEXT
+        epoch (a rewritten file's new generation must never mix with the old
+        generation inside one epoch). Returns the ordinals assigned to the new
+        items. Existing ordinals keep their identity, so consumed-ordinal
+        checkpoints taken before or after an extension stay exact."""
+        new_items = list(new_items)
+        if not new_items:
+            return []
+        with self._lock:
+            start = len(self._items)
+            self._items.extend(new_items)
+            birth = self._epoch + (1 if defer else 0)
+            self._births.append((birth, len(new_items)))
+            new_ords = np.arange(start, len(self._items))
+            if not defer:
+                ords = new_ords
+                if self._shuffle:
+                    seq = np.random.SeedSequence(
+                        [0 if self._seed is None else int(self._seed),
+                         int(self._epoch), int(start)])
+                    ords = ords[np.random.Generator(
+                        np.random.PCG64(seq)).permutation(len(ords))]
+                self._perm = np.concatenate([self._perm, ords])
+            return [int(o) for o in new_ords]
+
+    def items_in_epoch(self, epoch):
+        """How many plan items belong to ``epoch`` (items born at or before
+        it) — the per-epoch denominator the reader's consumed-ordinal
+        watermark advances against (a fixed ``num_items`` would wedge the
+        watermark the first time an extension landed mid-run)."""
+        with self._lock:
+            return sum(count for birth, count in self._births
+                       if birth <= epoch)
+
     def remaining_in_epoch(self):
-        return len(self._items) - self._pos
+        return len(self._perm) - self._pos
 
     def exhausted(self):
         if not self._items:
@@ -142,21 +213,31 @@ class EpochPlan:
         return self._num_epochs is not None and self._epoch >= self._num_epochs
 
     def reset(self):
-        """Restart from epoch 0 (reference ``Reader.reset()``, petastorm/reader.py ~L700)."""
-        self._epoch = 0
-        self._pos = 0
-        self._skip = {}
-        self._perm = epoch_permutation(len(self._items), 0, self._seed, self._shuffle)
+        """Restart from epoch 0 (reference ``Reader.reset()``, petastorm/reader.py ~L700).
+
+        Every item known so far — including extension-discovered ones — is
+        part of the restarted epoch 0 (births collapse: the plan replays the
+        dataset as currently known)."""
+        with self._lock:
+            self._epoch = 0
+            self._pos = 0
+            self._skip = {}
+            self._perm = epoch_permutation(len(self._items), 0, self._seed,
+                                           self._shuffle)
+            self._births = [(0, len(self._items))]
 
     def seek_epoch(self, epoch):
         """Jump to the start of ``epoch`` (used by consumed-aware resume)."""
-        self._epoch = int(epoch)
-        self._pos = 0
-        self._perm = epoch_permutation(len(self._items), self._epoch, self._seed, self._shuffle)
+        with self._lock:
+            self._epoch = int(epoch)
+            self._pos = 0
+            self._perm = epoch_permutation(len(self._items), self._epoch,
+                                           self._seed, self._shuffle)
 
     def set_skip(self, skip):
         """Set the {epoch: set(ordinal)} map of work to omit (consumed-aware resume)."""
-        self._skip = {int(k): set(v) for k, v in (skip or {}).items()}
+        with self._lock:
+            self._skip = {int(k): set(v) for k, v in (skip or {}).items()}
 
     # -- checkpoint/resume ---------------------------------------------------------------
 
@@ -171,16 +252,35 @@ class EpochPlan:
         }
 
     def load_state_dict(self, state):
-        if state["num_items"] != len(self._items):
+        # fewer items than the checkpoint saw is a real mismatch (ordinals in
+        # the consumed map would dangle); MORE is legal under mutable datasets
+        # (ISSUE 11): files appended after the save are simply unconsumed
+        if state["num_items"] > len(self._items):
             raise ValueError(
                 "Checkpoint was taken over %d items; plan has %d"
                 % (state["num_items"], len(self._items))
             )
-        self._epoch = int(state["epoch"])
-        self._pos = int(state["pos"])
-        self._seed = state["seed"]
-        self._shuffle = state["shuffle"]
-        self._num_epochs = state["num_epochs"]
-        self._perm = epoch_permutation(
-            len(self._items), self._epoch, self._seed, self._shuffle
-        )
+        if state["num_items"] < len(self._items) and state["shuffle"] \
+                and int(state["pos"]):
+            # a mid-epoch POSITION is only meaningful against the exact
+            # permutation it was taken over; a grown shuffled plan derives a
+            # different one, so restoring pos would replay some consumed
+            # ordinals and lose some unconsumed ones. The Reader's resume is
+            # immune (pos=0 + consumed-ordinal skip map) — raw-plan users
+            # must go the same way.
+            raise ValueError(
+                "cannot restore a mid-epoch shuffled checkpoint (pos=%d) into "
+                "a grown plan (%d -> %d items): the permutation changed; "
+                "resume via a consumed-ordinal skip map (pos=0 + set_skip), "
+                "as Reader.load_state_dict does"
+                % (state["pos"], state["num_items"], len(self._items)))
+        with self._lock:
+            self._epoch = int(state["epoch"])
+            self._pos = int(state["pos"])
+            self._seed = state["seed"]
+            self._shuffle = state["shuffle"]
+            self._num_epochs = state["num_epochs"]
+            self._perm = epoch_permutation(
+                len(self._items), self._epoch, self._seed, self._shuffle
+            )
+            self._births = [(0, len(self._items))]

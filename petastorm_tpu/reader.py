@@ -38,6 +38,8 @@ from petastorm_tpu.errors import (
     PERMANENT_IO_ERRORS as _PERMANENT_IO_ERRORS,
     DecodeFieldError,
     NoDataAvailableError,
+    PieceRemovedError,
+    PieceRewrittenError,
 )
 from petastorm_tpu.fs import get_filesystem_and_path_or_paths
 from petastorm_tpu.metadata import (
@@ -280,6 +282,62 @@ class _WorkerBase:
         else:
             cache.move_to_end(path)
         return pf
+
+    # -- mutable-dataset generation enforcement (ISSUE 11) ------------------------------
+
+    def _verify_generation(self, piece):
+        """Validate the piece's stamped generation token against the file as
+        it exists NOW (dataset watching on — ``piece.generation`` stamped).
+
+        A vanished file raises :class:`PieceRemovedError`; a stat or
+        footer-crc mismatch invalidates the piece's footer/open-handle/cache
+        entries and raises :class:`PieceRewrittenError` — both permanent
+        (never burned as transient retries), both quarantinable under the
+        PR-7 policy with their own causes. The hard invariant this enforces:
+        a read can only ever deliver rows of the generation stamped into its
+        plan item, so one epoch never mixes two generations of one file."""
+        from petastorm_tpu.dataset.watch import current_stat_token, stat_token_of
+
+        stat = current_stat_token(self._fs, piece.path)  # raises PieceRemovedError
+        stamped_crc = piece.generation.rsplit(".", 1)[1]
+        mismatch = stat_token_of(piece.generation) != stat
+        if not mismatch and stamped_crc != "-":
+            # stat identity held — a size+mtime-colliding rewrite can still
+            # hide behind it; the footer crc (pinned to THIS stat identity,
+            # so a stale parse cannot vouch for the new bytes) settles it
+            from petastorm_tpu.io.footercache import shared_footer_cache
+
+            entry = shared_footer_cache().get(self._fs, piece.path,
+                                              stat_token=stat)
+            mismatch = ("%08x" % entry.crc) != stamped_crc
+        if mismatch:
+            # NOT counted here: this runs in the worker — a pool child's
+            # registry is invisible to the parent's export. The parent counts
+            # ptpu_dataset_generation_conflicts_total when it absorbs the
+            # piece_rewritten quarantine marker (Reader._absorb_quarantine).
+            self.invalidate_pieces([piece])
+            raise PieceRewrittenError(
+                "%s row group %d was rewritten under the running reader "
+                "(stamped generation %s no longer matches the file); its "
+                "cache entries are invalidated and the watcher re-plans the "
+                "new generation into a later epoch"
+                % (piece.path, piece.row_group, piece.generation))
+
+    def invalidate_pieces(self, pieces):
+        """Drop every cache layer's entries for ``pieces`` under their
+        stamped generation: the open-handle LRU + shared footer entry, and
+        the mem/disk tiers' decoded payloads by exact key. Called by the
+        read path on a generation conflict and by the dataset watcher when
+        it observes a removal/rewrite."""
+        invalidate = getattr(self._cache, "invalidate", None)
+        for piece in pieces:
+            self._evict_parquet_file(piece.path)
+            if invalidate is not None:
+                for partition in range(max(1, self._drop_partitions)):
+                    invalidate(_cache_key(
+                        piece, self._read_schema, self._predicate,
+                        self._filters, partition, self._drop_partitions,
+                        self._seed, self._device_fields))
 
     def _evict_parquet_file(self, path):
         """Drop (and close) the cached handle for ``path`` — a transient IO failure may
@@ -568,6 +626,8 @@ class _WorkerBase:
             if _chaos.ACTIVE is not None:
                 _chaos.ACTIVE.hit("reader.read",
                                   key="%s:%s" % (piece.path, piece.row_group))
+            if getattr(piece, "generation", None) is not None:
+                self._verify_generation(piece)
             engine = self._remote_engine(create=True)
             if engine is not None:
                 # the engine filters unavailable columns against the footer it
@@ -611,6 +671,8 @@ class _WorkerBase:
                     "reader.read_run",
                     key="%s:%s" % (pieces[0].path,
                                    ",".join(str(p.row_group) for p in pieces)))
+            if getattr(pieces[0], "generation", None) is not None:
+                self._verify_generation(pieces[0])  # one file per run
             row_groups = [p.row_group for p in pieces]
             engine = self._remote_engine(create=True)
             if engine is not None:
@@ -1237,6 +1299,14 @@ def _cache_key(piece, schema, predicate, filters, partition, num_partitions, see
         # device-staged payloads differ from host-decoded ones — never cross-serve.
         # Appended only when active so pre-existing persistent cache keys stay valid.
         parts.append("dev:%s" % ",".join(sorted(device_fields)))
+    generation = getattr(piece, "generation", None)  # duck-typed test pieces
+    if generation is not None:
+        # generation-scoped caching (ISSUE 11): a rewritten source file — even
+        # one colliding on size AND mtime — maps to a NEW key, so no tier can
+        # serve the old generation's decoded payload to the new generation's
+        # plan items. Appended only when dataset watching stamped a token, so
+        # persistent cache keys from watch-less runs stay valid.
+        parts.append("gen:%s" % generation)
     return "|".join(parts)
 
 
@@ -1260,7 +1330,7 @@ class Reader:
                  reader_pool_type="thread", workers_count=4, results_queue_size=16,
                  is_batched_reader=False, ngram=None, results_timeout_s=300.0,
                  wire_serializer="pickle", worker_respawns=None, io_options=None,
-                 recovery=None, provenance=None):
+                 recovery=None, provenance=None, watch=None, watch_paths=None):
         self._fs = filesystem
         self._path = path
         self.schema = schema
@@ -1329,6 +1399,20 @@ class Reader:
         #: moment it starts, and a recorder attached later (the DataLoader's
         #: set_provenance) misses every item a small plan already drained.
         self._prov = provenance
+        #: dataset-watch plane (ISSUE 11): a watcher thread that diffs the
+        #: piece set every interval and feeds _apply_plan_delta; None when
+        #: the dataset is declared frozen (the default)
+        self._drop_partitions = max(1, shuffle_row_drop_partitions)
+        self._watcher = None
+        if watch is not None:
+            from petastorm_tpu.dataset.watch import DatasetWatcher
+
+            self._watcher = DatasetWatcher(filesystem, path, watch,
+                                           on_delta=self._apply_plan_delta)
+            # known_paths: every file that existed at plan time, including
+            # plan-time-pruned ones — the first tick must not re-add what the
+            # user's filters/selector excluded
+            self._watcher.prime(pieces, known_paths=watch_paths)
         self._start()
 
     def _start(self):
@@ -1358,16 +1442,95 @@ class Reader:
         self._executor.start(_Tagged(self._worker), self._plan)
         self._results_iter = self._executor.results()
         self.stopped = False
+        watcher = getattr(self, "_watcher", None)
+        if watcher is not None:
+            # (re)armed LAST: a failed executor start must not leak a watch
+            # thread, and reset()/restore restart watching with the stream
+            watcher.start()
 
     def _mark_consumed(self, tag):
         if tag is None:
             return
         epoch, ordinal = tag
         self._consumed.setdefault(epoch, set()).add(ordinal)
-        # advance the watermark: epochs below _resume_epoch are fully consumed (bounded state)
-        while len(self._consumed.get(self._resume_epoch, ())) >= self._num_items:
+        # advance the watermark: epochs below _resume_epoch are fully consumed
+        # (bounded state). The per-epoch denominator comes from the PLAN, not
+        # a fixed num_items — a mid-run extension (ISSUE 11) grows later
+        # epochs without wedging the watermark on earlier ones.
+        while len(self._consumed.get(self._resume_epoch, ())) \
+                >= self._plan.items_in_epoch(self._resume_epoch):
             del self._consumed[self._resume_epoch]
             self._resume_epoch += 1
+
+    # -- dataset-watch plane (ISSUE 11) --------------------------------------------------
+
+    @property
+    def dataset_watcher(self):
+        """The live :class:`~petastorm_tpu.dataset.watch.DatasetWatcher`, or
+        ``None`` when the reader was opened without ``watch=``."""
+        return self._watcher
+
+    def _apply_plan_delta(self, delta):
+        """The watcher's delta seam (runs on the watch thread).
+
+        Added files extend the CURRENT epoch (fresh paths cannot mix
+        generations); a rewritten file's new generation is deferred to the
+        NEXT epoch (the old generation may already have delivered rows this
+        epoch); removed/rewritten old-generation pieces get their cache
+        entries dropped — their still-pending plan items fail their
+        generation check at read time and quarantine as
+        ``piece_removed``/``piece_rewritten``, charged to the watermark like
+        any other skip."""
+        stale = [p for _path, pieces in delta.removed for p in pieces]
+        stale += [p for _path, old, _new in delta.rewritten for p in old]
+        if stale:
+            invalidate = getattr(self._worker, "invalidate_pieces", None)
+            if invalidate is not None:
+                invalidate(stale)
+        extended = False
+        added = [p for p in delta.added if self._owns_piece(p)]
+        if added:
+            self._plan.extend(self._to_items(added), defer=False)
+            extended = True
+        replanned = [p for _path, _old, new in delta.rewritten for p in new
+                     if self._owns_piece(p)]
+        if replanned:
+            self._plan.extend(self._to_items(replanned), defer=True)
+            extended = True
+        if extended:
+            self._num_items = len(self._plan.items)
+            from petastorm_tpu.dataset.watch import watch_metrics
+
+            watch_metrics()["plan_extensions"].inc()
+
+    def _items_identity_crc(self, count):
+        """crc32 over the identity (path:row_group:partition) of the first
+        ``count`` plan items in ordinal order — what binds a checkpoint's
+        consumed-ordinal map to the item order it was taken over."""
+        import zlib
+
+        h = 0
+        for piece, partition in self._plan.items[:count]:
+            h = zlib.crc32(("%s:%s:%s" % (piece.path, piece.row_group,
+                                          partition)).encode("utf-8"), h)
+        return h & 0xFFFFFFFF
+
+    def _owns_piece(self, piece):
+        """Deterministic shard assignment for watch-discovered pieces: every
+        host computes the same crc32 hash, so the shards' extensions stay
+        disjoint and their union exact — the same zero-communication property
+        the initial round-robin sharding has."""
+        if not self.shard_count:
+            return True
+        import zlib
+
+        key = "%s:%s" % (piece.path, piece.row_group)
+        return zlib.crc32(key.encode("utf-8")) % self.shard_count \
+            == self.cur_shard
+
+    def _to_items(self, pieces):
+        return [(piece, partition) for piece in pieces
+                for partition in range(self._drop_partitions)]
 
     def _absorb_quarantine(self, marker):
         """Absorb a :class:`~petastorm_tpu.recovery.QuarantinedItem` marker
@@ -1389,18 +1552,34 @@ class Reader:
             # that READ fails is the footer genuinely unreadable (ISSUE 8
             # satellite: this used to collapse to -1 silently either way)
             num_rows = self._resolve_quarantined_rows(path, row_group)
+        # dataset-mutation classification (ISSUE 11): a skip caused by the
+        # file vanishing or changing generation mid-run gets its own kind and
+        # degradation cause — an operator must tell "bad data" apart from
+        # "the dataset moved under me" without reading exception chains
+        kind = marker.kind
+        cause = "quarantined"
+        if isinstance(marker.error, PieceRewrittenError):
+            kind = cause = "piece_rewritten"
+            from petastorm_tpu.dataset.watch import watch_metrics
+
+            # counted HERE (the consumer process), not at the worker's
+            # detection site — a pool child's registry never reaches the
+            # parent's export/panel
+            watch_metrics()["generation_conflicts"].inc()
+        elif isinstance(marker.error, PieceRemovedError):
+            kind = cause = "piece_removed"
         entry = QuarantineEntry(epoch, ordinal, path, row_group, num_rows,
-                                marker.error, marker.attempts, marker.kind)
+                                marker.error, marker.attempts, kind)
         self.quarantine_report.add(entry)
         count_quarantined(num_rows)
         from petastorm_tpu.obs.log import degradation
 
         degradation(
-            "quarantined",
+            cause,
             "poison item quarantined after %d attempt(s): %s row group %s "
             "(epoch=%s ordinal=%s, %s) — skipped, charged to the checkpoint "
             "watermark; see Reader.quarantine_report", marker.attempts, path,
-            row_group, epoch, ordinal, marker.kind, once=False)
+            row_group, epoch, ordinal, kind, once=False)
         if self._prov is not None:
             # exactly-once beside delivery: a quarantined item never enters
             # the delivery FIFO, so the ledgers stay disjoint
@@ -1576,6 +1755,8 @@ class Reader:
             out.update(fn() or {})
         if self._footer_unreadable:
             out["footer_unreadable"] = self._footer_unreadable
+        if self._watcher is not None:
+            out.update(self._watcher.stats())
         return out
 
     def register_metrics(self, registry):
@@ -1661,6 +1842,11 @@ class Reader:
         self.stopped = True
 
     def join(self):
+        # the watch thread goes first: a delta applied while the executor is
+        # tearing down would extend a plan nobody will drain (reset()/restore
+        # re-arm it from _start)
+        if self._watcher is not None:
+            self._watcher.stop()
         # close the worker's IO runtime FIRST: a stop() mid-stream can leave
         # executor threads blocked inside ReadaheadPool.get, and shutdown()
         # releases those waiters (into the degradation-logged sync fallback)
@@ -1701,6 +1887,12 @@ class Reader:
             "plan": {k: plan_state[k] for k in ("seed", "shuffle", "num_epochs", "num_items")},
             "resume_epoch": self._resume_epoch,
             "consumed": {int(e): sorted(v) for e, v in self._consumed.items()},
+            # ordinal-identity binding (ISSUE 11): consumed ordinals are only
+            # meaningful against THIS item order. A restore into a reader
+            # whose first num_items items differ (a file appended between save
+            # and restore that sorts BETWEEN existing names shifts every later
+            # ordinal) must fail loudly, not silently replay/lose rows.
+            "items_crc": self._items_identity_crc(self._num_items),
         }
         if self.shard_count:
             # shard identity travels with the cursor so a pod restore can route each
@@ -1718,7 +1910,10 @@ class Reader:
                 "object" % sorted(state))
         self.stop()
         self.join()
-        if state["plan"]["num_items"] != self._num_items:
+        if state["plan"]["num_items"] > self._num_items:
+            # fewer checkpointed items than planned is legal under mutable
+            # datasets (ISSUE 11: files appended after the save are simply
+            # unconsumed); MORE means consumed ordinals would dangle
             raise ValueError(
                 "Checkpoint was taken over %d work items; reader has %d"
                 % (state["plan"]["num_items"], self._num_items)
@@ -1729,6 +1924,17 @@ class Reader:
                 "Checkpoint belongs to shard %s/%s but this reader is shard %s/%s — "
                 "resuming would replay the wrong rows"
                 % (ck_shard, state.get("shard_count"), self.cur_shard, self.shard_count))
+        ck_crc = state.get("items_crc")
+        if ck_crc is not None and \
+                ck_crc != self._items_identity_crc(state["plan"]["num_items"]):
+            raise ValueError(
+                "Checkpoint's consumed ordinals do not match this reader's "
+                "item order (the first %d work items differ — a file added "
+                "between save and restore sorts BETWEEN existing names?): "
+                "resuming would replay or lose rows. Mutable datasets must "
+                "append files that sort after existing ones (e.g. "
+                "monotonically-named parts) for cross-restart resume."
+                % state["plan"]["num_items"])
         self._resume_epoch = int(state["resume_epoch"])
         self._consumed = {int(e): set(v) for e, v in state["consumed"].items()}
         self._plan.load_state_dict(
@@ -1840,7 +2046,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
                 transform_spec=None, filters=None, storage_options=None, filesystem=None,
                 results_timeout_s=300.0, decode_on_device=False, wire_serializer=None,
                 io_retries=None, io_retry_backoff_s=None, worker_respawns=None,
-                io_options=None, recovery=None, provenance=None):
+                io_options=None, recovery=None, provenance=None, watch=None):
     """Open a petastorm(-tpu) dataset for per-row decoded reading (reference ~L60).
 
     ``schema_fields`` may be a list of names/regexes/UnischemaFields or an :class:`NGram`.
@@ -1875,15 +2081,32 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     or a dict of its fields) — row-group readahead (default on), adjacent-read
     coalescing, the in-memory decoded-row-group LRU (``memcache_bytes``), and
     work-stealing piece dispatch. See docs/performance.md "Read path".
+
+    ``watch``: the mutable-dataset plane (ISSUE 11) —
+    :class:`petastorm_tpu.dataset.WatchOptions`, a dict of its fields, or
+    ``True`` for defaults. Stamps a per-file generation token
+    (size+mtime+footer-crc) into every plan item and cache key, validates it
+    on every read (a rewritten file quarantines as ``piece_rewritten``, a
+    deleted one as ``piece_removed`` — under ``recovery.on_poison=
+    "quarantine"``), and runs a watcher thread that discovers appended files
+    mid-run and extends the epoch plan with checkpoint-watermark exactness.
+    See docs/robustness.md "Mutable datasets".
     """
     fs, path = get_filesystem_and_path_or_paths(dataset_url, storage_options, filesystem)
     stored_schema = get_schema(fs, path)
 
     pieces = load_row_groups(fs, path)
+    watch_paths = {p.path for p in pieces}  # pre-pruning file set (watch plane)
     pieces = _apply_rowgroup_selector(fs, path, pieces, rowgroup_selector)
     stats_pieces = pieces  # pre-plan view: row-group stats still attached
     pieces, partition_info, filters = _plan_pieces(pieces, filters, predicate,
                                                    shard_count)
+    watch = _resolve_watch(watch)
+    if watch is not None:
+        from petastorm_tpu.dataset.watch import stamp_generation_tokens
+
+        pieces = stamp_generation_tokens(fs, pieces,
+                                         footer_crc=watch.footer_crc)
     if partition_info:
         stored_schema = _schema_with_partitions(stored_schema, partition_info)
 
@@ -1921,11 +2144,19 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         results_timeout_s=results_timeout_s,
         wire_serializer=wire_serializer or "pickle",
         io_options=io_opts, recovery=rec,
-        provenance=_prov.resolve(provenance),
+        provenance=_prov.resolve(provenance), watch=watch,
+        watch_paths=watch_paths,
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields
     return r
+
+
+def _resolve_watch(watch):
+    """Factory-side normalization of the ``watch=`` kwarg (ISSUE 11)."""
+    from petastorm_tpu.dataset.watch import WatchOptions
+
+    return WatchOptions.normalize(watch)
 
 
 def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type="thread",
@@ -1938,7 +2169,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       filesystem=None, results_timeout_s=300.0, decode_on_device=False,
                       wire_serializer=None, io_retries=None, io_retry_backoff_s=None,
                       worker_respawns=None, io_options=None, recovery=None,
-                      provenance=None):
+                      provenance=None, watch=None):
     """Open ANY Parquet store for vectorized columnar batches (reference ~L200).
 
     ``decode_on_device``: see :func:`make_reader` — device-decodable codec columns come
@@ -1953,6 +2184,11 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
 
     ``io_options``: see :func:`make_reader` — readahead/coalesce/memcache/work
     stealing knobs for the async read path (docs/performance.md "Read path").
+
+    ``watch``: see :func:`make_reader` — the mutable-dataset plane (ISSUE 11):
+    generation-tokened plan items and cache keys, per-read validation, and a
+    watcher thread that extends the plan with appended files mid-run (single
+    dataset URL only).
 
     ``wire_serializer``: process-pool result wire format; defaults to ``"arrow"`` here
     (columnar batches ride Arrow IPC — reference ``ArrowTableSerializer`` parity) and
@@ -1971,9 +2207,16 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     pieces = []
     for p in paths:
         pieces.extend(load_row_groups(fs, p))
+    watch_paths = {p.path for p in pieces}  # pre-pruning file set (watch plane)
     stats_pieces = pieces  # pre-plan view: row-group stats still attached
     pieces, partition_info, filters = _plan_pieces(pieces, filters, predicate,
                                                    shard_count)
+    watch = _resolve_watch(watch)
+    if watch is not None:
+        from petastorm_tpu.dataset.watch import stamp_generation_tokens
+
+        pieces = stamp_generation_tokens(fs, pieces,
+                                         footer_crc=watch.footer_crc)
     if partition_info:
         stored_schema = _schema_with_partitions(stored_schema, partition_info)
 
@@ -2015,7 +2258,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
         wire_serializer={"shm": "shm-arrow", "shm-view": "shm-arrow-view"}.get(
             wire_serializer, wire_serializer) or "arrow",
         io_options=io_opts, recovery=rec,
-        provenance=_prov.resolve(provenance),
+        provenance=_prov.resolve(provenance), watch=watch,
+        watch_paths=watch_paths,
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields

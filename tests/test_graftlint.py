@@ -1162,6 +1162,83 @@ def test_unbounded_blocking_clean_cases():
     assert findings == [] and suppressed == 1
 
 
+# -- GL-R002: stat-then-open TOCTOU ------------------------------------------------------
+
+_R002_POSITIVE = """
+    import os
+
+    def serve_cached(fpath):
+        size = os.path.getsize(fpath)
+        if size == 0:
+            return None
+        with open(fpath, "rb") as f:  # BUG: stat-then-open window
+            return f.read()
+
+    class Validator:
+        def check(self, fs, path):
+            st = os.stat(path)
+            self._seen = st.st_size
+            return fs.open_input_file(path)  # BUG: fs open after stat
+"""
+
+
+def test_stat_then_open_fires_on_builtin_and_fs_opens():
+    findings, _ = _lint(_R002_POSITIVE)
+    findings = [f for f in findings if f.rule_id == "GL-R002"]
+    assert {f.line for f in findings} == {
+        _line_of(_R002_POSITIVE, "BUG: stat-then-open window"),
+        _line_of(_R002_POSITIVE, "BUG: fs open after stat"),
+    }
+    assert all("TOCTOU" in f.message for f in findings)
+
+
+def test_stat_then_open_clean_cases():
+    """Open-then-fstat (the fix), stats of a DIFFERENT variable, stat-only
+    functions (no open), computed path expressions (untracked on purpose),
+    and a justified inline disable all stay clean."""
+    findings, suppressed = _lint("""
+        import os
+
+        def open_then_validate(fpath):
+            f = open(fpath, "rb")
+            os.fstat(f.fileno())  # validation AFTER the open: no window
+            return f
+
+        def different_paths(a, b):
+            os.path.getsize(a)
+            return open(b, "rb")
+
+        def stat_only(fpath):
+            return os.stat(fpath).st_mtime_ns
+
+        def computed(root, name):
+            os.path.getsize(os.path.join(root, name))
+            return open(os.path.join(root, name), "rb")
+
+        def justified(fpath):
+            size = os.path.getsize(fpath)
+            f = open(fpath, "rb")  # graftlint: disable=GL-R002 (size re-checked against the handle below)
+            assert os.fstat(f.fileno()).st_size == size
+            return f
+    """)
+    assert [f.rule_id for f in findings] == [] and suppressed == 1
+
+
+def test_stat_then_open_scopes_are_per_function():
+    """A stat in one function must not taint an open of the same name in
+    another — the window the rule flags is intra-function."""
+    findings, _ = _lint("""
+        import os
+
+        def validate(fpath):
+            return os.path.getmtime(fpath)
+
+        def load(fpath):
+            return open(fpath, "rb").read()
+    """)
+    assert [f for f in findings if f.rule_id == "GL-R002"] == []
+
+
 # -- engine: suppressions, baseline, CLI ------------------------------------------------
 
 

@@ -100,8 +100,14 @@ def test_plan_checkpoint_resume():
 
 
 def test_plan_checkpoint_wrong_size_rejected():
-    plan = EpochPlan(list(range(5)))
+    # SHRINK is a real mismatch (consumed ordinals would dangle) ...
+    plan = EpochPlan(list(range(6)))
     state = plan.state_dict()
-    other = EpochPlan(list(range(6)))
+    other = EpochPlan(list(range(5)))
     with pytest.raises(ValueError, match="items"):
         other.load_state_dict(state)
+    # ... but GROWTH is legal under mutable datasets (ISSUE 11): files
+    # appended after the save are simply unconsumed on resume
+    grown = EpochPlan(list(range(7)))
+    grown.load_state_dict(state)
+    assert sorted(grown) == list(range(7))
