@@ -8,6 +8,7 @@ from petastorm_tpu.analysis.rules.hotpath import WallClockDurationRule
 from petastorm_tpu.analysis.rules.lifecycle import ResourceLifecycleRule
 from petastorm_tpu.analysis.rules.observability import (
     SilentExceptionSwallowRule,
+    SleepyPollLoopRule,
     UnpairedSpanRule,
 )
 from petastorm_tpu.analysis.rules.robustness import (
@@ -34,6 +35,7 @@ ALL_RULES = [
     WallClockDurationRule,
     SilentExceptionSwallowRule,
     UnpairedSpanRule,
+    SleepyPollLoopRule,
     UnboundedBlockingCallRule,
     StatThenOpenRule,
 ]

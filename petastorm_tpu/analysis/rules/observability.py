@@ -161,6 +161,83 @@ class UnpairedSpanRule(Rule):
                 "every later attribution on this thread" % (opener, closer))
 
 
+def _is_time_sleep(node):
+    """``time.sleep(...)`` or a bare ``sleep(...)`` call."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr == "sleep" and isinstance(func.value, ast.Name) \
+            and func.value.id == "time"
+    return isinstance(func, ast.Name) and func.id == "sleep"
+
+
+def _walk_loop(body):
+    """Walk a loop body WITHOUT descending into nested function/class
+    definitions (a closure's sleep is its own loop's business)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class SleepyPollLoopRule(Rule):
+    """GL-O004: a monitor/controller loop that watches an Event but sleeps
+    with ``time.sleep()``.
+
+    The pattern ``while not stop.is_set(): ...; time.sleep(t)`` (or the
+    body-check variant ``while True: if stop.is_set(): break; time.sleep(t)``)
+    is an *unkillable poll loop*: ``stop.set()`` does nothing until the
+    current sleep expires, so teardown latency is the poll interval — and a
+    long interval wedges joins, atexit hooks and test teardown behind it.
+    The watcher/health/reporter threads each shipped this bug once before
+    converging on ``stop_event.wait(timeout)``, which sleeps the same amount
+    but wakes IMMEDIATELY on ``set()``. Loops that sleep without any Event in
+    sight (deadline polls, retry backoff, CLI redraw loops) are clean — there
+    is nothing to wake them.
+    """
+
+    rule_id = "GL-O004"
+    severity = Severity.WARNING
+    description = ("poll loop watching an Event but sleeping with "
+                   "time.sleep() — stop() cannot wake it until the sleep "
+                   "expires (use <event>.wait(timeout))")
+    fix_hint = ("replace `while not ev.is_set(): ...; time.sleep(t)` with "
+                "`while not ev.wait(t): ...` (same cadence, wakes immediately "
+                "on set()), or justify with an inline "
+                "'# graftlint: disable=GL-O004' comment")
+
+    def check(self, tree, ctx):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.While):
+                continue
+            watches_event = any(
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "is_set"
+                for sub in ast.walk(node.test))
+            sleeps = []
+            for sub in _walk_loop(node.body):
+                if isinstance(sub, ast.Call) and _is_time_sleep(sub):
+                    sleeps.append(sub)
+                elif not watches_event and isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "is_set":
+                    # body-check variant: `if stop.is_set(): break` + sleep
+                    watches_event = True
+            if not watches_event:
+                continue
+            for sleep_call in sleeps:
+                yield ctx.finding(
+                    self, sleep_call,
+                    "this loop watches an Event (is_set) but sleeps with "
+                    "time.sleep() — stop()/set() cannot wake it until the "
+                    "sleep expires; use <event>.wait(timeout) as the loop "
+                    "condition instead")
+
+
 class SilentExceptionSwallowRule(Rule):
     """GL-O002: ``except Exception: pass`` / bare ``except: pass``."""
 

@@ -115,6 +115,23 @@ def main(argv=None):
         from petastorm_tpu.benchmark import attribution as attribution_bench
 
         return attribution_bench.main(argv[1:])
+    if argv and argv[0] == "slo":
+        # `petastorm-tpu-bench slo ...`: the temporal-plane acceptance harness
+        # — calibrate a step-p99 SLO on a clean run, inject a CloudLatencyFS
+        # remote tail, assert exactly one debounced slo_breach whose attached
+        # attribution snapshot names io.remote, and measure the armed-vs-off
+        # throughput delta — see benchmark/slo.py
+        from petastorm_tpu.benchmark import slo as slo_bench
+
+        return slo_bench.main(argv[1:])
+    if argv and argv[0] == "diff":
+        # `petastorm-tpu-bench diff run_a run_b`: regression forensics over
+        # two trend entries — names WHICH site's critical-path self time
+        # regressed ("rows/s -28%: io.remote self-time 2.3x") — see
+        # petastorm_tpu/obs/diff.py
+        from petastorm_tpu.obs import diff as diff_cli
+
+        return diff_cli.main(argv[1:])
     if argv and argv[0] == "trend":
         # `petastorm-tpu-bench trend ...`: the CI throughput-regression gate —
         # median rows/s of a fixed synthetic workload appended to

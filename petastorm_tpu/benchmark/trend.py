@@ -20,6 +20,15 @@ An empty (or missing) history is seeded with the current run and passes —
 the gate arms itself on first use. The entry is appended BEFORE the verdict
 so a failing run is still recorded (the regression is visible in the
 history, not just the log).
+
+Schema v2 (ISSUE 12): entries additionally carry the measured workload's
+per-site critical-path self-times (``"sites"``, from the attribution plane
+— the run executes with ``provenance=True``, ~1% instrument cost well
+inside the 30% gate margin) and its step p99, so a failing gate can say
+WHY: the failure message runs ``petastorm-tpu-bench diff``-style forensics
+against the baseline entry and names the regressed site ("rows/s −28%:
+io.remote self-time 2.3×") instead of just the number. v1 entries remain
+loadable (they simply carry no sites), so existing histories keep gating.
 """
 from __future__ import annotations
 
@@ -30,7 +39,10 @@ import statistics
 import tempfile
 import time
 
-SCHEMA = "ptpu-bench-trend-v1"
+SCHEMA = "ptpu-bench-trend-v2"
+#: older entries stay comparable — the gate metric (rows_per_s per workload
+#: fingerprint) is identical across versions; only the forensic fields grew
+ACCEPTED_SCHEMAS = ("ptpu-bench-trend-v1", "ptpu-bench-trend-v2")
 
 
 def _make_store(root, files, rows_per_file):
@@ -63,28 +75,44 @@ def measure(files=4, rows_per_file=2048, batch_size=256, epochs=5):
     individual epoch (observed 2-30x swings), but contention can only LOWER
     an epoch — it cannot inflate one. The best epoch is the machine's
     throughput envelope, and a real code regression lowers the envelope
-    itself. Returns ``(best, all_measured_rates)``."""
+    itself.
+
+    Every epoch runs with the provenance plane on (ISSUE 12) so the BEST
+    epoch's per-site critical-path self-times ride into the trend entry —
+    the forensic baseline ``petastorm-tpu-bench diff`` compares against.
+    The ~1% instrument cost applies equally to every entry (and to the
+    stored baseline from the first v2 run on), so the gate comparison stays
+    apples-to-apples. Returns ``(best, all_measured_rates, best_forensics)``
+    where ``best_forensics`` is ``{"sites": {...}, "step_p99_s": ...}``."""
     from petastorm_tpu.loader import DataLoader
     from petastorm_tpu.reader import make_batch_reader
 
     def one_epoch():
         reader = make_batch_reader("file://" + root, num_epochs=1,
-                                   workers_count=2)
+                                   workers_count=2, provenance=True)
         rows = 0
         t0 = time.perf_counter()
         with DataLoader(reader, batch_size, to_device=False) as loader:
             for batch in loader:
                 rows += len(batch["id"])
+        rate = rows / (time.perf_counter() - t0)
         assert rows == total, (rows, total)
-        return rows / (time.perf_counter() - t0)
+        report = loader.attribution_report()
+        return rate, {"sites": {site: round(sec, 4) for site, sec
+                                in report.stage_self_s.items()},
+                      "step_p99_s": report.step_p99_s}
 
     rates = []
+    forensics = []
     with tempfile.TemporaryDirectory(prefix="ptpu-trend-") as root:
         total = _make_store(root, files, rows_per_file)
         one_epoch()  # warmup: imports, first-open footers, allocator warm
         for _ in range(epochs):
-            rates.append(one_epoch())
-    return max(rates), rates
+            rate, f = one_epoch()
+            rates.append(rate)
+            forensics.append(f)
+    best_idx = max(range(len(rates)), key=rates.__getitem__)
+    return rates[best_idx], rates, forensics[best_idx]
 
 
 def load_history(path, workload=None):
@@ -102,7 +130,7 @@ def load_history(path, workload=None):
                 obj = json.loads(line)
             except ValueError:
                 continue
-            if isinstance(obj, dict) and obj.get("schema") == SCHEMA \
+            if isinstance(obj, dict) and obj.get("schema") in ACCEPTED_SCHEMAS \
                     and obj.get("rows_per_s") \
                     and (workload is None or obj.get("workload") == workload):
                 entries.append(obj)
@@ -129,10 +157,10 @@ def main(argv=None):
 
     if args.smoke:
         shape = dict(files=3, rows_per_file=1024, batch_size=128)
-        best, rates = measure(epochs=min(args.epochs, 3), **shape)
+        best, rates, forensics = measure(epochs=min(args.epochs, 3), **shape)
     else:
         shape = dict(files=4, rows_per_file=2048, batch_size=256)
-        best, rates = measure(epochs=args.epochs)
+        best, rates, forensics = measure(epochs=args.epochs)
     #: the comparability fingerprint: only same-shaped runs share a baseline
     workload = "f%d-r%d-b%d" % (shape["files"], shape["rows_per_file"],
                                 shape["batch_size"])
@@ -151,6 +179,10 @@ def main(argv=None):
         "baseline_rows_per_s": None if baseline is None
         else round(baseline, 1),
         "history_entries": len(history),
+        #: forensic fields (schema v2): the best epoch's per-site
+        #: critical-path self-times + step p99 — what `bench diff` compares
+        "sites": forensics["sites"],
+        "step_p99_s": forensics["step_p99_s"],
     }
     regressed = baseline is not None \
         and best < (1.0 - args.threshold) * baseline
@@ -171,6 +203,19 @@ def main(argv=None):
                  len(history), workload))
     print(json.dumps(entry))
     if regressed:
+        # forensics (ISSUE 12): diff the regressed run against the baseline
+        # entry closest to the gating median, so the failure NAMES the site
+        # that regressed instead of just the number
+        baseline_entry = min(
+            history, key=lambda e: abs(e["rows_per_s"] - baseline))
+        if baseline_entry.get("sites"):
+            from petastorm_tpu.obs.diff import diff_runs
+
+            verdict = diff_runs(baseline_entry, entry)
+            print("why: %s" % verdict["verdict"])
+            if verdict["regressed_site"]:
+                print("     rerun `petastorm-tpu-bench diff -2 -1 --history "
+                      "%s` for the per-site table" % args.history)
         print("FAIL: throughput regressed more than %.0f%% vs the stored "
               "median — investigate before merging (history: %s)"
               % (100 * args.threshold, args.history))

@@ -1,1 +1,24 @@
 """compat subpackage."""
+
+
+def shard_map():
+    """The ``shard_map`` entry point across jax versions (ISSUE 12 satellite):
+    new jax exposes ``jax.shard_map`` at top level; 0.4.x only ships
+    ``jax.experimental.shard_map.shard_map``. Returns the callable.
+
+    On the experimental (0.4.x) path the static replication check is
+    disabled: its inference cannot see the ``psum`` inside a
+    ``value_and_grad`` of a collective loss and rejects replicated
+    out_specs that ARE replicated at runtime (the oracle tests pin the
+    numbers either way); new jax's varying-axes types made the check
+    precise, so it stays on there."""
+    import functools
+
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map as exp_shard_map
+
+    return functools.partial(exp_shard_map, check_rep=False)

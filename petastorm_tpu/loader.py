@@ -638,13 +638,27 @@ class DataLoader:
         disabled, one module-global ``is None`` check per site. Batch↔item
         attribution is unavailable under shuffling (rows decorrelate from row
         groups); per-item records still collect.
+    slos : sequence of petastorm_tpu.obs.slo.SloSpec, or an SloEngine, optional
+        Temporal SLO watching (ISSUE 12; requires ``metrics=``): the specs
+        are evaluated against the registry's windowed time-series on the
+        sampling cadence (a :class:`petastorm_tpu.obs.export.Reporter`
+        flushing this registry, or explicit ``registry.sample_timelines()``
+        calls). Debounced breaches fire ``cause=slo_breach`` degradation
+        events mirrored into live flight recorders, and — when the loader
+        also has ``provenance=`` — each alert carries an
+        ``attribution_report()`` snapshot naming the culprit site. Read
+        alerts from ``loader.slo_alerts()`` / ``loader.slo_engine``; pass a
+        pre-built :class:`petastorm_tpu.obs.slo.SloEngine` to add anomaly
+        watches or share an engine. Zero hot-path cost — evaluation happens
+        on the sampler thread only.
     """
 
     def __init__(self, reader, batch_size, sharding=None, shuffling_queue_capacity=0,
                  seed=None, last_batch="drop", device_transform=None, prefetch=2,
                  to_device=True, host_queue_size=8, pad_shapes=None,
                  device_shuffle_capacity=0, device_decode_resize=None, trace=None,
-                 metrics=None, health=None, staging=None, provenance=None):
+                 metrics=None, health=None, staging=None, provenance=None,
+                 slos=None):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if last_batch not in ("drop", "pad", "partial"):
@@ -842,6 +856,37 @@ class DataLoader:
                 # keeps whatever registry its owner configured)
                 self._health.set_registry(registry)
             self._obs = _LoaderObs(registry, self)
+        #: optional SLO/anomaly engine (ISSUE 12) over the registry's windowed
+        #: time-series: attached to the timeline store's sampling cadence, so
+        #: the loader hot paths never see it. Breach alerts carry an
+        #: attribution snapshot when provenance is on.
+        self._slo_engine = None
+        self._slo_owned = False
+        if slos:
+            if self._obs is None:
+                raise ValueError(
+                    "DataLoader(slos=...) requires metrics= — the SLO engine "
+                    "evaluates the metrics registry's windowed time-series")
+            from petastorm_tpu.obs.slo import SloEngine
+
+            registry = self._obs.registry
+            if isinstance(slos, SloEngine):
+                # caller-supplied (shared) engine: like a shared
+                # HealthMonitor/ProvenanceRecorder, its lifecycle stays the
+                # caller's — never detached at __exit__, and never re-homed
+                # off a store the caller already attached it to
+                engine = slos
+                if engine._registry is None:
+                    engine._registry = registry
+                if engine._store is None:
+                    engine.attach(registry.timeline_store())
+            else:
+                engine = SloEngine(specs=list(slos), registry=registry)
+                engine.attach(registry.timeline_store())
+                self._slo_owned = True
+            if engine._attribution is None and self._prov_rec is not None:
+                engine.set_attribution(self.attribution_report)
+            self._slo_engine = engine
 
     # -- producer (background thread: reader → host batches) ---------------------------
     #
@@ -1730,6 +1775,15 @@ class DataLoader:
                 out["attribution"] = rec.summary()
             except Exception:  # noqa: BLE001 — evidence is best-effort
                 out["attribution"] = None
+        if self._slo_engine is not None:
+            # temporal plane (ISSUE 12): recent SLO alerts into the flight
+            # context — a stall that followed a burn shows the burn
+            alerts = self._slo_engine.alerts()
+            out["slo"] = {
+                "alerts": len(alerts),
+                "breaching": self._slo_engine.breaching(),
+                "last_alert": alerts[-1].message if alerts else None,
+            }
         return out
 
     def health_report(self, dump_path=None):
@@ -1874,6 +1928,19 @@ class DataLoader:
         .ProvenanceRecorder`, or None when ``provenance=`` was not passed."""
         return self._prov_rec
 
+    @property
+    def slo_engine(self):
+        """The attached :class:`~petastorm_tpu.obs.slo.SloEngine`, or None
+        when ``slos=`` was not passed."""
+        return self._slo_engine
+
+    def slo_alerts(self):
+        """Debounced SLO-breach/anomaly alerts so far (ISSUE 12) — each an
+        :class:`~petastorm_tpu.obs.slo.SloAlert` carrying an attribution
+        snapshot when provenance is on. Empty without ``slos=``."""
+        return self._slo_engine.alerts() if self._slo_engine is not None \
+            else []
+
     def _require_provenance(self):
         if self._prov_rec is None:
             raise ValueError(
@@ -1912,6 +1979,11 @@ class DataLoader:
         if self._staging is not None:
             self._staging.close()
             self._staging = None
+        if self._slo_engine is not None and self._slo_owned:
+            # a loader-built engine stops evaluating on the sampler cadence
+            # (alerts stay readable); a caller-supplied SHARED engine keeps
+            # watching — a sibling pipeline may still be burning
+            self._slo_engine.detach()
         if self._obs is not None:
             self._obs.close()
         if self._prov_rec is not None and self._prov_owned:
@@ -2517,7 +2589,7 @@ _UNSET = object()
 _LOADER_OPTS = ("last_batch", "device_transform", "prefetch", "pad_shapes",
                 "device_shuffle_capacity", "to_device", "host_queue_size",
                 "device_decode_resize", "trace", "metrics", "health", "staging",
-                "provenance")
+                "provenance", "slos")
 
 
 def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1,
@@ -2527,7 +2599,7 @@ def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1
                     to_device=_UNSET, host_queue_size=_UNSET,
                     device_decode_resize=_UNSET, trace=_UNSET, metrics=_UNSET,
                     health=_UNSET, staging=_UNSET, provenance=_UNSET,
-                    **reader_kwargs):
+                    slos=_UNSET, **reader_kwargs):
     """One-call convenience: ``make_batch_reader`` + :class:`DataLoader`.
 
     ``reader_kwargs`` pass through to :func:`petastorm_tpu.reader.make_batch_reader`

@@ -243,7 +243,9 @@ def make_train_step(cfg, mesh, n_micro=2, learning_rate=1e-2):
     pspecs = jax.tree.map(lambda s: s.spec, param_shardings(cfg, mesh),
                           is_leaf=lambda x: hasattr(x, "spec"))
     data_spec = P("dp", "sp")
-    step = jax.shard_map(
+    from petastorm_tpu.compat import shard_map
+
+    step = shard_map()(
         sharded_step, mesh=mesh,
         in_specs=(pspecs, data_spec, data_spec),
         out_specs=(pspecs, P()),

@@ -21,6 +21,16 @@ stall watchdog; :mod:`petastorm_tpu.obs.flight` keeps the bounded event ring
 dumped as a structured flight record on stall, crash, or demand
 (``DataLoader.health_report()``); ``petastorm-tpu-stats --watch`` renders it
 all as a live terminal dashboard.
+
+The TEMPORAL plane (ISSUE 12) adds windows over time:
+:mod:`petastorm_tpu.obs.timeseries` keeps bounded per-metric rings sampled on
+the Reporter cadence (counters as rates, histograms as per-window p50/p99);
+:mod:`petastorm_tpu.obs.slo` evaluates declarative :class:`SloSpec`s +
+robust-z anomaly detection per window, firing debounced alerts that carry an
+attribution snapshot naming the culprit site;
+:mod:`petastorm_tpu.obs.serve` is the opt-in loopback HTTP scrape endpoint
+(Prometheus text + JSON timelines) that ``petastorm-tpu-stats --merge``
+aggregates into fleet panels.
 """
 from petastorm_tpu.obs.flight import FlightRecorder
 from petastorm_tpu.obs.health import HealthMonitor, HealthOptions
@@ -31,7 +41,11 @@ from petastorm_tpu.obs.metrics import (
     MetricsRegistry,
     default_registry,
 )
+from petastorm_tpu.obs.serve import MetricsServer
+from petastorm_tpu.obs.slo import AnomalyDetector, SloEngine, SloSpec
+from petastorm_tpu.obs.timeseries import TimelineStore
 
-__all__ = ["Counter", "FlightRecorder", "Gauge", "HealthMonitor",
-           "HealthOptions", "Histogram", "MetricsRegistry",
+__all__ = ["AnomalyDetector", "Counter", "FlightRecorder", "Gauge",
+           "HealthMonitor", "HealthOptions", "Histogram", "MetricsRegistry",
+           "MetricsServer", "SloEngine", "SloSpec", "TimelineStore",
            "default_registry"]

@@ -55,6 +55,7 @@ class BottleneckReport:
     percentiles: dict | None = None  # stage -> {p50, p90, p99}, when metrics on
     straggler: dict | None = None    # {worker, mean_s, peer_median_s, ratio}, when detected
     transform_ops: dict | None = None  # fused-op label -> histogram summary (ISSUE 9)
+    slo_alerts: list | None = None   # recent debounced SLO/anomaly alerts (ISSUE 12)
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -101,6 +102,12 @@ class BottleneckReport:
                     "p99 %7.2fms"
                     % (op, s["sum"], s["count"], s["p50"] * 1e3,
                        s["p99"] * 1e3))
+        if self.slo_alerts:
+            lines.append("  slo alerts (newest last):")
+            for alert in self.slo_alerts[-5:]:
+                lines.append("    [%s] %s"
+                             % (alert.get("cause", "?"),
+                                alert.get("message", "")))
         return "\n".join(lines)
 
     def __str__(self):
@@ -250,4 +257,16 @@ def analyze_loader(loader):
     ops = transform_op_stats()
     if ops:
         report.transform_ops = ops
+    # temporal plane (ISSUE 12): recent debounced SLO/anomaly alerts ride on
+    # the verdict, so one report shows both the steady-state shape AND any
+    # burn the window crossed
+    engine = getattr(loader, "_slo_engine", None)
+    if engine is not None:
+        alerts = engine.alerts()
+        if alerts:
+            report.slo_alerts = [
+                {"name": a.name, "cause": a.cause, "t": a.t,
+                 "value": a.value, "culprit": a.culprit,
+                 "message": a.message}
+                for a in alerts]
     return report

@@ -64,6 +64,32 @@ def _flush(stack, out):
     out[site] = out.get(site, 0.0) + self_s
 
 
+def diff_self_times(sites_a, sites_b, min_share=0.05):
+    """Per-site self-time movement between two runs' ``stage_self_s`` maps
+    (a = baseline, b = candidate): ``[(site, ratio, a_s, b_s)]`` sorted
+    worst-growth-first. Only *significant* sites are compared — a site must
+    own at least ``min_share`` of either run's total self time, so a
+    0.1ms→0.4ms noise site cannot outrank a real 2× regression of the
+    dominant seam. A site absent from the baseline is ratioed against a tiny
+    epsilon floor of the baseline total (new work showing up IS a
+    regression). Feeds ``petastorm-tpu-bench diff`` (ISSUE 12)."""
+    total_a = sum(sites_a.values()) or 0.0
+    total_b = sum(sites_b.values()) or 0.0
+    floor = max(total_a, total_b) * 1e-3 + 1e-9
+    out = []
+    for site in set(sites_a) | set(sites_b):
+        a = sites_a.get(site, 0.0)
+        b = sites_b.get(site, 0.0)
+        share_a = a / total_a if total_a else 0.0
+        share_b = b / total_b if total_b else 0.0
+        if max(share_a, share_b) < min_share:
+            continue
+        ratio = b / max(a, floor)
+        out.append((site, ratio, a, b))
+    out.sort(key=lambda e: -e[1])
+    return out
+
+
 def _percentile(sorted_values, q):
     if not sorted_values:
         return 0.0

@@ -151,14 +151,31 @@ class Reporter:
         # petastorm-tpu-stats run_stats.jsonl   (live, from another terminal)
     """
 
+    #: JSONL line schema (ISSUE 12 satellite): v2 lines carry a ``perf``
+    #: stamp and the reporter's ``anchor`` (wall, perf, host, pid) so a
+    #: cross-host ``petastorm-tpu-stats --merge`` places every window on the
+    #: anchored timeline (wall trusted ONCE, elapsed measured on the perf
+    #: clock — the PR 3/10 trace-merge scheme) instead of trusting each
+    #: line's possibly-skewed/stepping wall stamp
+    SCHEMA = "ptpu-stats-v2"
+
     def __init__(self, registry=None, interval_s=5.0, jsonl_path=None,
-                 prom_path=None, max_bytes=None, keep=3):
+                 prom_path=None, max_bytes=None, keep=3, timelines=True):
         if jsonl_path is None and prom_path is None:
             raise ValueError("Reporter needs jsonl_path and/or prom_path")
         self._registry = registry or default_registry()
         self._interval_s = float(interval_s)
         self._jsonl_path = jsonl_path
         self._prom_path = prom_path
+        #: feed the registry's windowed time-series on this cadence (ISSUE
+        #: 12): one registry pass per flush on THIS thread — the hot paths
+        #: never see the temporal plane. False opts out (a second Reporter
+        #: tailing the same registry should not double-sample the windows).
+        self._timelines = bool(timelines)
+        import socket
+
+        self._anchor = {"wall": time.time(), "perf": time.perf_counter(),
+                        "host": socket.gethostname(), "pid": os.getpid()}
         #: size-capped rotation (ISSUE 10 satellite): when appending would
         #: grow the JSONL stream past ``max_bytes``, the file rotates to
         #: ``<path>.1`` (existing ``.1``→``.2``, …; at most ``keep`` rotated
@@ -199,10 +216,24 @@ class Reporter:
                 pass  # degrade: append past the cap rather than drop data
 
     def _write_once(self):
+        if self._timelines:
+            # sample the windowed series on the reporter cadence; the SLO
+            # engine (attached as a store listener) evaluates on the same
+            # tick. Never lets a listener/sampling failure kill the flush.
+            try:
+                self._registry.sample_timelines()
+            except Exception:  # noqa: BLE001 — flushing beats sampling
+                from petastorm_tpu.obs.log import degradation
+
+                degradation("timeline_sample_error",
+                            "timeline sampling failed on the Reporter "
+                            "cadence; snapshots continue without windows")
         if self._prom_path is not None:
             write_prometheus(self._prom_path, self._registry)
         if self._jsonl_path is not None:
-            line = json.dumps({"ts": time.time(),
+            line = json.dumps({"schema": self.SCHEMA, "ts": time.time(),
+                               "perf": time.perf_counter(),
+                               "anchor": self._anchor,
                                "metrics": self._registry.snapshot()}) + "\n"
             self._maybe_rotate(len(line))
             with open(self._jsonl_path, "a") as f:
@@ -249,7 +280,16 @@ class Reporter:
 def read_latest_jsonl_snapshot(path):
     """Last well-formed ``{"ts", "metrics"}`` object in a Reporter JSONL stream
     (tolerates a torn final line from a live writer); None when none exists."""
-    latest = None
+    recent = read_recent_jsonl_snapshots(path, limit=1)
+    return recent[-1] if recent else None
+
+
+def read_recent_jsonl_snapshots(path, limit=64):
+    """Last ``limit`` well-formed snapshot objects, oldest first (the
+    ``petastorm-tpu-stats --watch`` sparkline feed; tolerates torn lines)."""
+    from collections import deque
+
+    recent = deque(maxlen=max(1, int(limit)))
     with open(path, "r") as f:
         for line in f:
             try:
@@ -257,5 +297,5 @@ def read_latest_jsonl_snapshot(path):
             except ValueError:
                 continue
             if isinstance(obj, dict) and "metrics" in obj:
-                latest = obj
-    return latest
+                recent.append(obj)
+    return list(recent)

@@ -30,7 +30,12 @@ dataset-watch plane (ISSUE 11), ``dataset_mutated`` (the watcher observed a
 removal/rewrite under a running reader), ``piece_removed`` /
 ``piece_rewritten`` (a plan item quarantined because its file vanished /
 changed generation mid-run), and ``watch_error`` (a watch tick failed —
-scan, mutate hook, or delta application).
+scan, mutate hook, or delta application) — and, from the temporal plane
+(ISSUE 12), ``slo_breach`` / ``anomaly_detected`` (a debounced SLO/anomaly
+alert fired; the full alert rides into live flight recorders),
+``slo_attribution_error``, ``timeline_listener_error`` and
+``timeline_sample_error`` (best-effort temporal-plane failures that must
+stay visible without killing the cadence).
 """
 from __future__ import annotations
 

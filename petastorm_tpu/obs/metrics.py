@@ -261,6 +261,10 @@ class MetricsRegistry:
         self._families = {}    # name -> (kind, help)
         self._collectors = {}  # handle (int) -> (prefix, fn)
         self._next_handle = 0
+        #: lazily-built windowed time-series store (ISSUE 12); None until the
+        #: first timeline_store()/sample_timelines() call, so registries that
+        #: never asked for the temporal plane pay nothing
+        self._timeline_store = None
 
     # -- family construction ------------------------------------------------------------
 
@@ -308,8 +312,14 @@ class MetricsRegistry:
         return handle
 
     def unregister_collector(self, handle):
+        """Accepts one handle or an iterable of handles (``Reader
+        .register_metrics`` returns a list since it registers wire AND io
+        collectors)."""
+        handles = handle if isinstance(handle, (list, tuple, set)) \
+            else (handle,)
         with self._lock:
-            self._collectors.pop(handle, None)
+            for h in handles:
+                self._collectors.pop(h, None)
 
     def _collect(self):
         with self._lock:
@@ -323,6 +333,54 @@ class MetricsRegistry:
             for suffix, value in (polled or {}).items():
                 out["ptpu_%s_%s" % (prefix, suffix)] = value
         return out
+
+    # -- windowed time-series (ISSUE 12) ------------------------------------------------
+
+    def _timeline_sources(self):
+        """Raw per-series reads for the timeline sampler
+        (:mod:`petastorm_tpu.obs.timeseries`): ``[(full_name, kind, payload)]``
+        where payload is the scalar value for counters/gauges and
+        ``export_state()`` for histograms. Collector values ride along typed
+        by suffix (``*_total`` = counter semantics, everything else a level) —
+        their sources keep cumulative floats (``read_s``, ``rows``) that the
+        sampler differences either way."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out = []
+        for m in metrics:
+            if m.kind == "histogram":
+                out.append((m.full_name, "histogram", m.export_state()))
+            else:
+                out.append((m.full_name, m.kind, m.value))
+        for name, value in self._collect().items():
+            kind = "counter" if name.endswith("_total") else "gauge"
+            out.append((name, kind, float(value)))
+        return out
+
+    def timeline_store(self, **kwargs):
+        """The registry's :class:`~petastorm_tpu.obs.timeseries.TimelineStore`
+        (created on first use; ``kwargs`` — ``max_points``/``max_series`` —
+        apply only at creation). Sampling happens on whatever cadence calls
+        :meth:`sample_timelines` (the Reporter thread, normally) — never on an
+        instrumented hot path."""
+        with self._lock:
+            if self._timeline_store is None:
+                from petastorm_tpu.obs.timeseries import TimelineStore
+
+                self._timeline_store = TimelineStore(self, **kwargs)
+            return self._timeline_store
+
+    def sample_timelines(self):
+        """Sample every series into the timeline rings (one pass, one lock per
+        metric); returns the window dict. The Reporter calls this per flush."""
+        return self.timeline_store().sample()
+
+    def timeline(self, name):
+        """Windowed points of one series (full snapshot name, labels included)
+        — ``[]`` until the store has sampled it. Counters read back as
+        delta/rate points, histograms as per-window p50/p99."""
+        store = self._timeline_store
+        return store.points(name) if store is not None else []
 
     # -- output -------------------------------------------------------------------------
 

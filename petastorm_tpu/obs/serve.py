@@ -1,0 +1,150 @@
+"""Live metrics scrape endpoint: stdlib HTTP, opt-in, loopback by default
+(ISSUE 12).
+
+The file exporters (:mod:`petastorm_tpu.obs.export`) cover the sidecar-tail
+pattern; a *fleet* needs pull: the disaggregated-service roadmap item scrapes
+many hosts' pipelines, and ``petastorm-tpu-stats --merge`` aggregates what
+this endpoint serves. :class:`MetricsServer` is a tiny stdlib
+``ThreadingHTTPServer`` (no new dependencies, daemon threads, bounded
+shutdown) exposing:
+
+- ``GET /metrics`` — Prometheus text exposition (the standard scrape path);
+- ``GET /timelines`` — the fleet-export JSON document
+  (:func:`petastorm_tpu.obs.timeseries.export_document`): last snapshot +
+  windowed time-series + the (wall, perf) clock anchor identifying this
+  source — exactly what ``--merge`` consumes;
+- ``GET /alerts`` — the attached SLO engine's alert list (empty without one);
+- ``GET /healthz`` — liveness probe (200 + uptime JSON).
+
+**Security note:** the server binds ``127.0.0.1`` by default — metrics leak
+dataset paths, host names and operational detail, so exposing them beyond the
+host is an explicit opt-in (``host="0.0.0.0"``), to be fronted by whatever
+authn the deployment already has. There is no TLS and no auth here by design:
+this is a loopback/sidecar seam, not an internet-facing service.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+logger = logging.getLogger("petastorm_tpu.obs")
+
+
+class MetricsServer:
+    """Serve one registry's metrics + timelines over loopback HTTP.
+
+    ``port=0`` (default) picks a free port — read it back from ``.port``
+    after :meth:`start`. Use as a context manager around the serving loop::
+
+        registry = MetricsRegistry()
+        with MetricsServer(registry) as srv:
+            print("scrape me at http://127.0.0.1:%d/metrics" % srv.port)
+            ...
+
+    The handler reads the registry/engine per request (pull model — zero cost
+    when nobody scrapes), and request handling runs on daemon threads so a
+    wedged scraper cannot block pipeline teardown.
+    """
+
+    def __init__(self, registry=None, host="127.0.0.1", port=0,
+                 slo_engine=None):
+        from petastorm_tpu.obs.metrics import default_registry
+
+        self._registry = registry or default_registry()
+        self._slo_engine = slo_engine
+        self._host = host
+        self._requested_port = int(port)
+        self._httpd = None
+        self._thread = None
+        self._started = time.time()
+        self.port = None
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # stdlib default prints to stderr
+                logger.debug("metrics-server: " + fmt, *args)
+
+            def _send(self, body, content_type, status=200):
+                if isinstance(body, str):
+                    body = body.encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._send(server._registry.to_prometheus(),
+                                   "text/plain; version=0.0.4")
+                    elif path == "/timelines":
+                        from petastorm_tpu.obs.timeseries import export_document
+
+                        self._send(json.dumps(export_document(
+                            server._registry)), "application/json")
+                    elif path == "/alerts":
+                        engine = server._slo_engine
+                        alerts = [a.to_dict() for a in engine.alerts()] \
+                            if engine is not None else []
+                        self._send(json.dumps({"alerts": alerts}),
+                                   "application/json")
+                    elif path == "/healthz":
+                        self._send(json.dumps(
+                            {"ok": True,
+                             "uptime_s": round(time.time() - server._started,
+                                               3)}), "application/json")
+                    else:
+                        self._send(json.dumps(
+                            {"error": "unknown path %s" % path,
+                             "paths": ["/metrics", "/timelines", "/alerts",
+                                       "/healthz"]}),
+                            "application/json", status=404)
+                except BrokenPipeError:
+                    pass  # scraper went away mid-response: its problem
+                except Exception as e:  # noqa: BLE001 — a render bug must 500, not kill the thread
+                    try:
+                        self._send(json.dumps({"error": str(e)}),
+                                   "application/json", status=500)
+                    except OSError:
+                        pass
+
+        httpd = ThreadingHTTPServer((self._host, self._requested_port),
+                                    _Handler)
+        httpd.daemon_threads = True
+        self._httpd = httpd
+        self.port = httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            name="ptpu-metrics-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        httpd.shutdown()
+        httpd.server_close()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10)
+
+    @property
+    def url(self):
+        return None if self.port is None \
+            else "http://%s:%d" % (self._host, self.port)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.stop()

@@ -1,0 +1,392 @@
+"""SLO + anomaly engine: declarative objectives evaluated per time-series
+window, with debounced alerts that name the culprit site (ISSUE 12).
+
+The temporal plane (:mod:`petastorm_tpu.obs.timeseries`) turns the registry
+into windowed series; this module watches them. Two detection modes:
+
+- **SLO specs** (:class:`SloSpec`): declarative "this series must stay on this
+  side of this threshold" objectives — loader step p99 ≤ X, quarantine rate
+  ≤ Y/s, mem-tier hit share ≥ Z, producer idle share ≤ W. Evaluated on every
+  window; a spec must breach ``breach_windows`` CONSECUTIVE windows before the
+  alert fires (burn-rate debounce — one slow window on a shared host is not an
+  incident), fires exactly once per excursion, and re-arms only after a clean
+  window.
+- **Anomaly detection** (:class:`AnomalyDetector`): for series without a known
+  threshold, EWMA-smoothed robust-z drift detection against the trailing
+  window history (median/MAD — one outlier window cannot drag the baseline).
+  A step cliff fires exactly once: the detector latches while the series stays
+  out of band and re-arms when the baseline adapts or the series recovers.
+
+Every firing is a first-class degradation event (``cause=slo_breach`` /
+``anomaly_detected`` — counted on ``ptpu_degradations_total``, warn-once
+logged, mirrored into every live flight recorder) and carries an
+**attribution snapshot** when the engine was given an attribution source
+(``DataLoader(slos=...)`` wires ``attribution_report()`` automatically when
+provenance is on): the alert names the culprit SITE eating the critical path
+("io.remote"), not just the breached symptom.
+
+Zero hot-path cost: evaluation happens on the sampling cadence (the Reporter
+thread), never on the loader/reader paths.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+_OPS = {
+    "<=": lambda v, t: v <= t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    ">": lambda v, t: v > t,
+}
+
+#: stats resolvable from a window point (see SloSpec.stat)
+_STATS = ("value", "delta", "rate", "p50", "p99", "share")
+
+
+@dataclasses.dataclass(frozen=True)
+class SloSpec:
+    """One declarative objective over one windowed series.
+
+    ``metric`` is the snapshot full name (labels included), e.g.
+    ``'ptpu_pipeline_stage_seconds{stage="read"}'``. ``stat`` picks the window
+    statistic:
+
+    - ``value`` — the sampled level (gauges);
+    - ``delta`` / ``rate`` — the window's counter movement / per-second rate;
+    - ``p50`` / ``p99`` — the window-local histogram percentile;
+    - ``share`` — ``delta(metric) / Σ delta(denominator)``; with
+      ``denominator=None`` the denominator is the window length in seconds
+      (a *time share*: ``metric='ptpu_pipeline_put_wait_s', stat='share'``
+      is the producer's idle fraction).
+
+    A window where the series is absent, has no prior sample to delta
+    against, or (for histograms) saw fewer than ``min_count`` observations is
+    SKIPPED — it neither breaches nor clears, so sparse windows cannot flap
+    the debounce state.
+    """
+
+    name: str
+    metric: str
+    stat: str = "value"
+    op: str = "<="
+    threshold: float = 0.0
+    #: for ``stat='share'``: denominator series name(s), deltas summed;
+    #: None = the window duration (time share)
+    denominator: tuple | str | None = None
+    #: consecutive breaching windows before the alert fires (burn debounce)
+    breach_windows: int = 2
+    #: histogram windows with fewer observations than this are skipped
+    min_count: int = 1
+    description: str = ""
+
+    def __post_init__(self):
+        if self.op not in _OPS:
+            raise ValueError("SloSpec op must be one of %s, got %r"
+                             % (sorted(_OPS), self.op))
+        if self.stat not in _STATS:
+            raise ValueError("SloSpec stat must be one of %s, got %r"
+                             % (_STATS, self.stat))
+
+    def resolve(self, window, window_s=None):
+        """The spec's statistic from one window dict, or None to skip."""
+        point = window.get(self.metric)
+        if point is None:
+            return None
+        if self.stat in ("p50", "p99"):
+            if point.get("count", 0) < self.min_count:
+                return None
+            return point.get(self.stat)
+        if self.stat == "value":
+            return point.get("value")
+        if self.stat in ("delta", "rate"):
+            return point.get(self.stat)  # None on a series' first window
+        # share
+        num = point.get("delta")
+        if num is None:
+            return None
+        if self.denominator is None:
+            if not window_s:
+                return None
+            return num / window_s
+        denoms = (self.denominator,) if isinstance(self.denominator, str) \
+            else tuple(self.denominator)
+        total = 0.0
+        for name in denoms:
+            dpoint = window.get(name)
+            if dpoint is None or dpoint.get("delta") is None:
+                return None
+            total += dpoint["delta"]
+        if total <= 0:
+            return None  # nothing moved: no share to judge
+        return num / total
+
+    def ok(self, value):
+        return _OPS[self.op](value, self.threshold)
+
+
+class AnomalyDetector:
+    """EWMA + robust-z drift detector over one series' window values.
+
+    ``observe(value)`` returns True exactly when an anomaly FIRES: the
+    EWMA-smoothed value sits more than ``z_threshold`` robust standard
+    deviations (median/MAD over the trailing ``history`` windows) from the
+    baseline, with at least ``min_history`` windows of history. The detector
+    then latches — an injected step cliff fires ONCE, not once per window —
+    and re-arms when the smoothed series returns within ``z_clear`` (either
+    the series recovered, or the trailing baseline adapted to the new
+    normal)."""
+
+    def __init__(self, history=32, min_history=8, z_threshold=6.0,
+                 z_clear=3.0, ewma_alpha=0.4):
+        from collections import deque
+
+        self._history = deque(maxlen=max(min_history, int(history)))
+        self._min_history = int(min_history)
+        self._z_threshold = float(z_threshold)
+        self._z_clear = float(z_clear)
+        self._alpha = float(ewma_alpha)
+        self._ewma = None
+        self._fired = False
+        self.last_z = 0.0
+
+    def _z(self, value):
+        vals = sorted(self._history)
+        n = len(vals)
+        med = vals[n // 2] if n % 2 else 0.5 * (vals[n // 2 - 1] + vals[n // 2])
+        devs = sorted(abs(v - med) for v in vals)
+        mad = devs[n // 2] if n % 2 else 0.5 * (devs[n // 2 - 1] + devs[n // 2])
+        scale = 1.4826 * mad
+        if scale <= 0:
+            # a perfectly flat baseline: any departure is infinitely many
+            # MADs away — use a small floor relative to the median instead
+            scale = max(abs(med) * 0.05, 1e-9)
+        return abs(value - med) / scale
+
+    def observe(self, value):
+        if value is None:
+            return False
+        if self._ewma is None:
+            self._ewma = float(value)
+        else:
+            self._ewma = (self._alpha * float(value)
+                          + (1.0 - self._alpha) * self._ewma)
+        fired = False
+        if len(self._history) >= self._min_history:
+            z = self._z(self._ewma)
+            self.last_z = round(z, 3)
+            if not self._fired and z >= self._z_threshold:
+                self._fired = True
+                fired = True
+            elif self._fired and z <= self._z_clear:
+                self._fired = False  # recovered / baseline adapted: re-arm
+        self._history.append(float(value))
+        return fired
+
+
+@dataclasses.dataclass
+class SloAlert:
+    """One debounced firing (breach or anomaly)."""
+
+    name: str
+    cause: str          # slo_breach | anomaly_detected
+    metric: str
+    stat: str
+    t: float            # anchored window time
+    value: float
+    threshold: float | None   # None for anomalies
+    windows: int        # consecutive breaching windows at fire time
+    message: str
+    #: AttributionReport.to_dict() at fire time (None without an attribution
+    #: source) — the alert names the culprit site, not just the symptom
+    attribution: dict | None = None
+    #: the attribution snapshot's slow-decile culprit site (convenience)
+    culprit: str | None = None
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+class SloEngine:
+    """Evaluates :class:`SloSpec`s (+ anomaly watches) per sampled window.
+
+    Attach to a :class:`~petastorm_tpu.obs.timeseries.TimelineStore` with
+    :meth:`attach` (the Reporter's ``sample_timelines()`` cadence then drives
+    evaluation), or call :meth:`evaluate` directly with a window dict (tests,
+    manual cadences). ``attribution`` is a zero-arg callable returning an
+    :class:`~petastorm_tpu.obs.critical_path.AttributionReport` (or None);
+    ``DataLoader(slos=...)`` wires its ``attribution_report`` when provenance
+    is enabled. Alerts are kept in a bounded list (newest last) and counted
+    as ``ptpu_slo_alerts_total{slo=...}`` on the engine's registry."""
+
+    def __init__(self, specs=(), registry=None, attribution=None,
+                 anomaly_metrics=(), anomaly_kwargs=None, max_alerts=256):
+        self._specs = list(specs)
+        self._registry = registry
+        self._attribution = attribution
+        #: [(metric, stat)] series watched for anomalies without a threshold
+        self._anomaly_watch = [(m, s) for m, s in
+                               (tuple(w) for w in anomaly_metrics)]
+        self._anomaly_kwargs = dict(anomaly_kwargs or {})
+        self._detectors = {}
+        self._lock = threading.Lock()
+        self._alerts = []
+        self._max_alerts = int(max_alerts)
+        self._breach_streak = {}   # spec name -> consecutive breaching windows
+        self._breach_latched = {}  # spec name -> alert already fired this excursion
+        self._last_t = None
+        self._store = None
+        self._listener = None
+        self.windows_evaluated = 0
+
+    # -- wiring -------------------------------------------------------------------------
+
+    def set_attribution(self, fn):
+        self._attribution = fn
+
+    def attach(self, store):
+        """Subscribe to a TimelineStore's sampling cadence. Idempotent per
+        store; :meth:`detach` unsubscribes (loader ``__exit__``)."""
+        self.detach()
+        self._store = store
+        self._listener = store.add_listener(self._on_window)
+        return self
+
+    def detach(self):
+        store, self._store = self._store, None
+        if store is not None and self._listener is not None:
+            store.remove_listener(self._listener)
+        self._listener = None
+
+    def _on_window(self, window, t):
+        self.evaluate(window, t)
+
+    # -- evaluation ---------------------------------------------------------------------
+
+    def evaluate(self, window, t=None):
+        """Evaluate all specs + anomaly watches against one window; returns
+        the alerts fired by THIS window (possibly empty)."""
+        t = time.time() if t is None else t
+        with self._lock:
+            window_s = None if self._last_t is None else max(0.0, t - self._last_t)
+            self._last_t = t
+            self.windows_evaluated += 1
+            fired = []
+            for spec in self._specs:
+                value = spec.resolve(window, window_s=window_s)
+                if value is None:
+                    continue  # sparse window: neither breaches nor clears
+                if spec.ok(value):
+                    self._breach_streak[spec.name] = 0
+                    self._breach_latched[spec.name] = False
+                    continue
+                streak = self._breach_streak.get(spec.name, 0) + 1
+                self._breach_streak[spec.name] = streak
+                if streak >= spec.breach_windows \
+                        and not self._breach_latched.get(spec.name):
+                    self._breach_latched[spec.name] = True
+                    fired.append((spec, value, streak))
+            anomalies = []
+            for metric, stat in self._anomaly_watch:
+                point = window.get(metric)
+                value = None if point is None else point.get(stat)
+                key = (metric, stat)
+                det = self._detectors.get(key)
+                if det is None:
+                    det = self._detectors[key] = AnomalyDetector(
+                        **self._anomaly_kwargs)
+                if det.observe(value):
+                    anomalies.append((metric, stat, value, det.last_z))
+        out = []
+        for spec, value, streak in fired:
+            out.append(self._fire_breach(spec, value, streak, t))
+        for metric, stat, value, z in anomalies:
+            out.append(self._fire_anomaly(metric, stat, value, z, t))
+        return out
+
+    # -- alert plumbing -----------------------------------------------------------------
+
+    def _attribution_snapshot(self):
+        if self._attribution is None:
+            return None, None
+        try:
+            report = self._attribution()
+        except Exception:  # noqa: BLE001 — a broken source must not kill alerting
+            from petastorm_tpu.obs.log import degradation
+
+            degradation("slo_attribution_error",
+                        "SLO alert attribution snapshot failed; alert carries "
+                        "no culprit")
+            return None, None
+        if report is None:
+            return None, None
+        return report.to_dict(), report.slow_top
+
+    def _record_alert(self, alert):
+        from petastorm_tpu.obs import flight as _flight
+        from petastorm_tpu.obs.log import degradation
+
+        with self._lock:
+            self._alerts.append(alert)
+            del self._alerts[:-self._max_alerts]
+        if self._registry is not None:
+            self._registry.counter(
+                "ptpu_slo_alerts_total",
+                help="debounced SLO-breach/anomaly alerts", slo=alert.name).inc()
+        # count + warn-once log + flight mirror of the CAUSE; then the full
+        # alert (culprit included) into every live flight recorder
+        degradation(alert.cause, "%s", alert.message)
+        for recorder in _flight.active_recorders():
+            recorder.record("slo_alert", name=alert.name, cause=alert.cause,
+                            metric=alert.metric, value=alert.value,
+                            threshold=alert.threshold, culprit=alert.culprit)
+        return alert
+
+    def _fire_breach(self, spec, value, streak, t):
+        attribution, culprit = self._attribution_snapshot()
+        message = ("SLO %r breached: %s %s = %.6g violates %s %.6g for %d "
+                   "consecutive windows%s"
+                   % (spec.name, spec.metric, spec.stat, value, spec.op,
+                      spec.threshold, streak,
+                      " — critical path owned by %s" % culprit
+                      if culprit else ""))
+        return self._record_alert(SloAlert(
+            name=spec.name, cause="slo_breach", metric=spec.metric,
+            stat=spec.stat, t=t, value=round(float(value), 6),
+            threshold=spec.threshold, windows=streak, message=message,
+            attribution=attribution, culprit=culprit))
+
+    def _fire_anomaly(self, metric, stat, value, z, t):
+        attribution, culprit = self._attribution_snapshot()
+        message = ("anomaly on %s %s: window value %.6g sits %.1f robust "
+                   "stddevs from the trailing baseline%s"
+                   % (metric, stat, value, z,
+                      " — critical path owned by %s" % culprit
+                      if culprit else ""))
+        return self._record_alert(SloAlert(
+            name="anomaly:%s:%s" % (metric, stat), cause="anomaly_detected",
+            metric=metric, stat=stat, t=t, value=round(float(value), 6),
+            threshold=None, windows=1, message=message,
+            attribution=attribution, culprit=culprit))
+
+    # -- reads --------------------------------------------------------------------------
+
+    def alerts(self):
+        """All alerts so far (oldest first, bounded at ``max_alerts``)."""
+        with self._lock:
+            return list(self._alerts)
+
+    def breaching(self):
+        """Specs currently in a breach streak: ``{name: streak}``."""
+        with self._lock:
+            return {n: s for n, s in self._breach_streak.items() if s}
+
+    def collect(self):
+        """Pull-collector shape (``ptpu_slo_*``): alert totals + live breach
+        streaks, for registries that want the engine state exported."""
+        with self._lock:
+            return {"alerts": len(self._alerts),
+                    "windows_evaluated": self.windows_evaluated,
+                    "breaching": sum(1 for s in self._breach_streak.values()
+                                     if s)}

@@ -6,11 +6,20 @@ appending to) or a Prometheus text file — and renders one dashboard frame:
 stage latency percentiles, queue depths, heartbeat ages (with stalled actors
 flagged), per-worker latencies, degradation counts, and the bottleneck
 analyzer's verdict (``straggler`` included when per-worker data is present).
+In ``--watch`` mode (and against multi-line JSONL streams) the frame gains
+sparkline trend columns (rows/s, stage p99, mem-tier hit share) and
+window-over-window deltas on the dataset-watch/attribution panels (ISSUE 12).
 
     petastorm-tpu-stats run_stats.jsonl            # one frame
     petastorm-tpu-stats --watch run_stats.jsonl    # redraw every 2s
     petastorm-tpu-stats --watch 0.5 metrics.prom   # redraw every 0.5s
     petastorm-tpu-stats --watch --once stats.jsonl # render ONE watch frame (CI)
+    petastorm-tpu-stats --merge a.jsonl b.json ... # fleet merge (ISSUE 12):
+        aggregate several processes'/hosts' exports (Reporter JSONL streams
+        and/or the scrape endpoint's /timelines JSON documents) into one
+        fleet dashboard — totals summed per family, per-source breakdown,
+        fleet rows/s sparkline — windows aligned on each source's
+        (wall, perf) clock anchor, not its skew-prone wall stamps.
 
 Exit codes: 0 printed a snapshot, 1 no snapshot found / unreadable file.
 """
@@ -37,6 +46,21 @@ def _load_snapshot(path):
         return None if obj is None else obj["metrics"]
     with open(path, "r") as f:
         return _fold_prom_histograms(parse_prometheus_text(f.read()))
+
+
+def _load_history(path, limit=40):
+    """Recent ``(t, metrics)`` snapshots from a Reporter JSONL stream, oldest
+    first (the sparkline feed); None for Prometheus files (the watch loop
+    accumulates its own frames there). Times sit on the anchored timeline
+    when the stream carries the v2 (wall, perf) anchor."""
+    from petastorm_tpu.obs.export import read_recent_jsonl_snapshots
+    from petastorm_tpu.obs.timeseries import _anchored_t
+
+    with open(path, "r") as f:
+        if f.read(1) != "{":
+            return None
+    return [(_anchored_t(snap), snap["metrics"])
+            for snap in read_recent_jsonl_snapshots(path, limit=limit)]
 
 
 _BUCKET_RE = re.compile(r"^(?P<name>\w+)_bucket(?P<labels>\{.*\})$")
@@ -122,11 +146,92 @@ def _fmt_ms(v):
     return "%8.2f" % (v * 1e3)
 
 
-def render_dashboard(metrics, title=""):
+def _history_series(history, fn):
+    """Apply ``fn(metrics) -> value|None`` over snapshot history (oldest
+    first); returns the value series."""
+    return [fn(m) for _t, m in history]
+
+
+def _delta_series(history, key):
+    """Window deltas of one cumulative scalar across the history, divided by
+    the window length (a rate series); None where the series is absent."""
+    out = []
+    prev = None
+    for t, m in history:
+        v = m.get(key)
+        if not isinstance(v, (int, float)):
+            out.append(None)
+            prev = None
+            continue
+        if prev is None:
+            out.append(None)
+        else:
+            pv, pt = prev
+            dt = max(1e-9, t - pt)
+            out.append(max(0.0, v - pv) / dt)
+        prev = (v, t)
+    return out
+
+
+def _render_trends(lines, history):
+    """Sparkline trend panel over the snapshot history (ISSUE 12): rows/s
+    and mem-tier hit share from window deltas, read p99 from each snapshot's
+    cumulative histogram summary (JSONL lines carry summaries, not buckets —
+    the label says "cum"; scrape ``/timelines`` for true per-window p99)."""
+    from petastorm_tpu.obs.timeseries import sparkline
+
+    if len(history) < 3:
+        return
+    rows = _delta_series(history, "ptpu_pipeline_rows")
+
+    def stage_p99(m):
+        s = m.get('ptpu_pipeline_stage_seconds{stage="read"}')
+        return s.get("p99") if isinstance(s, dict) else None
+
+    p99s = _history_series(history, stage_p99)
+
+    def mem_share(m):
+        hits = {t: m.get('ptpu_io_tier_hits_total{tier="%s"}' % t, 0)
+                for t in ("mem", "disk", "remote")}
+        total = sum(v for v in hits.values() if isinstance(v, (int, float)))
+        return (hits.get("mem", 0) / total) if total else None
+
+    shares = _history_series(history, mem_share)
+    panel = []
+    for label, series, fmt in (
+            ("rows/s", rows, lambda v: "%.0f" % v),
+            ("read p99 ms (cum)", p99s, lambda v: "%.2f" % (v * 1e3)),
+            ("mem-tier share", shares, lambda v: "%.0f%%" % (100 * v))):
+        present = [v for v in series if v is not None]
+        if not present:
+            continue
+        panel.append("  %-16s %s  now %s"
+                     % (label, sparkline(series), fmt(present[-1])))
+    if panel:
+        lines.append("trends (last %d windows):" % len(history))
+        lines.extend(panel)
+
+
+def _fmt_delta(cur, prev, as_int=True):
+    """`` (+N this window)`` suffix, empty when unchanged/unknown."""
+    if prev is None or cur is None:
+        return ""
+    d = cur - prev
+    if not d:
+        return ""
+    return " (%+d this window)" % d if as_int else " (%+.3f this window)" % d
+
+
+def render_dashboard(metrics, title="", history=None):
     """One dashboard frame (a plain string — the CLI prints it, tests assert
     on it). Sections appear only when their families are present, so the same
-    renderer serves a bare-metrics run and a full health-enabled one."""
+    renderer serves a bare-metrics run and a full health-enabled one.
+    ``history`` is an optional oldest-first ``[(t, metrics)]`` list (the
+    current snapshot last) enabling the sparkline trend panel and the
+    window-over-window deltas."""
     lines = []
+    history = history or []
+    prev_metrics = history[-2][1] if len(history) >= 2 else {}
     if title:
         lines.append(title)
         lines.append("=" * min(78, max(20, len(title))))
@@ -155,6 +260,9 @@ def render_dashboard(metrics, title=""):
                      % (snap.get("rows", 0), snap.get("batches", 0),
                         snap.get("host_queue_depth", 0),
                         snap.get("device_queue_depth", 0)))
+
+    # -- sparkline trends over the snapshot history (ISSUE 12)
+    _render_trends(lines, history)
 
     # -- stage latency percentiles
     stages = _labeled(metrics, "ptpu_pipeline_stage_seconds")
@@ -249,18 +357,26 @@ def render_dashboard(metrics, title=""):
                          % (label, _fmt_ms(h.get("p50", 0)),
                             _fmt_ms(h.get("p99", 0)), h.get("count", 0)))
 
-    # -- dataset watch (ISSUE 11): mutation counters, excluded from "other"
+    # -- dataset watch (ISSUE 11): mutation counters, excluded from "other";
+    # window-over-window deltas ride along when history is present (ISSUE 12)
     ds = {name[len("ptpu_dataset_"):]: v for name, v in metrics.items()
           if name.startswith("ptpu_dataset_") and isinstance(v, (int, float))}
     if any(ds.values()):
-        lines.append(
-            "dataset watch: added=%d removed=%d rewritten=%d extensions=%d "
-            "generation_conflicts=%d"
-            % (int(ds.get("pieces_added_total", 0)),
-               int(ds.get("pieces_removed_total", 0)),
-               int(ds.get("pieces_rewritten_total", 0)),
-               int(ds.get("plan_extensions_total", 0)),
-               int(ds.get("generation_conflicts_total", 0))))
+        def _ds_prev(key):
+            v = prev_metrics.get("ptpu_dataset_" + key)
+            return int(v) if isinstance(v, (int, float)) else None
+
+        parts = []
+        for label, key in (("added", "pieces_added_total"),
+                           ("removed", "pieces_removed_total"),
+                           ("rewritten", "pieces_rewritten_total"),
+                           ("extensions", "plan_extensions_total"),
+                           ("generation_conflicts",
+                            "generation_conflicts_total")):
+            cur = int(ds.get(key, 0))
+            parts.append("%s=%d%s" % (label, cur,
+                                      _fmt_delta(cur, _ds_prev(key))))
+        lines.append("dataset watch: " + " ".join(parts))
 
     # -- declarative transform ops (ISSUE 9): per-fused-stage timings
     ops = _labeled(metrics, "ptpu_transform_seconds")
@@ -277,7 +393,8 @@ def render_dashboard(metrics, title=""):
         if rows_total:
             lines.append("  transform rows total: %d" % int(rows_total))
 
-    # -- provenance / critical-path attribution (ISSUE 10)
+    # -- provenance / critical-path attribution (ISSUE 10); per-site
+    # window-over-window self-time deltas when history is present (ISSUE 12)
     prov_self = {name[len("ptpu_prov_self_s_"):]: v
                  for name, v in metrics.items()
                  if name.startswith("ptpu_prov_self_s_")}
@@ -289,18 +406,29 @@ def render_dashboard(metrics, title=""):
                      % (int(metrics.get("ptpu_prov_items", 0)),
                         int(metrics.get("ptpu_prov_batches", 0))))
         for site, sec in top[:8]:
-            lines.append("  %-28s %9.3fs  %5.1f%%"
-                         % (site, sec, 100.0 * sec / total))
+            prev_sec = prev_metrics.get("ptpu_prov_self_s_" + site)
+            if not isinstance(prev_sec, (int, float)):
+                prev_sec = None
+            lines.append("  %-28s %9.3fs  %5.1f%%%s"
+                         % (site, sec, 100.0 * sec / total,
+                            _fmt_delta(sec, prev_sec, as_int=False)))
         quarantined = metrics.get("ptpu_prov_quarantined", 0)
         if quarantined:
             lines.append("  quarantined items: %d" % int(quarantined))
+
+    # -- SLO alerts (ISSUE 12): debounced breach/anomaly counters
+    slo = _labeled(metrics, "ptpu_slo_alerts_total")
+    slo = {k: v for k, v in slo.items() if v}
+    if slo:
+        lines.append("slo alerts: " + "  ".join(
+            "%s=%d" % (name, int(slo[name])) for name in sorted(slo)))
 
     # -- everything else, compact (numbers only; histogram summaries as p50s)
     shown_prefixes = ("ptpu_pipeline_", "ptpu_worker_item_seconds",
                       "ptpu_health_", "ptpu_degradations_total",
                       "ptpu_io_tier_", "ptpu_io_remote_", "ptpu_io_hedge",
                       "ptpu_io_footer_cache_", "ptpu_transform_",
-                      "ptpu_prov_", "ptpu_dataset_")
+                      "ptpu_prov_", "ptpu_dataset_", "ptpu_slo_")
     rest = {n: v for n, v in metrics.items()
             if not n.startswith(shown_prefixes)}
     scalars = [(n, v) for n, v in sorted(rest.items())
@@ -323,6 +451,38 @@ def render_dashboard(metrics, title=""):
     return "\n".join(lines)
 
 
+def render_merge(exports):
+    """Fleet-merge dashboard (ISSUE 12): per-source breakdown + fleet totals
+    (counters summed across the sources' last snapshots — unit-pinned by the
+    test suite) + the fleet rows/s sparkline on the anchored timeline."""
+    from petastorm_tpu.obs.timeseries import (
+        fleet_rate_series,
+        merge_exports,
+        sparkline,
+        uniquify_sources,
+    )
+
+    exports = uniquify_sources(exports)
+    merged = merge_exports(exports)
+    lines = ["fleet merge: %d sources" % len(merged["sources"])]
+    for export in exports:
+        m = export["metrics"]
+        rows = m.get("ptpu_pipeline_rows", 0)
+        rates = [p.get("rate") for p in
+                 export["series"].get("ptpu_pipeline_rows", ())]
+        lines.append("  %-28s rows=%-10d %s"
+                     % (export["source"], int(rows or 0), sparkline(rates)))
+    fleet = fleet_rate_series(exports, "ptpu_pipeline_rows")
+    if fleet:
+        lines.append("  %-28s peak %.0f rows/s  %s"
+                     % ("fleet rows/s", max(v for _t, v in fleet),
+                        sparkline([v for _t, v in fleet])))
+    lines.append("")
+    lines.append(render_dashboard(merged["totals"],
+                                  title="fleet totals (summed)"))
+    return "\n".join(lines)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="petastorm-tpu-stats",
@@ -340,7 +500,25 @@ def main(argv=None):
                         help="render exactly one frame and exit (with --watch: "
                              "one watch-mode frame, no screen clear — the CI "
                              "render check)")
+    parser.add_argument("--merge", nargs="+", metavar="EXPORT", default=None,
+                        help="fleet mode: aggregate several exports (Reporter "
+                             "JSONL streams and/or /timelines JSON documents) "
+                             "into one dashboard — totals summed, per-source "
+                             "breakdown, clock-anchor-aligned windows")
     args = parser.parse_args(argv)
+    if args.merge:
+        from petastorm_tpu.obs.timeseries import load_export
+
+        exports = []
+        for path in args.merge:
+            try:
+                exports.append(load_export(path))
+            except (OSError, ValueError) as e:
+                print("petastorm-tpu-stats: cannot read export %s: %s"
+                      % (path, e), file=sys.stderr)
+                return 1
+        print(render_merge(exports))
+        return 0
     if isinstance(args.watch, str):
         # `--watch FILE` (the documented default-interval form): argparse's
         # greedy nargs="?" consumes the path as the SECONDS value — reclaim it
@@ -352,20 +530,38 @@ def main(argv=None):
             args.path = args.watch
             args.watch = 2.0
 
+    #: prometheus files carry no history — the watch loop accumulates its own
+    #: frames so the sparklines still move
+    from collections import deque
+
+    frame_history = deque(maxlen=40)
+
     def show():
+        # one parse per frame: a JSONL stream's history already contains the
+        # latest snapshot (its last entry) — only Prometheus files / empty
+        # streams fall through to the single-snapshot loader
         try:
-            metrics = _load_snapshot(args.path)
-        except (OSError, ValueError) as e:
-            print("petastorm-tpu-stats: cannot read %s: %s" % (args.path, e),
-                  file=sys.stderr)
-            return 1
-        if not metrics:
-            print("petastorm-tpu-stats: no snapshot in %s yet" % args.path,
-                  file=sys.stderr)
-            return 1
+            history = _load_history(args.path)
+        except (OSError, ValueError):
+            history = None
+        if history:
+            metrics = history[-1][1]
+        else:
+            try:
+                metrics = _load_snapshot(args.path)
+            except (OSError, ValueError) as e:
+                print("petastorm-tpu-stats: cannot read %s: %s"
+                      % (args.path, e), file=sys.stderr)
+                return 1
+            if not metrics:
+                print("petastorm-tpu-stats: no snapshot in %s yet"
+                      % args.path, file=sys.stderr)
+                return 1
+            frame_history.append((time.time(), metrics))
+            history = list(frame_history)
         title = "petastorm-tpu-stats · %s · %s" % (
             args.path, time.strftime("%H:%M:%S"))
-        print(render_dashboard(metrics, title=title))
+        print(render_dashboard(metrics, title=title, history=history))
         return 0
 
     if args.watch is None or args.once:

@@ -108,11 +108,13 @@ def ulysses_attention(q, k, v, axis_name, causal=False):
 
 
 def _mesh_wrap(fn, mesh, seq_axis, batch_axis):
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    from petastorm_tpu.compat import shard_map
+
     spec = P(batch_axis if batch_axis in mesh.axis_names else None, seq_axis)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return shard_map()(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
 
 
 def ring_self_attention(q, k, v, mesh, seq_axis="sp", batch_axis="dp", causal=False):

@@ -50,8 +50,15 @@ def spmd_pipeline(stage_fn, stage_params, microbatches, axis_name):
     # the carries become pp-varying after the ppermute/one-hot write, so the inits must
     # carry that varying-axes type too; deriving from microbatches (* 0) also inherits any
     # dp/sp varying axes the data brings in
-    state0 = lax.pcast(microbatches[0] * 0, (axis_name,), to="varying")
-    outputs0 = lax.pcast(microbatches * 0, (axis_name,), to="varying")
+    # lax.pcast only exists on jax versions with explicit varying-axes types;
+    # older shard_map treats everything as varying already, so identity is the
+    # correct degenerate form there (ISSUE 12 satellite: version compat)
+    if hasattr(lax, "pcast"):
+        state0 = lax.pcast(microbatches[0] * 0, (axis_name,), to="varying")
+        outputs0 = lax.pcast(microbatches * 0, (axis_name,), to="varying")
+    else:
+        state0 = microbatches[0] * 0
+        outputs0 = microbatches * 0
     (_, outputs), _ = lax.scan(tick, (state0, outputs0), jnp.arange(n_micro + n_stages - 1))
     # every stage's `outputs` buffer is only filled on the last stage; broadcast it back so
     # the result is replicated along pp (psum over one-hot keeps it a collective, not a gather)
@@ -66,8 +73,9 @@ def pipelined_apply(stage_fn, stacked_params, x, mesh, n_micro, pp_axis="pp"):
     ``stage_sharding``); ``x``: (batch, ...) global batch; ``n_micro`` microbatches must
     divide batch. Returns (batch, ...) outputs.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from petastorm_tpu.compat import shard_map
 
     if x.shape[0] % n_micro:
         raise ValueError("batch %d not divisible into %d microbatches" % (x.shape[0], n_micro))
@@ -76,7 +84,7 @@ def pipelined_apply(stage_fn, stacked_params, x, mesh, n_micro, pp_axis="pp"):
 
     fn = functools.partial(spmd_pipeline, stage_fn, axis_name=pp_axis)
     param_specs = jax.tree.map(lambda _: P(pp_axis), stacked_params)
-    out = shard_map(
+    out = shard_map()(
         fn, mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=P(),

@@ -1760,13 +1760,16 @@ class Reader:
         return out
 
     def register_metrics(self, registry):
-        """Export this reader's wire gauges onto a
-        :class:`petastorm_tpu.obs.MetricsRegistry` as live ``ptpu_wire_*``
-        families (pull-mode — the executor hot path is untouched). For readers
-        consumed WITHOUT a ``DataLoader`` (which wires this itself via
-        ``metrics=``). Returns the collector handle for
-        ``registry.unregister_collector``."""
-        return registry.register_collector("wire", self.wire_stats)
+        """Export this reader's wire AND io gauges onto a
+        :class:`petastorm_tpu.obs.MetricsRegistry` as live ``ptpu_wire_*`` /
+        ``ptpu_io_*`` families (pull-mode — the executor hot path is
+        untouched). For readers consumed WITHOUT a ``DataLoader`` (which
+        wires this itself via ``metrics=``) — paired with a
+        :class:`petastorm_tpu.obs.serve.MetricsServer` over the registry this
+        is the scrape seam for loader-less pipelines. Returns the collector
+        handles for ``registry.unregister_collector``."""
+        return [registry.register_collector("wire", self.wire_stats),
+                registry.register_collector("io", self.io_stats)]
 
     def set_trace(self, tracer):
         """Attach a :class:`petastorm_tpu.trace.TraceRecorder` to the pool wire

@@ -1610,3 +1610,88 @@ def test_o003_inline_disable_respected():
     """)
     assert findings == []
     assert suppressed == 1
+
+
+# -- GL-O004: Event-watching poll loops that sleep --------------------------------------
+
+_O004_COND_POSITIVE = """
+    import threading
+    import time
+
+    class Watcher:
+        def __init__(self):
+            self._stop = threading.Event()
+
+        def _run(self):
+            while not self._stop.is_set():
+                poll_once()
+                time.sleep(0.5)  # BUG: stop() cannot wake this
+"""
+
+
+def test_sleepy_poll_loop_fires_on_is_set_condition():
+    findings, _ = _lint(_O004_COND_POSITIVE)
+    f = _only_rule(findings, "GL-O004")[0]
+    assert f.line == _line_of(_O004_COND_POSITIVE, "BUG: stop() cannot")
+    assert "is_set" in f.message and "wait(timeout)" in f.message
+
+
+_O004_BODY_POSITIVE = """
+    import time
+
+    def controller(stop_event):
+        while True:
+            if stop_event.is_set():  # the Event IS in sight...
+                break
+            retune()
+            time.sleep(1.0)  # BUG: ...but the sleep ignores it
+"""
+
+
+def test_sleepy_poll_loop_fires_on_body_is_set_check():
+    findings, _ = _lint(_O004_BODY_POSITIVE)
+    f = _only_rule(findings, "GL-O004")[0]
+    assert f.line == _line_of(_O004_BODY_POSITIVE, "BUG: ...but the sleep")
+
+
+def test_event_wait_loop_is_clean():
+    findings, _ = _lint("""
+        import threading
+
+        class Watcher:
+            def __init__(self):
+                self._stop = threading.Event()
+
+            def _run(self):
+                while not self._stop.wait(0.5):
+                    poll_once()
+    """)
+    assert findings == []
+
+
+def test_sleep_without_event_in_sight_is_clean():
+    """Deadline polls / retry backoff / CLI redraw loops have no Event to
+    wake them — sleeping is all they CAN do."""
+    findings, _ = _lint("""
+        import time
+
+        def wait_for_file(path, deadline):
+            while time.monotonic() < deadline:
+                if exists(path):
+                    return True
+                time.sleep(0.05)
+            return False
+    """)
+    assert findings == []
+
+
+def test_o004_inline_disable_respected():
+    findings, suppressed = _lint("""
+        import time
+
+        def drain(stop_event):
+            while not stop_event.is_set():
+                time.sleep(0.01)  # graftlint: disable=GL-O004 (50ms slices notice disarm)
+    """)
+    assert findings == []
+    assert suppressed == 1

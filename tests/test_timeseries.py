@@ -1,0 +1,734 @@
+"""Temporal observability plane (ISSUE 12): windowed time-series, SLO/anomaly
+engine, scrape endpoint, fleet merge, and bench-diff forensics."""
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from petastorm_tpu.obs.export import (
+    Reporter,
+    parse_prometheus_text,
+    read_recent_jsonl_snapshots,
+)
+from petastorm_tpu.obs.metrics import MetricsRegistry
+from petastorm_tpu.obs.slo import AnomalyDetector, SloEngine, SloSpec
+from petastorm_tpu.obs.timeseries import (
+    fleet_rate_series,
+    load_export,
+    merge_exports,
+    sparkline,
+    uniquify_sources,
+)
+
+
+# -- timeline rings ---------------------------------------------------------------------
+
+def test_timeline_ring_bound_and_eviction():
+    r = MetricsRegistry()
+    c = r.counter("events_total")
+    r.timeline_store(max_points=4)
+    for i in range(10):
+        c.inc(5)
+        r.sample_timelines()
+    pts = r.timeline("events_total")
+    assert len(pts) == 4  # ring bound: oldest evicted
+    # the surviving points are the NEWEST four (values 35..50)
+    assert [p["value"] for p in pts] == [35, 40, 45, 50]
+    assert all(p["delta"] == 5 for p in pts)
+
+
+def test_timeline_empty_without_store_or_series():
+    r = MetricsRegistry()
+    assert r.timeline("nope") == []
+    r.sample_timelines()
+    assert r.timeline("still_nope") == []
+
+
+def test_counter_rate_from_delta():
+    r = MetricsRegistry()
+    c = r.counter("rows_total")
+    r.sample_timelines()          # first window: baseline, rate None
+    first = r.timeline("rows_total")[-1]
+    assert first["delta"] is None and first["rate"] is None
+    c.inc(100)
+    time.sleep(0.02)
+    r.sample_timelines()
+    point = r.timeline("rows_total")[-1]
+    assert point["delta"] == 100
+    # rate = delta / window length, so well above the raw count over a 20ms+
+    # window; sanity-band rather than exact (wall time jitters)
+    assert point["rate"] > 100
+
+
+def test_counter_restart_charges_current_value():
+    """A *_total collector value that shrank is a restart: the window is
+    charged the current value, never a negative delta/rate."""
+    r = MetricsRegistry()
+    state = {"v": 500}
+    r.register_collector("io", lambda: {"gets_total": state["v"]})
+    r.sample_timelines()
+    state["v"] = 700
+    r.sample_timelines()
+    assert r.timeline("ptpu_io_gets_total")[-1]["delta"] == 200
+    state["v"] = 30  # the process behind the collector restarted
+    r.sample_timelines()
+    point = r.timeline("ptpu_io_gets_total")[-1]
+    assert point["delta"] == 30 and point["rate"] >= 0
+
+
+def test_cumulative_collector_restart_never_yields_negative_rate():
+    """A gauge-kind cumulative collector (ptpu_pipeline_rows — no *_total
+    suffix) behind a restarted pipeline shrinks: the window keeps its honest
+    negative delta but reports NO rate, never a negative one (review
+    hardening: the counter-restart clamp only covers counter-kind series)."""
+    r = MetricsRegistry()
+    state = {"rows": 5000}
+    r.register_collector("pipeline", lambda: dict(state))
+    r.sample_timelines()
+    state["rows"] = 6000
+    time.sleep(0.01)
+    r.sample_timelines()
+    state["rows"] = 40  # a fresh loader re-registered: cumulative restarted
+    time.sleep(0.01)
+    r.sample_timelines()
+    points = r.timeline("ptpu_pipeline_rows")
+    assert points[1]["rate"] > 0
+    assert points[2]["delta"] == 40 - 6000  # the drop stays visible
+    assert points[2]["rate"] is None        # but never a negative rate
+    assert all(p["rate"] is None or p["rate"] >= 0 for p in points)
+
+
+def test_load_export_honors_per_line_anchors(tmp_path):
+    """A restarted process appending to the same JSONL stream carries a FRESH
+    (wall, perf) anchor; its lines must be placed by their OWN anchor, not
+    the first run's (review hardening: perf restarts near 0, and the old
+    anchor would throw run-2 windows onto the wrong clock epoch entirely)."""
+    path = str(tmp_path / "restarted.jsonl")
+    run1 = {"wall": 1000.0, "perf": 500.0, "host": "h", "pid": 1}
+    run2 = {"wall": 1060.0, "perf": 2.0, "host": "h", "pid": 2}
+    with open(path, "w") as f:
+        for perf, rows in ((501.0, 100), (502.0, 200)):
+            f.write(json.dumps({"schema": "ptpu-stats-v2", "ts": 0.0,
+                                "perf": perf, "anchor": run1,
+                                "metrics": {"rows_total": rows}}) + "\n")
+        for perf, rows in ((3.0, 50), (4.0, 150)):
+            f.write(json.dumps({"schema": "ptpu-stats-v2", "ts": 0.0,
+                                "perf": perf, "anchor": run2,
+                                "metrics": {"rows_total": rows}}) + "\n")
+    export = load_export(path)
+    points = export["series"]["rows_total"]
+    assert [p["t"] for p in points] == [1001.0, 1002.0, 1061.0, 1062.0]
+    # the restart window: counter restart semantics, positive rate
+    assert points[2]["delta"] == 50
+    assert all(p["rate"] is None or p["rate"] >= 0 for p in points)
+
+
+def test_unregister_collector_accepts_handle_list():
+    r = MetricsRegistry()
+    handles = [r.register_collector("a", lambda: {"x": 1}),
+               r.register_collector("b", lambda: {"y": 2})]
+    assert "ptpu_a_x" in r.snapshot()
+    r.unregister_collector(handles)  # the Reader.register_metrics shape
+    snap = r.snapshot()
+    assert "ptpu_a_x" not in snap and "ptpu_b_y" not in snap
+
+
+def test_rates_survive_reporter_restart(tmp_path):
+    """The timeline store lives on the REGISTRY, not the Reporter: stopping
+    one Reporter and starting another must not re-baseline the deltas (a
+    fresh store would charge the whole cumulative count to its first
+    window)."""
+    r = MetricsRegistry()
+    c = r.counter("rows_total")
+    jsonl = str(tmp_path / "a.jsonl")
+    with Reporter(registry=r, interval_s=600.0, jsonl_path=jsonl) as rep:
+        c.inc(1000)
+        rep._write_once()
+    # second reporter, same registry
+    c.inc(50)
+    with Reporter(registry=r, interval_s=600.0,
+                  jsonl_path=str(tmp_path / "b.jsonl")) as rep2:
+        rep2._write_once()
+    deltas = [p["delta"] for p in r.timeline("rows_total")]
+    # four windows: first-ever (baseline, None), first stop-flush (0), the
+    # second reporter's write (the 50 inc'd between reporters), its flush (0)
+    # — at no point does a window re-charge the cumulative 1000/1050
+    assert deltas == [None, 0, 50, 0]
+
+
+def test_histogram_window_percentiles():
+    r = MetricsRegistry()
+    h = r.histogram("lat_seconds")
+    for _ in range(50):
+        h.observe(0.01)
+    r.sample_timelines()
+    # new window: only slow observations land in it
+    for _ in range(10):
+        h.observe(0.5)
+    r.sample_timelines()
+    name = "lat_seconds"
+    first, second = r.timeline(name)[-2:]
+    assert first["count"] == 50 and first["p99"] < 0.02
+    assert second["count"] == 10
+    # window p99 reflects ONLY the window's observations, not the cumulative
+    # distribution (cumulative p99 would still sit near 0.5 only because of
+    # these same points; the pinned part is the window count + p50)
+    assert second["p50"] >= 0.4
+
+
+def test_histogram_reset_starts_fresh_window():
+    r = MetricsRegistry()
+    h = r.histogram("lat_seconds")
+    for _ in range(8):
+        h.observe(0.2)
+    r.sample_timelines()
+    h.reset()
+    h.observe(0.01)
+    r.sample_timelines()
+    point = r.timeline("lat_seconds")[-1]
+    assert point["count"] == 1 and point["p99"] < 0.02
+
+
+def test_listener_error_does_not_kill_sampling():
+    r = MetricsRegistry()
+    c = r.counter("x_total")
+    store = r.timeline_store()
+    calls = []
+    store.add_listener(lambda w, t: calls.append(1) or (_ for _ in ()).throw(
+        RuntimeError("bad listener")))
+    c.inc()
+    r.sample_timelines()
+    c.inc()
+    r.sample_timelines()
+    assert len(calls) == 2  # still invoked; sampling never died
+    assert len(r.timeline("x_total")) == 2
+
+
+# -- SLO engine -------------------------------------------------------------------------
+
+class _StubReport:
+    def __init__(self, slow_top="io.remote"):
+        self.slow_top = slow_top
+
+    def to_dict(self):
+        return {"slow_top": self.slow_top, "slow_share": {self.slow_top: 0.8}}
+
+
+def _hist_window(p99, count=5):
+    return {"kind": "histogram", "t": 0, "count": count, "sum": p99 * count,
+            "p50": p99, "p99": p99}
+
+
+def test_slo_breach_debounce_and_attribution_snapshot():
+    engine = SloEngine(
+        specs=[SloSpec(name="p99", metric="m", stat="p99", op="<=",
+                       threshold=0.1, breach_windows=3)],
+        attribution=lambda: _StubReport("io.remote"))
+    # two breaching windows: debounced, nothing fires
+    assert engine.evaluate({"m": _hist_window(0.5)}, t=1.0) == []
+    assert engine.evaluate({"m": _hist_window(0.5)}, t=2.0) == []
+    # third consecutive: exactly one alert, with the snapshot attached
+    alerts = engine.evaluate({"m": _hist_window(0.5)}, t=3.0)
+    assert len(alerts) == 1
+    alert = alerts[0]
+    assert alert.cause == "slo_breach" and alert.windows == 3
+    assert alert.culprit == "io.remote"
+    assert alert.attribution["slow_top"] == "io.remote"
+    assert "io.remote" in alert.message
+    # still breaching: latched, no refire
+    assert engine.evaluate({"m": _hist_window(0.6)}, t=4.0) == []
+    # recovery clears the latch...
+    assert engine.evaluate({"m": _hist_window(0.01)}, t=5.0) == []
+    # ...and a NEW excursion fires again after its own debounce
+    assert engine.evaluate({"m": _hist_window(0.5)}, t=6.0) == []
+    assert engine.evaluate({"m": _hist_window(0.5)}, t=7.0) == []
+    assert len(engine.evaluate({"m": _hist_window(0.5)}, t=8.0)) == 1
+    assert len(engine.alerts()) == 2
+
+
+def test_slo_sparse_windows_neither_breach_nor_clear():
+    engine = SloEngine(specs=[SloSpec(name="p99", metric="m", stat="p99",
+                                      op="<=", threshold=0.1,
+                                      breach_windows=2, min_count=3)])
+    assert engine.evaluate({"m": _hist_window(0.5)}, t=1.0) == []
+    # absent series and a below-min_count window both skip: the streak from
+    # window 1 must survive them
+    assert engine.evaluate({}, t=2.0) == []
+    assert engine.evaluate({"m": _hist_window(0.5, count=1)}, t=3.0) == []
+    assert len(engine.evaluate({"m": _hist_window(0.5)}, t=4.0)) == 1
+
+
+def test_slo_rate_share_and_time_share_stats():
+    engine = SloEngine(specs=[
+        SloSpec(name="quarantine-rate", metric="q_total", stat="rate",
+                op="<=", threshold=1.0, breach_windows=1),
+        SloSpec(name="mem-share", metric="mem_total", stat="share",
+                denominator=("mem_total", "disk_total"), op=">=",
+                threshold=0.5, breach_windows=1),
+        SloSpec(name="idle-share", metric="wait_s", stat="share",
+                denominator=None, op="<=", threshold=0.5, breach_windows=1),
+    ])
+
+    def scalar(delta, rate=None):
+        return {"kind": "counter", "t": 0, "value": 0, "delta": delta,
+                "rate": rate if rate is not None else delta}
+
+    # first window establishes the time base (idle-share needs window_s)
+    engine.evaluate({}, t=10.0)
+    alerts = engine.evaluate(
+        {"q_total": scalar(6, rate=6.0),           # 6/s > 1/s: breach
+         "mem_total": scalar(2), "disk_total": scalar(8),  # 20% < 50%: breach
+         "wait_s": scalar(0.2)},                   # 0.2s of a 1s window: ok
+        t=11.0)
+    assert sorted(a.name for a in alerts) == ["mem-share", "quarantine-rate"]
+    # flip: healthy rates, breaching idle share
+    alerts = engine.evaluate(
+        {"q_total": scalar(0, rate=0.0),
+         "mem_total": scalar(9), "disk_total": scalar(1),
+         "wait_s": scalar(0.9)},
+        t=12.0)
+    assert [a.name for a in alerts] == ["idle-share"]
+
+
+def test_slo_alert_counter_and_flight_mirror():
+    from petastorm_tpu.obs.flight import FlightRecorder, activate, deactivate
+
+    registry = MetricsRegistry()
+    recorder = FlightRecorder()
+    activate(recorder)
+    try:
+        engine = SloEngine(specs=[SloSpec(name="p99", metric="m", stat="p99",
+                                          op="<=", threshold=0.1,
+                                          breach_windows=1)],
+                           registry=registry)
+        engine.evaluate({"m": _hist_window(0.7)}, t=1.0)
+    finally:
+        deactivate(recorder)
+    snap = registry.snapshot()
+    assert snap['ptpu_slo_alerts_total{slo="p99"}'] == 1
+    kinds = [e["kind"] for e in recorder.events()]
+    assert "slo_alert" in kinds and "degradation" in kinds
+
+
+def test_anomaly_fires_once_on_step_cliff():
+    det = AnomalyDetector(min_history=6, z_threshold=5.0, ewma_alpha=1.0)
+    fires = []
+    for v in [10.0, 10.2, 9.9, 10.1, 10.0, 10.05, 9.95]:
+        fires.append(det.observe(v))
+    assert not any(fires)
+    # the injected cliff: fires exactly once, stays latched while out of band
+    fires = [det.observe(50.0) for _ in range(12)]
+    assert sum(fires) == 1 and fires[0] is True
+
+
+def test_anomaly_rearms_after_recovery():
+    det = AnomalyDetector(min_history=5, z_threshold=5.0, z_clear=2.0,
+                          ewma_alpha=1.0)
+    for v in [10, 10.1, 9.9, 10, 10.05, 10.02]:
+        det.observe(v)
+    assert det.observe(80.0) is True
+    # back in band for a while: re-arms
+    for v in [10, 10.1, 9.95, 10.0]:
+        det.observe(v)
+    assert det.observe(80.0) is True  # a second distinct cliff fires again
+
+
+def test_engine_anomaly_watch_end_to_end():
+    engine = SloEngine(anomaly_metrics=[("m", "p99")],
+                       anomaly_kwargs=dict(min_history=5, z_threshold=5.0,
+                                           ewma_alpha=1.0),
+                       attribution=lambda: _StubReport("transform"))
+    for i in range(7):
+        engine.evaluate({"m": _hist_window(0.01 + 0.0001 * (i % 2))},
+                        t=float(i))
+    alerts = engine.evaluate({"m": _hist_window(0.4)}, t=99.0)
+    assert len(alerts) == 1
+    assert alerts[0].cause == "anomaly_detected"
+    assert alerts[0].culprit == "transform"
+
+
+# -- Reporter schema + store cadence ----------------------------------------------------
+
+def test_reporter_v2_lines_carry_clock_anchor(tmp_path):
+    r = MetricsRegistry()
+    r.counter("x_total").inc()
+    jsonl = str(tmp_path / "s.jsonl")
+    with Reporter(registry=r, interval_s=600.0, jsonl_path=jsonl) as rep:
+        rep._write_once()
+    snaps = read_recent_jsonl_snapshots(jsonl)
+    assert len(snaps) == 2  # explicit write + stop-flush
+    for snap in snaps:
+        assert snap["schema"] == "ptpu-stats-v2"
+        assert isinstance(snap["perf"], float)
+        anchor = snap["anchor"]
+        assert {"wall", "perf", "host", "pid"} <= set(anchor)
+    # the reporter cadence sampled the registry's timelines
+    assert len(r.timeline("x_total")) == 2
+
+
+def test_reporter_timelines_opt_out(tmp_path):
+    r = MetricsRegistry()
+    r.counter("x_total").inc()
+    with Reporter(registry=r, interval_s=600.0,
+                  jsonl_path=str(tmp_path / "s.jsonl"),
+                  timelines=False) as rep:
+        rep._write_once()
+    assert r.timeline("x_total") == []
+
+
+# -- fleet merge ------------------------------------------------------------------------
+
+def _write_export(path, anchor, rows_points, skew_ts=None):
+    """Hand-build a v2 Reporter JSONL stream: ``rows_points`` is
+    [(perf, cumulative_rows)]; ``skew_ts`` optionally writes garbage wall
+    stamps per line (the anchor must win)."""
+    with open(path, "w") as f:
+        for perf, rows in rows_points:
+            f.write(json.dumps({
+                "schema": "ptpu-stats-v2",
+                "ts": skew_ts if skew_ts is not None else anchor["wall"] + perf,
+                "perf": perf,
+                "anchor": anchor,
+                "metrics": {"ptpu_pipeline_rows": rows,
+                            "rows_total": rows}}) + "\n")
+
+
+def test_merge_aligns_clock_skewed_exports(tmp_path):
+    """Source B's per-line wall stamps are garbage (NTP stepped mid-run);
+    the merge must place its windows via the (wall, perf) anchor pair —
+    the same scheme the trace merge uses — not the line stamps."""
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    t0 = 1_000_000.0
+    _write_export(a, {"wall": t0, "perf": 0.0, "host": "ha", "pid": 1},
+                  [(1.0, 100), (2.0, 200), (3.0, 300)])
+    _write_export(b, {"wall": t0, "perf": 50.0, "host": "hb", "pid": 2},
+                  [(51.0, 10), (52.0, 30), (53.0, 60)],
+                  skew_ts=t0 + 9_999_999.0)
+    ea, eb = load_export(a), load_export(b)
+    # anchored timelines: both sources' points land at t0+1..t0+3 despite
+    # B's garbage wall stamps
+    tb = [p["t"] for p in eb["series"]["rows_total"]]
+    assert tb == [t0 + 1.0, t0 + 2.0, t0 + 3.0]
+    fleet = fleet_rate_series([ea, eb], "rows_total", bin_s=1.0)
+    # every bin holds BOTH sources (aligned): fleet rate = a_rate + b_rate
+    assert len(fleet) == 2  # bins for windows 2 and 3 (window 1 has no rate)
+    assert fleet[0][1] == pytest.approx(100 + 20)
+    assert fleet[1][1] == pytest.approx(100 + 30)
+
+
+def test_fleet_totals_equal_sum_of_sources(tmp_path):
+    """Acceptance pin: merged totals == the sum of the per-source series."""
+    paths = []
+    for i, rows in enumerate((300, 800)):
+        p = str(tmp_path / ("s%d.jsonl" % i))
+        _write_export(p, {"wall": 10.0, "perf": 0.0, "host": "h%d" % i,
+                          "pid": i}, [(1.0, rows)])
+        paths.append(p)
+    exports = [load_export(p) for p in paths]
+    merged = merge_exports(exports)
+    assert merged["totals"]["rows_total"] == 1100
+    assert merged["totals"]["rows_total"] == sum(
+        m["rows_total"] for m in merged["per_source"].values())
+    assert len(merged["sources"]) == 2
+
+
+def test_merge_histogram_summaries_conservatively(tmp_path):
+    docs = []
+    for i, (count, p99) in enumerate(((10, 0.1), (30, 0.4))):
+        docs.append({"source": "s%d" % i, "anchor": None,
+                     "metrics": {"lat": {"count": count, "sum": count * p99,
+                                         "p50": p99 / 2, "p90": p99,
+                                         "p99": p99}},
+                     "series": {}})
+    merged = merge_exports(docs)
+    agg = merged["totals"]["lat"]
+    assert agg["count"] == 40
+    assert agg["p99"] == 0.4  # max across sources: conservative upper bound
+
+
+def test_uniquify_sources_keeps_collisions_visible():
+    exports = [{"source": "h:1", "metrics": {"x": 1}, "series": {}},
+               {"source": "h:1", "metrics": {"x": 2}, "series": {}}]
+    named = [e["source"] for e in uniquify_sources(exports)]
+    assert named == ["h:1", "h:1#2"]
+    merged = merge_exports(exports)
+    assert merged["totals"]["x"] == 3 and len(merged["per_source"]) == 2
+
+
+def test_stats_cli_merge_renders(tmp_path, capsys):
+    from petastorm_tpu.obs.stats_cli import main as stats_main
+
+    paths = []
+    for i in range(2):
+        p = str(tmp_path / ("s%d.jsonl" % i))
+        _write_export(p, {"wall": 10.0, "perf": 0.0, "host": "h%d" % i,
+                          "pid": i},
+                      [(1.0, 0), (2.0, 500 * (i + 1)), (3.0, 1000 * (i + 1))])
+        paths.append(p)
+    assert stats_main(["--merge"] + paths) == 0
+    out = capsys.readouterr().out
+    assert "fleet merge: 2 sources" in out
+    assert "fleet totals (summed)" in out
+    assert "rows=1000" in out and "rows=2000" in out  # per-source breakdown
+    assert "rows=3000" in out                          # fleet total = the sum
+    assert "fleet rows/s" in out
+
+
+# -- dashboard trends / deltas ----------------------------------------------------------
+
+def test_sparkline_shapes():
+    assert sparkline([]) == ""
+    assert sparkline([None, None]) == "  "
+    s = sparkline([1, 2, 3, 4])
+    assert len(s) == 4 and s[0] == "▁" and s[-1] == "█"
+    assert sparkline([5, 5, 5]) == "▁▁▁"  # flat series: flat line
+
+
+def test_render_dashboard_trends_and_window_deltas():
+    from petastorm_tpu.obs.stats_cli import render_dashboard
+
+    def frame(rows, added, self_s):
+        return {"ptpu_pipeline_rows": rows, "ptpu_pipeline_batches": 1,
+                "ptpu_dataset_pieces_added_total": added,
+                "ptpu_prov_self_s_io_remote": self_s,
+                "ptpu_prov_items": 4, "ptpu_prov_batches": 2}
+
+    history = [(float(i), frame(1000 * i, i, 0.1 * i)) for i in range(1, 5)]
+    out = render_dashboard(history[-1][1], history=history)
+    assert "trends (last 4 windows):" in out
+    assert "rows/s" in out
+    assert "(+1 this window)" in out            # dataset-watch delta
+    assert "(+0.100 this window)" in out        # attribution self-time delta
+    # a single frame renders without any trend panel
+    out_single = render_dashboard(history[-1][1])
+    assert "trends" not in out_single
+
+
+# -- scrape endpoint --------------------------------------------------------------------
+
+def test_metrics_server_endpoints():
+    from petastorm_tpu.obs.serve import MetricsServer
+
+    r = MetricsRegistry()
+    r.counter("hits_total").inc(7)
+    r.sample_timelines()
+    engine = SloEngine(specs=[SloSpec(name="s", metric="hits_total",
+                                      stat="value", op="<=", threshold=1,
+                                      breach_windows=1)], registry=r)
+    engine.evaluate({"hits_total": {"kind": "counter", "value": 7,
+                                    "delta": 7, "rate": 7.0}}, t=1.0)
+    with MetricsServer(r, slo_engine=engine) as srv:
+        assert srv.port and srv.url.startswith("http://127.0.0.1:")
+        prom = urllib.request.urlopen(srv.url + "/metrics").read().decode()
+        samples = parse_prometheus_text(prom)
+        assert samples["hits_total"] == 7
+        doc = json.loads(urllib.request.urlopen(
+            srv.url + "/timelines").read())
+        assert doc["schema"] == "ptpu-fleet-export-v1"
+        assert doc["anchor"]["pid"] == os.getpid()
+        assert doc["timelines"]["hits_total"]["points"]
+        alerts = json.loads(urllib.request.urlopen(
+            srv.url + "/alerts").read())["alerts"]
+        assert len(alerts) == 1 and alerts[0]["cause"] == "slo_breach"
+        hz = json.loads(urllib.request.urlopen(srv.url + "/healthz").read())
+        assert hz["ok"]
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(srv.url + "/nope")
+    # stopped: the port no longer accepts
+    with pytest.raises(OSError):
+        urllib.request.urlopen("http://127.0.0.1:%d/healthz" % srv.port,
+                               timeout=0.5)
+
+
+def test_metrics_server_document_is_merge_loadable(tmp_path):
+    from petastorm_tpu.obs.serve import MetricsServer
+
+    r = MetricsRegistry()
+    r.counter("rows_total").inc(42)
+    r.sample_timelines()
+    with MetricsServer(r) as srv:
+        body = urllib.request.urlopen(srv.url + "/timelines").read()
+    path = str(tmp_path / "doc.json")
+    with open(path, "wb") as f:
+        f.write(body)
+    export = load_export(path)
+    assert export["metrics"]["rows_total"] == 42
+    assert merge_exports([export])["totals"]["rows_total"] == 42
+
+
+# -- loader wiring ----------------------------------------------------------------------
+
+def test_loader_slos_requires_metrics(scalar_dataset):
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    reader = make_batch_reader(scalar_dataset.url, num_epochs=1,
+                               workers_count=1)
+    try:
+        with pytest.raises(ValueError, match="requires metrics"):
+            DataLoader(reader, 8, to_device=False,
+                       slos=[SloSpec(name="x", metric="m", threshold=1)])
+    finally:
+        reader.stop()
+        reader.join()
+
+
+def test_loader_slos_end_to_end(scalar_dataset):
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    registry = MetricsRegistry()
+    spec = SloSpec(name="impossible-step",
+                   metric='ptpu_pipeline_stage_seconds{stage="read"}',
+                   stat="p99", op="<=", threshold=1e-12, breach_windows=2)
+    reader = make_batch_reader(scalar_dataset.url, num_epochs=1,
+                               workers_count=1)
+    with DataLoader(reader, 8, to_device=False, metrics=registry,
+                    last_batch="partial", slos=[spec]) as loader:
+        assert loader.slo_engine is not None
+        rows = 0
+        for batch in loader:
+            rows += len(batch["id"])
+            registry.sample_timelines()
+        registry.sample_timelines()
+        assert rows == 30
+        alerts = loader.slo_alerts()
+        assert len(alerts) == 1 and alerts[0].cause == "slo_breach"
+        # the analyzer report carries the alerts
+        report = loader.bottleneck_report()
+        assert report.slo_alerts and \
+            report.slo_alerts[0]["name"] == "impossible-step"
+        assert "slo alerts" in report.render()
+        # flight context mirrors the state
+        ctx = loader._health_context()
+        assert ctx["slo"]["alerts"] == 1
+    # post-exit: detached from the store (no more evaluation), alerts readable
+    windows_before = loader.slo_engine.windows_evaluated
+    registry.sample_timelines()
+    assert loader.slo_engine.windows_evaluated == windows_before
+    assert len(loader.slo_alerts()) == 1
+
+
+def test_loader_shared_slo_engine_survives_loader_exit(scalar_dataset):
+    """A caller-supplied (shared) SloEngine follows the shared-monitor
+    convention: the loader's __exit__ must NOT detach it — a sibling
+    pipeline on the same registry may still be burning."""
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.reader import make_batch_reader
+
+    registry = MetricsRegistry()
+    engine = SloEngine(specs=[SloSpec(name="always", metric="never_total",
+                                      stat="value", op="<=", threshold=1e9)],
+                       registry=registry)
+    reader = make_batch_reader(scalar_dataset.url, num_epochs=1,
+                               workers_count=1)
+    with DataLoader(reader, 8, to_device=False, metrics=registry,
+                    last_batch="partial", slos=engine) as loader:
+        assert loader.slo_engine is engine
+        for _ in loader:
+            pass
+    before = engine.windows_evaluated
+    registry.sample_timelines()  # the shared engine still rides the cadence
+    assert engine.windows_evaluated == before + 1
+    engine.detach()  # the caller's job, as with a shared HealthMonitor
+
+
+# -- bench diff forensics ---------------------------------------------------------------
+
+def _run_entry(rows, sites, schema="ptpu-bench-trend-v2", **extra):
+    return dict({"schema": schema, "ts": 1.0, "workload": "f3-r1024-b128",
+                 "rows_per_s": rows, "sites": sites}, **extra)
+
+
+def test_bench_diff_names_regressed_site():
+    from petastorm_tpu.obs.diff import diff_runs
+
+    a = _run_entry(50000, {"io.remote": 0.42, "transform": 0.60,
+                           "wire.roundtrip": 0.20}, step_p99_s=0.010)
+    b = _run_entry(36000, {"io.remote": 0.97, "transform": 0.61,
+                           "wire.roundtrip": 0.21}, step_p99_s=0.025)
+    verdict = diff_runs(a, b)
+    assert verdict["regressed_site"] == "io.remote"
+    assert verdict["regressed_site_ratio"] == pytest.approx(2.31, abs=0.01)
+    assert verdict["rows_per_s_delta"] == pytest.approx(-0.28)
+    assert "io.remote self-time 2.3x" in verdict["verdict"]
+    assert verdict["verdict"].startswith("rows/s -28.0%")
+
+
+def test_bench_diff_ignores_noise_sites():
+    from petastorm_tpu.obs.diff import diff_runs
+
+    # the 40x blowup on a 0.1% site must not outrank the flat dominant site
+    a = _run_entry(1000, {"transform": 10.0, "tiny.site": 0.001})
+    b = _run_entry(990, {"transform": 10.1, "tiny.site": 0.04})
+    verdict = diff_runs(a, b)
+    assert verdict["regressed_site"] is None
+    assert "tiny.site" not in verdict["site_ratios"]
+
+
+def test_bench_diff_hedge_note():
+    from petastorm_tpu.obs.diff import diff_runs
+
+    a = _run_entry(1000, {"io.remote": 1.0},
+                   io={"hedges": 100, "hedge_wins": 80})
+    b = _run_entry(700, {"io.remote": 2.0},
+                   io={"hedges": 100, "hedge_wins": 20})
+    verdict = diff_runs(a, b)
+    assert "hedge win rate 80% -> 20%" in verdict["verdict"]
+
+
+def test_bench_diff_cli_on_synthetic_regression(tmp_path, capsys):
+    """Acceptance pin: the CLI's one-line JSON verdict names the regressed
+    site on a synthetic regression."""
+    from petastorm_tpu.obs.diff import main as diff_main
+
+    a = tmp_path / "run_a.json"
+    b = tmp_path / "run_b.json"
+    a.write_text(json.dumps(_run_entry(
+        50000, {"io.remote": 0.42, "transform": 0.60})))
+    b.write_text(json.dumps(_run_entry(
+        36000, {"io.remote": 0.97, "transform": 0.61})))
+    assert diff_main([str(a), str(b)]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    verdict = json.loads(out[-1])  # LAST line is the JSON verdict
+    assert verdict["schema"] == "ptpu-bench-diff-v1"
+    assert verdict["regressed_site"] == "io.remote"
+    assert "<-- regressed" in "\n".join(out[:-1])
+    # --fail-threshold turns the regression into a failing exit code
+    assert diff_main([str(a), str(b), "--fail-threshold", "0.1"]) == 1
+    capsys.readouterr()
+
+
+def test_bench_diff_history_indices(tmp_path, capsys):
+    from petastorm_tpu.obs.diff import load_run
+
+    history = tmp_path / "hist.jsonl"
+    with open(history, "w") as f:
+        for rows in (50000, 48000, 36000):
+            f.write(json.dumps(_run_entry(rows, {"io.remote": 0.4})) + "\n")
+        f.write("not json\n")  # foreign lines skipped
+    assert load_run("latest", history=str(history))["rows_per_s"] == 36000
+    assert load_run("prev", history=str(history))["rows_per_s"] == 48000
+    assert load_run("0", history=str(history))["rows_per_s"] == 50000
+    with pytest.raises(ValueError, match="out of range"):
+        load_run("7", history=str(history))
+    # v1 entries load too (schema compat)
+    v1 = tmp_path / "old.json"
+    v1.write_text(json.dumps(_run_entry(
+        100, {}, schema="ptpu-bench-trend-v1")))
+    assert load_run(str(v1))["rows_per_s"] == 100
+
+
+def test_diff_self_times_significance_and_new_sites():
+    from petastorm_tpu.obs.critical_path import diff_self_times
+
+    out = diff_self_times({"a": 1.0, "noise": 0.001},
+                          {"a": 3.0, "noise": 0.1, "new.site": 2.0})
+    sites = {site: ratio for site, ratio, _x, _y in out}
+    assert "noise" not in sites
+    assert sites["a"] == pytest.approx(3.0)
+    assert sites["new.site"] > 100  # new work: huge ratio vs the floor
+    assert out[0][0] == "new.site"  # sorted worst-first
