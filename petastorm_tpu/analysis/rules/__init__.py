@@ -2,6 +2,7 @@
 from petastorm_tpu.analysis.rules.concurrency import (
     BlockingTeardownRule,
     LockDisciplineRule,
+    OptionsMutationRule,
     ThreadHandlingRule,
 )
 from petastorm_tpu.analysis.rules.hotpath import WallClockDurationRule
@@ -27,6 +28,7 @@ ALL_RULES = [
     LockDisciplineRule,
     BlockingTeardownRule,
     ThreadHandlingRule,
+    OptionsMutationRule,
     ResourceLifecycleRule,
     NumpyInJitRule,
     TracedBranchRule,

@@ -321,3 +321,78 @@ class ThreadHandlingRule(Rule):
                 ".join(" in scope_src:
             return True
         return False
+
+
+_OPTIONS_SEGMENT = re.compile(r"(?:^|_)opt(?:ion)?s$", re.IGNORECASE)
+
+#: scopes where writing an options field IS the contract: the options class's
+#: own methods (construction/unpickle/normalization) and the sanctioned live
+#: actuation seam (control/knobs.py KnobSet)
+_OPTIONS_OWNER_CLASS = re.compile(r"Options$")
+_SANCTIONED_CLASSES = {"KnobSet"}
+
+
+class OptionsMutationRule(Rule):
+    """GL-C004: post-construction mutation of an ``*Options`` struct field.
+
+    The ``IoOptions``/``RemoteIoOptions``/``RecoveryOptions``/... structs are
+    construction-frozen config: one instance is shared across readers, crosses
+    the pool-child pickle wire, and is read lock-free by worker threads.
+    Mutating a field after construction (``reader._io_options.readahead_depth
+    = 8``) silently retunes OTHER pipelines sharing the struct, never reaches
+    components that copied the value at build time, and races every lock-free
+    reader. Live retunes go through the sanctioned seam instead
+    (ISSUE 13): ``petastorm_tpu.control.KnobSet.apply()`` / the component's
+    ``apply_*()`` setters, which are bounded, thread-safe, and observable
+    (``ptpu_ctl_*``).
+
+    Exempt: methods of classes named ``*Options`` (their ``__init__``/
+    ``normalize`` own the fields) and the ``KnobSet`` seam itself.
+    """
+
+    rule_id = "GL-C004"
+    severity = Severity.WARNING
+    description = ("post-construction mutation of an *Options struct field "
+                   "outside the sanctioned KnobSet.apply() seam")
+    fix_hint = ("route live retunes through petastorm_tpu.control.KnobSet"
+                ".apply() or the component's apply_*() setter (options "
+                "structs are shared, pickled config — mutating them races "
+                "lock-free readers and skips components that copied the "
+                "value); or justify with '# graftlint: disable=GL-C004'")
+
+    def check(self, tree, ctx):
+        exempt = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and (
+                    _OPTIONS_OWNER_CLASS.search(node.name)
+                    or node.name in _SANCTIONED_CLASSES):
+                for sub in ast.walk(node):
+                    exempt.add(id(sub))
+        for node in ast.walk(tree):
+            if id(node) in exempt:
+                continue
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for target in targets:
+                    for finding in self._check_target(node, target, ctx):
+                        yield finding
+
+    def _check_target(self, node, target, ctx):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                yield from self._check_target(node, elt, ctx)
+            return
+        if not isinstance(target, ast.Attribute):
+            return
+        chain = attr_chain(target.value)
+        if chain is None:
+            return
+        segments = chain.split(".")
+        if not any(_OPTIONS_SEGMENT.search(seg) for seg in segments):
+            return
+        yield ctx.finding(
+            self, node,
+            "field %r assigned on options object `%s` after construction — "
+            "options structs are frozen config; use the KnobSet/apply_*() "
+            "seam for live retunes" % (target.attr, chain))

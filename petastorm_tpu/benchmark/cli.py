@@ -124,6 +124,16 @@ def main(argv=None):
         from petastorm_tpu.benchmark import slo as slo_bench
 
         return slo_bench.main(argv[1:])
+    if argv and argv[0] == "autotune":
+        # `petastorm-tpu-bench autotune ...`: the closed-loop controller's
+        # acceptance harness — wrong initial knobs + injected latency must
+        # converge live to >=80% of the hand-tuned arm, a consumer-bound run
+        # must shrink the fleet under the chaos-style invariant, and a clean
+        # run must see ZERO actuations at <=1% overhead — see
+        # benchmark/autotune.py
+        from petastorm_tpu.benchmark import autotune as autotune_bench
+
+        return autotune_bench.main(argv[1:])
     if argv and argv[0] == "diff":
         # `petastorm-tpu-bench diff run_a run_b`: regression forensics over
         # two trend entries — names WHICH site's critical-path self time

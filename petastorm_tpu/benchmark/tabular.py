@@ -287,6 +287,47 @@ def run_tabular_bench(rows=16384, rows_per_group=256, batch_size=256, files=2,
             tmp.cleanup()
 
 
+def string_hash_bench(rows=200000, reps=3, check=True):
+    """The ISSUE-13 satellite micro-bench: the vectorized byte-matrix crc32
+    (:func:`petastorm_tpu.ops.tabular._hash_strings_matrix` behind
+    ``_hash_strings_host``) vs the per-element ``zlib.crc32`` loop it
+    replaced as the default lane, on the hot tabular string shapes
+    (short-uniform ids, categorical codes, emails). With ``check`` the two
+    lanes must be bit-identical on every shape — the dispatch is invisible
+    to pipelines."""
+    from petastorm_tpu.ops.tabular import (_hash_strings_host,
+                                           _hash_strings_scalar)
+
+    shapes = {
+        "ids": ["u%08d" % i for i in range(rows)],
+        "categories": ["cat-%03d" % (i % 512) for i in range(rows)],
+        "emails": ["user-%d@example.com" % i for i in range(rows)],
+    }
+    out = []
+    for name, data in shapes.items():
+        if check:
+            a = _hash_strings_host(data)
+            b = _hash_strings_scalar(data)
+            if a.dtype != np.uint32 or not (a == b).all():
+                raise AssertionError(
+                    "vectorized string hash diverged from zlib.crc32 on %r"
+                    % name)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _hash_strings_host(data)
+        t1 = time.perf_counter()
+        for _ in range(reps):
+            _hash_strings_scalar(data)
+        t2 = time.perf_counter()
+        vec_s, loop_s = (t1 - t0) / reps, (t2 - t1) / reps
+        out.append({"shape": name, "rows": len(data),
+                    "vectorized_s": round(vec_s, 4),
+                    "scalar_loop_s": round(loop_s, 4),
+                    "speedup": round(loop_s / vec_s, 2) if vec_s else None,
+                    "identical": bool(check)})
+    return out
+
+
 def summarize(results):
     by_name = {r["scenario"]: r for r in results}
     summary = {"tabular_summary": True}
@@ -355,10 +396,25 @@ def main(argv=None):
     else:
         print(_format_table(results))
     summary = summarize(results)
+    # string-hash satellite (ISSUE 13): identity always asserted; the timing
+    # is informational off-smoke and a soft floor on smoke (the vectorized
+    # lane must not LOSE to the loop it replaced on its target shapes)
+    hash_rows = string_hash_bench(rows=20000 if args.smoke else 200000,
+                                  reps=2 if args.smoke else 3, check=True)
+    summary["string_hash"] = hash_rows
+    for r in hash_rows:
+        print("string-hash %-10s %d rows: vectorized %.4fs vs loop %.4fs "
+              "(%.2fx, bit-identical)" % (r["shape"], r["rows"],
+                                          r["vectorized_s"],
+                                          r["scalar_loop_s"], r["speedup"]))
     if args.smoke:
         assert summary.get("speedup") and summary["speedup"] >= 2.0, \
             "declarative path is not >= 2x the pandas twin: %r" % summary
         assert summary["leases_leaked"] == 0, summary
+        slow = [r for r in hash_rows if r["speedup"] is not None
+                and r["speedup"] < 0.8]
+        assert not slow, ("vectorized string hash regressed below the scalar "
+                          "loop on: %r" % slow)
     if kwargs["check"]:
         print("identity: declarative scenarios delivered value-identical "
               "batches to the pandas twin")

@@ -1,0 +1,30 @@
+"""Closed-loop pipeline control (ISSUE 13; ROADMAP item 4).
+
+Three layers:
+
+- :mod:`petastorm_tpu.control.knobs` — :class:`Knob`/:class:`KnobSet`, the
+  sanctioned bounded live-retune seam over the components' ``apply_*()``
+  setters (options structs stay frozen; graftlint GL-C004 enforces it), and
+  :func:`build_knobset` wiring the standard knobs for a running reader.
+- :mod:`petastorm_tpu.control.controller` — the :class:`Controller` policy
+  engine riding the PR 12 window cadence: declarative :class:`PolicyRule`\\ s
+  with hysteresis, debounce, cooldowns, step limits, warmup, and the global
+  revert-and-freeze no-gain guard.
+- the acceptance harness lives in :mod:`petastorm_tpu.benchmark.autotune`
+  (``petastorm-tpu-bench autotune``): injected bottlenecks with wrong initial
+  knobs must converge live; a clean run must see zero actuations.
+
+``DataLoader(controller=True, metrics=..., provenance=True)`` wires all of it.
+"""
+from petastorm_tpu.control.controller import (  # noqa: F401
+    ControlOptions,
+    Controller,
+    Decision,
+    PolicyRule,
+    WindowContext,
+    default_rules,
+)
+from petastorm_tpu.control.knobs import Knob, KnobSet, build_knobset  # noqa: F401
+
+__all__ = ["Knob", "KnobSet", "build_knobset", "Controller", "ControlOptions",
+           "Decision", "PolicyRule", "WindowContext", "default_rules"]

@@ -1,0 +1,303 @@
+"""Live pipeline knobs: the sanctioned, bounded, thread-safe actuation seam.
+
+PRs 8–10 froze the tuning surface at construction: ``IoOptions`` /
+``RemoteIoOptions`` travel to the workers as picklable structs and every
+component (readahead pool, ranged-GET engine, cache tiers, executors) reads
+its knobs once and never again. That is the right contract for *config* —
+options stay immutable, shareable and picklable — but it leaves a running
+pipeline tuned for yesterday's bottleneck. This module adds the one sanctioned
+mutation seam (ISSUE 13):
+
+- Components grew ``apply_*()`` setters (``ReadaheadPool.apply_depth``,
+  ``RemoteReadEngine.apply_max_inflight``, ``ThreadExecutor.resize``, ...)
+  that retune LIVE state under the component's own lock. The ``*Options``
+  structs are never mutated — graftlint GL-C004 flags any post-construction
+  options-field assignment outside this seam.
+- :class:`Knob` describes one tunable: bounds, default, and the getter/setter
+  closures binding it to a live component.
+- :class:`KnobSet` is the registry the controller actuates through:
+  ``apply()`` clamps into the knob's bounds, calls the setter, and records
+  the change; ``describe()``/``collect()`` expose the LIVE values (satellite:
+  dashboards and the controller's own feedback must read the truth after a
+  retune, not the construction-time configuration).
+
+:func:`build_knobset` wires the standard knobs for a running
+:class:`~petastorm_tpu.reader.Reader`: worker-fleet size on every resizable
+pool; the IO knobs (readahead depth/bytes, GET pool width, hedge quantile,
+mem-tier budget, disk admission) when the worker runs in-process (thread/
+dummy pools — a process pool's children own their IO runtimes in other
+processes, where a parent-side setter cannot reach; their knobs bind at the
+next spawn via the worker's pickled overrides).
+"""
+from __future__ import annotations
+
+import threading
+
+#: enum knobs export their value as the index into ``values`` (Prometheus
+#: gauges are numeric); ``describe()`` carries the string
+ENUM = "enum"
+NUMERIC = "numeric"
+
+
+class Knob:
+    """One live tunable: bounds + the closures binding it to a component.
+
+    ``get()`` returns the live value; ``apply(value)`` retunes the component
+    and returns the value actually applied (a component may quantize). For
+    ``kind="enum"`` the domain is ``values`` instead of ``[lo, hi]``.
+    """
+
+    __slots__ = ("name", "kind", "get", "apply_fn", "lo", "hi", "default",
+                 "values", "integer", "unit")
+
+    def __init__(self, name, get, apply_fn, lo=None, hi=None, default=None,
+                 values=None, integer=True, unit=""):
+        self.name = name
+        self.get = get
+        self.apply_fn = apply_fn
+        self.kind = ENUM if values is not None else NUMERIC
+        self.values = tuple(values) if values is not None else None
+        self.lo = lo
+        self.hi = hi
+        self.default = default if default is not None else get()
+        self.integer = bool(integer)
+        self.unit = unit
+
+    def clamp(self, value):
+        """The in-bounds value closest to ``value`` (identity for enums that
+        are already members; ValueError otherwise — an enum has no nearest
+        neighbor to guess)."""
+        if self.kind == ENUM:
+            if value not in self.values:
+                raise ValueError("knob %r accepts %s, got %r"
+                                 % (self.name, self.values, value))
+            return value
+        value = float(value)
+        if self.lo is not None:
+            value = max(float(self.lo), value)
+        if self.hi is not None:
+            value = min(float(self.hi), value)
+        if self.integer:
+            value = int(round(value))
+        return value
+
+    def numeric_value(self, value=None):
+        """The knob's value as a number (enum -> index): the export shape."""
+        value = self.get() if value is None else value
+        if self.kind == ENUM:
+            try:
+                return self.values.index(value)
+            except ValueError:
+                return -1
+        return value
+
+
+class KnobSet:
+    """Thread-safe registry of live knobs — the controller's actuation seam.
+
+    All mutation goes through :meth:`apply` (bounded, serialized under one
+    lock, recorded); reads (:meth:`get`/:meth:`describe`/:meth:`collect`)
+    return LIVE component state. ``checkpoint()``/``restore()`` are the
+    controller's revert mechanism.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._knobs = {}
+
+    # -- registration -------------------------------------------------------------------
+
+    def add(self, knob):
+        with self._lock:
+            if knob.name in self._knobs:
+                raise ValueError("knob %r already registered" % knob.name)
+            self._knobs[knob.name] = knob
+        return knob
+
+    def numeric(self, name, get, apply_fn, lo, hi, default=None, integer=True,
+                unit=""):
+        return self.add(Knob(name, get, apply_fn, lo=lo, hi=hi,
+                             default=default, integer=integer, unit=unit))
+
+    def enum(self, name, get, apply_fn, values, default=None):
+        return self.add(Knob(name, get, apply_fn, values=values,
+                             default=default))
+
+    # -- reads --------------------------------------------------------------------------
+
+    def __contains__(self, name):
+        with self._lock:
+            return name in self._knobs
+
+    def names(self):
+        with self._lock:
+            return sorted(self._knobs)
+
+    def knob(self, name):
+        with self._lock:
+            return self._knobs[name]
+
+    def get(self, name):
+        """The LIVE value of ``name`` (reads the component, not a cache)."""
+        return self.knob(name).get()
+
+    def describe(self):
+        """``{name: {"value", "default", "lo", "hi"/"values", "unit"}}`` —
+        live values beside their configured defaults (the stats panel's knob
+        table)."""
+        with self._lock:
+            knobs = dict(self._knobs)
+        out = {}
+        for name, knob in knobs.items():
+            entry = {"value": knob.get(), "default": knob.default,
+                     "unit": knob.unit}
+            if knob.kind == ENUM:
+                entry["values"] = knob.values
+            else:
+                entry["lo"] = knob.lo
+                entry["hi"] = knob.hi
+            out[name] = entry
+        return out
+
+    # -- actuation ----------------------------------------------------------------------
+
+    def apply(self, name, value):
+        """Retune ``name`` to (the clamped) ``value``. Returns
+        ``(before, after)`` — equal when the clamp or the component made the
+        call a no-op. The ONLY sanctioned way to change a knob (GL-C004
+        enforces that options structs are not mutated around it)."""
+        with self._lock:
+            knob = self._knobs[name]
+            before = knob.get()
+            target = knob.clamp(value)
+            if target == before:
+                return before, before
+            after = knob.apply_fn(target)
+            if after is None:
+                after = knob.get()
+        return before, after
+
+    def checkpoint(self):
+        """``{name: live value}`` — the revert target the controller snapshots
+        before an actuation experiment."""
+        with self._lock:
+            return {name: knob.get() for name, knob in self._knobs.items()}
+
+    def restore(self, snapshot):
+        """Re-apply a :meth:`checkpoint`. Returns the ``[(name, before,
+        after)]`` list of knobs that actually moved (the revert decisions)."""
+        moved = []
+        for name, value in snapshot.items():
+            if name not in self:
+                continue
+            before, after = self.apply(name, value)
+            if after != before:
+                moved.append((name, before, after))
+        return moved
+
+    # -- export -------------------------------------------------------------------------
+
+    def collect(self):
+        """Pull-collector payload: per-knob LIVE value + default (numeric;
+        enums as value index) — exported as ``ptpu_ctl_knob_*`` so dashboards
+        and the controller's own feedback read post-retune truth."""
+        with self._lock:
+            knobs = dict(self._knobs)
+        out = {}
+        for name, knob in knobs.items():
+            out["knob_%s" % name] = knob.numeric_value()
+            out["knob_%s_default" % name] = knob.numeric_value(knob.default)
+        return out
+
+
+def build_knobset(reader):
+    """The standard :class:`KnobSet` over a running reader's live components.
+
+    Always included (when the executor supports it): ``workers`` — the
+    fleet-size knob actuating :meth:`~petastorm_tpu.reader.Reader
+    .resize_workers` (grow spawns, shrink drains — never kills mid-item).
+
+    In-process pools (thread/dummy) additionally expose the IO knobs — the
+    worker object is shared with the caller's process, so its readahead pool
+    / ranged-GET engine / cache tiers are directly actuable:
+
+    - ``readahead_depth`` / ``readahead_bytes`` — the prefetcher's in-flight
+      and held-byte bounds (depth also resizes the dispatch lookahead and the
+      IO thread pool so a deeper window actually overlaps);
+    - ``remote_max_inflight`` / ``hedge_quantile`` — the ranged-GET engine's
+      pool width and hedge deadline quantile (bound only when the remote tier
+      is active for the reader's filesystem);
+    - ``mem_cache_bytes`` — the mem tier's byte budget (the hot-row-group
+      promotion lever) when a mem tier exists;
+    - ``disk_admit`` — the tiered admission policy enum.
+
+    A process pool's children construct their own IO runtimes in other
+    processes; parent-side setters cannot reach them, so only the fleet knob
+    binds there (the applied overrides still ride the worker pickle to any
+    child spawned AFTER the retune).
+    """
+    ks = KnobSet()
+    worker = getattr(reader, "_worker", None)
+    opts = getattr(reader, "_io_options", None)
+    pool_args = getattr(reader, "_pool_args", None)
+    pool_type = pool_args[0] if pool_args else "thread"
+    configured_workers = pool_args[1] if pool_args else 4
+
+    if getattr(reader, "resize_workers", None) is not None \
+            and pool_type not in ("dummy", "sync"):
+        def _workers_target():
+            # the knob's value is the applied TARGET, not the instantaneous
+            # alive count: retiring workers drain with a lag, and a finished
+            # stream has zero alive — both would feed the controller (and
+            # the revert checkpoints) phantom values
+            target = getattr(reader._executor, "target_workers", None)
+            return target if target is not None else configured_workers
+
+        ks.numeric(
+            "workers",
+            get=_workers_target,
+            apply_fn=reader.resize_workers,
+            lo=1, hi=max(2 * configured_workers, 8),
+            default=configured_workers)
+
+    in_process = pool_type in ("thread", "dummy", "sync")
+    if worker is None or opts is None or not in_process:
+        return ks
+
+    if opts.readahead:
+        ks.numeric("readahead_depth",
+                   get=lambda: worker.live_io_knobs()["readahead_depth"],
+                   apply_fn=reader.apply_readahead_depth,
+                   lo=1, hi=64, default=opts.readahead_depth)
+        # lo=0: 0 IS a legal value (the construction convention for
+        # "uncapped") — a tighter floor would let a checkpoint restore()
+        # re-clamp an uncapped budget into a hard cap, and a default that
+        # disagrees with the live getter would flag [RETUNED] forever
+        ks.numeric("readahead_bytes",
+                   get=lambda: worker.live_io_knobs()["readahead_bytes"],
+                   apply_fn=worker.apply_readahead_bytes,
+                   lo=0, hi=4 << 30,
+                   default=opts.readahead_bytes, unit="bytes")
+    if opts.remote.active_for(worker._fs):
+        ks.numeric("remote_max_inflight",
+                   get=lambda: worker.live_io_knobs()["remote_max_inflight"],
+                   apply_fn=worker.apply_remote_max_inflight,
+                   lo=1, hi=64, default=opts.remote.max_inflight)
+        ks.numeric("hedge_quantile",
+                   get=lambda: worker.live_io_knobs()["hedge_quantile"],
+                   apply_fn=worker.apply_hedge_quantile,
+                   lo=0.5, hi=0.999, default=opts.remote.hedge_quantile,
+                   integer=False)
+    cache = getattr(worker, "_cache", None)
+    mem = getattr(cache, "mem", None) if cache is not None else None
+    if mem is not None:
+        ks.numeric("mem_cache_bytes",
+                   get=lambda: mem.budget,
+                   apply_fn=worker.apply_mem_cache_bytes,
+                   lo=8 << 20, hi=16 << 30, default=mem.budget, unit="bytes")
+    if cache is not None and hasattr(cache, "apply_disk_admit"):
+        ks.enum("disk_admit",
+                get=lambda: cache.disk_admit,
+                apply_fn=cache.apply_disk_admit,
+                values=("always", "scan-resistant"))
+    return ks

@@ -153,6 +153,28 @@ class _Store:
             if budget > self._budget:
                 self._budget = budget
 
+    def set_budget(self, budget):
+        """Live budget retune (ISSUE 13): the store is process-wide, so this
+        moves the SHARED ceiling — shrinking evicts (LRU-first) down to the
+        new budget immediately. Served views stay valid (numpy refcounting);
+        the per-entry leases release like any eviction."""
+        evicted = []
+        with self._lock:
+            self._budget = max(0, int(budget))
+            while self._total > self._budget and self._entries:
+                _, (_, old_bytes, old_lease) = self._entries.popitem(last=False)
+                self._total -= old_bytes
+                self._evictions.inc()
+                evicted.append(old_lease)
+            self._bytes_gauge.set(self._total)
+        for lease in evicted:
+            lease.release()
+
+    @property
+    def budget(self):
+        with self._lock:
+            return self._budget
+
     def lookup(self, key):
         """(hit?, stored_value) — the STORED read-only structure; the caller
         picks the serve shape (zero-copy views or a CoW escalation copy)."""
@@ -238,6 +260,9 @@ class _Store:
             # ptpu_io_memcache_bytes gauge family (duplicate-family scrape)
             "memcache_entries": count,
             "memcache_held_bytes": total,
+            # LIVE budget (ISSUE 13 satellite): reports the applied value
+            # after a controller retune, not the construction-time one
+            "memcache_budget_bytes": self._budget,
             "memcache_hits": self._hits.value,
             "memcache_misses": self._misses.value,
             "memcache_evictions": self._evictions.value,
@@ -332,6 +357,24 @@ class MemCache(CacheBase):
         copy = _defensive_copy(value)
         count_copy("memcache_cow", _copied_nbytes(copy))
         return copy
+
+    def apply_budget(self, size_limit_bytes):
+        """Live budget retune (ISSUE 13) — the controller's hot-row-group
+        promotion lever: growing the budget lets more hot decoded row groups
+        stay resident in the mem tier; shrinking evicts down immediately.
+        Moves this instance's budget AND the backing store's shared ceiling
+        (the store is process-wide — a retune here is visible to every
+        MemCache over it; per-reader isolation needs a private store)."""
+        size_limit_bytes = max(1, int(size_limit_bytes))
+        self._budget = size_limit_bytes
+        store = self._private_store if self._private_store is not None \
+            else shared_store()
+        store.set_budget(size_limit_bytes)
+        return size_limit_bytes
+
+    @property
+    def budget(self):
+        return self._budget
 
     def would_admit(self, value):
         """Will :meth:`get`'s admit path actually store ``value``? False for

@@ -59,6 +59,14 @@ from petastorm_tpu.obs.metrics import default_registry
 #: bookkeeping so its unbounded join cannot hang exit on them.
 _live_pools_lock = threading.Lock()
 _live_pools = weakref.WeakSet()
+#: STRONG refs to the executors of shut-down pools whose IO threads may
+#: still be exiting. The WeakSet alone has a teardown hole: ``worker.close()``
+#: drops the pool reference right after ``shutdown()``, the pool is GC'd out
+#: of ``_live_pools``, and the exit drain never joins its still-exiting
+#: threads — under CPU contention one can then die mid-``ParquetFile``
+#: thread-local cleanup during interpreter finalization (the PR 5 abort,
+#: back through the GC window). Entries are pruned once their threads die.
+_dying_executors = []
 _drain_installed = False
 
 
@@ -81,6 +89,10 @@ def _install_exit_drain():
         atexit.register(_drain_live_pools)
 
 
+def _executor_threads_alive(executor):
+    return any(t.is_alive() for t in getattr(executor, "_threads", ()) or ())
+
+
 def _drain_live_pools():
     with _live_pools_lock:
         pools = list(_live_pools)
@@ -91,8 +103,20 @@ def _drain_live_pools():
         pool.drain(max(0.1, deadline - time.monotonic()))
     for pool in pools:
         pool.join_threads(max(0.1, deadline - time.monotonic()))
+    # executors of pools already GC'd (their reader closed earlier): their
+    # threads exit on their own, but must still be JOINED before
+    # finalization or a straggler dies mid-pyarrow cleanup
+    with _live_pools_lock:
+        dying = list(_dying_executors)
+    for executor in dying:
+        for t in list(getattr(executor, "_threads", ()) or ()):
+            t.join(max(0.05, deadline - time.monotonic()))
     for pool in pools:
         pool.abandon_hung_threads()
+    from concurrent.futures import thread as cf_thread
+
+    for executor in dying:
+        ReadaheadPool._abandon_pool_threads(executor, cf_thread)
 
 
 class _CancelledRead(Exception):
@@ -151,6 +175,13 @@ class ReadaheadPool:
         self._wait_timeout_s = wait_timeout_s
         self._coalesce = bool(coalesce) and read_run_fn is not None
         self._max_run = max(1, int(coalesce_max_run))
+        self._io_threads = max(1, int(io_threads))
+        #: IO pools replaced by a live apply_io_threads() resize: their
+        #: still-executing reads finish on their own threads, which must be
+        #: joined by the exit drain like the active pool's (see the module
+        #: comment — a daemon IO thread dying mid-ParquetFile-cleanup during
+        #: interpreter finalization aborts the process)
+        self._retired_pools = []
         self._lock = threading.Lock()
         self._entries = OrderedDict()  # key -> _Entry (insertion = FIFO age)
         self._pending = 0
@@ -169,7 +200,14 @@ class ReadaheadPool:
         self._n_evictions = 0
         self._n_coalesced_reads = 0
         self._n_coalesced_items = 0
-        self._pool = ThreadPoolExecutor(max_workers=max(1, int(io_threads)),
+        #: cumulative seconds (this pool): background read time, foreground
+        #: wait on in-flight prefetches, and miss-fallback sync reads — the
+        #: wait + sync sum is the EXPOSED read latency, the controller's
+        #: grow-readahead trigger scale
+        self._read_s_cum = 0.0
+        self._wait_s_cum = 0.0
+        self._sync_s_cum = 0.0
+        self._pool = ThreadPoolExecutor(max_workers=self._io_threads,
                                         thread_name_prefix="ptpu-io")
         reg = registry if registry is not None else default_registry()
         self._hits = reg.counter("ptpu_io_readahead_hits_total",
@@ -209,6 +247,75 @@ class ReadaheadPool:
         between tasks), so a read hung against a wedged filesystem trips the
         stall watchdog instead of silently pinning its thread."""
         self._health = monitor
+
+    # -- live knobs (ISSUE 13) ----------------------------------------------------------
+    #
+    # The sanctioned retune seam: the controller's KnobSet calls these; the
+    # pool's IoOptions are never mutated (graftlint GL-C004). All three are
+    # thread-safe against concurrent schedule()/get()/_read_task traffic.
+
+    def apply_depth(self, depth):
+        """Retune the in-flight background-read bound live. Takes effect at
+        the next ``schedule()`` (in-flight reads above a SHRUNK bound finish
+        normally — the bound gates admission, it never cancels work)."""
+        depth = max(1, int(depth))
+        with self._lock:
+            self._depth = depth
+            self._evict_over_budget()  # the entry-count cap scales with depth
+            self._bytes_gauge.set(self._held_bytes)
+        return depth
+
+    def apply_byte_budget(self, nbytes):
+        """Retune the completed-unclaimed byte budget live (<= 0 = uncapped,
+        the construction convention); over-budget tables are evicted now."""
+        nbytes = int(nbytes)
+        with self._lock:
+            self._byte_budget = nbytes if nbytes > 0 else None
+            self._evict_over_budget()
+            self._bytes_gauge.set(self._held_bytes)
+        return 0 if self._byte_budget is None else self._byte_budget
+
+    def apply_io_threads(self, io_threads):
+        """Resize the IO thread pool live via a pool swap: new reads submit
+        to a fresh pool of the target size; the old pool's queued/executing
+        reads finish on its own threads (``shutdown(wait=False)`` without
+        cancellation — a retune must never fail reads), which the exit drain
+        still joins through ``_retired_pools``."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        io_threads = max(1, int(io_threads))
+        with self._lock:
+            if self._closed or io_threads == self._io_threads:
+                return self._io_threads
+            old = self._pool
+            self._pool = ThreadPoolExecutor(max_workers=io_threads,
+                                            thread_name_prefix="ptpu-io")
+            self._io_threads = io_threads
+            # prune retired pools whose threads have all exited — repeated
+            # retunes over a long run must not accumulate dead executors
+            self._retired_pools = [
+                p for p in self._retired_pools
+                if any(t.is_alive()
+                       for t in getattr(p, "_threads", ()) or ())]
+            self._retired_pools.append(old)
+        old.shutdown(wait=False)
+        return io_threads
+
+    @property
+    def depth(self):
+        return self._depth
+
+    @property
+    def byte_budget(self):
+        return self._byte_budget
+
+    @property
+    def io_threads(self):
+        return self._io_threads
+
+    def _all_pools(self):
+        with self._lock:
+            return [self._pool] + list(self._retired_pools)
 
     # -- scheduling ---------------------------------------------------------------------
 
@@ -322,6 +429,7 @@ class ReadaheadPool:
         if tracer is not None:
             tracer.add("io.readahead", t0, dur)
         with self._lock:
+            self._read_s_cum += dur
             if not self._closed:
                 # in-flight count tracks the READS, not the entries: an entry a
                 # timed-out waiter already popped still finished its IO here
@@ -399,6 +507,8 @@ class ReadaheadPool:
         completed = entry.event.wait(self._wait_timeout_s)
         wait = time.perf_counter() - t0
         self._wait_hist.observe(wait)
+        with self._lock:
+            self._wait_s_cum += wait
         tracer = self._tracer
         if tracer is not None and wait > 1e-6:
             tracer.add("io.wait", t0, wait)
@@ -437,6 +547,12 @@ class ReadaheadPool:
 
     # -- lifecycle ----------------------------------------------------------------------
 
+    def note_sync_read(self, seconds):
+        """Account a miss-fallback synchronous read (the worker times it):
+        exposed latency the prefetch window failed to hide."""
+        with self._lock:
+            self._sync_s_cum += seconds
+
     def drain(self, timeout_s):
         """Wait (bounded) until no read task is executing. Returns True when
         idle."""
@@ -449,8 +565,9 @@ class ReadaheadPool:
         or their dying thread-local ``ParquetFile`` cleanup aborts inside
         pyarrow."""
         deadline = time.monotonic() + max(0.0, timeout_s)
-        for t in list(getattr(self._pool, "_threads", ()) or ()):
-            t.join(max(0.05, deadline - time.monotonic()))
+        for pool in self._all_pools():
+            for t in list(getattr(pool, "_threads", ()) or ()):
+                t.join(max(0.05, deadline - time.monotonic()))
 
     def abandon_hung_threads(self):
         """Detach still-alive IO threads from interpreter-exit bookkeeping
@@ -464,7 +581,15 @@ class ReadaheadPool:
         try:
             from concurrent.futures import thread as cf_thread
 
-            for t in list(getattr(self._pool, "_threads", ()) or ()):
+            for pool in self._all_pools():
+                self._abandon_pool_threads(pool, cf_thread)
+        except Exception:
+            pass  # graftlint: disable=GL-O002 (best-effort private-API detach at interpreter exit)
+
+    @staticmethod
+    def _abandon_pool_threads(pool, cf_thread):
+        try:
+            for t in list(getattr(pool, "_threads", ()) or ()):
                 if not t.is_alive():
                     continue
                 cf_thread._threads_queues.pop(t, None)
@@ -496,7 +621,17 @@ class ReadaheadPool:
             if entry.table is None and entry.error is None:
                 entry.error = _CancelledRead()
             entry.event.set()
-        self._pool.shutdown(wait=False, cancel_futures=True)
+        executors = self._all_pools()
+        for executor in executors:
+            executor.shutdown(wait=False, cancel_futures=True)
+        # strong-ref the executors until their threads die (pruned here and
+        # by later shutdowns): the pool object itself is usually dropped by
+        # worker.close() right after this call, and the exit drain must
+        # still be able to join any straggling IO thread
+        with _live_pools_lock:
+            _dying_executors[:] = [ex for ex in _dying_executors
+                                   if _executor_threads_alive(ex)]
+            _dying_executors.extend(executors)
 
     def stats(self):
         """Live gauges/counters for ``Reader.io_stats()`` (thread/dummy pools —
@@ -509,6 +644,20 @@ class ReadaheadPool:
             return {
                 "readahead_pending": self._pending,
                 "readahead_held_bytes": self._held_bytes,
+                # LIVE knob values (ISSUE 13 satellite): after a controller
+                # retune these must report the applied value, not the
+                # construction-time configuration
+                "readahead_depth_limit": self._depth,
+                "readahead_byte_budget": self._byte_budget or 0,
+                "readahead_io_threads": self._io_threads,
+                # cumulative seconds: window deltas of the EXPOSED series
+                # (foreground waits + miss-fallback sync reads) are the
+                # controller's exposed-read-latency scale — the time share
+                # of wall-clock the prefetch window failed to hide
+                "readahead_read_s": round(self._read_s_cum, 4),
+                "readahead_wait_s": round(self._wait_s_cum, 4),
+                "readahead_exposed_s": round(
+                    self._wait_s_cum + self._sync_s_cum, 4),
                 "readahead_hits": self._n_hits,
                 "readahead_misses": self._n_misses,
                 "readahead_evictions": self._n_evictions,

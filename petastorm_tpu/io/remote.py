@@ -353,7 +353,16 @@ class RemoteReadEngine:
         self._store = store_key or str(getattr(fs, "type_name", "remote"))
         self._closed = False
         self._lock = threading.Lock()
-        self._pool = ThreadPoolExecutor(max_workers=self._opts.max_inflight,
+        #: LIVE knob state (ISSUE 13): seeded from the options once at
+        #: construction and retuned only through the sanctioned apply_*()
+        #: seam — the options struct itself is never mutated (GL-C004)
+        self._max_inflight = self._opts.max_inflight
+        self._hedge_quantile = self._opts.hedge_quantile
+        #: GET pools replaced by a live apply_max_inflight() resize: their
+        #: in-flight attempts finish on their own threads (never cancelled —
+        #: a retune must not fail reads)
+        self._retired_pools = []
+        self._pool = ThreadPoolExecutor(max_workers=self._max_inflight,
                                         thread_name_prefix="ptpu-remote")
         reg = registry if registry is not None else default_registry()
         self._gets = reg.counter("ptpu_io_remote_gets_total",
@@ -488,7 +497,7 @@ class RemoteReadEngine:
         state.outstanding = 1
         if self._opts.hedge:
             state.deadline_s = self._model.deadline(
-                self._store, length, self._opts.hedge_quantile,
+                self._store, length, self._hedge_quantile,
                 self._opts.hedge_min_samples, self._opts.hedge_min_s)
         self._submit_attempt(state, path, offset, length, "primary")
         return state
@@ -632,21 +641,72 @@ class RemoteReadEngine:
             f.seek(offset)
             return f.read(length)
 
+    # -- live knobs (ISSUE 13) ----------------------------------------------------------
+
+    def apply_max_inflight(self, max_inflight):
+        """Resize the GET pool live via a pool swap: new attempts submit to
+        a fresh pool of the target width; the old pool's queued/executing
+        GETs finish on its own threads (their ``_GetState`` delivery keeps
+        the lease accounting exact). The sanctioned retune seam — the
+        ``RemoteIoOptions`` struct is never mutated (GL-C004)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        max_inflight = max(1, int(max_inflight))
+        with self._lock:
+            if self._closed or max_inflight == self._max_inflight:
+                return self._max_inflight
+            old = self._pool
+            self._pool = ThreadPoolExecutor(max_workers=max_inflight,
+                                            thread_name_prefix="ptpu-remote")
+            self._max_inflight = max_inflight
+            # prune retired pools whose threads have all exited — repeated
+            # retunes over a long run must not accumulate dead executors
+            self._retired_pools = [
+                p for p in self._retired_pools
+                if any(t.is_alive()
+                       for t in getattr(p, "_threads", ()) or ())]
+            self._retired_pools.append(old)
+        old.shutdown(wait=False)
+        return max_inflight
+
+    def apply_hedge_quantile(self, quantile):
+        """Retune the hedge-arming latency quantile live (bounded to the
+        same [0.5, 0.999] window the options constructor enforces). Takes
+        effect at the next GET's deadline computation."""
+        quantile = min(0.999, max(0.5, float(quantile)))
+        with self._lock:
+            self._hedge_quantile = quantile
+        return quantile
+
+    @property
+    def max_inflight(self):
+        return self._max_inflight
+
+    @property
+    def hedge_quantile(self):
+        return self._hedge_quantile
+
     # -- lifecycle ----------------------------------------------------------------------
 
     def shutdown(self):
-        """Stop the GET pool (idempotent). In-flight attempts are abandoned
-        to finish on their own — their ``_GetState`` delivery keeps the lease
-        accounting exact either way."""
+        """Stop the GET pool(s) (idempotent). In-flight attempts are
+        abandoned to finish on their own — their ``_GetState`` delivery keeps
+        the lease accounting exact either way."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
-        self._pool.shutdown(wait=False, cancel_futures=True)
+            pools = [self._pool] + list(self._retired_pools)
+        for pool in pools:
+            pool.shutdown(wait=False, cancel_futures=True)
 
     def stats(self):
         with self._lock:
             out = {"remote_%s" % k: v for k, v in self._n.items()}
+            # LIVE knob values (ISSUE 13 satellite): dashboards and the
+            # controller's feedback read the applied value post-retune
+            out["remote_max_inflight"] = self._max_inflight
+            out["remote_hedge_quantile"] = self._hedge_quantile
         return out
 
 

@@ -148,6 +148,28 @@ class TieredCache(CacheBase):
             _prov.annotate("cache_tier", served[0])
         return value
 
+    # -- live knobs (ISSUE 13) ----------------------------------------------------------
+
+    def apply_disk_admit(self, policy):
+        """Retune the disk admission policy live — the sanctioned seam (the
+        options struct is never mutated, GL-C004). Applies from the next
+        remote fill; already-admitted entries are untouched."""
+        if policy not in ("always", "scan-resistant"):
+            raise ValueError("disk_admit must be 'always' or 'scan-resistant', "
+                             "got %r" % (policy,))
+        self._disk_admit = policy
+        return policy
+
+    @property
+    def disk_admit(self):
+        return self._disk_admit
+
+    @property
+    def mem(self):
+        """The mem tier (:class:`~petastorm_tpu.io.memcache.MemCache`) or
+        ``None`` — the controller's hot-row-group promotion target."""
+        return self._mem
+
     def contains(self, key):
         if self._mem is not None and self._mem.contains(key):
             return True

@@ -214,6 +214,11 @@ class _LoaderObs:
                 "prov", lambda: (lambda r: r.summary() if r is not None
                                  else {})(prov_ref())))
 
+    def add_collector(self, prefix, fn):
+        """Register one more pull collector whose lifetime follows this
+        loader's (unregistered with the rest at ``close()``)."""
+        self._handles.append(self.registry.register_collector(prefix, fn))
+
     def observe(self, stage, dur):
         self._hists[stage].observe(dur)
 
@@ -651,6 +656,22 @@ class DataLoader:
         pre-built :class:`petastorm_tpu.obs.slo.SloEngine` to add anomaly
         watches or share an engine. Zero hot-path cost — evaluation happens
         on the sampler thread only.
+    controller : True, petastorm_tpu.control.ControlOptions or Controller, optional
+        Closed-loop self-tuning (ISSUE 13; requires ``metrics=``): a
+        :class:`~petastorm_tpu.control.Controller` rides the same window
+        cadence as the SLO engine and retunes the reader's LIVE knobs
+        through the sanctioned :class:`~petastorm_tpu.control.KnobSet`
+        seam — readahead depth/bytes, ranged-GET pool width, hedge
+        quantile, mem-tier budget, disk admission, worker-fleet size
+        (shrink drains, never kills mid-item). Declarative rules with
+        hysteresis, debounce, per-knob cooldowns, step limits and a global
+        revert-and-freeze no-gain guard (the anti-oscillation contract —
+        docs/performance.md). With ``provenance=`` the rules read the
+        attribution snapshot, so actuations are triggered by (and logged
+        with) the culprit SITE. Decisions are ``cause=ctl_actuate``/
+        ``ctl_revert``/``ctl_freeze`` degradation events plus
+        ``ptpu_ctl_*`` families; read them from ``loader.ctl_decisions()``
+        / ``loader.controller``. Zero hot-path cost.
     """
 
     def __init__(self, reader, batch_size, sharding=None, shuffling_queue_capacity=0,
@@ -658,7 +679,7 @@ class DataLoader:
                  to_device=True, host_queue_size=8, pad_shapes=None,
                  device_shuffle_capacity=0, device_decode_resize=None, trace=None,
                  metrics=None, health=None, staging=None, provenance=None,
-                 slos=None):
+                 slos=None, controller=None):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if last_batch not in ("drop", "pad", "partial"):
@@ -887,6 +908,44 @@ class DataLoader:
             if engine._attribution is None and self._prov_rec is not None:
                 engine.set_attribution(self.attribution_report)
             self._slo_engine = engine
+        #: optional closed-loop controller (ISSUE 13; requires ``metrics=``):
+        #: rides the same window cadence as the SLO engine and actuates the
+        #: reader's live knobs (readahead depth/bytes, GET pool width, hedge
+        #: quantile, mem-tier budget, worker fleet) through the sanctioned
+        #: KnobSet seam. ``True`` = default rules over the standard knobs; a
+        #: ControlOptions tunes warmup/cooldown/no-gain policy; a pre-built
+        #: Controller is shared (caller-owned lifecycle). With provenance on,
+        #: rules read the attribution snapshot (culprit-site triggers).
+        self._controller = None
+        self._ctl_owned = False
+        if controller:
+            if self._obs is None:
+                raise ValueError(
+                    "DataLoader(controller=...) requires metrics= — the "
+                    "controller reads the registry's windowed time-series")
+            from petastorm_tpu.control import (ControlOptions, Controller,
+                                               build_knobset)
+
+            registry = self._obs.registry
+            if isinstance(controller, Controller):
+                # caller-supplied (shared): lifecycle stays the caller's —
+                # never detached at __exit__ (same convention as slos=)
+                ctl = controller
+                if ctl._registry is None:
+                    ctl._registry = registry
+                if ctl._store is None:
+                    ctl.attach(registry.timeline_store())
+            else:
+                ctl_opts = controller \
+                    if isinstance(controller, ControlOptions) else None
+                ctl = Controller(build_knobset(reader), registry=registry,
+                                 options=ctl_opts)
+                ctl.attach(registry.timeline_store())
+                self._ctl_owned = True
+            if ctl._attribution is None and self._prov_rec is not None:
+                ctl.set_attribution(self.attribution_report)
+            self._controller = ctl
+            self._obs.add_collector("ctl", ctl.collect)
 
     # -- producer (background thread: reader → host batches) ---------------------------
     #
@@ -1934,6 +1993,21 @@ class DataLoader:
         when ``slos=`` was not passed."""
         return self._slo_engine
 
+    @property
+    def controller(self):
+        """The attached :class:`~petastorm_tpu.control.Controller`, or None
+        when ``controller=`` was not passed."""
+        return self._controller
+
+    def ctl_decisions(self):
+        """The controller's decisions so far (ISSUE 13) — each a
+        :class:`~petastorm_tpu.control.Decision` carrying the cause
+        (``ctl_actuate``/``ctl_revert``/``ctl_freeze``), the knob's
+        before/after values and the triggering window. Empty without
+        ``controller=``."""
+        return self._controller.decisions() if self._controller is not None \
+            else []
+
     def slo_alerts(self):
         """Debounced SLO-breach/anomaly alerts so far (ISSUE 12) — each an
         :class:`~petastorm_tpu.obs.slo.SloAlert` carrying an attribution
@@ -1984,6 +2058,11 @@ class DataLoader:
             # (alerts stay readable); a caller-supplied SHARED engine keeps
             # watching — a sibling pipeline may still be burning
             self._slo_engine.detach()
+        if self._controller is not None and self._ctl_owned:
+            # same ownership convention: a loader-built controller stops
+            # actuating (decisions stay readable); a shared one is the
+            # caller's to detach
+            self._controller.detach()
         if self._obs is not None:
             self._obs.close()
         if self._prov_rec is not None and self._prov_owned:
@@ -2589,7 +2668,7 @@ _UNSET = object()
 _LOADER_OPTS = ("last_batch", "device_transform", "prefetch", "pad_shapes",
                 "device_shuffle_capacity", "to_device", "host_queue_size",
                 "device_decode_resize", "trace", "metrics", "health", "staging",
-                "provenance", "slos")
+                "provenance", "slos", "controller")
 
 
 def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1,
@@ -2599,7 +2678,7 @@ def make_dataloader(dataset_url_or_urls, batch_size, sharding=None, num_epochs=1
                     to_device=_UNSET, host_queue_size=_UNSET,
                     device_decode_resize=_UNSET, trace=_UNSET, metrics=_UNSET,
                     health=_UNSET, staging=_UNSET, provenance=_UNSET,
-                    slos=_UNSET, **reader_kwargs):
+                    slos=_UNSET, controller=_UNSET, **reader_kwargs):
     """One-call convenience: ``make_batch_reader`` + :class:`DataLoader`.
 
     ``reader_kwargs`` pass through to :func:`petastorm_tpu.reader.make_batch_reader`

@@ -423,12 +423,45 @@ def render_dashboard(metrics, title="", history=None):
         lines.append("slo alerts: " + "  ".join(
             "%s=%d" % (name, int(slo[name])) for name in sorted(slo)))
 
+    # -- self-tuning controller (ISSUE 13): live knob values vs defaults,
+    # decision totals, freeze state — excluded from the catch-all
+    knob_names = sorted(name[len("ptpu_ctl_knob_"):]
+                        for name in metrics
+                        if name.startswith("ptpu_ctl_knob_")
+                        and not name.endswith("_default"))
+    if knob_names or metrics.get("ptpu_ctl_windows"):
+        frozen = metrics.get("ptpu_ctl_frozen", 0)
+        actuations = _labeled(metrics, "ptpu_ctl_actuations_total")
+        lines.append(
+            "controller: windows=%d  actuations=%d  reverts=%d  freezes=%d%s"
+            % (int(metrics.get("ptpu_ctl_windows", 0)),
+               int(metrics.get("ptpu_ctl_actuations", 0)),
+               int(metrics.get("ptpu_ctl_reverts", 0)),
+               int(metrics.get("ptpu_ctl_freezes", 0)),
+               "  [FROZEN]" if frozen else ""))
+        for knob in knob_names:
+            value = metrics.get("ptpu_ctl_knob_" + knob, 0)
+            default = metrics.get("ptpu_ctl_knob_%s_default" % knob, 0)
+            acted = int(actuations.get(knob, 0)) if actuations else 0
+            retuned = value != default
+            lines.append("  knob %-22s %12s  (default %s)%s%s"
+                         % (knob,
+                            ("%.4g" % value) if isinstance(value, float)
+                            and not float(value).is_integer()
+                            else str(int(value)),
+                            ("%.4g" % default) if isinstance(default, float)
+                            and not float(default).is_integer()
+                            else str(int(default)),
+                            "  [RETUNED]" if retuned else "",
+                            ("  actuations=%d" % acted) if acted else ""))
+
     # -- everything else, compact (numbers only; histogram summaries as p50s)
     shown_prefixes = ("ptpu_pipeline_", "ptpu_worker_item_seconds",
                       "ptpu_health_", "ptpu_degradations_total",
                       "ptpu_io_tier_", "ptpu_io_remote_", "ptpu_io_hedge",
                       "ptpu_io_footer_cache_", "ptpu_transform_",
-                      "ptpu_prov_", "ptpu_dataset_", "ptpu_slo_")
+                      "ptpu_prov_", "ptpu_dataset_", "ptpu_slo_",
+                      "ptpu_ctl_")
     rest = {n: v for n, v in metrics.items()
             if not n.startswith(shown_prefixes)}
     scalars = [(n, v) for n, v in sorted(rest.items())

@@ -209,6 +209,11 @@ class _WorkerBase:
         self._io_health = None  # optional HealthMonitor for the IO threads
         self._remote = None  # RemoteReadEngine built lazily per process (ISSUE 8)
         self._remote_unavailable = False  # this worker's engine failed to build
+        #: live knob overrides (ISSUE 13): applied retunes recorded here so a
+        #: LAZILY-built pool/engine starts at the retuned value (and a pool
+        #: child spawned after a retune inherits it through the pickle); the
+        #: IoOptions struct itself is never mutated (graftlint GL-C004)
+        self._knob_overrides = {}
 
     def __getstate__(self):
         state = dict(self.__dict__)
@@ -381,11 +386,15 @@ class _WorkerBase:
                         # PR 4 strict-adjacency behavior
                         gap_ok = self._rowgroup_gap_ok \
                             if opts.remote.active_for(self._fs) else None
+                        knobs = self._knob_overrides
                         pool = ReadaheadPool(
                             self._read_columns_sync, read_run_fn=self._read_run,
-                            depth=opts.readahead_depth,
-                            byte_budget=opts.readahead_bytes,
-                            io_threads=opts.io_threads, coalesce=opts.coalesce,
+                            depth=knobs.get("readahead_depth",
+                                            opts.readahead_depth),
+                            byte_budget=knobs.get("readahead_bytes",
+                                                  opts.readahead_bytes),
+                            io_threads=knobs.get("io_threads", opts.io_threads),
+                            coalesce=opts.coalesce,
                             coalesce_max_run=opts.coalesce_max_run,
                             gap_ok=gap_ok)
                     except Exception as e:  # noqa: BLE001 — degrade to sync reads
@@ -443,6 +452,13 @@ class _WorkerBase:
                     if engine is None:
                         self._remote_unavailable = True
                         return None
+                    # live retunes applied before this lazy build (ISSUE 13):
+                    # the fresh engine starts at the retuned values
+                    knobs = self._knob_overrides
+                    if "remote_max_inflight" in knobs:
+                        engine.apply_max_inflight(knobs["remote_max_inflight"])
+                    if "hedge_quantile" in knobs:
+                        engine.apply_hedge_quantile(knobs["hedge_quantile"])
                     self._remote = engine
         return engine
 
@@ -550,6 +566,80 @@ class _WorkerBase:
         if pool is not None:
             pool.set_health(monitor)
 
+    # -- live knobs (ISSUE 13) ----------------------------------------------------------
+    #
+    # The sanctioned retune seam the controller's KnobSet binds to. Each
+    # apply records the override (a lazily-built pool/engine starts retuned;
+    # pool children spawned after the retune inherit it through the pickle)
+    # and forwards to the live component when one exists. The IoOptions
+    # struct is never mutated (GL-C004): one options object may be shared
+    # across readers, and a retune here must stay this reader's.
+
+    def live_io_knobs(self):
+        """The LIVE IO knob values (overrides > live components > options)."""
+        opts = self._io_options
+        pool = self._readahead
+        engine = self._remote
+        knobs = self._knob_overrides
+        return {
+            "readahead_depth": pool.depth if pool is not None
+            else knobs.get("readahead_depth", opts.readahead_depth),
+            "readahead_bytes": (pool.byte_budget or 0) if pool is not None
+            else knobs.get("readahead_bytes", opts.readahead_bytes),
+            "io_threads": pool.io_threads if pool is not None
+            else knobs.get("io_threads", opts.io_threads),
+            "remote_max_inflight": engine.max_inflight if engine is not None
+            else knobs.get("remote_max_inflight", opts.remote.max_inflight),
+            "hedge_quantile": engine.hedge_quantile if engine is not None
+            else knobs.get("hedge_quantile", opts.remote.hedge_quantile),
+        }
+
+    def apply_readahead_depth(self, depth):
+        """Retune the prefetch window live. The IO thread pool is sized with
+        it (bounded) — a deeper window on the configured 2 threads would
+        queue, not overlap."""
+        depth = max(1, int(depth))
+        self._knob_overrides["readahead_depth"] = depth
+        io_threads = max(self._io_options.io_threads, min(depth, 16))
+        self._knob_overrides["io_threads"] = io_threads
+        pool = self._readahead
+        if pool is not None:
+            pool.apply_depth(depth)
+            pool.apply_io_threads(io_threads)
+        return depth
+
+    def apply_readahead_bytes(self, nbytes):
+        nbytes = max(0, int(nbytes))
+        self._knob_overrides["readahead_bytes"] = nbytes
+        pool = self._readahead
+        if pool is not None:
+            pool.apply_byte_budget(nbytes)
+        return nbytes
+
+    def apply_remote_max_inflight(self, max_inflight):
+        max_inflight = max(1, int(max_inflight))
+        self._knob_overrides["remote_max_inflight"] = max_inflight
+        engine = self._remote
+        if engine is not None:
+            engine.apply_max_inflight(max_inflight)
+        return max_inflight
+
+    def apply_hedge_quantile(self, quantile):
+        quantile = min(0.999, max(0.5, float(quantile)))
+        self._knob_overrides["hedge_quantile"] = quantile
+        engine = self._remote
+        if engine is not None:
+            engine.apply_hedge_quantile(quantile)
+        return quantile
+
+    def apply_mem_cache_bytes(self, nbytes):
+        """Retune the mem tier's budget (the hot-row-group promotion lever);
+        a no-op returning 0 when no mem tier exists."""
+        mem = getattr(self._cache, "mem", None)
+        if mem is None:
+            return 0
+        return mem.apply_budget(nbytes)
+
     # -- reads --------------------------------------------------------------------------
 
     def _read_columns(self, piece, columns):
@@ -562,6 +652,13 @@ class _WorkerBase:
             table = pool.get(piece, columns)
             if table is not None:
                 return table
+            # a readahead MISS falling to the blocking path is EXPOSED read
+            # latency just like a foreground wait — the controller's
+            # grow-readahead trigger scale (io/readahead.py stats)
+            t0 = time.perf_counter()
+            table = self._read_columns_sync(piece, columns)
+            pool.note_sync_read(time.perf_counter() - t0)
+            return table
         return self._read_columns_sync(piece, columns)
 
     def _read_columns_sync(self, piece, columns):
@@ -1808,6 +1905,38 @@ class Reader:
         fn = getattr(self._worker, "set_health", None)
         if fn is not None:
             fn(monitor)
+
+    # -- live knobs (ISSUE 13) -----------------------------------------------------------
+
+    def resize_workers(self, workers_count):
+        """Grow/shrink this reader's worker fleet LIVE (thread and process
+        pools; ``None`` on the sync pool, which has no fleet). Grow spawns;
+        shrink drains between items — never kills mid-item — and returns the
+        retiring workers' claims to the dispatcher, so the delivered row set
+        and the checkpoint watermark are identical to an un-resized run.
+        ``reset()`` rebuilds the executor at the CONFIGURED count (a retune
+        is runtime state, not config)."""
+        fn = getattr(self._executor, "resize", None)
+        if fn is None:
+            return None
+        return fn(workers_count)
+
+    def live_workers(self):
+        """Workers currently running (including ones draining toward a
+        shrink target), or ``None`` for pools without a fleet."""
+        return getattr(self._executor, "alive_workers", None)
+
+    def apply_readahead_depth(self, depth):
+        """Retune the readahead window live: the worker's pool depth (and IO
+        threads), AND the dispatcher's per-worker claim lookahead — the claim
+        is the prefetch hint window, so depth without lookahead would starve
+        the deeper pool of hints."""
+        fn = getattr(self._worker, "apply_readahead_depth", None)
+        applied = fn(depth) if fn is not None else max(1, int(depth))
+        set_lookahead = getattr(self._executor, "set_lookahead", None)
+        if set_lookahead is not None and self._io_options.readahead:
+            set_lookahead(applied)
+        return applied
 
     @property
     def wire_views(self):

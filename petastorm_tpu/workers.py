@@ -78,6 +78,10 @@ class PullDispatcher:
         self._exhausted = False
         self._lookahead = max(0, int(lookahead))
         self._stealing = bool(stealing)
+        #: items handed back by a retiring worker (live fleet shrink, ISSUE
+        #: 13): refilled into claims BEFORE the plan iterator — they were
+        #: claimed earlier in plan order than anything still unclaimed
+        self._returned = deque()
         #: optional petastorm_tpu.obs.flight.FlightRecorder — steal decisions
         #: ride in the health layer's event ring (None = no recording)
         self._recorder = recorder
@@ -111,12 +115,58 @@ class PullDispatcher:
         with self._lock:
             self._recorder = recorder
 
+    def set_lookahead(self, lookahead):
+        """Retune the per-worker claim window live (ISSUE 13): the claim IS
+        the readahead hint window, so a controller growing the prefetch depth
+        must widen the hints with it or the deeper pool never sees more than
+        the old window's worth of upcoming items."""
+        with self._lock:
+            self._lookahead = max(0, int(lookahead))
+
+    def ensure_workers(self, workers_count):
+        """Grow the claim table to at least ``workers_count`` slots (live
+        fleet grow — ISSUE 13). Never shrinks: a retiring worker's slot stays
+        (empty) so surviving indices keep their claims."""
+        with self._lock:
+            while len(self._claims) < workers_count:
+                self._claims.append(deque())
+
+    def withdraw(self, worker_idx):
+        """Return ``worker_idx``'s unprocessed claim to the pool (live fleet
+        shrink): the items refill other workers' claims before the plan
+        iterator, so a drained worker loses no work and duplicates none.
+        Returns the number of items handed back."""
+        with self._lock:
+            claim = self._claims[worker_idx]
+            n = len(claim)
+            self._returned.extend(claim)
+            claim.clear()
+        return n
+
+    def has_work(self):
+        """Is anything left to dispatch — handed-back items, claimed items,
+        or an unexhausted plan? The executors' last-worker exit gate: a
+        retiring worker may hand its claim back AFTER the surviving peers
+        already saw an empty dispatcher and exited, and posting the
+        end-of-stream marker over those stranded items would silently drop
+        rows (the resize contract is byte-identical delivery)."""
+        with self._lock:
+            return bool(self._returned) or not self._exhausted \
+                or any(self._claims)
+
     def _fill(self, claim, target):
-        while len(claim) < target and not self._exhausted:
+        # caller MUST hold self._lock (all call sites do — the analyzer
+        # cannot see cross-method lock ownership)
+        while len(claim) < target:
+            if self._returned:
+                claim.append(self._returned.popleft())  # graftlint: disable=GL-C001
+                continue
+            if self._exhausted:
+                break
             try:
                 claim.append(next(self._iter))
             except StopIteration:
-                self._exhausted = True
+                self._exhausted = True  # graftlint: disable=GL-C001 (caller holds self._lock)
 
     def stats(self):
         return {"steals": self.steals}
@@ -258,6 +308,11 @@ class SyncExecutor(ExecutorBase):
         self._lookahead = max(0, int(lookahead))
         self._recovery = RecoveryOptions.normalize(recovery)
 
+    def set_lookahead(self, lookahead):
+        """Live lookahead retune (ISSUE 13): ``results()`` reads the value
+        per item, so the next iteration peeks the new window."""
+        self._lookahead = max(0, int(lookahead))
+
     def start(self, worker, plan):
         self._worker = worker
         self._plan = plan
@@ -326,11 +381,17 @@ class ThreadExecutor(ExecutorBase):
         self._dispatch = None
         self._active = 0
         self._active_lock = threading.Lock()
+        # live fleet-resize state (ISSUE 13), all under _active_lock
+        self._target = workers_count   # intended fleet size
+        self._retire = 0               # workers asked to drain and exit
+        self._next_idx = workers_count
+        self._worker_obj = None
 
     def start(self, worker, plan):
         self._results = queue.Queue(maxsize=self._queue_size)
         self._stop_event.clear()
         self.truncated = False
+        self._worker_obj = worker
         monitor = self._health
         self._dispatch = PullDispatcher(
             plan, self._workers_count, lookahead=self._lookahead,
@@ -338,6 +399,9 @@ class ThreadExecutor(ExecutorBase):
             recorder=monitor.flight if monitor is not None else None)
         with self._active_lock:
             self._active = self._workers_count
+            self._target = self._workers_count
+            self._retire = 0
+            self._next_idx = self._workers_count
         for i in range(self._workers_count):
             t = threading.Thread(
                 target=self._run_worker, args=(worker, self._dispatch, i),
@@ -346,13 +410,84 @@ class ThreadExecutor(ExecutorBase):
             t.start()
             self._threads.append(t)
 
+    def _should_retire(self):
+        """Claim one pending retirement (live shrink): checked by workers
+        BETWEEN items only — a shrink drains, it never kills mid-item."""
+        with self._active_lock:
+            if self._retire > 0:
+                self._retire -= 1
+                return True
+            return False
+
+    def set_lookahead(self, lookahead):
+        """Live dispatch-lookahead retune (rides with the readahead-depth
+        knob — see :meth:`PullDispatcher.set_lookahead`)."""
+        self._lookahead = max(0, int(lookahead))
+        dispatch = self._dispatch
+        if dispatch is not None:
+            dispatch.set_lookahead(self._lookahead)
+
+    @property
+    def alive_workers(self):
+        """Workers currently running (retiring ones still count until they
+        drain out). Lock-free read: collectors poll this from other
+        threads, and an int read is atomic."""
+        return self._active
+
+    @property
+    def target_workers(self):
+        return self._target
+
+    def resize(self, workers_count):
+        """Grow/shrink the worker fleet LIVE (ISSUE 13). Grow spawns fresh
+        worker threads against the running dispatcher; shrink queues
+        retirements that draining workers pick up between items — their
+        unprocessed claims return to the dispatcher, so the delivered row
+        set (and the consumed-ordinal watermark) is byte-identical to an
+        un-resized run. A no-op once the stream has finished. Returns the
+        applied target."""
+        n = max(1, int(workers_count))
+        dispatch = self._dispatch
+        to_start = []
+        with self._active_lock:
+            if dispatch is None or self._active == 0:
+                return self._target  # not started / already drained
+            if n > self._target:
+                grow = n - self._target
+                cancelled = min(grow, self._retire)
+                self._retire -= cancelled  # un-retire before spawning anew
+                for _ in range(grow - cancelled):
+                    to_start.append(self._next_idx)
+                    self._next_idx += 1
+                self._active += len(to_start)
+            elif n < self._target:
+                self._retire += self._target - n
+            self._target = n
+            next_idx = self._next_idx
+        if to_start:
+            dispatch.ensure_workers(next_idx)
+            for idx in to_start:
+                t = threading.Thread(
+                    target=self._run_worker,
+                    args=(self._worker_obj, dispatch, idx),
+                    daemon=True, name="ptpu-worker-%d" % idx)
+                t.start()
+                self._threads.append(t)
+        return n
+
     def _run_worker(self, worker, dispatch, idx):
         import time
 
         prefetch = getattr(worker, "prefetch", None)
         hb = None
+        worker_fatal = False  # a fatal exit must never trigger the rescue gate
         try:
             while not self._stop_event.is_set():
+                if self._should_retire():
+                    # live shrink: hand the unprocessed claim back (others
+                    # pick it up before the plan iterator) and drain out
+                    dispatch.withdraw(idx)
+                    break
                 # health is resolved per pass, so a monitor attached after
                 # start() (the loader wires the reader post-construction)
                 # still instruments the rest of the stream
@@ -398,6 +533,7 @@ class ThreadExecutor(ExecutorBase):
                     if _prov.ACTIVE is not None:
                         _prov.end_item()
                 if fatal:
+                    worker_fatal = True
                     break
                 if monitor is not None:
                     # per-worker latency histogram: the straggler detector's input
@@ -408,10 +544,52 @@ class ThreadExecutor(ExecutorBase):
         finally:
             if hb is not None:
                 hb.done()
-            with self._active_lock:
-                self._active -= 1
-                if self._active == 0:
-                    self._put(_DONE)
+            self._retire_worker(worker, dispatch, worker_fatal)
+
+    def _retire_worker(self, worker, dispatch, fatal):
+        """One worker's exit gate: decrement the fleet count and post the
+        end-of-stream marker when this was the LAST worker — unless the
+        dispatcher still holds work. That happens in exactly one (rare) race:
+        a retiring worker hands its claim back AFTER the surviving peers
+        already saw an empty dispatcher and exited; the last decrementer is
+        the only actor that observes the strand atomically (the withdraw
+        precedes its decrement in program order), so it spawns a rescue
+        worker instead of declaring the stream complete over undelivered
+        rows."""
+        rescue_idx = None
+        with self._active_lock:
+            self._active -= 1
+            if self._active == 0 and not fatal \
+                    and not self._stop_event.is_set() and dispatch.has_work():
+                self._active += 1  # the rescue worker's slot, reserved now
+                rescue_idx = self._next_idx
+                self._next_idx += 1
+            last = self._active == 0
+        if rescue_idx is not None:
+            try:
+                dispatch.ensure_workers(rescue_idx + 1)
+                t = threading.Thread(
+                    target=self._run_worker, args=(worker, dispatch,
+                                                   rescue_idx),
+                    daemon=True, name="ptpu-worker-%d" % rescue_idx)
+                t.start()
+                self._threads.append(t)
+                return
+            except Exception as e:  # noqa: BLE001 — degrade to stream end
+                from petastorm_tpu.obs.log import degradation
+
+                degradation(
+                    "ctl_rescue_failed",
+                    "stranded-claim rescue worker could not start (%s); the "
+                    "handed-back items are LOST for this pass", e, once=False)
+                with self._active_lock:
+                    self._active -= 1
+                    last = self._active == 0
+        if last:
+            # OUTSIDE the lock: _put blocks on a full results queue, and
+            # a blocked holder would deadlock any reader of the fleet
+            # gauges (the controller's collector) on the consumer thread
+            self._put(_DONE)
 
     def dispatch_stats(self):
         """Work-stealing gauges for ``Reader.io_stats()``."""
@@ -519,6 +697,10 @@ class ProcessExecutor(ExecutorBase):
         self._stop_event = threading.Event()
         self._active = 0
         self._active_lock = threading.Lock()
+        # live fleet-resize state (ISSUE 13), all under _active_lock
+        self._target = workers_count
+        self._retire = 0
+        self._next_idx = workers_count
         self._tmpdir = None
         #: Elastic recovery (no reference analog — SURVEY §6: a worker death kills the
         #: read there): a child that dies mid-item is replaced by a fresh clean
@@ -634,12 +816,104 @@ class ProcessExecutor(ExecutorBase):
             recorder=monitor.flight if monitor is not None else None)
         with self._active_lock:
             self._active = self._workers_count
+            self._target = self._workers_count
+            self._retire = 0
+            self._next_idx = self._workers_count
         for i, conn in enumerate(self._conns):
             t = threading.Thread(target=self._drive_child,
                                  args=(conn, self._dispatch, i),
                                  daemon=True, name="ptpu-pdrv-%d" % i)
             t.start()
             self._threads.append(t)
+
+    def _should_retire(self):
+        """Claim one pending retirement (live shrink): checked by drivers
+        BETWEEN items only — a shrink drains, it never kills mid-item."""
+        with self._active_lock:
+            if self._retire > 0:
+                self._retire -= 1
+                return True
+            return False
+
+    def set_lookahead(self, lookahead):
+        """Live dispatch-lookahead retune (parent side; a child's own
+        readahead pool follows the hints it is sent)."""
+        self._lookahead = max(0, int(lookahead))
+        dispatch = self._dispatch
+        if dispatch is not None:
+            dispatch.set_lookahead(self._lookahead)
+
+    @property
+    def alive_workers(self):
+        """Lock-free like ThreadExecutor.alive_workers (collector-safe)."""
+        return self._active
+
+    @property
+    def target_workers(self):
+        return self._target
+
+    def resize(self, workers_count):
+        """Grow/shrink the child fleet LIVE (ISSUE 13). Grow spawns clean
+        interpreter children through the same handshake as the initial pool
+        (and the elastic respawn path); shrink queues retirements — a
+        retiring driver finishes its in-flight item, returns its unprocessed
+        claim to the dispatcher, sends the orderly-shutdown ``None`` to its
+        child and drains out. Never kills mid-item; the delivered ∪
+        quarantined set is identical to an un-resized run. Returns the
+        applied target (spawn failures leave the fleet smaller and are
+        degradation-logged)."""
+        n = max(1, int(workers_count))
+        dispatch = self._dispatch
+        grow_idxs = []
+        with self._active_lock:
+            if dispatch is None or self._active == 0:
+                return self._target
+            if n > self._target:
+                grow = n - self._target
+                cancelled = min(grow, self._retire)
+                self._retire -= cancelled
+                for _ in range(grow - cancelled):
+                    grow_idxs.append(self._next_idx)
+                    self._next_idx += 1
+            elif n < self._target:
+                self._retire += self._target - n
+            self._target = n
+            next_idx = self._next_idx
+        if not grow_idxs:
+            return n
+        dispatch.ensure_workers(next_idx)
+        from petastorm_tpu.obs.log import degradation
+
+        # the slots were RESERVED in _active above (before the slow child
+        # spawns): concurrent driver exits must not see a transient zero and
+        # post _DONE while a grown child is mid-handshake
+        with self._active_lock:
+            self._active += len(grow_idxs)
+        for idx in grow_idxs:
+            try:
+                conn, proc = self._spawn_one()
+            except Exception as e:  # noqa: BLE001 — degrade, never fail the pool
+                degradation(
+                    "ctl_spawn_failed",
+                    "live fleet grow could not spawn a pool child (%s); "
+                    "running with %d fewer worker(s) than the target", e,
+                    1, once=False)
+                with self._active_lock:
+                    self._target -= 1
+                    self._active -= 1
+                    last = self._active == 0
+                if last:
+                    self._put(_DONE)  # the reservation was the only holdout
+                continue
+            with self._respawn_lock:
+                self._child_by_idx[idx] = proc
+            t = threading.Thread(target=self._drive_child,
+                                 args=(conn, dispatch, idx),
+                                 daemon=True, name="ptpu-pdrv-%d" % idx)
+            t.start()
+            self._threads.append(t)
+        with self._active_lock:
+            return self._target
 
     def _await_accept(self, accepted, procs, what, check_stop=False, deadline=120.0):
         """Wait for one accepted connection (or the acceptor thread's exception),
@@ -1081,6 +1355,14 @@ class ProcessExecutor(ExecutorBase):
         try:
             fatal = False
             while not fatal and not self._stop_event.is_set():
+                if self._should_retire():
+                    # live shrink (ISSUE 13): hand the unprocessed claim back
+                    # and drain out — the post-loop None send retires the
+                    # child cleanly; its process is reaped by join()
+                    dispatch.withdraw(idx)
+                    with self._respawn_lock:
+                        self._child_by_idx.pop(idx, None)
+                    break
                 monitor = self._health
                 if monitor is not None and hb is None:
                     hb = monitor.register("pooldrv-%d" % idx, "worker")
@@ -1292,10 +1574,49 @@ class ProcessExecutor(ExecutorBase):
                 hb.done()
             if child_hb is not None:
                 child_hb.done()
-            with self._active_lock:
-                self._active -= 1
-                if self._active == 0:
-                    self._put(_DONE)
+            self._retire_driver(dispatch, fatal)
+
+    def _retire_driver(self, dispatch, fatal):
+        """The drivers' exit gate — same strand-rescue contract as
+        :meth:`ThreadExecutor._retire_worker`: the last decrementer finding
+        handed-back claims in the dispatcher spawns a rescue child (this
+        driver's own child already received its orderly shutdown) instead of
+        posting ``_DONE`` over undelivered rows."""
+        rescue_idx = None
+        with self._active_lock:
+            self._active -= 1
+            if self._active == 0 and not fatal \
+                    and not self._stop_event.is_set() and dispatch.has_work():
+                self._active += 1
+                rescue_idx = self._next_idx
+                self._next_idx += 1
+            last = self._active == 0
+        if rescue_idx is not None:
+            try:
+                conn, proc = self._spawn_one()
+                with self._respawn_lock:
+                    self._child_by_idx[rescue_idx] = proc
+                dispatch.ensure_workers(rescue_idx + 1)
+                t = threading.Thread(target=self._drive_child,
+                                     args=(conn, dispatch, rescue_idx),
+                                     daemon=True,
+                                     name="ptpu-pdrv-%d" % rescue_idx)
+                t.start()
+                self._threads.append(t)
+                return
+            except Exception as e:  # noqa: BLE001 — degrade to stream end
+                from petastorm_tpu.obs.log import degradation
+
+                degradation(
+                    "ctl_rescue_failed",
+                    "stranded-claim rescue child could not start (%s); the "
+                    "handed-back items are LOST for this pass", e, once=False)
+                with self._active_lock:
+                    self._active -= 1
+                    last = self._active == 0
+        if last:
+            # OUTSIDE the lock (see ThreadExecutor._retire_worker)
+            self._put(_DONE)
 
     def _put(self, value):
         # Even the _DONE marker yields to a SET stop event: the consumer is the one

@@ -308,6 +308,69 @@ def test_thread_handling_clean_with_daemon_or_join():
     assert findings == []
 
 
+# -- GL-C004: options-struct mutation outside the KnobSet seam --------------------------
+
+_C004_POSITIVE = """
+    def retune(reader):
+        reader._io_options.readahead_depth = 8  # BUG: frozen config mutated
+"""
+
+
+def test_options_mutation_fires_on_post_construction_assignment():
+    findings, _ = _lint(_C004_POSITIVE)
+    f = _only_rule(findings, "GL-C004")[0]
+    assert f.line == _line_of(_C004_POSITIVE, "BUG: frozen config mutated")
+    assert "readahead_depth" in f.message and "_io_options" in f.message
+
+
+def test_options_mutation_fires_on_bare_opts_and_augassign():
+    findings, _ = _lint("""
+        def widen(opts):
+            opts.max_inflight += 4
+    """)
+    assert _only_rule(findings, "GL-C004")
+
+
+def test_options_mutation_fires_on_nested_options_chain():
+    findings, _ = _lint("""
+        def hedge_off(reader):
+            reader._io_options.remote.hedge = False
+    """)
+    assert _only_rule(findings, "GL-C004")
+
+
+def test_options_mutation_clean_inside_options_class_and_knobset():
+    findings, _ = _lint("""
+        class FancyOptions:
+            def __init__(self, depth=3):
+                self.depth = depth
+
+            def normalize(self, opts):
+                opts.depth = max(1, opts.depth)
+
+        class KnobSet:
+            def apply(self, name, value, opts):
+                opts.depth = value  # the sanctioned seam
+                return value
+
+        def unrelated():
+            box = Box()
+            box.options_list = []   # target attr, not an options base
+            opts = {}
+            opts["depth"] = 8       # dict, not an attribute assignment
+    """)
+    assert findings == []
+
+
+def test_options_mutation_inline_disable():
+    findings, suppressed = _lint("""
+        def legacy(opts):
+            opts.readahead = False  # graftlint: disable=GL-C004
+    """)
+    assert findings == []
+    assert suppressed == 1
+
+
 # -- GL-L001: resource lifecycle --------------------------------------------------------
 
 _L001_POSITIVE = """

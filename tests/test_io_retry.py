@@ -267,7 +267,10 @@ def test_readahead_failure_spends_the_same_retry_budget(recorded_sleep):
     import time as _time
 
     w, state = _bare_worker(io_retries=1, fail_times=10)
-    w._io_options.readahead = True
+    # this worker's options are private to the test, and the un-built pool
+    # must observe readahead=True at its lazy construction — exactly the
+    # shape GL-C004 exists to keep OUT of production code
+    w._io_options.readahead = True  # graftlint: disable=GL-C004
     piece = _Piece()
     w.prefetch([(piece, 0)])
     try:
