@@ -129,6 +129,29 @@ def main():
             msg = conn.recv()  # graftlint: disable=GL-R001 (parent teardown closes the pipe)
             if msg is None:
                 return
+            if isinstance(msg, tuple) and len(msg) == 2 and msg[0] == "ctl":
+                # live knob control frame (ISSUE 14 satellite, PR 13's
+                # declared leftover): the parent's KnobSet retune reaches
+                # ALREADY-RUNNING children here instead of only ones spawned
+                # after it. Unambiguous on the wire: item messages carry a
+                # (piece, partition) tuple first, never a string. The ack
+                # (applied values) is drained by the driver like heartbeats —
+                # the autotune harness asserts a retune lands respawn-free.
+                applied = {}
+                for knob, value in (msg[1] or {}).items():
+                    fn = getattr(worker, "apply_%s" % knob, None)
+                    if fn is None:
+                        continue
+                    try:
+                        applied[knob] = fn(value)
+                    except Exception as e:  # noqa: BLE001 — a bad retune must not kill the child
+                        from petastorm_tpu.obs.log import degradation
+
+                        degradation("ctl_child_apply_failed",
+                                    "pool-child knob %r apply failed: %s",
+                                    knob, e)
+                conn.send(("ctl_ack", applied))
+                continue
             if ping_s:
                 conn.send(("hb", time.time()))  # item received, about to work
             if shm_wire:

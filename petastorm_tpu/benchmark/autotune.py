@@ -207,16 +207,35 @@ def scenario_fleet(workdir, smoke, pool):
                                             settle_windows=1))
     delivered = []
     min_alive = workers
+    ctl_ack = None  # process pool: a mid-stream child retune must land live
+    respawns_at_retune = None
     with DataLoader(reader, 32, to_device=False, metrics=registry,
                     controller=ctl, host_queue_size=2) as loader:
-        for batch in loader:
+        for i, batch in enumerate(loader):
             delivered.extend(int(v) for v in np.asarray(batch["id"]))
             time.sleep(0.02)  # the slow consumer: the pipeline IS the bill
             registry.sample_timelines()
             alive = reader.live_workers()
             if alive:  # 0 = stream already drained, not a shrink
                 min_alive = min(min_alive, alive)
+            if pool == "process":
+                # ISSUE 14 satellite: a KnobSet retune of a child-side IO
+                # knob reaches ALREADY-RUNNING children over the pool
+                # control frame — assert it lands without a respawn
+                executor = reader._executor
+                if i == 2:
+                    respawns_at_retune = executor._respawn_budget
+                    reader.apply_readahead_depth(6)
+                acks = executor.ctl_acks()
+                if any(a.get("readahead_depth") == 6 for a in acks.values()):
+                    ctl_ack = acks
         report = reader.quarantine_report
+        if pool == "process" and respawns_at_retune is not None:
+            if ctl_ack is None:
+                # the stream may have drained before a dispatch flushed the
+                # frame — check the ledger one last time
+                ctl_ack = reader._executor.ctl_acks() or None
+            respawn_delta = respawns_at_retune - reader._executor._respawn_budget
     import gc
 
     gc.collect()
@@ -244,12 +263,24 @@ def scenario_fleet(workdir, smoke, pool):
     if leak_delta:
         failures.append("%s pool: ptpu_lease_leaked_total moved by %d"
                         % (pool, leak_delta))
+    child_retune_ok = None
+    if pool == "process" and respawns_at_retune is not None:
+        child_retune_ok = bool(ctl_ack) and not respawn_delta
+        if not ctl_ack:
+            failures.append("%s pool: no running child acked the live "
+                            "readahead_depth retune (control frame never "
+                            "landed)" % pool)
+        elif respawn_delta:
+            failures.append("%s pool: the child retune coincided with %d "
+                            "respawn(s) — the frame must land on RUNNING "
+                            "children" % (pool, respawn_delta))
     return {
         "pool": pool,
         "shrinks": [d.to_dict() for d in shrinks],
         "min_alive": min_alive,
         "delivered_rows": len(delivered),
         "lease_leak_delta": leak_delta,
+        "child_retune_ok": child_retune_ok,
         "ok": not failures,
     }, failures
 

@@ -134,6 +134,16 @@ def main(argv=None):
         from petastorm_tpu.benchmark import autotune as autotune_bench
 
         return autotune_bench.main(argv[1:])
+    if argv and argv[0] == "decompress":
+        # `petastorm-tpu-bench decompress ...`: the compressed-page
+        # pass-through acceptance harness — device-bound bytes/batch on
+        # pass-through columns <=60% of the host-inflate twin, delivered-
+        # batch byte identity, zero leaked leases, and the no-eligible-
+        # columns store running classic with one warn-once degradation —
+        # see benchmark/decompress.py
+        from petastorm_tpu.benchmark import decompress as decompress_bench
+
+        return decompress_bench.main(argv[1:])
     if argv and argv[0] == "diff":
         # `petastorm-tpu-bench diff run_a run_b`: regression forensics over
         # two trend entries — names WHICH site's critical-path self time

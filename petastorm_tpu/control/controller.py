@@ -295,6 +295,16 @@ def default_rules():
             description="producer blocked on a full host queue most of the "
                         "window (consumer-bound) -> drain one worker; unused "
                         "producer CPU is the bill"),
+        PolicyRule(
+            "pagedec-host-inflate", "pagedec",
+            signal=_slow_share_signal("decode.device_inflate"),
+            fire_above=0.5, clear_below=0.2, windows=3, cooldown=6,
+            propose=lambda ctx, current: "off", guarded=False,
+            description="the device inflate stage owns the slow decile -> "
+                        "flip the compressed-page pass-through back to host "
+                        "inflate live (efficiency rule, guarded like "
+                        "shrink-workers: its own guard reverts on a rows/s "
+                        "drop)"),
     ]
 
 
@@ -702,4 +712,5 @@ def _signal_label(rule):
         "hedge-sooner": "slow_share(io.remote)",
         "promote-hot-rows": "tier_share(remote)",
         "shrink-workers": "time_share(put_wait)",
+        "pagedec-host-inflate": "slow_share(decode.device_inflate)",
     }.get(rule.name, rule.name)

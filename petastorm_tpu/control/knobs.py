@@ -217,9 +217,11 @@ def build_knobset(reader):
     fleet-size knob actuating :meth:`~petastorm_tpu.reader.Reader
     .resize_workers` (grow spawns, shrink drains — never kills mid-item).
 
-    In-process pools (thread/dummy) additionally expose the IO knobs — the
-    worker object is shared with the caller's process, so its readahead pool
-    / ranged-GET engine / cache tiers are directly actuable:
+    The IO knobs bind for in-process pools (thread/dummy — the worker object
+    is shared, components directly actuable) AND for process pools whose
+    executor supports the pool control frame (ISSUE 14 satellite: retunes
+    reach already-running children live; spawned-later children inherit via
+    the worker pickle as before):
 
     - ``readahead_depth`` / ``readahead_bytes`` — the prefetcher's in-flight
       and held-byte bounds (depth also resizes the dispatch lookahead and the
@@ -227,14 +229,13 @@ def build_knobset(reader):
     - ``remote_max_inflight`` / ``hedge_quantile`` — the ranged-GET engine's
       pool width and hedge deadline quantile (bound only when the remote tier
       is active for the reader's filesystem);
+    - ``pagedec`` — the compressed-page pass-through mode enum (ISSUE 14):
+      the controller's live revert-to-host-inflate lever;
     - ``mem_cache_bytes`` — the mem tier's byte budget (the hot-row-group
-      promotion lever) when a mem tier exists;
-    - ``disk_admit`` — the tiered admission policy enum.
-
-    A process pool's children construct their own IO runtimes in other
-    processes; parent-side setters cannot reach them, so only the fleet knob
-    binds there (the applied overrides still ride the worker pickle to any
-    child spawned AFTER the retune).
+      promotion lever) when a mem tier exists (in-process only);
+    - ``disk_admit`` — the tiered admission policy enum (in-process only —
+      a process pool's cache tiers live in the children with no parent-side
+      truth to read back).
     """
     ks = KnobSet()
     worker = getattr(reader, "_worker", None)
@@ -261,7 +262,17 @@ def build_knobset(reader):
             default=configured_workers)
 
     in_process = pool_type in ("thread", "dummy", "sync")
-    if worker is None or opts is None or not in_process:
+    # process pools: parent-side setters cannot reach the children's IO
+    # runtimes, but the pool CONTROL FRAME can (ISSUE 14 satellite) — the
+    # Reader.apply_* seam records the override (future spawns inherit it via
+    # the worker pickle) AND broadcasts it to already-running children, so
+    # the IO knobs bind for every pool whose executor supports the frame.
+    # The getter reads the parent worker's applied TARGET (live_io_knobs
+    # consults the override ledger) — the same convention as the workers
+    # knob, which reads the applied target rather than a per-child census.
+    can_broadcast = hasattr(getattr(reader, "_executor", None),
+                            "broadcast_io_knobs")
+    if worker is None or opts is None or not (in_process or can_broadcast):
         return ks
 
     if opts.readahead:
@@ -275,19 +286,32 @@ def build_knobset(reader):
         # disagrees with the live getter would flag [RETUNED] forever
         ks.numeric("readahead_bytes",
                    get=lambda: worker.live_io_knobs()["readahead_bytes"],
-                   apply_fn=worker.apply_readahead_bytes,
+                   apply_fn=reader.apply_readahead_bytes,
                    lo=0, hi=4 << 30,
                    default=opts.readahead_bytes, unit="bytes")
     if opts.remote.active_for(worker._fs):
         ks.numeric("remote_max_inflight",
                    get=lambda: worker.live_io_knobs()["remote_max_inflight"],
-                   apply_fn=worker.apply_remote_max_inflight,
+                   apply_fn=reader.apply_remote_max_inflight,
                    lo=1, hi=64, default=opts.remote.max_inflight)
         ks.numeric("hedge_quantile",
                    get=lambda: worker.live_io_knobs()["hedge_quantile"],
-                   apply_fn=worker.apply_hedge_quantile,
+                   apply_fn=reader.apply_hedge_quantile,
                    lo=0.5, hi=0.999, default=opts.remote.hedge_quantile,
                    integer=False)
+    if getattr(worker, "_pagedec_supported", False) \
+            and getattr(opts, "pagedec", "off") != "off":
+        # the compressed-page pass-through mode (ISSUE 14): the controller's
+        # revert-to-host-inflate lever when decode.device_inflate dominates
+        ks.enum("pagedec",
+                get=worker.live_pagedec,
+                apply_fn=reader.apply_pagedec,
+                values=("auto", "on", "off"), default=opts.pagedec)
+    if not in_process:
+        # the cache tiers live only in the children for process pools —
+        # budget/admission stay construction-time there (their retunes have
+        # no parent-side truth to read back)
+        return ks
     cache = getattr(worker, "_cache", None)
     mem = getattr(cache, "mem", None) if cache is not None else None
     if mem is not None:

@@ -84,6 +84,17 @@ class PieceRewrittenError(PetastormTpuError):
     the dataset watcher re-plans the new generation into a later epoch."""
 
 
+class PagedecCorruptError(PetastormTpuError):
+    """A compressed-page pass-through decoder found a malformed page: a
+    truncated/bit-flipped header, a payload running past its chunk, a codec
+    stream that fails to inflate, or a dictionary index out of range
+    (ISSUE 14). Classified PERMANENT — retrying would re-read the same bytes —
+    and quarantine-eligible under the PR 7 poison policy
+    (``cause="pagedec_corrupt"``). Every decoder bounds-checks before touching
+    memory, so corrupt input degrades to this error, never to an
+    out-of-bounds read."""
+
+
 class StallError(PetastormTpuError):
     """A pipeline actor missed its heartbeat threshold and the health monitor's
     escalation policy is ``raise`` — the training loop fails fast instead of

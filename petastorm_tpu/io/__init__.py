@@ -89,6 +89,16 @@ class IoOptions:
     hits                HITS                       deep-copy every memcache
                                                    serve writable (default off:
                                                    zero-copy read-only views)
+    pagedec             PTPU_PAGEDEC               compressed-page pass-through
+                                                   (ISSUE 14): "auto" (on when
+                                                   a non-CPU jax backend is
+                                                   live in the worker process),
+                                                   "on", "off". Eligible
+                                                   fixed-width columns ship
+                                                   raw snappy/uncompressed
+                                                   pages to the loader and
+                                                   inflate on device; others
+                                                   fall back per column.
     remote              (see RemoteIoOptions)      the object-store tier's
                                                    knobs (ISSUE 8): ranged-GET
                                                    sizing, hedging, footer
@@ -100,12 +110,12 @@ class IoOptions:
 
     __slots__ = ("readahead", "readahead_depth", "readahead_bytes", "io_threads",
                  "coalesce", "coalesce_max_run", "work_stealing", "memcache_bytes",
-                 "memcache_writable_hits", "remote")
+                 "memcache_writable_hits", "pagedec", "remote")
 
     def __init__(self, readahead=None, readahead_depth=None, readahead_bytes=None,
                  io_threads=None, coalesce=None, coalesce_max_run=None,
                  work_stealing=None, memcache_bytes=None,
-                 memcache_writable_hits=None, remote=None):
+                 memcache_writable_hits=None, pagedec=None, remote=None):
         self.readahead = _env_bool("PTPU_READAHEAD", True) \
             if readahead is None else bool(readahead)
         self.readahead_depth = max(1, _env_int("PTPU_READAHEAD_DEPTH", 3)
@@ -130,6 +140,18 @@ class IoOptions:
         self.memcache_writable_hits = \
             _env_bool("PTPU_MEMCACHE_WRITABLE_HITS", False) \
             if memcache_writable_hits is None else bool(memcache_writable_hits)
+        # compressed-page pass-through (ISSUE 14): "auto" engages only when a
+        # non-CPU jax backend is already initialized in the worker process
+        # (host inflate is strictly cheaper when there is no PCIe link to
+        # save); "on" forces it (process pools ship compressed over the pool
+        # wire either way); "off" is the classic path. Also a live enum Knob
+        # (control.build_knobset) the controller can flip back to host inflate.
+        pagedec = (os.environ.get("PTPU_PAGEDEC") or "auto").strip().lower() \
+            if pagedec is None else str(pagedec).strip().lower()
+        if pagedec not in ("auto", "on", "off"):
+            raise ValueError("pagedec must be 'auto', 'on' or 'off'; got %r"
+                             % pagedec)
+        self.pagedec = pagedec
         # the remote tier's knobs (ISSUE 8): a RemoteIoOptions (or a dict of
         # its fields) riding on the same struct so one `io_options=` kwarg
         # still configures the whole read path; lazy import — remote.py

@@ -27,6 +27,7 @@ import collections
 import functools
 import itertools
 import logging
+import os
 import queue
 import threading
 import time
@@ -143,7 +144,7 @@ class PipelineStats:
 #: per-span stage keys for the loader's latency histograms (the trace span
 #: names map 1:1: reader.next -> read, batch.form -> batch, ...)
 _OBS_STAGES = ("read", "batch", "host_queue_put", "host_queue_wait", "decode",
-               "h2d", "device_queue_wait")
+               "device_inflate", "h2d", "device_queue_wait")
 
 
 class _LoaderObs:
@@ -459,6 +460,28 @@ def _detach_slab_views(columns):
     return out
 
 
+def _materialize_passthrough(batch, cause=None):
+    """Inflate any compressed-page pass-through columns IN PLACE via the host
+    reference decode (ISSUE 14). In-place keeps a ``LeasedBatch``'s identity
+    and leases intact (pass-through buffers are owned bytes, never slab
+    views). ``cause`` names the degradation to count when this seam is a
+    FALLBACK (shuffling buffers, pad tails) rather than the designed host
+    path (host-only delivery, loader-less readers pass ``None``)."""
+    names = [name for name, v in batch.items()
+             if getattr(v, "is_passthrough", False)]
+    if not names:
+        return batch
+    if cause is not None:
+        from petastorm_tpu.obs.log import degradation
+
+        degradation(cause, "pass-through column(s) %s inflated on host at a "
+                    "buffering seam; the device inflate stage was bypassed",
+                    names)
+    for name in names:
+        batch[name] = batch[name].materialize()
+    return batch
+
+
 def _batch_valid_rows(batch):
     """Rows the READER actually delivered in this batch: under ``last_batch='pad'``
     the tail batch repeats its final row up to ``batch_size`` with a ``__valid__``
@@ -479,6 +502,16 @@ def _concat(chunks):
         return np.empty((0,))
     if len(chunks) == 1:
         return chunks[0]
+    if any(getattr(c, "is_passthrough", False) for c in chunks):
+        from petastorm_tpu.io.pagedec import PassthroughColumn
+
+        if all(getattr(c, "is_passthrough", False) for c in chunks):
+            # window chaining, not a copy: the batch keeps riding raw pages
+            return PassthroughColumn.concat(chunks)
+        # mixed chunk types for one column (a per-chunk fallback mid-epoch):
+        # the decoded form is the common denominator
+        chunks = [c.materialize() if getattr(c, "is_passthrough", False)
+                  else c for c in chunks]
     if any(c.dtype == object for c in chunks):
         out = np.empty(sum(len(c) for c in chunks), dtype=object)
         pos = 0
@@ -706,6 +739,17 @@ class DataLoader:
         self._device_decode_resize = _validate_decode_resize(
             device_decode_resize, getattr(reader, "device_decode_fields", None))
         self._device_shuffle_capacity = int(device_shuffle_capacity or 0)
+        #: compressed-page pass-through adoption (ISSUE 14): this loader
+        #: finishes the inflate itself (device kernels when a non-CPU backend
+        #: is live, the numpy reference otherwise), so the reader must stop
+        #: materializing PassthroughColumn values at delivery. Un-adopted at
+        #: __exit__ — a reader outliving its loader serves decoded batches
+        #: again.
+        self._adopted_passthrough = False
+        if getattr(reader, "is_batched_reader", False) \
+                and hasattr(reader, "keep_passthrough"):
+            reader.keep_passthrough = True
+            self._adopted_passthrough = True
         #: optional petastorm_tpu.trace.TraceRecorder — per-span chrome-trace view of
         #: the same stages PipelineStats totals (None = zero overhead). The pool
         #: wire joins in: an shm-wire reader records shm.acquire_wait spans too.
@@ -1058,6 +1102,13 @@ class DataLoader:
                 if self._pad_shapes:
                     columns = _pad_ragged_columns(columns, self._pad_shapes)
                 if self._shuffling_queue_capacity:
+                    # the shuffling buffer permutes ROWS — compressed pages
+                    # cannot be row-permuted without decoding, so this seam
+                    # inflates on host (counted; pagedec=auto never pairs
+                    # with a host shuffle on purpose — the HBM ring shuffle
+                    # is the pass-through-compatible one)
+                    columns = _materialize_passthrough(
+                        columns, cause="pagedec_host_inflate")
                     # rows linger in the shuffling buffer across row groups: staged
                     # payloads that are views into a row group's stacked buffers must be
                     # detached or one straggler row pins its whole group's memory
@@ -1193,6 +1244,9 @@ class DataLoader:
                 batch["__valid__"] = np.ones(n, dtype=bool)
             return batch
         pad = self.local_batch_size - n
+        # pass-through columns inflate on host before the gather below (a
+        # short TAIL batch only — full batches never reach this line)
+        batch = _materialize_passthrough(batch, cause="pagedec_host_inflate")
         # the gather index and validity mask depend only on (n, batch_size):
         # built once per row count and frozen, instead of the old
         # np.concatenate([arange, full]) rebuild on every partial batch
@@ -1443,6 +1497,79 @@ class DataLoader:
         arrays.update(host)
         return arrays
 
+    def _inflate_passthrough(self, batch):
+        """The device inflate stage of the compressed-page pass-through
+        (ISSUE 14): PassthroughColumn values → device arrays via the Pallas
+        kernels (:mod:`petastorm_tpu.ops.pagedec_kernels`) when a non-CPU
+        backend is live, the numpy reference twin otherwise (the decoded
+        array then rides the normal staging + ``device_put`` path). Returns
+        ``(batch_without_passthrough, {name: device array})``.
+
+        Accounting: pages and compressed/saved bytes land in the
+        ``ptpu_pagedec_*`` family — the compressed payload (plus the small
+        page tables) is what the pipeline carried in place of decoded
+        arrays: the pool-wire volume on every path, and the PCIe volume when
+        the DEVICE inflate runs. Columns that take the host fallback here
+        (CPU backend, sharded delivery, kernel bail) additionally count
+        ``ptpu_pagedec_host_inflate_columns_total`` — their H2D leg shipped
+        the decoded array, so the saved-bytes number covered the wire only.
+        The stage records a ``decode.device_inflate`` span (provenance +
+        trace + kernel-time histogram) so ``attribution_report()`` can blame
+        or exonerate it, and carries a chaos hook site of the same name for
+        synthetic kernel-slow injection."""
+        names = [name for name, v in batch.items()
+                 if getattr(v, "is_passthrough", False)]
+        if not names:
+            return batch, {}
+        import jax
+
+        from petastorm_tpu import chaos as _chaos
+        from petastorm_tpu.io.pagedec import pagedec_counters
+        from petastorm_tpu.ops import pagedec_kernels as pk
+
+        counters = pagedec_counters()
+        rec = self._prov_rec
+        t0 = time.perf_counter()
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.hit("decode.device_inflate")
+        # sharded delivery keeps the host path for now: the decoded array
+        # goes through the same sharded device_put as any other column
+        # (per-shard device inflate is the ROADMAP item-2 follow-up)
+        use_device = self.sharding is None and (
+            jax.default_backend() != "cpu"
+            or os.environ.get("PTPU_PAGEDEC_DEVICE", "") not in ("", "0"))
+        decoded = {}
+        for name in names:
+            col = batch.pop(name)
+            counters["pages"].inc(sum(
+                (p1 - p0) + (1 if c.dict_page is not None else 0)
+                for c, s, t in col.parts
+                for p0, p1, _base in (c.covering_pages(s, t),)))
+            shipped = col.shipped_nbytes
+            counters["bytes_compressed"].inc(shipped)
+            counters["bytes_saved"].inc(max(0, col.raw_nbytes - shipped))
+            arr = None
+            if use_device:
+                try:
+                    arr = pk.inflate_column(col)
+                except pk.DeviceInflateError:
+                    arr = None  # host twin below validates + raises if corrupt
+            if arr is None:
+                # CPU fallback / kernel bail: reference decode, normal H2D
+                counters["host_inflate_columns"].inc()
+                batch[name] = col.materialize()
+            else:
+                decoded[name] = arr
+        dt = time.perf_counter() - t0
+        counters["inflate_seconds"].observe(dt)
+        if self._trace is not None:
+            self._trace.add("decode.device_inflate", t0, dt)
+        if self._obs is not None:
+            self._obs.observe("device_inflate", dt)
+        if rec is not None:
+            rec.transfer_span("decode.device_inflate", t0, dt)
+        return batch, decoded
+
     def _ensure_staging(self, device):
         """Resolve (once) and return the pinned H2D staging pool, or None.
 
@@ -1497,6 +1624,7 @@ class DataLoader:
             # host batches flow to this thread strictly FIFO: advance the
             # recorder's transfer pointer to this batch's provenance
             rec.transfer_next()
+        batch, inflated = self._inflate_passthrough(batch)
         if hb is not None:
             hb.beat("decode")
         t0 = time.perf_counter()
@@ -1566,6 +1694,7 @@ class DataLoader:
                 else:
                     arrays[name] = jax.device_put(arr, s)
         arrays.update(staged)
+        arrays.update(inflated)
         if staging_lease is not None or leases:
             # the H2D copy may still be reading the source buffers (device_put
             # is async): wait for it before the slabs go back to their rings
@@ -1669,6 +1798,9 @@ class DataLoader:
             # host numpy) so CPU-only consumers see images, not coefficient payloads
             if getattr(self.reader, "device_decode_fields", None):
                 for batch in self._host_batches(host_q):
+                    # host delivery IS the designed host-decode seam for
+                    # pass-through columns (no degradation counted)
+                    batch = _materialize_passthrough(batch)
                     rest, staged = self._decode_staged(batch)
                     rest.update({k: np.asarray(v) for k, v in staged.items()})
                     self._advance_consumed(_batch_valid_rows(rest))
@@ -1686,6 +1818,7 @@ class DataLoader:
                         if prev is not None:
                             prev.release()
                         prev = batch if isinstance(batch, LeasedBatch) else None
+                        batch = _materialize_passthrough(batch)
                         self._advance_consumed(_batch_valid_rows(batch))
                         if self._prov_rec is not None:
                             self._prov_rec.batch_delivered()
@@ -2050,6 +2183,11 @@ class DataLoader:
         self.join()
         self.reader.stop()
         self.reader.join()
+        if self._adopted_passthrough:
+            # hand delivery materialization back to the reader: a reader
+            # outliving this loader serves decoded batches again
+            self.reader.keep_passthrough = False
+            self._adopted_passthrough = False
         if self._staging is not None:
             self._staging.close()
             self._staging = None

@@ -416,6 +416,27 @@ def render_dashboard(metrics, title="", history=None):
         if quarantined:
             lines.append("  quarantined items: %d" % int(quarantined))
 
+    # -- compressed-page pass-through (ISSUE 14): pages/bytes shipped,
+    # H2D bytes saved, per-column fallbacks, inflate-stage latency
+    pd_pages = metrics.get("ptpu_pagedec_pages_total", 0)
+    pd_fallbacks = metrics.get("ptpu_pagedec_fallback_columns_total", 0)
+    if pd_pages or pd_fallbacks:
+        shipped = metrics.get("ptpu_pagedec_bytes_compressed_total", 0)
+        saved = metrics.get("ptpu_pagedec_bytes_saved_h2d_total", 0)
+        raw = shipped + saved
+        lines.append(
+            "pagedec pass-through: pages=%d  shipped=%.1f MB  "
+            "saved=%.1f MB%s  fallback columns=%d"
+            % (int(pd_pages), shipped / 1e6, saved / 1e6,
+               ("  (%.0f%% of raw)" % (100.0 * shipped / raw)) if raw else "",
+               int(pd_fallbacks)))
+        inflate = metrics.get("ptpu_pagedec_inflate_seconds")
+        if isinstance(inflate, dict) and inflate.get("count"):
+            lines.append("  inflate stage: p50=%s p99=%s over %d batches"
+                         % (_fmt_ms(inflate.get("p50", 0)),
+                            _fmt_ms(inflate.get("p99", 0)),
+                            int(inflate.get("count", 0))))
+
     # -- SLO alerts (ISSUE 12): debounced breach/anomaly counters
     slo = _labeled(metrics, "ptpu_slo_alerts_total")
     slo = {k: v for k, v in slo.items() if v}
@@ -461,7 +482,7 @@ def render_dashboard(metrics, title="", history=None):
                       "ptpu_io_tier_", "ptpu_io_remote_", "ptpu_io_hedge",
                       "ptpu_io_footer_cache_", "ptpu_transform_",
                       "ptpu_prov_", "ptpu_dataset_", "ptpu_slo_",
-                      "ptpu_ctl_")
+                      "ptpu_ctl_", "ptpu_pagedec_")
     rest = {n: v for n, v in metrics.items()
             if not n.startswith(shown_prefixes)}
     scalars = [(n, v) for n, v in sorted(rest.items())

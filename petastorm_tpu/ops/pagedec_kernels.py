@@ -1,0 +1,528 @@
+"""Device inflate stage for the compressed-page pass-through (ISSUE 14).
+
+The CODAG thesis (PAPERS.md) is that decompression is bandwidth-bound and
+belongs on the accelerator: ship the ~storage-ratio compressed pages over
+PCIe, inflate in HBM. This module is the device half of
+:mod:`petastorm_tpu.io.pagedec` — same two-stage split as the JPEG path
+(:mod:`petastorm_tpu.ops.jpeg`):
+
+- :func:`snappy_inflate_pages` — the snappy LZ token machine as a **Pallas
+  kernel**, one page per grid program (the CODAG block-parallel shape: a
+  page is sequential, pages are independent). The token walk is a
+  ``lax.while_loop`` byte machine inside the kernel; bounds violations latch
+  a per-page ``ok`` flag instead of reading out of range.
+- :func:`rle_expand` — RLE/bit-packed hybrid dictionary-index expansion as
+  the two-phase CODAG shape: a sequential run-table scan (runs ≪ values)
+  followed by a **vectorized Pallas extraction kernel** (bit-window shift +
+  mask + RLE/packed select over all values at once), then a device gather
+  through the inflated dictionary.
+- :func:`inflate_column` — the loader-facing entry: a
+  :class:`~petastorm_tpu.io.pagedec.PassthroughColumn` window → the decoded
+  ``jax.Array`` in HBM, page tables and compressed bytes being the only H2D
+  traffic.
+
+Like the JPEG kernels, everything runs in Pallas **interpret mode on CPU
+topologies** (tested that way in CI); the numpy reference decoders in
+``io/pagedec.py`` are the bit-identity twin — any per-page ``ok=False``
+(corruption, unsupported shape like bit widths over 24) falls back to the
+host reference path, which validates fully and raises the classified
+:class:`~petastorm_tpu.errors.PagedecCorruptError`.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+def _use_interpret():
+    import jax
+
+    return jax.default_backend() == "cpu"
+
+
+# -- snappy LZ token machine -----------------------------------------------------------
+#
+# Format: varint uncompressed length, then tagged elements. tag & 3:
+#   0 literal  (len from tag>>2, 60..63 select 1..4 extra length bytes)
+#   1 copy     (len 4..11 from tag bits 2-4, offset 11 bits: tag bits 5-7 + 1 byte)
+#   2 copy     (len 1..64 from tag>>2, offset 2 bytes LE)
+#   3 copy     (len 1..64 from tag>>2, offset 4 bytes LE)
+# Copies may overlap their own output (offset < len): the byte-serial inner
+# loop IS the semantics, exactly like the host reference.
+
+def _snappy_machine(comp, comp_len, out_cap):
+    """Decode one snappy page: ``comp`` (src_cap,) uint8 → ((out_cap,) uint8,
+    produced length, ok). Pure jnp/lax — runs inside the Pallas kernel body
+    (one grid program per page) and under ``vmap`` in the fallback path."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    src_cap = comp.shape[0]
+    comp = comp.astype(jnp.int32)
+
+    def rd(i):
+        # clamped gather: the ok flag (checked by every consumer) carries the
+        # violation; the read itself can never leave the buffer
+        return comp[jnp.clip(i, 0, src_cap - 1)]
+
+    # preamble: varint uncompressed length (<= 5 bytes)
+    def pre_body(state):
+        pos, shift, val, done, ok = state
+        b = rd(pos)
+        val = val | ((b & 0x7F) << shift)
+        done = (b & 0x80) == 0
+        ok = ok & (pos < comp_len) & (shift <= 28)
+        return pos + 1, shift + 7, val, done, ok
+
+    pos, _, out_len, _, ok = lax.while_loop(
+        lambda s: (~s[3]) & s[4],
+        pre_body, (jnp.int32(0), jnp.int32(0), jnp.int32(0), False, True))
+    ok = ok & (out_len <= out_cap)
+
+    out = jnp.zeros((out_cap,), jnp.uint8)
+
+    def copy_byte(k, state):
+        out, dst, src_off = state
+        v = out[jnp.clip(dst - src_off + k, 0, out_cap - 1)]
+        out = out.at[jnp.clip(dst + k, 0, out_cap - 1)].set(v)
+        return out, dst, src_off
+
+    def lit_byte(k, state):
+        out, dst, src = state
+        v = rd(src + k).astype(jnp.uint8)
+        out = out.at[jnp.clip(dst + k, 0, out_cap - 1)].set(v)
+        return out, dst, src
+
+    def step(state):
+        src, dst, out, ok = state
+        tag = rd(src)
+        t = tag & 3
+
+        def literal(_):
+            n0 = tag >> 2
+            extra = jnp.where(n0 >= 60, n0 - 59, 0)  # 0..4 length bytes
+            # extra-byte mask without a dynamic 1 << 32 (implementation-
+            # defined in int32; an array table would be a captured constant
+            # pallas refuses): shifts stay <= 24, the 4-byte case keeps 31
+            # bits — a >=2GB literal in a page-sized stream is corruption
+            # the bounds checks below reject anyway
+            mask = jnp.where(
+                extra >= 4, jnp.int32(0x7FFFFFFF),
+                (jnp.int32(1) << (8 * jnp.minimum(extra, 3))) - 1)
+            word = (rd(src + 1) | (rd(src + 2) << 8) | (rd(src + 3) << 16)
+                    | ((rd(src + 4) & 0x7F) << 24))
+            ln = jnp.where(n0 >= 60, word & mask, n0) + 1
+            start = src + 1 + extra
+            # ln >= 1: a corrupt length must latch ok=False, never step the
+            # cursors backwards (a negative ln with ok still True would let
+            # the while_loop cycle forever)
+            good = (ln >= 1) & (start + ln <= comp_len) \
+                & (dst + ln <= out_len)
+            new_out, _, _ = lax.fori_loop(
+                0, jnp.where(good, ln, 0), lit_byte, (out, dst, start))
+            return start + ln, dst + ln, new_out, ok & good
+
+        def copy(_):
+            ln = jnp.where(t == 1, ((tag >> 2) & 0x7) + 4, (tag >> 2) + 1)
+            off = jnp.where(
+                t == 1, ((tag >> 5) << 8) | rd(src + 1),
+                jnp.where(t == 2, rd(src + 1) | (rd(src + 2) << 8),
+                          rd(src + 1) | (rd(src + 2) << 8)
+                          | (rd(src + 3) << 16) | (rd(src + 4) << 24)))
+            consumed = jnp.where(t == 1, 2, jnp.where(t == 2, 3, 5))
+            good = (src + consumed <= comp_len) & (off > 0) & (off <= dst) \
+                & (dst + ln <= out_len)
+            new_out, _, _ = lax.fori_loop(
+                0, jnp.where(good, ln, 0), copy_byte, (out, dst, off))
+            return src + consumed, dst + ln, new_out, ok & good
+
+        return lax.cond(t == 0, literal, copy, None)
+
+    src, dst, out, ok = lax.while_loop(
+        lambda s: (s[0] < comp_len) & (s[1] < out_len) & s[3],
+        step, (pos, jnp.int32(0), out, ok))
+    ok = ok & (dst == out_len) & (src == comp_len)
+    return out, out_len, ok
+
+
+def _snappy_pages_kernel(comp_ref, meta_ref, out_ref, ok_ref):
+    """Pallas kernel body: one grid program inflates one page. ``meta`` is
+    the page table row [comp_len, out_len]."""
+    import jax.numpy as jnp
+
+    comp = comp_ref[0, :]
+    comp_len = meta_ref[0, 0]
+    out, _n, ok = _snappy_machine(comp, comp_len, out_ref.shape[1])
+    out_ref[0, :] = out
+    ok_ref[0, 0] = jnp.where(ok, jnp.int32(1), jnp.int32(0))
+
+
+@functools.lru_cache(maxsize=64)
+def _snappy_pages_fn(n_pages, src_cap, out_cap, interpret):
+    import jax
+    from jax.experimental import pallas as pl
+
+    def fn(comp, meta):
+        return pl.pallas_call(
+            _snappy_pages_kernel,
+            out_shape=(jax.ShapeDtypeStruct((n_pages, out_cap), np.uint8),
+                       jax.ShapeDtypeStruct((n_pages, 1), np.int32)),
+            grid=(n_pages,),
+            in_specs=[
+                pl.BlockSpec((1, src_cap), lambda i: (i, 0)),
+                pl.BlockSpec((1, 2), lambda i: (i, 0)),
+            ],
+            out_specs=(pl.BlockSpec((1, out_cap), lambda i: (i, 0)),
+                       pl.BlockSpec((1, 1), lambda i: (i, 0))),
+            interpret=interpret,
+        )(comp, meta)
+
+    return jax.jit(fn)
+
+
+def snappy_inflate_pages(comp, meta, out_cap, interpret=None):
+    """Inflate a batch of snappy pages on device.
+
+    ``comp``: (n_pages, src_cap) uint8, zero-padded compressed pages.
+    ``meta``: (n_pages, 2) int32 — [compressed_len, uncompressed_len] rows.
+    Returns ``(raw (n_pages, out_cap) uint8, ok (n_pages,) bool)``.
+    """
+    import jax.numpy as jnp
+
+    interpret = _use_interpret() if interpret is None else interpret
+    n, src_cap = comp.shape
+    fn = _snappy_pages_fn(n, src_cap, int(out_cap), bool(interpret))
+    out, ok = fn(jnp.asarray(comp), jnp.asarray(meta, jnp.int32))
+    return out, ok[:, 0] != 0
+
+
+def stored_pages(comp, meta, out_cap):
+    """The UNCOMPRESSED-codec twin of :func:`snappy_inflate_pages`: pages are
+    already raw — pad/truncate to the output layout (pure device reshuffle,
+    no kernel needed)."""
+    import jax.numpy as jnp
+
+    comp = jnp.asarray(comp)
+    n, src_cap = comp.shape
+    meta = jnp.asarray(meta, jnp.int32)
+    if src_cap < out_cap:
+        comp = jnp.pad(comp, ((0, 0), (0, out_cap - src_cap)))
+    else:
+        comp = comp[:, :out_cap]
+    idx = jnp.arange(out_cap)[None, :]
+    out = jnp.where(idx < meta[:, 1:2], comp, 0).astype(jnp.uint8)
+    ok = meta[:, 0] == meta[:, 1]
+    return out, ok
+
+
+# -- RLE/bit-packed hybrid expansion ---------------------------------------------------
+
+_MAX_BIT_WIDTH = 24  # 4-byte windows cover shift(<=7)+bw bits; wider -> host path
+
+
+def _rle_run_scan(data, data_len, total, max_runs, bit_width):
+    """Phase 1 (sequential, runs ≪ values): parse the hybrid run stream into
+    a bounded run table. Returns (run_end, is_packed, rle_value,
+    packed_bit_base, n_runs, ok)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    cap = data.shape[0]
+    data = data.astype(jnp.int32)
+
+    def rd(i):
+        return data[jnp.clip(i, 0, cap - 1)]
+
+    byte_width = (bit_width + 7) // 8  # static
+
+    def varint(pos, ok):
+        def body(state):
+            p, shift, val, done, ok = state
+            b = rd(p)
+            val = val | ((b & 0x7F) << shift)
+            return p + 1, shift + 7, val, (b & 0x80) == 0, \
+                ok & (p < data_len) & (shift <= 28)
+
+        p, _, val, _, ok = lax.while_loop(
+            lambda s: (~s[3]) & s[4], body,
+            (pos, jnp.int32(0), jnp.int32(0), False, ok))
+        return val, p, ok
+
+    run_end = jnp.full((max_runs,), jnp.iinfo(jnp.int32).max, jnp.int32)
+    is_packed = jnp.zeros((max_runs,), jnp.int32)
+    rle_value = jnp.zeros((max_runs,), jnp.int32)
+    bit_base = jnp.zeros((max_runs,), jnp.int32)
+
+    def body(state):
+        pos, filled, nruns, run_end, is_packed, rle_value, bit_base, ok = state
+        header, pos, ok = varint(pos, ok)
+        packed = (header & 1) == 1
+
+        groups = header >> 1
+        packed_n = groups * 8
+        packed_bytes = groups * bit_width  # bytes per 8 values == bit_width
+        rle_run = header >> 1
+        v = jnp.int32(0)
+        for k in range(byte_width):
+            v = v | (rd(pos + k) << (8 * k))
+        count = jnp.where(packed, packed_n, rle_run)
+        consumed = jnp.where(packed, packed_bytes, byte_width)
+        ok = ok & (pos + consumed <= data_len) & (count > 0) \
+            & (nruns < max_runs)
+        idx = jnp.clip(nruns, 0, max_runs - 1)
+        # a packed run's trailing values beyond `total` are spec-legal padding
+        run_end = run_end.at[idx].set(jnp.minimum(filled + count, total))
+        is_packed = is_packed.at[idx].set(packed.astype(jnp.int32))
+        rle_value = rle_value.at[idx].set(jnp.where(packed, 0, v))
+        bit_base = bit_base.at[idx].set(pos * 8)
+        return (pos + consumed, filled + count, nruns + 1,
+                run_end, is_packed, rle_value, bit_base, ok)
+
+    init = (jnp.int32(0), jnp.int32(0), jnp.int32(0),
+            run_end, is_packed, rle_value, bit_base, True)
+    pos, filled, nruns, run_end, is_packed, rle_value, bit_base, ok = \
+        lax.while_loop(lambda s: (s[1] < total) & s[7], body, init)
+    ok = ok & (filled >= total)
+    return run_end, is_packed, rle_value, bit_base, nruns, ok
+
+
+def _extract_kernel(win_ref, shift_ref, sel_ref, rlev_ref, mask_ref, out_ref):
+    """Phase 2 Pallas kernel (vectorized VPU work): little-endian 4-byte
+    windows → ``(word >> shift) & mask`` for packed values, the run's RLE
+    value otherwise."""
+    import jax.numpy as jnp
+
+    w = win_ref[:, :].astype(jnp.int32)
+    word = w[:, 0] | (w[:, 1] << 8) | (w[:, 2] << 16) | (w[:, 3] << 24)
+    packed = (word >> shift_ref[:, 0]) & mask_ref[0, 0]
+    out_ref[:, 0] = jnp.where(sel_ref[:, 0] != 0, packed, rlev_ref[:, 0])
+
+
+@functools.lru_cache(maxsize=64)
+def _extract_fn(n, interpret):
+    import jax
+    from jax.experimental import pallas as pl
+
+    block = 1024
+    padded = ((n + block - 1) // block) * block
+
+    def fn(win, shift, sel, rlev, mask):
+        import jax.numpy as jnp
+
+        pad = padded - n
+        if pad:
+            win = jnp.pad(win, ((0, pad), (0, 0)))
+            shift = jnp.pad(shift, ((0, pad), (0, 0)))
+            sel = jnp.pad(sel, ((0, pad), (0, 0)))
+            rlev = jnp.pad(rlev, ((0, pad), (0, 0)))
+        out = pl.pallas_call(
+            _extract_kernel,
+            out_shape=jax.ShapeDtypeStruct((padded, 1), jnp.int32),
+            grid=(padded // block,),
+            in_specs=[
+                pl.BlockSpec((block, 4), lambda i: (i, 0)),
+                pl.BlockSpec((block, 1), lambda i: (i, 0)),
+                pl.BlockSpec((block, 1), lambda i: (i, 0)),
+                pl.BlockSpec((block, 1), lambda i: (i, 0)),
+                pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            interpret=interpret,
+        )(win, shift, sel, rlev, mask)
+        return out[:n, 0]
+
+    return jax.jit(fn)
+
+
+def rle_expand(data, data_len, bit_width, total, interpret=None):
+    """RLE/bit-packed hybrid stream → ``total`` int32 values on device.
+
+    ``data``: (cap,) uint8 (the values section after the bit-width byte,
+    zero-padded); ``bit_width`` is static (host-read from the inflated page's
+    first byte). Returns ``(values (total,) int32, ok)``."""
+    import jax.numpy as jnp
+
+    interpret = _use_interpret() if interpret is None else interpret
+    bit_width = int(bit_width)
+    if bit_width == 0:
+        return jnp.zeros((total,), jnp.int32), jnp.asarray(True)
+    if bit_width > _MAX_BIT_WIDTH:
+        return jnp.zeros((total,), jnp.int32), jnp.asarray(False)
+    data = jnp.asarray(data)
+    max_runs = max(8, total)  # worst case: 1-value RLE runs
+    run_end, is_packed, rle_value, bit_base, nruns, ok = _rle_run_scan(
+        data, data_len, total, max_runs, bit_width)
+    i = jnp.arange(total, dtype=jnp.int32)
+    rid = jnp.searchsorted(run_end, i, side="right").astype(jnp.int32)
+    rid = jnp.clip(rid, 0, max_runs - 1)
+    run_start = jnp.where(rid == 0, 0, run_end[jnp.clip(rid - 1, 0,
+                                                        max_runs - 1)])
+    local = i - run_start
+    bitpos = bit_base[rid] + local * bit_width
+    byte_off = bitpos >> 3
+    shift = (bitpos & 7).astype(jnp.int32)
+    cap = data.shape[0]
+    gather = jnp.clip(byte_off[:, None] + jnp.arange(4)[None, :], 0, cap - 1)
+    win = data[gather]
+    mask = jnp.asarray([[(1 << bit_width) - 1]], jnp.int32)
+    fn = _extract_fn(int(total), bool(interpret))
+    values = fn(win, shift[:, None], is_packed[rid][:, None],
+                rle_value[rid][:, None], mask)
+    return values, ok
+
+
+def bitcast_values(raw_bytes, dtype):
+    """(n*itemsize,) uint8 → (n,) ``dtype`` on device (little-endian, which
+    both the CPU and TPU hosts are).
+
+    On x64-disabled runtimes jax canonicalizes 8-byte dtypes: the classic
+    path's ``device_put(np.int64 column)`` delivers int32 by value-truncation,
+    which for little-endian two's complement IS the low word — so INT64
+    bitcasts to (n, 2) int32 word pairs and keeps the low word, byte-identical
+    to the classic delivery. FLOAT64 would need a value-rounding conversion a
+    bitcast cannot express — it raises :class:`DeviceInflateError` and the
+    column takes the host-reference fallback (still compressed on the wire,
+    host-decoded before the transfer)."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = np.dtype(dtype)
+    k = dtype.itemsize
+    n = raw_bytes.shape[0] // k
+    words = raw_bytes[:n * k].reshape(n, k)
+    x64 = bool(jax.config.jax_enable_x64)
+    if k == 8 and not x64:
+        if dtype.kind == "f":
+            raise DeviceInflateError(
+                "float64 device inflate needs jax_enable_x64 (host fallback)")
+        pairs = jax.lax.bitcast_convert_type(
+            words.reshape(n, 2, 4), jnp.int32)
+        out = pairs[:, 0]
+        return out.astype(jnp.uint32) if dtype.kind == "u" else out
+    return jax.lax.bitcast_convert_type(words, jnp.dtype(dtype.name))
+
+
+# -- loader-facing orchestration -------------------------------------------------------
+
+def _pack_pages(chunk, pages):
+    """Host prep of the device transfer: pad the COMPRESSED page payloads into
+    one (n, src_cap) matrix + the (n, 2) page table. These bytes (plus the
+    table) are exactly the H2D traffic the pass-through ships."""
+    src_cap = max(p.comp_size for p in pages)
+    out_cap = max(p.uncomp_size for p in pages)
+    comp = np.zeros((len(pages), src_cap), np.uint8)
+    meta = np.zeros((len(pages), 2), np.int32)
+    for i, p in enumerate(pages):
+        payload = np.frombuffer(chunk.buf, np.uint8, count=p.comp_size,
+                                offset=p.payload_offset)
+        comp[i, :p.comp_size] = payload
+        meta[i] = (p.comp_size, p.uncomp_size)
+    return comp, meta, out_cap
+
+
+def _inflate_chunk_pages(chunk, pages, interpret):
+    """All of ``pages`` (+ data pages' raw bytes) inflated on device:
+    returns (raw (n, out_cap) uint8, meta, ok_all)."""
+    comp, meta, out_cap = _pack_pages(chunk, pages)
+    if chunk.codec == "SNAPPY":
+        raw, ok = snappy_inflate_pages(comp, meta, out_cap, interpret)
+    else:
+        raw, ok = stored_pages(comp, meta, out_cap)
+    return raw, meta, ok
+
+
+class DeviceInflateError(Exception):
+    """Internal: the device path bailed (ok flag latched false / unsupported
+    width) — the caller falls back to the host reference, which validates
+    fully and raises the classified error if the bytes are actually bad."""
+
+
+def inflate_window(chunk, skip, take, interpret=None):
+    """Rows ``[skip, skip+take)`` of one
+    :class:`~petastorm_tpu.io.pagedec.PassthroughChunk`, inflated on device
+    from the COVERING pages only (plus the dictionary page when one exists)
+    — cutting a row group into many batches ships and decodes each data
+    page at most twice (boundary pages), never the whole chunk per batch.
+    Raises :class:`DeviceInflateError` when any page's ok flag latches
+    false."""
+    import jax.numpy as jnp
+
+    interpret = _use_interpret() if interpret is None else interpret
+    p0, p1, base = chunk.covering_pages(skip, take)
+    data_pages = list(chunk.pages[p0:p1])
+    pages = ([chunk.dict_page] if chunk.dict_page is not None else []) \
+        + data_pages
+    raw, meta, ok = _inflate_chunk_pages(chunk, pages, interpret)
+    if not bool(jnp.all(ok)):
+        raise DeviceInflateError("page inflate kernel latched ok=False")
+    pos = 0
+    dict_vals = None
+    from petastorm_tpu.io import pagedec as _pd
+
+    if chunk.dict_page is not None:
+        dict_raw = raw[0, :chunk.dict_page.uncomp_size]
+        dict_vals = bitcast_values(dict_raw, chunk.dtype)
+        if chunk.dict_page.num_values > dict_vals.shape[0]:
+            raise DeviceInflateError("dictionary page shorter than its values")
+        dict_vals = dict_vals[:chunk.dict_page.num_values]
+        pos = 1
+    outs = []
+    for i, page in enumerate(data_pages):
+        body = raw[pos + i, :page.uncomp_size]
+        off = 0
+        if chunk.max_def:
+            if page.uncomp_size < 4:
+                raise DeviceInflateError("page too short for level block")
+            # the level-block length is part of the page layout: read the 4
+            # prefix bytes on host from the DEVICE array (4-byte D2H, not a
+            # decode) — offsets must be static for the slicing below
+            head = np.asarray(body[:4]).view("<u4")[0]
+            off = 4 + int(head)
+            if off > page.uncomp_size:
+                raise DeviceInflateError("level block past page end")
+        values = body[off:]
+        if page.encoding == _pd.ENC_PLAIN:
+            need = page.num_values * chunk.dtype.itemsize
+            if values.shape[0] < need:
+                raise DeviceInflateError("PLAIN page shorter than its values")
+            outs.append(bitcast_values(values[:need], chunk.dtype))
+        else:  # RLE_DICTIONARY / PLAIN_DICTIONARY
+            if dict_vals is None:
+                raise DeviceInflateError("dictionary page missing")
+            if values.shape[0] < 1:
+                raise DeviceInflateError("empty dictionary-index body")
+            bit_width = int(np.asarray(values[0]))
+            idx, ok = rle_expand(values[1:], int(values.shape[0] - 1),
+                                 bit_width, page.num_values, interpret)
+            if not bool(ok):
+                raise DeviceInflateError("RLE expansion latched ok=False")
+            in_range = jnp.all((idx >= 0) & (idx < dict_vals.shape[0]))
+            if not bool(in_range):
+                raise DeviceInflateError("dictionary index out of range")
+            outs.append(dict_vals[idx])
+    full = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+    return full[skip - base:skip - base + take]
+
+
+def inflate_chunk(chunk, interpret=None):
+    """All rows of one chunk (the full-range window) — test/CLI convenience."""
+    return inflate_window(chunk, 0, chunk.num_rows, interpret)
+
+
+def inflate_column(column, interpret=None):
+    """The loader's device inflate: a
+    :class:`~petastorm_tpu.io.pagedec.PassthroughColumn` window → the decoded
+    device array for exactly its rows, one covering-pages inflate per
+    window. Raises :class:`DeviceInflateError` for the caller's host
+    fallback."""
+    import jax.numpy as jnp
+
+    outs = []
+    for chunk, skip, take in column.parts:
+        if take == 0:
+            continue
+        outs.append(inflate_window(chunk, skip, take, interpret))
+    if not outs:
+        return jnp.zeros((0,), jnp.dtype(column.dtype.name))
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs)

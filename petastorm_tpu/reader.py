@@ -108,6 +108,33 @@ class _Tagged:
         if fn is not None:
             fn(monitor)
 
+    # -- live knob seam (ISSUE 13/14): forwarded so the pool control frame's
+    # -- apply_<knob>() dispatch reaches the real worker inside a child
+
+    def apply_readahead_depth(self, depth):
+        fn = getattr(self._worker, "apply_readahead_depth", None)
+        return fn(depth) if fn is not None else None
+
+    def apply_readahead_bytes(self, nbytes):
+        fn = getattr(self._worker, "apply_readahead_bytes", None)
+        return fn(nbytes) if fn is not None else None
+
+    def apply_remote_max_inflight(self, max_inflight):
+        fn = getattr(self._worker, "apply_remote_max_inflight", None)
+        return fn(max_inflight) if fn is not None else None
+
+    def apply_hedge_quantile(self, quantile):
+        fn = getattr(self._worker, "apply_hedge_quantile", None)
+        return fn(quantile) if fn is not None else None
+
+    def apply_pagedec(self, mode):
+        fn = getattr(self._worker, "apply_pagedec", None)
+        return fn(mode) if fn is not None else None
+
+    def live_io_knobs(self):
+        fn = getattr(self._worker, "live_io_knobs", None)
+        return fn() if fn is not None else {}
+
 
 #: Exception-module roots of the storage client stacks fsspec-bridged filesystems
 #: raise through pyarrow (gcsfs.retry.HttpError, botocore errors, aiohttp client
@@ -214,6 +241,13 @@ class _WorkerBase:
         #: child spawned after a retune inherits it through the pickle); the
         #: IoOptions struct itself is never mutated (graftlint GL-C004)
         self._knob_overrides = {}
+        #: pass-through negative memo (ISSUE 14): (path, column) pairs whose
+        #: chunks declined at the PAGE level (no byte saving / unsupported
+        #: encoding) — footer eligibility would otherwise re-fetch the raw
+        #: span on every read just to decline again. Conservative by design
+        #: (one declining chunk mutes the column for the whole file);
+        #: invalidate_pieces clears the path's entries on a rewrite.
+        self._pagedec_refused = set()
 
     def __getstate__(self):
         state = dict(self.__dict__)
@@ -280,6 +314,10 @@ class _WorkerBase:
                 # handle's size() doubles as the entry's validation token)
                 metadata = footers.get(self._fs, path, source=f).metadata
             pf = cache[path] = pq.ParquetFile(f, metadata=metadata)
+            # the open handle doubles as the pass-through path's raw-span
+            # reader (ISSUE 14): positional read_at calls never disturb the
+            # ParquetFile's own cursor
+            pf._ptpu_source = f
             while len(cache) > self.MAX_OPEN_FILES:  # LRU-evict to bound open fds
                 _, old = cache.popitem(last=False)
                 _close_quietly(old)
@@ -337,6 +375,10 @@ class _WorkerBase:
         invalidate = getattr(self._cache, "invalidate", None)
         for piece in pieces:
             self._evict_parquet_file(piece.path)
+            # a rewritten file may compress differently: let the pass-through
+            # re-judge its columns from the fresh bytes
+            self._pagedec_refused = {
+                t for t in self._pagedec_refused if t[0] != piece.path}
             if invalidate is not None:
                 for partition in range(max(1, self._drop_partitions)):
                     invalidate(_cache_key(
@@ -494,7 +536,19 @@ class _WorkerBase:
                 piece, partition = item
                 if self._cache_contains(piece, partition):
                     continue  # the (mem/disk) cache will serve it without a read
-                requests.append((piece, columns))
+                cols = columns
+                # pass-through columns (ISSUE 14) are fetched by the
+                # foreground read as raw pages — prefetching their DECODED
+                # form would read them twice and key-miss besides. peek_only:
+                # a prefetch never pays a footer fetch; until the footer is
+                # cached the hint simply requests the full (classic) set.
+                eligible = self._pagedec_eligible(piece, columns,
+                                                  peek_only=True)
+                if eligible:
+                    cols = [c for c in columns if c not in eligible]
+                    if not cols:
+                        continue  # nothing classic left to prefetch
+                requests.append((piece, cols))
             if requests:
                 pool.schedule(requests)
         except Exception as e:  # noqa: BLE001 — prefetch must never fail a read
@@ -639,6 +693,186 @@ class _WorkerBase:
         if mem is None:
             return 0
         return mem.apply_budget(nbytes)
+
+    # -- compressed-page pass-through (ISSUE 14) ----------------------------------------
+    #
+    # Eligible fixed-width columns skip pyarrow's host inflate entirely: the
+    # raw compressed pages ride the delivery path as opaque
+    # PassthroughColumn values and inflate on device in the loader
+    # (ops/pagedec_kernels.py). Ineligible columns degrade PER COLUMN to the
+    # classic read (cause=pagedec_ineligible, warn-once), so any dataset
+    # works unchanged. The whole mode is the IoOptions.pagedec auto/on/off
+    # knob — also a live enum Knob the controller can flip back to host
+    # inflate (apply_pagedec below).
+
+    #: per-row workers decode rows — pass-through is a batch-path feature
+    _pagedec_supported = False
+
+    def live_pagedec(self):
+        """The LIVE pagedec mode (override > options) — the knob getter."""
+        return self._knob_overrides.get("pagedec", self._io_options.pagedec)
+
+    def apply_pagedec(self, mode):
+        """Retune the pass-through mode live (lands on the next read; for
+        process pools the retune rides the pool control frame)."""
+        mode = str(mode).strip().lower()
+        if mode not in ("auto", "on", "off"):
+            raise ValueError("pagedec accepts auto/on/off, got %r" % mode)
+        self._knob_overrides["pagedec"] = mode
+        return mode
+
+    def _pagedec_active(self):
+        """Is pass-through live for this worker's reads? ``auto`` engages
+        only when a non-CPU jax backend is already initialized in THIS
+        process (no PCIe link to save otherwise, and pool children never pay
+        a jax import for the probe); ``on`` forces it (the pool wire ships
+        compressed either way)."""
+        if not self._pagedec_supported:
+            return False
+        mode = self.live_pagedec()
+        if mode == "off":
+            return False
+        if mode == "on":
+            return True
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return False
+        try:
+            return jax.default_backend() != "cpu"
+        except Exception:  # noqa: BLE001 — an uninitializable backend = no device
+            return False
+
+    def _pagedec_shape_ok(self):
+        """Row-selecting features (predicate/filter masks, row-drop
+        partitions) and in-worker rewrites (host transforms, NGram windows)
+        need decoded rows — the whole read falls back when any is
+        configured."""
+        return (self._predicate is None and not self._filters
+                and self._drop_partitions <= 1
+                and self._transform_spec is None
+                and getattr(self, "_ngram", None) is None)
+
+    def _pagedec_footer(self, path, peek_only=False):
+        """The parsed footer for eligibility classification, or ``None``.
+        ``peek_only`` (the prefetch path) never triggers IO — eligibility is
+        then simply unknown until the first real open caches the footer."""
+        footers = self._footer_cache()
+        if footers is not None:
+            entry = footers.peek(path)
+            if entry is not None:
+                return entry.metadata
+        if peek_only:
+            return None
+        engine = self._remote_engine(create=True)
+        try:
+            if engine is not None:
+                return engine.footer(path).metadata
+            return self._parquet_file(path).metadata
+        except Exception:  # noqa: BLE001 — the classic read will surface it
+            return None
+
+    def _pagedec_eligible(self, piece, wanted, peek_only=False):
+        """Footer-only eligibility: ``{column: (col_index, Eligibility)}``
+        for the eligible subset of ``wanted``. Cheap (no chunk bytes); the
+        walker's page-level gate runs after the raw spans arrive."""
+        if not self._pagedec_active() or not self._pagedec_shape_ok():
+            return {}
+        md = self._pagedec_footer(piece.path, peek_only=peek_only)
+        if md is None or piece.row_group >= md.num_row_groups:
+            return {}
+        from petastorm_tpu.io.pagedec import classify_chunk
+
+        names = set(wanted)
+        out = {}
+        rgmd = md.row_group(piece.row_group)
+        for i in range(rgmd.num_columns):
+            name = rgmd.column(i).path_in_schema.split(".")[0]
+            if name not in names or name in out \
+                    or (piece.path, name) in self._pagedec_refused:
+                continue
+            el = classify_chunk(md, piece.row_group, i)
+            if el.eligible:
+                out[name] = (i, el)
+        return out
+
+    def _pagedec_read(self, piece, eligible):
+        """Fetch + walk the eligible columns' raw chunk spans into
+        PassthroughColumn values (``io.pagedec`` span + chaos hook site).
+        Page-level ineligibility degrades per column; corruption raises
+        :class:`~petastorm_tpu.errors.PagedecCorruptError` (permanent,
+        quarantine-eligible)."""
+        from petastorm_tpu.io.pagedec import (PassthroughColumn, build_chunk,
+                                              chunk_byte_range,
+                                              pagedec_counters,
+                                              shared_page_index)
+        from petastorm_tpu.obs.log import degradation
+
+        out = {}
+        counters = pagedec_counters()
+        with _prov.span("io.pagedec"):
+            if _chaos.ACTIVE is not None:
+                _chaos.ACTIVE.hit("io.pagedec",
+                                  key="%s:%s" % (piece.path, piece.row_group))
+            md = self._pagedec_footer(piece.path)
+            if md is None:
+                # the footer vanished between eligibility and read (cache
+                # eviction + a failing re-fetch): degrade to the classic
+                # read, whose own path surfaces/classifies the real error
+                return {}
+            raws = self._pagedec_fetch_raw(piece, eligible)
+            rgmd = md.row_group(piece.row_group)
+            for name, (col_idx, el) in eligible.items():
+                raw = raws.get(name)
+                if raw is None:
+                    continue
+                chunk, reason = build_chunk(raw, el,
+                                            expected_values=rgmd.num_rows)
+                if chunk is None:
+                    counters["fallback_columns"].inc()
+                    # mute the column for this file: re-fetching its raw span
+                    # on every read just to decline again is pure overhead
+                    self._pagedec_refused.add((piece.path, name))
+                    degradation(
+                        "pagedec_ineligible",
+                        "column %r of %s degraded to the classic host-inflate "
+                        "path (%s); further per-column fallbacks are counted "
+                        "in ptpu_pagedec_fallback_columns_total",
+                        name, piece.path, reason)
+                    continue
+                start, _length = chunk_byte_range(rgmd.column(col_idx))
+                shared_page_index().put(
+                    piece.path, piece.row_group, name, start,
+                    [start + p.header_offset
+                     for p in ((chunk.dict_page,) if chunk.dict_page else ())
+                     + chunk.pages])
+                out[name] = PassthroughColumn.from_chunk(chunk)
+        return out
+
+    def _pagedec_fetch_raw(self, piece, eligible):
+        """Raw chunk byte spans for the eligible columns: ONE batched
+        ranged-GET plan through the remote engine (page-granular splits on
+        re-reads), or positional reads on the local open handle."""
+        engine = self._remote_engine(create=True)
+        if engine is not None:
+            return engine.read_raw_column_chunks(
+                piece.path, piece.row_group, list(eligible))
+        from petastorm_tpu.io.pagedec import chunk_byte_range
+
+        pf = self._parquet_file(piece.path)
+        source = getattr(pf, "_ptpu_source", None)
+        md = pf.metadata
+        rgmd = md.row_group(piece.row_group)
+        out = {}
+        for name, (col_idx, _el) in eligible.items():
+            start, length = chunk_byte_range(rgmd.column(col_idx))
+            if source is not None:
+                out[name] = bytes(source.read_at(length, start))
+            else:
+                with self._fs.open_input_file(piece.path) as f:
+                    out[name] = bytes(f.read_at(length, start))
+        return out
 
     # -- reads --------------------------------------------------------------------------
 
@@ -950,6 +1184,9 @@ class ArrowWorker(_WorkerBase):
     exists only on the per-row path (petastorm/ngram.py ~L40).
     """
 
+    #: the batch path delivers columns — the shape the pass-through can ride
+    _pagedec_supported = True
+
     def __init__(self, *args, ngram=None, **kwargs):
         super().__init__(*args, **kwargs)
         self._ngram = ngram
@@ -1006,7 +1243,29 @@ class ArrowWorker(_WorkerBase):
     def _load_columns(self, item):
         piece, partition = item
         wanted = list(self._read_schema.fields.keys())
-        table = self._read_columns(piece, self._first_read_columns())
+        # compressed-page pass-through (ISSUE 14): eligible fixed-width
+        # columns ship their raw compressed pages as opaque columnar values;
+        # the classic read below fetches only the remainder. Eligibility is
+        # footer-only here (cheap) — the page walk inside _pagedec_read may
+        # still degrade a column back (per-column fallback).
+        passthrough = {}
+        eligible = self._pagedec_eligible(piece, wanted)
+        if eligible:
+            # same transient-retry budget as any other read of this piece;
+            # PagedecCorruptError is PERMANENT (fails fast -> quarantinable)
+            passthrough = self._retry_io(
+                lambda: self._pagedec_read(piece, eligible), piece.path,
+                "%s row group %d (pagedec)" % (piece.path, piece.row_group))
+        read_columns = self._first_read_columns()
+        if passthrough:
+            read_columns = [c for c in read_columns if c not in passthrough]
+        if not read_columns:
+            # every wanted column passed through: nothing left to decode on
+            # the host, but the generation contract (ISSUE 11) still holds
+            if getattr(piece, "generation", None) is not None:
+                self._verify_generation(piece)
+            return dict(passthrough)
+        table = self._read_columns(piece, read_columns)
         mask = self._row_mask(table)
         indices = np.arange(table.num_rows)
         if mask is not None:
@@ -1031,6 +1290,7 @@ class ArrowWorker(_WorkerBase):
                     raise _annotate_decode_error(
                         DecodeFieldError("Unable to decode field %r: %s" % (name, e)),
                         piece) from e
+        out.update(passthrough)
         return out
 
 
@@ -1481,6 +1741,12 @@ class Reader:
         self._resume_epoch = 0  # every epoch below this is fully consumed
         self.last_row_consumed = False
         self.stopped = False
+        #: compressed-page pass-through adoption (ISSUE 14): False (the
+        #: default) materializes PassthroughColumn values into decoded
+        #: arrays at delivery — loader-less consumers see ordinary batches;
+        #: the DataLoader sets True and finishes the inflate itself (on
+        #: device when a non-CPU backend is live)
+        self.keep_passthrough = False
         #: lease of the CURRENT batch/row-buffer on a view-mode wire — held
         #: until the consumer asks for the next batch (or calls release_batch()
         #: / takes ownership via take_lease())
@@ -1787,6 +2053,13 @@ class Reader:
             if self._prov is not None:
                 self._prov.note_delivery(
                     epoch, ordinal, len(next(iter(columns.values()))))
+            if not self.keep_passthrough:
+                # no loader adopted the pass-through: this consumer expects
+                # decoded arrays — the numpy reference twin IS the designed
+                # host decode for loader-less readers (no degradation)
+                from petastorm_tpu.io.pagedec import materialize_columns
+
+                columns = materialize_columns(columns)
             if self.ngram is not None:
                 # flat 'offset/field' window columns cannot be namedtuple
                 # attributes — batched NGram delivers plain dicts
@@ -1936,7 +2209,48 @@ class Reader:
         set_lookahead = getattr(self._executor, "set_lookahead", None)
         if set_lookahead is not None and self._io_options.readahead:
             set_lookahead(applied)
+        self._broadcast_child_knobs({"readahead_depth": applied})
         return applied
+
+    def apply_readahead_bytes(self, nbytes):
+        fn = getattr(self._worker, "apply_readahead_bytes", None)
+        applied = fn(nbytes) if fn is not None else max(0, int(nbytes))
+        self._broadcast_child_knobs({"readahead_bytes": applied})
+        return applied
+
+    def apply_remote_max_inflight(self, max_inflight):
+        fn = getattr(self._worker, "apply_remote_max_inflight", None)
+        applied = fn(max_inflight) if fn is not None \
+            else max(1, int(max_inflight))
+        self._broadcast_child_knobs({"remote_max_inflight": applied})
+        return applied
+
+    def apply_hedge_quantile(self, quantile):
+        fn = getattr(self._worker, "apply_hedge_quantile", None)
+        applied = fn(quantile) if fn is not None \
+            else min(0.999, max(0.5, float(quantile)))
+        self._broadcast_child_knobs({"hedge_quantile": applied})
+        return applied
+
+    def apply_pagedec(self, mode):
+        """Retune the compressed-page pass-through mode live (ISSUE 14):
+        the controller's revert-to-host-inflate lever. Lands on the worker's
+        next read; pool children receive it through the control frame."""
+        fn = getattr(self._worker, "apply_pagedec", None)
+        applied = fn(mode) if fn is not None else str(mode)
+        self._broadcast_child_knobs({"pagedec": applied})
+        return applied
+
+    def _broadcast_child_knobs(self, knobs):
+        """Live cross-process actuation (ISSUE 14 satellite, PR 13's declared
+        leftover): a process pool's children own their IO runtimes — the
+        parent-side setters above cannot reach them, so the applied values
+        also ride a small control frame on the existing pool wire (beside
+        the slab-grant protocol) to every ALREADY-RUNNING child. Thread/dummy
+        pools share the worker object and need no frame."""
+        fn = getattr(self._executor, "broadcast_io_knobs", None)
+        if fn is not None:
+            fn(dict(knobs))
 
     @property
     def wire_views(self):

@@ -740,6 +740,17 @@ class ProcessExecutor(ExecutorBase):
         #: (pings are drained by the driver before every result header)
         self._ping_interval_s = float(
             os.environ.get("PTPU_CHILD_PING_S", "") or 5.0)
+        #: live cross-process knob actuation (ISSUE 14 satellite): pending
+        #: control-frame payload + a version stamp per driver; drivers send
+        #: the frame on the pool wire (beside the slab-grant protocol) before
+        #: their next item dispatch, children apply and ack. All under
+        #: _ctl_lock — broadcast_io_knobs() is called from the controller
+        #: thread while drivers read concurrently.
+        self._ctl_lock = threading.Lock()
+        self._ctl_pending = {}
+        self._ctl_version = 0
+        self._ctl_seen = {}   # driver idx -> version last sent to its child
+        self._ctl_acks = {}   # driver idx -> {knob: applied value}
 
     def start(self, worker, plan):
         import os
@@ -1318,11 +1329,13 @@ class ProcessExecutor(ExecutorBase):
             once=False)
         return conn
 
-    def _recv_result(self, conn, child_hb):
+    def _recv_result(self, conn, child_hb, idx=None):
         """Receive the next result/exc header, draining child heartbeat pings
         (``("hb", ts)`` — sent at item receipt and while idle) into the
-        child's heartbeat stamp. Children always ping; without a monitor the
-        pings are simply dropped here (one tuple check per message).
+        child's heartbeat stamp, and control-frame acks (``("ctl_ack",
+        applied)``) into the pool's ack ledger. Children always ping; without
+        a monitor the pings are simply dropped here (one tuple check per
+        message).
 
         The receive is a bounded poll loop, not a bare ``recv()`` (GL-R001):
         once the pool is stopping this driver abandons the wait promptly —
@@ -1339,7 +1352,43 @@ class ProcessExecutor(ExecutorBase):
                 if child_hb is not None:
                     child_hb.beat("working")
                 continue
+            if isinstance(msg, tuple) and len(msg) == 2 and msg[0] == "ctl_ack":
+                if idx is not None:
+                    with self._ctl_lock:
+                        self._ctl_acks.setdefault(idx, {}).update(msg[1] or {})
+                continue
             return msg
+
+    # -- live cross-process knobs (ISSUE 14 satellite) ----------------------------------
+
+    def broadcast_io_knobs(self, knobs):
+        """Queue a ``{knob: value}`` retune for every RUNNING child: each
+        driver sends one ``("ctl", knobs)`` frame on its item pipe before its
+        next dispatch (beside the slab-grant protocol), the child applies via
+        its worker's ``apply_<knob>()`` seam and acks. Children spawned AFTER
+        the retune inherit it through the worker pickle instead (the PR 13
+        behavior, now the backstop rather than the only path)."""
+        if not knobs:
+            return
+        with self._ctl_lock:
+            self._ctl_pending.update(knobs)
+            self._ctl_version += 1
+
+    def _pending_ctl(self, idx):
+        """The control frame driver ``idx`` still owes its child, or None."""
+        with self._ctl_lock:
+            if not self._ctl_pending \
+                    or self._ctl_seen.get(idx, 0) == self._ctl_version:
+                return None
+            self._ctl_seen[idx] = self._ctl_version
+            return dict(self._ctl_pending)
+
+    def ctl_acks(self):
+        """``{driver idx: {knob: applied value}}`` — which children confirmed
+        a live retune (the autotune harness asserts a child-side retune lands
+        WITHOUT a respawn)."""
+        with self._ctl_lock:
+            return {idx: dict(acks) for idx, acks in self._ctl_acks.items()}
 
     def _drive_child(self, conn, dispatch, idx):
         import time
@@ -1412,10 +1461,17 @@ class ProcessExecutor(ExecutorBase):
                         if _chaos.ACTIVE is not None:
                             _chaos.ACTIVE.hit("pool.dispatch",
                                               key=_chaos.item_key(item))
+                        ctl = self._pending_ctl(idx)
+                        if ctl is not None:
+                            # live knob control frame (ISSUE 14 satellite):
+                            # the retune rides the item pipe ahead of the
+                            # next dispatch — the child applies + acks, no
+                            # respawn involved
+                            conn.send(("ctl", ctl))
                         t_send = time.perf_counter() if prov is not None else 0.0
                         conn.send((slab, item, hints) if ring is not None
                                   else (item, hints))
-                        header = self._recv_result(conn, child_hb)
+                        header = self._recv_result(conn, child_hb, idx=idx)
                         if prov is not None:
                             # the child's own spans nest INSIDE this roundtrip
                             # once merged — the flame fold charges the wire the
@@ -1554,6 +1610,10 @@ class ProcessExecutor(ExecutorBase):
                         except OSError:
                             pass
                         conn = replacement
+                        with self._ctl_lock:
+                            # the fresh child inherited current knob overrides
+                            # through the worker pickle — no frame owed
+                            self._ctl_seen[idx] = self._ctl_version
                         if poison:
                             break  # quarantined: the fresh child takes the NEXT item
                         continue  # re-dispatch the SAME item on the fresh child
