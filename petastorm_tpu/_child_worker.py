@@ -52,7 +52,20 @@ from multiprocessing.connection import Client
 def main():
     address = sys.argv[1]
     authkey = sys.stdin.buffer.read(32)
-    conn = Client(address, authkey=authkey)
+    link_down = ()  # a dead pipe cannot heal: EOF/reset = parent gone
+    if address.startswith("tcp:"):
+        # framed tcp transport (ISSUE 15): the child dials the parent's hub
+        # and REDIALS with jittered backoff on any link death — a healed link
+        # surfaces as TransportLinkDown (caught by the work loop, which
+        # discards the broken conversation and awaits the re-dispatch);
+        # an unreachable parent surfaces as EOFError like a closed pipe.
+        from petastorm_tpu.errors import TransportLinkDown
+        from petastorm_tpu.transport.tcp import connect_child_tcp
+
+        conn = connect_child_tcp(address, authkey)
+        link_down = TransportLinkDown
+    else:
+        conn = Client(address, authkey=authkey)
     serializer = None
     worker = None
     # clock-alignment anchors: one wall/perf pair, sampled back to back so the
@@ -99,6 +112,10 @@ def main():
         # bookkeeping (accept order is not spawn order) — the heal tier kills
         # hung children by exactly this mapping (ISSUE 7)
         conn.send(("pid", pid))
+        if hasattr(conn, "mark_ready"):
+            # tcp steady state: transport heartbeats + chaos sites engage
+            # only after the bootstrap handshake completed
+            conn.mark_ready()
         # chaos bootstrap (ISSUE 7): a parent armed while spawning exports its
         # FaultPlan as PTPU_CHAOS_SPEC; in-child hook sites (child.item, plus
         # the worker's own reader.read/io.readahead) evaluate this process's
@@ -117,6 +134,12 @@ def main():
         _prov.arm_child()
         prefetch = getattr(worker, "prefetch", None)
         while True:
+          # one indent level for the whole conversation: a TcpTransport link
+          # death ANYWHERE in it (item receive, result/exc send) lands in the
+          # except at the bottom — the transport already redialed, the broken
+          # conversation's result is discarded, and the loop waits for the
+          # parent's re-dispatch. Pipe links never raise it (empty tuple).
+          try:
             if ping_s:
                 # idle heartbeat: prove liveness while waiting for work (the
                 # driver drains these; they never interleave with result frames
@@ -183,6 +206,8 @@ def main():
                     try:
                         pickle.dumps(e)
                         conn.send(("exc", e))
+                    except link_down:
+                        raise  # to the conversation-level handler below
                     except Exception:  # unpicklable exception: reconstruct
                         conn.send(("exc", RuntimeError(
                             "%s: %s" % (type(e).__name__, e))))
@@ -198,6 +223,12 @@ def main():
                        (pid, wall_anchor, perf_anchor, spans, prov_blob)))
             for frame in frames:
                 conn.send_bytes(frame)
+          except link_down:
+            # the link died but REDIALED (an unreachable parent raises
+            # EOFError instead, handled with the pipe's below): whatever this
+            # conversation was — a result half-sent, an item half-received —
+            # is void; the parent's in-flight ledger re-dispatches it.
+            continue
     except (EOFError, BrokenPipeError, ConnectionResetError):
         return
     finally:

@@ -15,6 +15,7 @@ from petastorm_tpu.analysis.rules.observability import (
 from petastorm_tpu.analysis.rules.robustness import (
     StatThenOpenRule,
     UnboundedBlockingCallRule,
+    UnboundedSocketRule,
 )
 from petastorm_tpu.analysis.rules.schema import SchemaCodecContractRule
 from petastorm_tpu.analysis.rules.tracing import (
@@ -40,6 +41,7 @@ ALL_RULES = [
     SleepyPollLoopRule,
     UnboundedBlockingCallRule,
     StatThenOpenRule,
+    UnboundedSocketRule,
 ]
 
 __all__ = [cls.__name__ for cls in ALL_RULES] + ["ALL_RULES"]

@@ -1,9 +1,13 @@
-"""Robustness rule family (ISSUE 7, extended by ISSUE 11).
+"""Robustness rule family (ISSUE 7, extended by ISSUES 11 and 15).
 
 GL-R001: unbounded blocking calls in pipeline code.
 GL-R002: stat-then-open TOCTOU windows — validating a path via
 ``os.stat``/``os.path.getsize``/``os.path.getmtime`` and later ``open()``-ing
 it without re-checking a validation token.
+GL-R003: unbounded sockets — a ``socket.socket()`` that reaches a blocking
+use (``recv``/``accept``/``connect``) with no ``settimeout`` anywhere on the
+same receiver chain (ISSUE 15: the framed transport's contract is that every
+socket wait ticks and re-checks its deadline/stop condition).
 
 At pod scale the failure mode that hurts most is not a crash but a *hang*: a
 thread parked forever in ``queue.get()`` / ``Connection.recv()`` /
@@ -164,6 +168,125 @@ class UnboundedBlockingCallRule(Rule):
             return False
         # thread.join(timeout) / event.wait(timeout): 1st positional is it
         return len(call.args) >= 1 and bounded(call.args[0])
+
+
+#: socket methods whose unbounded form blocks forever on a quiet peer
+_SOCKET_BLOCKING = frozenset(("recv", "recv_into", "recvfrom", "accept",
+                              "connect"))
+
+
+class UnboundedSocketRule(Rule):
+    """GL-R003 (ISSUE 15): a raw socket used to block without a timeout.
+
+    At pod scale a socket parked forever in ``recv()``/``accept()`` against a
+    dead or half-open peer is the same silent hang GL-R001 polices for queues
+    and pipes — except the peer is now a *network* away, where "gone without
+    a FIN" is the common failure, not the exotic one. The transport plane's
+    contract (``petastorm_tpu/transport/tcp.py``) is that every socket
+    carries a tick timeout and every wait re-checks its deadline between
+    ticks; this rule keeps that true for future socket code.
+
+    Tracking mirrors GL-R001's receiver typing: variables (or ``self.<attr>``
+    chains) assigned from ``socket.socket(...)`` / ``socket.create_connection
+    (...)`` — including the first element of a ``conn, addr = srv.accept()``
+    tuple unpack — are typed as sockets module-wide. A blocking call
+    (``recv``/``recv_into``/``recvfrom``/``accept``/``connect``) on a tracked
+    chain is flagged unless the chain is BOUNDED somewhere in the module:
+
+    - ``<chain>.settimeout(x)`` with a non-None ``x`` (a ``settimeout(None)``
+      re-flags it — that is "blocking forever" spelled out);
+    - ``<chain>.setblocking(False)`` (non-blocking mode);
+    - the socket came from ``socket.create_connection(..., timeout=...)``
+      (the stdlib applies the timeout to the returned socket).
+
+    Untyped receivers are left alone (same philosophy as GL-R001: drowning
+    real findings in false positives helps nobody); justified unbounded
+    sockets carry an inline ``# graftlint: disable=GL-R003`` with the reason.
+    """
+
+    rule_id = "GL-R003"
+    severity = Severity.WARNING
+    description = ("unbounded socket: blocking use (recv/accept/connect) of a "
+                   "socket with no settimeout on its chain — a dead or "
+                   "half-open peer hangs this thread forever")
+    fix_hint = ("call settimeout(t) on the socket before blocking (and "
+                "re-check a deadline/stop condition per tick), use "
+                "create_connection(..., timeout=...), or justify with an "
+                "inline '# graftlint: disable=GL-R003' comment")
+
+    def check(self, tree, ctx):
+        socks, bounded = self._collect(tree)
+        if not socks:
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            recv = attr_chain(node.func.value)
+            if recv not in socks or recv in bounded:
+                continue
+            if node.func.attr not in _SOCKET_BLOCKING:
+                continue
+            yield ctx.finding(
+                self, node,
+                "%s.%s() on a socket with no settimeout anywhere on its "
+                "chain blocks forever if the peer is gone or half-open — "
+                "bound it with settimeout(t) and re-check a deadline per "
+                "tick" % (recv, node.func.attr))
+
+    @staticmethod
+    def _collect(tree):
+        """``(socket chains, bounded chains)`` from module-wide assignments:
+        a chain is bounded by a non-None ``settimeout``, a
+        ``setblocking(False)``, or a ``create_connection`` timeout."""
+        socks = set()
+        bounded = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                name = call_func_name(node.value)
+                ctor = name in ("socket", "create_connection")
+                if name == "accept":
+                    # conn, addr = srv.accept(): the FIRST unpack element is
+                    # the new socket (plain targets get the tuple, untracked)
+                    for target in node.targets:
+                        if isinstance(target, (ast.Tuple, ast.List)) \
+                                and target.elts:
+                            chain = attr_chain(target.elts[0])
+                            if chain is not None:
+                                socks.add(chain)
+                    continue
+                if not ctor:
+                    continue
+                timeout = call_kwarg(node.value, "timeout")
+                has_timeout = name == "create_connection" and (
+                    len(node.value.args) >= 2
+                    or (timeout is not None
+                        and not (isinstance(timeout, ast.Constant)
+                                 and timeout.value is None)))
+                for target in node.targets:
+                    chain = attr_chain(target)
+                    if chain is None:
+                        continue
+                    socks.add(chain)
+                    if has_timeout:
+                        bounded.add(chain)
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                recv = attr_chain(node.func.value)
+                if recv is None:
+                    continue
+                if node.func.attr == "settimeout" and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) and arg.value is None:
+                        bounded.discard(recv)  # "block forever", spelled out
+                    else:
+                        bounded.add(recv)
+                elif node.func.attr == "setblocking" and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        node.args[0].value is False:
+                    bounded.add(recv)
+        return socks, bounded
 
 
 #: callables whose dotted name (or bare from-import name) marks their first

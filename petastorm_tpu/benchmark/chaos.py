@@ -90,7 +90,8 @@ def _leaked_total():
 
 
 def _run_scenario(name, root, expected_ids, pool, plan, recovery=None,
-                  wire=None, health=None, workers=2, timeout_s=180.0):
+                  wire=None, health=None, workers=2, timeout_s=180.0,
+                  transport=None):
     """One epoch under an armed plan; returns the scenario result dict and
     raises AssertionError the moment the invariant breaks."""
     import gc
@@ -108,7 +109,7 @@ def _run_scenario(name, root, expected_ids, pool, plan, recovery=None,
             "file://" + root, num_epochs=1, shuffle_row_groups=False,
             reader_pool_type=pool, workers_count=workers,
             results_timeout_s=timeout_s, wire_serializer=wire,
-            recovery=recovery)
+            recovery=recovery, transport=transport)
         delivered = []
         wire_stats = {}
         try:
@@ -142,6 +143,7 @@ def _run_scenario(name, root, expected_ids, pool, plan, recovery=None,
     quarantined = _quarantined_ids(report)
     result = {
         "scenario": name, "pool": pool, "wire": wire or "default",
+        "transport": transport or "pipe",
         "delivered": len(delivered), "quarantined_items": len(report),
         "quarantined_rows": len(quarantined),
         "injected": plan.stats()["injected_total"],
@@ -212,6 +214,78 @@ def _scenarios(files, smoke):
                       hang_s=60.0),
         ], seed=7), RecoveryOptions(worker_respawns=4 * files), "heal"),
     ]
+
+
+# -- network scenario (ISSUE 15) ---------------------------------------------------------
+
+
+def _run_transport_identity(root, expected_count):
+    """Clean-run twin check: the default pipe pool and the tcp pool must
+    deliver BYTE-IDENTICAL payloads (per-id crc over the float column) — the
+    framed transport is a wire, not a transform."""
+    import zlib
+
+    from petastorm_tpu.reader import make_batch_reader
+
+    def run(transport):
+        reader = make_batch_reader(
+            "file://" + root, num_epochs=1, shuffle_row_groups=False,
+            reader_pool_type="process", workers_count=2, transport=transport)
+        out = {}
+        try:
+            for batch in reader:
+                for i, x in zip(np.asarray(batch.id), np.asarray(batch.x)):
+                    out[int(i)] = zlib.crc32(np.float64(x).tobytes())
+        finally:
+            reader.stop()
+            reader.join()
+        return out
+
+    pipe, tcp = run(None), run("tcp")
+    assert len(pipe) == expected_count, len(pipe)
+    assert pipe == tcp, \
+        "transport identity: pipe vs tcp delivered payloads differ"
+    return len(pipe)
+
+
+def _run_network(root, expected_ids, timeout_s=180.0):
+    """Seeded partition/reset/slow/corrupt-frame injection on a loopback
+    ``TcpTransport`` pool. ``worker_respawns=0`` makes the assertion sharp:
+    every injected link fault must be absorbed by RECONNECT + ledgered
+    re-dispatch alone (a reconnect slower than the configured ceiling would
+    surface as ``WorkerDiedError`` and fail the scenario), and no plan item
+    may quarantine — link faults re-dispatch, they do not poison."""
+    from petastorm_tpu.chaos import FaultPlan, FaultRule
+    from petastorm_tpu.obs.metrics import default_registry
+    from petastorm_tpu.recovery import RecoveryOptions
+
+    recovery = RecoveryOptions(
+        on_poison="quarantine", poison_attempts=10, worker_respawns=0,
+        io_retry_backoff_s=0.01, link_heartbeat_s=0.2, link_miss_threshold=3,
+        link_reconnect_s=8.0, link_connect_timeout_s=5.0)
+    plan = FaultPlan([
+        FaultRule("transport.send", "net.slow", every=7, latency_s=0.005),
+        FaultRule("transport.send", "net.reset", nth=5, times=1),
+        FaultRule("transport.send", "net.corrupt_frame", nth=11, times=1),
+        # the drop window (latency_s) sits ABOVE the half-open threshold
+        # (miss_threshold x heartbeat = 0.6s): detection — and therefore
+        # teardown + re-dispatch — is guaranteed, not probabilistic
+        FaultRule("transport.send", "net.partition", nth=17, times=1,
+                  latency_s=1.0),
+    ], seed=7)
+    reconnects = default_registry().counter("ptpu_net_reconnects_total")
+    before = reconnects.value
+    result = _run_scenario("network", root, expected_ids, "process", plan,
+                           recovery=recovery, transport="tcp",
+                           timeout_s=timeout_s)
+    delta = reconnects.value - before
+    assert delta >= 1, \
+        "network: no transport reconnect observed (delta=%d)" % delta
+    assert result["quarantined_items"] == 0, \
+        "network: link faults must re-dispatch, not quarantine (%d items)" \
+        % result["quarantined_items"]
+    result["reconnects"] = delta
+    return result
 
 
 # -- mutating-dataset scenario (ISSUE 11) ------------------------------------------------
@@ -408,7 +482,12 @@ def main(argv=None):
                         help="rows per file; default 64 (smoke) / 512")
     parser.add_argument("--scenario", default=None,
                         help="run only this scenario (by name)")
+    parser.add_argument("scenario_pos", nargs="?", default=None,
+                        metavar="SCENARIO",
+                        help="positional form of --scenario "
+                             "(petastorm-tpu-bench chaos network --smoke)")
     args = parser.parse_args(argv)
+    args.scenario = args.scenario or args.scenario_pos
 
     files = args.files or (8 if args.smoke else 16)
     rows = args.rows_per_file or (64 if args.smoke else 512)
@@ -443,6 +522,23 @@ def main(argv=None):
                          result["heals"], result["lease_leak_delta"],
                          result["seconds"]))
                 results.append(result)
+
+        # network scenario (ISSUE 15): seeded link faults on the loopback
+        # tcp transport — reconnect + ledgered re-dispatch must carry the
+        # epoch with a ZERO respawn budget; plus the clean-run pipe-vs-tcp
+        # byte-identity twin
+        if not args.scenario or args.scenario == "network":
+            count = _run_transport_identity(root, len(expected))
+            print("chaos %-13s %-8s pipe vs tcp byte-identical over %d rows"
+                  % ("transport-id", "process", count))
+            result = _run_network(root, expected)
+            print("chaos %-13s %-8s delivered=%-6d quarantined=%-3d "
+                  "injected=%-3d reconnects=%d leak_delta=%d %.2fs"
+                  % ("network", "process", result["delivered"],
+                     result["quarantined_rows"], result["injected"],
+                     result["reconnects"], result["lease_leak_delta"],
+                     result["seconds"]))
+            results.append(result)
 
     # mutating-dataset (ISSUE 11) runs against its own per-run dataset dirs
     # (the mutations destroy them); at least 16 files so the pools' claimed/

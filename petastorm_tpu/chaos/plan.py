@@ -36,6 +36,13 @@ dataset.mutate     ``DatasetWatcher.poll_once`` (ISSUE 11) — once per watch
                    tick when a mutator is attached; the only site where the
                    ``remove_file``/``rewrite_file``/``append_piece`` actions
                    mutate a real dataset
+transport.send     ``transport/tcp.py`` (ISSUE 15) — one hit per outbound
+                   wire frame of a READY tcp link (parent and child evaluate
+                   their own per-process plan copies); where the
+                   ``net.partition``/``net.reset``/``net.slow``/
+                   ``net.corrupt_frame`` actions live
+transport.recv     same, per inbound frame (before the crc check, so
+                   ``net.corrupt_frame`` is caught by the trailer)
 =================  ====================================================
 
 Every injected fault is recorded: a ``ptpu_degradations_total{cause=
@@ -52,7 +59,35 @@ import time
 import zlib
 
 _ACTIONS = ("raise_transient", "raise_permanent", "latency", "corrupt",
-            "kill", "hang", "remove_file", "rewrite_file", "append_piece")
+            "kill", "hang", "remove_file", "rewrite_file", "append_piece",
+            "net.partition", "net.reset", "net.slow", "net.corrupt_frame")
+
+#: network fault actions (ISSUE 15): evaluated at the framed transport's
+#: ``transport.send``/``transport.recv`` hook sites, where the payload is one
+#: raw wire frame. ``net.partition`` opens a drop window of ``latency_s``
+#: seconds on the firing rule — every frame matching that rule's site pattern
+#: inside the window returns :data:`DROPPED`; the transport then DROPS
+#: heartbeat frames (starving the peer's half-open detector — the partition's
+#: observable signal) but STALLS app frames at the send site until the window
+#: closes or the link dies under them (reliable-transport semantics: TCP
+#: retransmits through a partition, so data is delayed or the connection is
+#: torn down — never silently lost). ``net.reset`` raises a
+#: ``ConnectionResetError`` the transport turns into a REAL socket teardown
+#: (mid-frame reset); ``net.slow`` sleeps ``latency_s`` per frame;
+#: ``net.corrupt_frame`` flips a byte the receiver's crc32 trailer catches.
+_NET_ACTIONS = ("net.partition", "net.reset", "net.slow", "net.corrupt_frame")
+
+
+class _Dropped:
+    """Sentinel returned by :meth:`FaultPlan.hit` when a ``net.partition``
+    window swallowed the frame — transports check ``payload is DROPPED`` and
+    pretend the frame was sent/never arrived."""
+
+    def __repr__(self):
+        return "<chaos DROPPED frame>"
+
+
+DROPPED = _Dropped()
 
 #: dataset-mutation actions (ISSUE 11): evaluated at the ``dataset.mutate``
 #: hook site, where the payload is a mutator object (e.g.
@@ -96,7 +131,12 @@ class FaultRule:
         ``FileNotFoundError`` — never retried), ``latency`` (sleep
         ``latency_s``), ``corrupt`` (flip a byte in the site's payload — only
         meaningful at ``wire.decode``), ``kill`` (``os._exit`` — pool children
-        only), ``hang`` (sleep ``hang_s``, the stall-watchdog's prey).
+        only), ``hang`` (sleep ``hang_s``, the stall-watchdog's prey), or a
+        ``transport.*`` network fault (ISSUE 15): ``net.partition`` (drop
+        every frame matching this rule's site pattern for ``latency_s``
+        seconds), ``net.reset`` (mid-frame connection reset), ``net.slow``
+        (per-frame latency), ``net.corrupt_frame`` (byte flip caught by the
+        receiver's crc32 trailer).
     nth : int, optional
         Fire on the Nth matching hit (1-based), counted per rule per process.
     every : int, optional
@@ -204,6 +244,10 @@ class FaultPlan:
         self._lock = threading.Lock()
         self._hits = [0] * len(self._rules)   # matching hits per rule
         self._fires = [0] * len(self._rules)  # executed actions per rule
+        #: rule idx -> monotonic deadline of an OPEN net.partition window:
+        #: frames matching that rule's site pattern are dropped until then
+        self._drop_until = {}
+        self._dropped_frames = 0
         self._ledger = []
         self._max_ledger = int(max_ledger)
 
@@ -217,9 +261,13 @@ class FaultPlan:
         """Evaluate every rule against one hook-site hit.
 
         May sleep (``latency``/``hang``), raise (``raise_*``), exit the
-        process (``kill``, opted-in processes only), or return a corrupted
-        copy of ``payload`` (``corrupt``); returns ``payload`` unchanged when
-        nothing fires. Hook sites call this only when a plan is armed."""
+        process (``kill``, opted-in processes only), return a corrupted copy
+        of ``payload`` (``corrupt``/``net.corrupt_frame``), or return
+        :data:`DROPPED` (an open ``net.partition`` window swallowed the
+        frame); returns ``payload`` unchanged when nothing fires. Hook sites
+        call this only when a plan is armed."""
+        if self._drop_until and self._in_drop_window(site):
+            return DROPPED
         for idx, rule in enumerate(self._rules):
             if not fnmatch.fnmatchcase(site, rule.site):
                 continue
@@ -234,6 +282,21 @@ class FaultPlan:
                 self._fires[idx] += 1
             payload = self._execute(rule, idx, site, key, payload)
         return payload
+
+    def _in_drop_window(self, site):
+        """Is ``site`` inside an open ``net.partition`` window? Expired
+        windows are pruned; dropped frames are counted (not ledgered — a
+        partition drops heartbeats at wire rate and would flood it)."""
+        now = time.monotonic()
+        with self._lock:
+            for idx, deadline in list(self._drop_until.items()):
+                if now >= deadline:
+                    del self._drop_until[idx]
+                    continue
+                if fnmatch.fnmatchcase(site, self._rules[idx].site):
+                    self._dropped_frames += 1
+                    return True
+        return False
 
     def _should_fire(self, rule, idx, hit_no):
         """Caller holds the lock. Trigger conditions compose conjunctively."""
@@ -274,8 +337,24 @@ class FaultPlan:
             raise FileNotFoundError(
                 rule.message or "chaos-injected permanent IO error at %s (%s)"
                 % (site, key))
-        if action == "corrupt":
+        if action in ("corrupt", "net.corrupt_frame"):
             return _corrupt_payload(payload, self.seed, idx)
+        if action == "net.slow":
+            time.sleep(rule.latency_s)
+            return payload
+        if action == "net.reset":
+            # the transport turns this into a REAL socket teardown (so the
+            # peer observes it too): exactly a mid-frame connection reset
+            raise ConnectionResetError(
+                rule.message or "chaos net.reset at %s (%s)" % (site, key))
+        if action == "net.partition":
+            # open the drop window (latency_s doubles as its duration) and
+            # swallow the triggering frame; subsequent frames matching this
+            # rule's site pattern vanish until the window closes
+            with self._lock:
+                self._drop_until[idx] = time.monotonic() + rule.latency_s
+                self._dropped_frames += 1
+            return DROPPED
         if action in _MUTATE_ACTIONS:
             # the dataset.mutate hook site passes a mutator object as the
             # payload; the action is a method call on it with the rule's spec
@@ -330,6 +409,7 @@ class FaultPlan:
                 "hits": list(self._hits),
                 "fires": list(self._fires),
                 "injected_total": sum(self._fires),
+                "dropped_frames": self._dropped_frames,
             }
 
     # -- (de)serialization --------------------------------------------------------------

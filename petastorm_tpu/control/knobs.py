@@ -221,7 +221,12 @@ def build_knobset(reader):
     is shared, components directly actuable) AND for process pools whose
     executor supports the pool control frame (ISSUE 14 satellite: retunes
     reach already-running children live; spawned-later children inherit via
-    the worker pickle as before):
+    the worker pickle as before). The frame rides whatever transport the
+    pool runs (ISSUE 15): over ``transport="tcp"`` it crosses the framed
+    link like any result conversation — acked, seen-version stamped, and
+    respawn-free — and a frame that dies with a link is re-armed on the
+    reconnected one, so live retunes reach remote fleets the day a
+    dispatcher exists:
 
     - ``readahead_depth`` / ``readahead_bytes`` — the prefetcher's in-flight
       and held-byte bounds (depth also resizes the dispatch lookahead and the

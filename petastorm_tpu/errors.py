@@ -95,6 +95,28 @@ class PagedecCorruptError(PetastormTpuError):
     out-of-bounds read."""
 
 
+class TransportLinkDown(ConnectionResetError):
+    """A framed transport link (ISSUE 15) died mid-conversation: socket error,
+    clean EOF from the peer, a heartbeat-detected half-open connection, or a
+    replaced socket after the peer reconnected. Subclasses
+    ``ConnectionResetError`` so the process pool's existing dead-child
+    machinery classifies it without new except clauses — the driver first
+    offers the link a bounded ``reconnect()`` (the child redials with
+    jittered backoff) and only then spends the respawn budget. The in-flight
+    item re-dispatches through the PR 7 poison/quarantine path either way:
+    delivered exactly once or quarantined, never twice, never lost."""
+
+
+class TransportFrameCorrupt(TransportLinkDown):
+    """A framed transport received a frame whose crc32 trailer (or magic/
+    header) does not match its bytes — a flipped bit on the wire, or a stream
+    desync. The link cannot be trusted past this point, so it is torn down
+    and treated exactly like a link death (counted separately as
+    ``ptpu_degradations_total{cause="transport_frame_corrupt"}`` and
+    ``ptpu_net_frames_corrupt_total``): the corrupt payload is never
+    delivered, the in-flight item re-dispatches on the reconnected link."""
+
+
 class StallError(PetastormTpuError):
     """A pipeline actor missed its heartbeat threshold and the health monitor's
     escalation policy is ``raise`` — the training loop fails fast instead of

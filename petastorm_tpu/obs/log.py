@@ -35,7 +35,14 @@ scan, mutate hook, or delta application) — and, from the temporal plane
 alert fired; the full alert rides into live flight recorders),
 ``slo_attribution_error``, ``timeline_listener_error`` and
 ``timeline_sample_error`` (best-effort temporal-plane failures that must
-stay visible without killing the cadence).
+stay visible without killing the cadence) — and, from the transport plane
+(ISSUE 15), ``transport_link_down`` (a framed tcp link died — socket error,
+EOF, half-open heartbeat trip; warn-once per connection; also the
+tcp-unavailable fallback to the pipe pool), ``transport_frame_corrupt`` (a
+crc32-trailer/magic rejection, link torn down), ``transport_reconnected``
+(the child redialed and the hub re-adopted; un-acked items re-dispatched),
+and ``transport_shm_bypass`` (slab wire disabled over tcp — payloads ride
+the framed socket frames).
 """
 from __future__ import annotations
 

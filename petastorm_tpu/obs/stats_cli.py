@@ -437,6 +437,39 @@ def render_dashboard(metrics, title="", history=None):
                             _fmt_ms(inflate.get("p99", 0)),
                             int(inflate.get("count", 0))))
 
+    # -- transport plane (ISSUE 15): link traffic, reconnects, heartbeat
+    # misses, rtt — excluded from the catch-all; window-over-window deltas
+    # on the fault counters when history is present
+    net_frames = _labeled(metrics, "ptpu_net_frames_total")
+    net_connects = metrics.get("ptpu_net_connects_total", 0)
+    if net_connects or any(net_frames.values()):
+        net_bytes = _labeled(metrics, "ptpu_net_bytes_total")
+
+        def _net_prev(key):
+            v = prev_metrics.get("ptpu_net_%s_total" % key)
+            return int(v) if isinstance(v, (int, float)) else None
+
+        reconnects = int(metrics.get("ptpu_net_reconnects_total", 0))
+        missed = int(metrics.get("ptpu_net_heartbeats_missed_total", 0))
+        corrupt = int(metrics.get("ptpu_net_frames_corrupt_total", 0))
+        lines.append(
+            "transport:    connects=%d  reconnects=%d%s  hb_missed=%d%s  "
+            "corrupt_frames=%d%s"
+            % (int(net_connects),
+               reconnects, _fmt_delta(reconnects, _net_prev("reconnects")),
+               missed, _fmt_delta(missed, _net_prev("heartbeats_missed")),
+               corrupt, _fmt_delta(corrupt, _net_prev("frames_corrupt"))))
+        lines.append(
+            "  frames tx=%d (%.1f MB)  rx=%d (%.1f MB)"
+            % (int(net_frames.get("tx", 0)), net_bytes.get("tx", 0) / 1e6,
+               int(net_frames.get("rx", 0)), net_bytes.get("rx", 0) / 1e6))
+        rtt = metrics.get("ptpu_net_rtt_seconds")
+        if isinstance(rtt, dict) and rtt.get("count"):
+            lines.append("  rtt: p50=%s p99=%s ms over %d heartbeat echoes"
+                         % (_fmt_ms(rtt.get("p50", 0)),
+                            _fmt_ms(rtt.get("p99", 0)),
+                            int(rtt.get("count", 0))))
+
     # -- SLO alerts (ISSUE 12): debounced breach/anomaly counters
     slo = _labeled(metrics, "ptpu_slo_alerts_total")
     slo = {k: v for k, v in slo.items() if v}
@@ -482,7 +515,7 @@ def render_dashboard(metrics, title="", history=None):
                       "ptpu_io_tier_", "ptpu_io_remote_", "ptpu_io_hedge",
                       "ptpu_io_footer_cache_", "ptpu_transform_",
                       "ptpu_prov_", "ptpu_dataset_", "ptpu_slo_",
-                      "ptpu_ctl_", "ptpu_pagedec_")
+                      "ptpu_ctl_", "ptpu_pagedec_", "ptpu_net_")
     rest = {n: v for n, v in metrics.items()
             if not n.startswith(shown_prefixes)}
     scalars = [(n, v) for n, v in sorted(rest.items())

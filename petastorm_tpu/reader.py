@@ -1687,7 +1687,8 @@ class Reader:
                  reader_pool_type="thread", workers_count=4, results_queue_size=16,
                  is_batched_reader=False, ngram=None, results_timeout_s=300.0,
                  wire_serializer="pickle", worker_respawns=None, io_options=None,
-                 recovery=None, provenance=None, watch=None, watch_paths=None):
+                 recovery=None, provenance=None, watch=None, watch_paths=None,
+                 transport=None):
         self._fs = filesystem
         self._path = path
         self.schema = schema
@@ -1731,7 +1732,7 @@ class Reader:
         self._pool_args = (reader_pool_type, workers_count, results_queue_size,
                            results_timeout_s, wire_serializer,
                            self._recovery.worker_respawns, self._io_options,
-                           self._recovery)
+                           self._recovery, transport)
         self._executor = None
         self._results_iter = None
         self._buffer = []
@@ -1780,13 +1781,14 @@ class Reader:
 
     def _start(self):
         (pool_type, workers_count, queue_size, timeout_s, serializer,
-         respawns, io_options, recovery) = self._pool_args
+         respawns, io_options, recovery, transport) = self._pool_args
         reopen = getattr(self._worker, "reopen", None)
         if reopen is not None:  # reset()/restore after join() closed the IO runtime
             reopen()
         self._executor = make_executor(
             pool_type, workers_count, queue_size, timeout_s, serializer,
-            respawns, io_options=io_options, recovery=recovery)
+            respawns, io_options=io_options, recovery=recovery,
+            transport=transport)
         monitor = getattr(self, "_health_monitor", None)
         if monitor is not None:
             # reset()/restore rebuilds the executor — re-attach BEFORE start so
@@ -2492,7 +2494,8 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
                 transform_spec=None, filters=None, storage_options=None, filesystem=None,
                 results_timeout_s=300.0, decode_on_device=False, wire_serializer=None,
                 io_retries=None, io_retry_backoff_s=None, worker_respawns=None,
-                io_options=None, recovery=None, provenance=None, watch=None):
+                io_options=None, recovery=None, provenance=None, watch=None,
+                transport=None):
     """Open a petastorm(-tpu) dataset for per-row decoded reading (reference ~L60).
 
     ``schema_fields`` may be a list of names/regexes/UnischemaFields or an :class:`NGram`.
@@ -2537,6 +2540,14 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     "quarantine"``), and runs a watcher thread that discovers appended files
     mid-run and extends the epoch plan with checkpoint-watermark exactness.
     See docs/robustness.md "Mutable datasets".
+
+    ``transport``: the process pool's wire (ISSUE 15) — ``'pipe'`` (default;
+    today's unix-socket connection, byte-identical) or ``'tcp'`` (framed
+    crc32-trailered loopback/LAN sockets with heartbeat half-open detection
+    and jittered-backoff reconnect; a link death re-dispatches un-acked items
+    through the quarantine path — exactly-once-or-quarantined survives the
+    network). Also via ``PTPU_TRANSPORT``. See docs/robustness.md
+    "The network fault model".
     """
     fs, path = get_filesystem_and_path_or_paths(dataset_url, storage_options, filesystem)
     stored_schema = get_schema(fs, path)
@@ -2591,7 +2602,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         wire_serializer=wire_serializer or "pickle",
         io_options=io_opts, recovery=rec,
         provenance=_prov.resolve(provenance), watch=watch,
-        watch_paths=watch_paths,
+        watch_paths=watch_paths, transport=transport,
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields
@@ -2615,7 +2626,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       filesystem=None, results_timeout_s=300.0, decode_on_device=False,
                       wire_serializer=None, io_retries=None, io_retry_backoff_s=None,
                       worker_respawns=None, io_options=None, recovery=None,
-                      provenance=None, watch=None):
+                      provenance=None, watch=None, transport=None):
     """Open ANY Parquet store for vectorized columnar batches (reference ~L200).
 
     ``decode_on_device``: see :func:`make_reader` — device-decodable codec columns come
@@ -2643,6 +2654,11 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     framing but the frames travel through a slab ring instead of the socket
     (``"shm"``/``"shm-view"`` normalize to ``"shm-arrow"``/``"shm-arrow-view"``
     here). Thread/dummy pools share memory and ignore it.
+
+    ``transport``: see :func:`make_reader` — the process pool's wire
+    (``'pipe'`` default / ``'tcp'`` framed partition-tolerant sockets,
+    ISSUE 15). The shm slab wire is bypassed over tcp (a network link cannot
+    carry slab grants); payloads ride the framed socket wire instead.
     """
     fs, path = get_filesystem_and_path_or_paths(
         dataset_url_or_urls, storage_options, filesystem
@@ -2705,7 +2721,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
             wire_serializer, wire_serializer) or "arrow",
         io_options=io_opts, recovery=rec,
         provenance=_prov.resolve(provenance), watch=watch,
-        watch_paths=watch_paths,
+        watch_paths=watch_paths, transport=transport,
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields

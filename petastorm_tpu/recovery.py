@@ -65,16 +65,39 @@ class RecoveryOptions:
                                                     across respawns and heals)
                                                     before it is quarantined
                                                     (default 2)
+    link_heartbeat_s    PTPU_LINK_HEARTBEAT_S       framed-transport (tcp)
+                                                    heartbeat cadence per
+                                                    direction (default 2.0)
+    link_miss_threshold PTPU_LINK_MISS_THRESHOLD    consecutive missed
+                                                    heartbeat intervals before
+                                                    a quiet link is declared
+                                                    half-open and torn down
+                                                    (default 3)
+    link_reconnect_s    PTPU_LINK_RECONNECT_S       ceiling on one reconnect
+                                                    wait after a link death —
+                                                    the child redials with
+                                                    jittered exponential
+                                                    backoff (base
+                                                    io_retry_backoff_s) under
+                                                    this cap; past it the link
+                                                    is a dead child
+                                                    (default 10.0)
+    link_connect_       PTPU_LINK_CONNECT_          bound on a single tcp
+    timeout_s           TIMEOUT_S                   connect/hello exchange
+                                                    (default 10.0)
     ==================  ==========================  ===========================
     """
 
     __slots__ = ("io_retries", "io_retry_backoff_s", "io_retry_max_backoff_s",
                  "read_deadline_s", "worker_respawns", "on_poison",
-                 "poison_attempts")
+                 "poison_attempts", "link_heartbeat_s", "link_miss_threshold",
+                 "link_reconnect_s", "link_connect_timeout_s")
 
     def __init__(self, io_retries=None, io_retry_backoff_s=None,
                  io_retry_max_backoff_s=None, read_deadline_s=None,
-                 worker_respawns=None, on_poison=None, poison_attempts=None):
+                 worker_respawns=None, on_poison=None, poison_attempts=None,
+                 link_heartbeat_s=None, link_miss_threshold=None,
+                 link_reconnect_s=None, link_connect_timeout_s=None):
         self.io_retries = max(0, _env_int("PTPU_IO_RETRIES", 2)
                               if io_retries is None else int(io_retries))
         self.io_retry_backoff_s = max(
@@ -98,6 +121,22 @@ class RecoveryOptions:
         self.poison_attempts = max(1, _env_int("PTPU_POISON_ATTEMPTS", 2)
                                    if poison_attempts is None
                                    else int(poison_attempts))
+        # framed-transport link policy (ISSUE 15): heartbeat cadence, half-open
+        # detection threshold, and the reconnect/connect bounds the tcp
+        # transport derives its jittered backoff ceiling from
+        self.link_heartbeat_s = max(
+            0.05, _env_float("PTPU_LINK_HEARTBEAT_S", 2.0)
+            if link_heartbeat_s is None else float(link_heartbeat_s))
+        self.link_miss_threshold = max(
+            1, _env_int("PTPU_LINK_MISS_THRESHOLD", 3)
+            if link_miss_threshold is None else int(link_miss_threshold))
+        self.link_reconnect_s = max(
+            0.1, _env_float("PTPU_LINK_RECONNECT_S", 10.0)
+            if link_reconnect_s is None else float(link_reconnect_s))
+        self.link_connect_timeout_s = max(
+            0.1, _env_float("PTPU_LINK_CONNECT_TIMEOUT_S", 10.0)
+            if link_connect_timeout_s is None
+            else float(link_connect_timeout_s))
 
     @classmethod
     def normalize(cls, value):
@@ -159,7 +198,8 @@ class QuarantinedItem:
         self.item = item          # the dispatched (epoch, ordinal, work) tuple
         self.error = error        # the LAST failure (original exception chain)
         self.attempts = attempts  # how many times the item was tried
-        self.kind = kind          # 'exception' | 'child_death'
+        self.kind = kind          # 'exception' | 'child_death' | 'link_death'
+        #                           | 'wire_decode'
 
     def __repr__(self):
         return "<QuarantinedItem attempts=%d kind=%s error=%r>" % (

@@ -1,0 +1,165 @@
+"""Partition-tolerant transport plane for the worker-pool wire (ISSUE 15).
+
+The process pool's (serializer, descriptor, lease) result protocol was welded
+to ``multiprocessing.connection`` pipes — none of the robustness machinery
+(PR 7 exactly-once-or-quarantined accounting, PR 11 generation tokens, PR 14
+control frames) could cross a host boundary, which blocked ROADMAP item 1's
+disaggregated data service. This package lifts the wire into a ``Transport``
+interface with two implementations:
+
+- :class:`PipeTransport` — today's unix-socket ``Connection``, byte-identical
+  and zero new cost (methods are bound straight to the connection's in
+  ``__init__``; the only additions are no-op ledger hooks).
+- :class:`~petastorm_tpu.transport.tcp.TcpTransport` — length-prefixed
+  crc32-trailered frames (:mod:`~petastorm_tpu.transport.framing`) over
+  loopback/LAN sockets with bounded connect/read timeouts, transport-level
+  heartbeats with half-open link detection, jittered-backoff reconnect driven
+  by :class:`~petastorm_tpu.recovery.RecoveryOptions`, and a per-connection
+  in-flight ledger so a link death re-dispatches un-acked items through the
+  PR 7 poison/quarantine path — never delivering twice, never losing a
+  watermark row.
+
+The interface is deliberately the ``multiprocessing.connection.Connection``
+surface the pool already speaks (``send``/``recv``/``poll``/``send_bytes``/
+``recv_bytes``/``close``) plus the robustness extensions (``reconnect``,
+``track``/``settle``/``inflight``, ``mark_ready``), so ``ProcessExecutor``'s
+driver protocol — result blobs, control frames, pid/handshake acks, heartbeat
+pings — rides either implementation unchanged. Link faults surface as
+:class:`petastorm_tpu.errors.TransportLinkDown` (a ``ConnectionResetError``
+subclass, so the existing dead-child except clauses classify it).
+
+Metrics (``ptpu_net_*``, resolved once per process): connects, reconnects,
+heartbeats missed, frames/bytes by direction, corrupt frames, and an rtt
+histogram over the PR 5 log-bucket primitive. See docs/robustness.md for the
+fault model and docs/observability.md for the family rows.
+"""
+from __future__ import annotations
+
+import threading
+
+from petastorm_tpu.errors import (  # noqa: F401  (re-export: the plane's API)
+    TransportFrameCorrupt,
+    TransportLinkDown,
+)
+
+#: transport selector values accepted by the pool factories / PTPU_TRANSPORT
+TRANSPORTS = ("pipe", "tcp")
+
+
+class Transport:
+    """The pool-wire interface: framed send/recv of result blobs, control
+    frames, and pid/handshake acks, plus the robustness extensions. Concrete
+    transports implement the ``Connection`` surface; the base supplies the
+    no-op robustness hooks so the pipe path stays byte-identical."""
+
+    #: True once the app-level handshake (pid ack) completed — chaos hook
+    #: sites and heartbeat policing only engage on the steady-state link
+    #: (bootstrap failures are the spawn-failure path's job)
+    ready = False
+
+    def mark_ready(self):
+        self.ready = True
+
+    # -- per-connection in-flight ledger ------------------------------------------------
+    # The driver tracks the item it dispatched and settles it when the result
+    # (or exc header) is fully consumed; whatever is still tracked at link
+    # death is exactly what must re-dispatch. Pipe links have no partial-
+    # delivery mode (a dead pipe IS a dead child), so the base is a no-op.
+
+    def track(self, key):
+        pass
+
+    def settle(self):
+        pass
+
+    def inflight(self):
+        """The un-acked dispatched item key, or None."""
+        return None
+
+
+class PipeTransport(Transport):
+    """Today's pool wire: a ``multiprocessing.connection.Connection`` behind
+    the :class:`Transport` interface. Methods are bound directly to the
+    connection in ``__init__`` — the pipe path costs nothing new (no
+    per-message indirection), and there is no ``reconnect``: a dead pipe is a
+    dead child, handled by the pool's respawn machinery."""
+
+    def __init__(self, conn):
+        self._conn = conn
+        self.send = conn.send
+        self.recv = conn.recv
+        self.send_bytes = conn.send_bytes
+        self.recv_bytes = conn.recv_bytes
+        self.poll = conn.poll
+        self.close = conn.close
+
+    def fileno(self):
+        return self._conn.fileno()
+
+
+_net_metrics = None
+_net_lock = threading.Lock()
+
+
+class _NetMetrics:
+    """The ``ptpu_net_*`` families, resolved once per process (same contract
+    as the steal counter in workers.py — hot paths pay one ``inc()``)."""
+
+    __slots__ = ("connects", "reconnects", "hb_missed", "frames_tx",
+                 "frames_rx", "bytes_tx", "bytes_rx", "frames_corrupt", "rtt")
+
+    def __init__(self, registry):
+        self.connects = registry.counter(
+            "ptpu_net_connects_total",
+            help="tcp transport links established (first connects + redials)")
+        self.reconnects = registry.counter(
+            "ptpu_net_reconnects_total",
+            help="tcp transport links re-established after a link death")
+        self.hb_missed = registry.counter(
+            "ptpu_net_heartbeats_missed_total",
+            help="heartbeat intervals that passed with no traffic from the "
+                 "peer (link_miss_threshold of these = half-open, torn down)")
+        self.frames_tx = registry.counter(
+            "ptpu_net_frames_total", direction="tx",
+            help="transport frames by direction")
+        self.frames_rx = registry.counter(
+            "ptpu_net_frames_total", direction="rx")
+        self.bytes_tx = registry.counter(
+            "ptpu_net_bytes_total", direction="tx",
+            help="transport wire bytes by direction (headers + trailers "
+                 "included)")
+        self.bytes_rx = registry.counter(
+            "ptpu_net_bytes_total", direction="rx")
+        self.frames_corrupt = registry.counter(
+            "ptpu_net_frames_corrupt_total",
+            help="frames rejected by the crc32 trailer / magic check — each "
+                 "one also tears its link down")
+        self.rtt = registry.histogram(
+            "ptpu_net_rtt_seconds",
+            help="transport heartbeat round-trip time (HB -> HB_ACK)")
+
+
+def net_metrics():
+    """The process-wide net-metric struct (created on first use)."""
+    global _net_metrics
+    m = _net_metrics
+    if m is None:
+        with _net_lock:
+            if _net_metrics is None:
+                from petastorm_tpu.obs.metrics import default_registry
+
+                _net_metrics = _NetMetrics(default_registry())
+            m = _net_metrics
+    return m
+
+
+def normalize_transport(value):
+    """``None``/env -> 'pipe'; validates the selector."""
+    import os
+
+    if value is None:
+        value = os.environ.get("PTPU_TRANSPORT") or "pipe"
+    if value not in TRANSPORTS:
+        raise ValueError("transport must be one of %s, got %r"
+                         % (TRANSPORTS, value))
+    return value

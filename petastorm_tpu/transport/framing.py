@@ -1,0 +1,91 @@
+"""Wire framing for the tcp transport (ISSUE 15): length-prefixed,
+crc32-trailered frames.
+
+A frame is::
+
+    >H   magic   (0xF7A5 — stream-desync canary)
+    >B   kind    (K_* below)
+    >I   length  (payload bytes; bounded by MAX_FRAME)
+    ...  payload
+    >I   crc32 over (kind byte + payload)
+
+The crc covers the kind so a flipped kind byte cannot re-type a payload; the
+magic makes a desynchronized stream (a partial frame left behind by a link
+death) fail loudly instead of parsing garbage lengths. Verification failures
+raise :class:`petastorm_tpu.errors.TransportFrameCorrupt` — the link is torn
+down and the in-flight item re-dispatches; a corrupt payload is never
+delivered (the chaos ``net.corrupt_frame`` action is caught exactly here).
+
+Parsing is buffer-based (:func:`take_frame` over a ``bytearray`` the endpoint
+appends socket reads into), so a read timeout mid-frame keeps the partial
+bytes and resumes — bounded-socket-timeout reads never lose sync.
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+
+from petastorm_tpu.errors import TransportFrameCorrupt
+
+MAGIC = 0xF7A5
+_HEADER = struct.Struct(">HBI")
+_TRAILER = struct.Struct(">I")
+HEADER_LEN = _HEADER.size
+TRAILER_LEN = _TRAILER.size
+
+#: frame kinds
+K_OBJ = 1      #: a pickled python object (the Connection.send/recv parity)
+K_RAW = 2      #: raw serializer bytes (the Connection.send_bytes parity)
+K_HB = 3       #: transport heartbeat; payload = ">d" sender-monotonic stamp
+K_HB_ACK = 4   #: heartbeat echo (same payload) — the sender's rtt sample
+K_HELLO = 5    #: connection bootstrap: token + session id + dial attempt
+K_HELLO_ACK = 6
+
+#: hard bound on one frame's payload — a desynced length field must fail fast,
+#: not allocate gigabytes (result payloads are row-group batches, well under)
+MAX_FRAME = 1 << 31
+
+
+def pack_frame(kind, payload):
+    """One wire frame for ``payload`` (bytes-like)."""
+    payload = bytes(payload)
+    crc = zlib.crc32(bytes((kind,)) + payload) & 0xFFFFFFFF
+    return _HEADER.pack(MAGIC, kind, len(payload)) + payload \
+        + _TRAILER.pack(crc)
+
+
+def frame_size(buf):
+    """Total byte length of the frame at the head of ``buf``, or None while
+    the header (or body) is still incomplete. Raises on a bad magic/length —
+    the stream is desynchronized and the link must die."""
+    if len(buf) < HEADER_LEN:
+        return None
+    magic, _kind, length = _HEADER.unpack_from(buf)
+    if magic != MAGIC:
+        raise TransportFrameCorrupt(
+            "transport stream desynchronized (bad frame magic 0x%04X)" % magic)
+    if length > MAX_FRAME:
+        raise TransportFrameCorrupt(
+            "transport frame length %d exceeds the %d-byte bound (desynced "
+            "stream?)" % (length, MAX_FRAME))
+    total = HEADER_LEN + length + TRAILER_LEN
+    return total if len(buf) >= total else None
+
+
+def take_frame(buf):
+    """Pop one complete frame off the head of ``buf`` (a ``bytearray``):
+    ``(kind, payload-bytes)``, or ``None`` when the buffer holds only a
+    partial frame. Raises :class:`TransportFrameCorrupt` on a crc/magic
+    mismatch (the corrupt bytes are consumed first so the caller can count
+    before tearing the link down)."""
+    total = frame_size(buf)
+    if total is None:
+        return None
+    _magic, kind, length = _HEADER.unpack_from(buf)
+    payload = bytes(buf[HEADER_LEN:HEADER_LEN + length])
+    (crc,) = _TRAILER.unpack_from(buf, HEADER_LEN + length)
+    del buf[:total]
+    if crc != (zlib.crc32(bytes((kind,)) + payload) & 0xFFFFFFFF):
+        raise TransportFrameCorrupt(
+            "transport frame crc mismatch (kind=%d len=%d)" % (kind, length))
+    return kind, payload

@@ -666,8 +666,10 @@ class ProcessExecutor(ExecutorBase):
     def __init__(self, workers_count=4, results_queue_size=16, results_timeout_s=300.0,
                  serializer="pickle", worker_respawns=None, shm_slab_bytes=None,
                  shm_slabs=None, lookahead=0, work_stealing=True, recovery=None,
-                 **_ignored):
+                 transport=None, **_ignored):
         import os
+
+        from petastorm_tpu.transport import normalize_transport
 
         self._workers_count = workers_count
         self._queue_size = results_queue_size
@@ -690,6 +692,15 @@ class ProcessExecutor(ExecutorBase):
         self._ring = None
         self._shm_unavailable = False
         self._tracer = None
+        #: pool wire transport (ISSUE 15): 'pipe' (the default — today's unix
+        #: socket, byte-identical) or 'tcp' (framed crc-trailered loopback/LAN
+        #: sockets with reconnect + heartbeats; also via PTPU_TRANSPORT). The
+        #: tcp hub and the shared authkey/token live for the pool's lifetime;
+        #: a tcp setup failure degrades the pool back to 'pipe'.
+        self._transport_name = normalize_transport(transport)
+        self._hub = None
+        self._authkey = None
+        self._session_counter = 0
         self._procs = []
         self._conns = []
         self._threads = []
@@ -755,17 +766,16 @@ class ProcessExecutor(ExecutorBase):
     def start(self, worker, plan):
         import os
         import tempfile
-        from multiprocessing.connection import Listener
 
         self._results = queue.Queue(maxsize=self._queue_size)
         self._stop_event.clear()
         self.truncated = False
+        authkey = self._authkey = os.urandom(32)
+        if self._transport_name == "tcp":
+            self._setup_hub(authkey)  # degrades self._transport_name on failure
         self._setup_shm()
         with self._respawn_lock:
             self._tmpdir = tempfile.mkdtemp(prefix="ptpu-pool-")
-            address = os.path.join(self._tmpdir, "sock")
-        authkey = os.urandom(32)
-        listener = Listener(address, family="AF_UNIX", authkey=authkey)
         # children must find petastorm_tpu BEFORE the bootstrap handshake can hand them
         # the parent's sys.path — put the package root on PYTHONPATH explicitly (the
         # parent may have found it via sys.path.insert, which does not propagate)
@@ -775,6 +785,73 @@ class ProcessExecutor(ExecutorBase):
         self._worker = worker  # respawned replacements re-handshake the same worker
         self._child_env = {**os.environ, "PYTHONPATH": child_pp,
                            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")}
+        if self._transport_name == "tcp":
+            # the child's link policy (redial backoff, heartbeat cadence,
+            # half-open threshold) rides the environment: the transport must
+            # bootstrap BEFORE any handshake payload could carry it
+            rec = self._recovery
+            self._child_env.update({
+                "PTPU_LINK_HEARTBEAT_S": repr(rec.link_heartbeat_s),
+                "PTPU_LINK_MISS_THRESHOLD": str(rec.link_miss_threshold),
+                "PTPU_LINK_RECONNECT_S": repr(rec.link_reconnect_s),
+                "PTPU_LINK_CONNECT_TIMEOUT_S":
+                    repr(rec.link_connect_timeout_s),
+                "PTPU_IO_RETRY_BACKOFF_S": repr(rec.io_retry_backoff_s),
+                "PTPU_IO_RETRY_MAX_BACKOFF_S":
+                    repr(rec.io_retry_max_backoff_s),
+            })
+            self._start_children_tcp(authkey)
+        else:
+            self._start_children_pipe(authkey)
+        monitor = self._health
+        self._dispatch = PullDispatcher(
+            plan, self._workers_count, lookahead=self._lookahead,
+            stealing=self._stealing,
+            recorder=monitor.flight if monitor is not None else None)
+        with self._active_lock:
+            self._active = self._workers_count
+            self._target = self._workers_count
+            self._retire = 0
+            self._next_idx = self._workers_count
+        for i, conn in enumerate(self._conns):
+            t = threading.Thread(target=self._drive_child,
+                                 args=(conn, self._dispatch, i),
+                                 daemon=True, name="ptpu-pdrv-%d" % i)
+            t.start()
+            self._threads.append(t)
+
+    def _setup_hub(self, authkey):
+        """Create the tcp listener hub (ISSUE 15). A setup failure — cannot
+        bind/listen — is a CLASSIFIED degradation back to the local pipe
+        pool, never a raise: the transport is an availability feature and
+        must not cost any."""
+        from petastorm_tpu.transport.tcp import TcpHub
+
+        try:
+            hub = TcpHub(self._recovery, token=authkey.hex())
+        except Exception as e:  # noqa: BLE001 — degrade, never fail the pool
+            from petastorm_tpu.obs.log import degradation
+
+            degradation(
+                "transport_link_down",
+                "tcp transport unavailable (%s); falling back to the local "
+                "pipe pool", e, once=False)
+            hub = None
+            self._transport_name = "pipe"
+        with self._respawn_lock:  # join() hands the hub off under this lock
+            self._hub = hub
+
+    def _start_children_pipe(self, authkey):
+        """Spawn + handshake the initial fleet over the unix-socket pipe wire
+        (today's behavior, byte-identical)."""
+        import os
+        from multiprocessing.connection import Listener
+
+        from petastorm_tpu.transport import PipeTransport
+
+        with self._respawn_lock:
+            address = os.path.join(self._tmpdir, "sock")
+        listener = Listener(address, family="AF_UNIX", authkey=authkey)
         for _ in range(self._workers_count):
             child = self._popen_child(address, authkey)
             with self._respawn_lock:  # _spawn_one/join also touch the proc list
@@ -803,39 +880,73 @@ class ProcessExecutor(ExecutorBase):
             # (sum of bootstraps instead of the slowest one)
             pending = []
             while len(pending) < self._workers_count:
-                conn = self._await_accept(accepted, self._procs, "Pool child")
+                conn = PipeTransport(
+                    self._await_accept(accepted, self._procs, "Pool child"))
                 self._send_handshake(conn)
                 pending.append(conn)
             for conn in pending:
-                pid = self._await_pid_ack(conn)
-                with self._respawn_lock:
-                    # accept order ≠ spawn order: the handshake's pid ack is
-                    # what ties this connection (→ driver idx) to its OS
-                    # process — the heal tier kills by exactly this mapping
-                    idx = len(self._conns)
-                    self._conns.append(conn)
-                    for p in self._procs:
-                        if p.pid == pid:
-                            self._child_by_idx[idx] = p
-                            break
+                self._register_conn(conn)
         finally:
             listener.close()  # also unblocks the acceptor thread if we raised
-        monitor = self._health
-        self._dispatch = PullDispatcher(
-            plan, self._workers_count, lookahead=self._lookahead,
-            stealing=self._stealing,
-            recorder=monitor.flight if monitor is not None else None)
-        with self._active_lock:
-            self._active = self._workers_count
-            self._target = self._workers_count
-            self._retire = 0
-            self._next_idx = self._workers_count
-        for i, conn in enumerate(self._conns):
-            t = threading.Thread(target=self._drive_child,
-                                 args=(conn, self._dispatch, i),
-                                 daemon=True, name="ptpu-pdrv-%d" % i)
-            t.start()
-            self._threads.append(t)
+
+    def _start_children_tcp(self, authkey):
+        """Spawn + handshake the initial fleet over the framed tcp transport:
+        one hub session per child, children dial back concurrently."""
+        pending = []
+        for _ in range(self._workers_count):
+            with self._respawn_lock:
+                sid = self._session_counter
+                self._session_counter += 1
+            transport = self._hub.create_session(sid)
+            child = self._popen_child(self._hub.address_for(sid), authkey)
+            with self._respawn_lock:
+                self._procs.append(child)
+            pending.append(transport)
+        # same two-phase shape as the pipe path: handshake each link as it
+        # connects, then collect the pid acks
+        for transport in pending:
+            self._await_tcp_connected(transport, "Pool child")
+            self._send_handshake(transport)
+        for transport in pending:
+            self._register_conn(transport)
+
+    def _register_conn(self, conn):
+        """Collect one child's pid ack and register its connection as the
+        next driver slot. Accept order ≠ spawn order: the handshake's pid ack
+        is what ties this connection (→ driver idx) to its OS process — the
+        heal tier kills by exactly this mapping."""
+        pid = self._await_pid_ack(conn)
+        conn.mark_ready()  # steady-state link: chaos sites + heartbeats on
+        with self._respawn_lock:
+            idx = len(self._conns)
+            self._conns.append(conn)
+            for p in self._procs:
+                if p.pid == pid:
+                    self._child_by_idx[idx] = p
+                    break
+
+    def _await_tcp_connected(self, transport, what, procs=None,
+                             check_stop=False, deadline=120.0):
+        """Bounded wait for one tcp session's first adoption, polling child
+        liveness every second — the tcp twin of :meth:`_await_accept` (same
+        tolerance: a host slow enough to need start()'s full window must also
+        be able to heal)."""
+        waited = 0.0
+        while not transport.wait_connected(1.0):
+            waited += 1.0
+            if check_stop and self._stop_event.is_set():
+                raise RuntimeError("pool stopping during respawn")
+            with self._respawn_lock:
+                snapshot = list(self._procs if procs is None else procs)
+            for p in snapshot:
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        "%s exited with code %s before connecting (run 'python "
+                        "-m petastorm_tpu._child_worker' manually to debug)"
+                        % (what, p.returncode))
+            if waited > deadline:
+                raise TimeoutWaitingForResultError(
+                    "%s did not connect within %.0fs" % (what, deadline))
 
     def _should_retire(self):
         """Claim one pending retirement (live shrink): checked by drivers
@@ -978,6 +1089,21 @@ class ProcessExecutor(ExecutorBase):
         from petastorm_tpu.serializers import ShmSerializer
 
         if not isinstance(self._serializer, ShmSerializer):
+            return
+        if self._transport_name == "tcp":
+            # the tcp wire must behave as if the host boundary were real
+            # (ROADMAP item 1: the same frames cross hosts tomorrow) — slab
+            # grants cannot ride a network link, so payloads take the socket
+            # frames. Classified, warn-once, and visible in wire_stats().
+            from petastorm_tpu.obs.log import degradation
+
+            degradation(
+                "transport_shm_bypass",
+                "shared-memory slab wire disabled over the tcp transport; "
+                "result payloads ride the framed socket wire instead")
+            self._shm_unavailable = True
+            self._serializer_name = self._serializer.inner_name
+            self._serializer = self._serializer.inner
             return
         from petastorm_tpu.parallel.shm_ring import SlabRing, shm_supported
 
@@ -1241,10 +1367,62 @@ class ProcessExecutor(ExecutorBase):
         return self._await_pid_ack(conn)
 
     def _spawn_one(self):
-        """Spawn + handshake ONE replacement child (elastic respawn). Returns
-        ``(connection, process)``; raises when the child cannot start/connect
-        or the pool is stopping (the replacement is then killed, never
-        leaked)."""
+        """Spawn + handshake ONE replacement child (elastic respawn / live
+        grow / strand rescue). Returns ``(connection, process)``; raises when
+        the child cannot start/connect or the pool is stopping (the
+        replacement is then killed, never leaked). On the tcp transport a
+        spawn whose LINK cannot establish falls back to a pipe-connected
+        local child — all-links-down degrades to the local pool as a
+        classified degradation, never a hang or a hard failure."""
+        if self._transport_name == "tcp" and self._hub is not None:
+            try:
+                return self._spawn_one_tcp()
+            except Exception as e:  # noqa: BLE001 — degrade to the local pool
+                if self._stop_event.is_set():
+                    raise
+                from petastorm_tpu.obs.log import degradation
+
+                degradation(
+                    "transport_link_down",
+                    "tcp child spawn could not establish a link (%s); "
+                    "falling back to a pipe-connected local child", e,
+                    once=False)
+        return self._spawn_one_pipe()
+
+    def _spawn_one_tcp(self):
+        """One replacement child over a fresh tcp hub session."""
+        with self._respawn_lock:
+            if self._tmpdir is None:
+                raise RuntimeError("pool stopping during respawn")
+            sid = self._session_counter
+            self._session_counter += 1
+        transport = self._hub.create_session(sid)
+        p = None
+        try:
+            p = self._popen_child(self._hub.address_for(sid), self._authkey)
+            self._await_tcp_connected(transport, "respawned pool child",
+                                      procs=[p], check_stop=True)
+            self._send_handshake(transport)
+            self._await_pid_ack(transport)
+            transport.mark_ready()
+            with self._respawn_lock:
+                if self._stop_event.is_set():
+                    raise RuntimeError("pool stopping during respawn")
+                self._procs.append(p)
+                self._conns.append(transport)
+            return transport, p
+        except BaseException:
+            self._hub.drop_session(sid)
+            transport.close()
+            if p is not None:
+                try:
+                    p.kill()
+                except Exception:  # noqa: BLE001
+                    pass  # graftlint: disable=GL-O002 (best-effort kill on the raising path)
+            raise
+
+    def _spawn_one_pipe(self):
+        """One replacement child over the unix-socket pipe wire."""
         import os
         from multiprocessing.connection import Listener
 
@@ -1267,9 +1445,13 @@ class ProcessExecutor(ExecutorBase):
 
             t = threading.Thread(target=_accept, daemon=True, name="ptpu-respawn-accept")
             t.start()
-            conn = self._await_accept(accepted, [p], "respawned pool child",
-                                      check_stop=True)
+            from petastorm_tpu.transport import PipeTransport
+
+            conn = PipeTransport(
+                self._await_accept(accepted, [p], "respawned pool child",
+                                   check_stop=True))
             self._handshake(conn)
+            conn.mark_ready()
             with self._respawn_lock:
                 # join()/stop() may have begun while we were mid-handshake:
                 # registering into already-cleared lists would leak an unreaped
@@ -1469,6 +1651,11 @@ class ProcessExecutor(ExecutorBase):
                             # respawn involved
                             conn.send(("ctl", ctl))
                         t_send = time.perf_counter() if prov is not None else 0.0
+                        # in-flight ledger (ISSUE 15): the item is tracked on
+                        # its link until the result conversation completes —
+                        # whatever is still tracked at a link death is exactly
+                        # what re-dispatches (no-op on the pipe transport)
+                        conn.track(item)
                         conn.send((slab, item, hints) if ring is not None
                                   else (item, hints))
                         header = self._recv_result(conn, child_hb, idx=idx)
@@ -1485,6 +1672,7 @@ class ProcessExecutor(ExecutorBase):
                         if child_hb is not None:
                             child_hb.wait("idle")
                         if header[0] == "exc":
+                            conn.settle()  # the conversation completed
                             if slab is not None:
                                 ring.release(slab)
                             attempts += 1
@@ -1514,6 +1702,7 @@ class ProcessExecutor(ExecutorBase):
                                 prov.absorb_child(trace_blob[4], child_pid,
                                                   wall0, perf0)
                         frames = [conn.recv_bytes() for _ in range(nframes)]
+                        conn.settle()  # result fully received off the link
                         if slab is not None and kind != KIND_SHM:
                             # granted but unused (oversized payload): reclaim first
                             # so a deserialize error cannot leak the slab
@@ -1588,6 +1777,41 @@ class ProcessExecutor(ExecutorBase):
                         # will not be retried — no crash-loop risk)
                         poison = (recovery.quarantine
                                   and attempts >= recovery.poison_attempts)
+                        # transport-level link death (ISSUE 15): when the
+                        # child PROCESS is alive only the LINK died — the
+                        # child redials with jittered backoff and the hub
+                        # re-adopts; re-dispatch on the same child then. An
+                        # attempt is charged (the poison policy applies — a
+                        # frame that reliably kills its link quarantines like
+                        # any poison item) but the respawn budget is not.
+                        # Under on_poison='raise' the fast-path is BOUNDED by
+                        # the poison threshold too: past it we fall through
+                        # to the respawn path, whose budget (and then
+                        # WorkerDiedError) bounds a deterministic link-killer
+                        # exactly like the pipe wire's child-death contract —
+                        # never an unbounded reconnect spin. PipeTransport
+                        # has no reconnect: a dead pipe IS a dead child.
+                        reconnect = getattr(conn, "reconnect", None)
+                        if reconnect is not None \
+                                and (recovery.quarantine
+                                     or attempts < recovery.poison_attempts) \
+                                and not self._stop_event.is_set():
+                            with self._respawn_lock:
+                                proc = self._child_by_idx.get(idx)
+                            if proc is not None and proc.poll() is None \
+                                    and reconnect():
+                                with self._ctl_lock:
+                                    # a knob frame may have died with the old
+                                    # link: re-arm the pending-control send so
+                                    # the retune rides the fresh one (applies
+                                    # are idempotent)
+                                    if self._ctl_pending:
+                                        self._ctl_seen[idx] = 0
+                                if poison:
+                                    self._put(QuarantinedItem(
+                                        item, e, attempts, kind="link_death"))
+                                    break
+                                continue  # re-dispatch on the healed link
                         replacement = self._respawn(e, idx, charged=not poison)
                         if poison:
                             self._put(QuarantinedItem(item, e, attempts,
@@ -1609,6 +1833,11 @@ class ProcessExecutor(ExecutorBase):
                             conn.close()
                         except OSError:
                             pass
+                        if self._hub is not None \
+                                and hasattr(conn, "session"):
+                            # a zombie child redialing its DEAD session must
+                            # find it gone, not adopt into a closed transport
+                            self._hub.drop_session(conn.session)
                         conn = replacement
                         with self._ctl_lock:
                             # the fresh child inherited current knob overrides
@@ -1731,6 +1960,7 @@ class ProcessExecutor(ExecutorBase):
             # about to rmtree (it fails cleanly on None instead)
             tmpdir, self._tmpdir = self._tmpdir, None
             ring, self._ring = self._ring, None
+            hub, self._hub = self._hub, None
             self._child_by_idx = {}
             self._inflight_attempts = {}
         for conn in conns:
@@ -1738,6 +1968,11 @@ class ProcessExecutor(ExecutorBase):
                 conn.close()
             except OSError:
                 pass
+        if hub is not None:
+            # after the per-link closes, before reaping: a child mid-redial
+            # sees connection-refused and exits on its own ceiling; stragglers
+            # are killed below either way
+            hub.close()
         for p in procs:
             try:
                 p.wait(timeout=5)
@@ -1755,7 +1990,7 @@ class ProcessExecutor(ExecutorBase):
 def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size=16,
                   results_timeout_s=300.0, serializer="pickle", worker_respawns=None,
                   shm_slab_bytes=None, shm_slabs=None, io_options=None,
-                  recovery=None):
+                  recovery=None, transport=None):
     """Factory matching the reference's ``reader_pool_type`` kwarg ('thread'|'process'|'dummy').
 
     ``serializer`` selects the process-pool wire format: 'pickle'|'arrow' (reference
@@ -1773,9 +2008,19 @@ def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size
     recovery policy (ISSUE 7): the process pool's respawn budget defaults from it
     (an explicit ``worker_respawns`` still wins), and every pool applies its
     ``on_poison``/``poison_attempts`` quarantine policy to failing items.
+    ``transport`` selects the process pool's wire (ISSUE 15): ``'pipe'`` (the
+    default — today's unix-socket connection, byte-identical) or ``'tcp'``
+    (framed crc-trailered loopback/LAN sockets that survive link death with
+    exactly-once-or-quarantined re-dispatch; also via ``PTPU_TRANSPORT``).
+    Thread/dummy pools share memory and ignore it.
     """
     from petastorm_tpu.io import IoOptions
+    from petastorm_tpu.transport import normalize_transport
 
+    # validated for EVERY pool type: a typo'd transport (or PTPU_TRANSPORT)
+    # must fail loudly at the factory, not be silently ignored because the
+    # pool happened to be thread/dummy
+    transport = normalize_transport(transport)
     io_options = IoOptions.normalize(io_options)
     lookahead = io_options.lookahead
     stealing = io_options.work_stealing
@@ -1790,7 +2035,7 @@ def make_executor(reader_pool_type="thread", workers_count=4, results_queue_size
                                serializer=serializer, worker_respawns=worker_respawns,
                                shm_slab_bytes=shm_slab_bytes, shm_slabs=shm_slabs,
                                lookahead=lookahead, work_stealing=stealing,
-                               recovery=recovery)
+                               recovery=recovery, transport=transport)
     raise ValueError(
         "Unknown reader_pool_type %r (expected 'thread', 'process' or 'dummy')"
         % reader_pool_type

@@ -1302,6 +1302,95 @@ def test_stat_then_open_scopes_are_per_function():
     assert [f for f in findings if f.rule_id == "GL-R002"] == []
 
 
+# -- GL-R003: unbounded sockets (ISSUE 15) -----------------------------------------------
+
+_R003_POSITIVE = """
+    import socket
+
+    class Link:
+        def __init__(self, host, port):
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.connect((host, port))  # BUG: unbounded connect
+
+        def read(self):
+            return self._sock.recv(4096)  # BUG: unbounded recv
+
+    def serve():
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        conn, _addr = srv.accept()  # BUG: unbounded accept
+        return conn.recv(16)  # BUG: accepted socket, still unbounded
+"""
+
+
+def test_unbounded_socket_fires_on_recv_accept_connect():
+    findings, _ = _lint(_R003_POSITIVE)
+    findings = [f for f in findings if f.rule_id == "GL-R003"]
+    lines = {f.line for f in findings}
+    assert lines == {
+        _line_of(_R003_POSITIVE, "BUG: unbounded connect"),
+        _line_of(_R003_POSITIVE, "BUG: unbounded recv"),
+        _line_of(_R003_POSITIVE, "BUG: unbounded accept"),
+        _line_of(_R003_POSITIVE, "BUG: accepted socket, still unbounded"),
+    }, findings
+
+
+def test_unbounded_socket_clean_cases():
+    findings, _ = _lint("""
+        import socket
+
+        def bounded_tick_loop(host, port):
+            # settimeout anywhere on the chain bounds every blocking use
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.settimeout(0.05)
+            sock.connect((host, port))
+            return sock.recv(4096)
+
+        def create_connection_timeout(host, port):
+            # the stdlib applies the timeout to the returned socket
+            sock = socket.create_connection((host, port), timeout=5.0)
+            return sock.recv(4096)
+
+        def accepted_then_bounded(srv):
+            srv.settimeout(0.2)
+            conn, _addr = srv.accept()
+            conn.settimeout(0.2)
+            return conn.recv(16)
+
+        def untyped_receiver(thing):
+            # receivers the tracker cannot type are left alone (GL-R001's
+            # philosophy: no false-positive flood)
+            return thing.recv(16)
+    """)
+    assert [f for f in findings if f.rule_id == "GL-R003"] == [], findings
+
+
+def test_settimeout_none_is_still_unbounded():
+    findings, _ = _lint("""
+        import socket
+
+        def forever(host, port):
+            sock = socket.create_connection((host, port), timeout=5.0)
+            sock.settimeout(None)  # "block forever", spelled out
+            return sock.recv(4096)  # BUG: unbounded again
+    """)
+    findings = [f for f in findings if f.rule_id == "GL-R003"]
+    assert len(findings) == 1 and "recv" in findings[0].message, findings
+
+
+def test_r003_inline_disable_respected():
+    findings, suppressed = _lint("""
+        import socket
+
+        def blocking_by_design(host, port):
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.connect((host, port))  # graftlint: disable=GL-R003 (bootstrap dial; parent kills us on teardown)
+            return sock.recv(4096)  # graftlint: disable=GL-R003 (same: the recv IS this process's job)
+    """)
+    assert [f.rule_id for f in findings] == [] and suppressed == 2
+
+
 # -- engine: suppressions, baseline, CLI ------------------------------------------------
 
 
