@@ -16,6 +16,12 @@ AST-based rule engine with four project-specific rule families:
 - **schema/codec contracts** (``GL-S0xx``): literal ``UnischemaField``
   declarations whose codec cannot faithfully store the declared numpy dtype.
 
+A second, whole-program phase runs after the per-file rules over the same
+parsed trees: :class:`~petastorm_tpu.analysis.project.ProjectContext` resolves
+lock identities and a one-hop call graph across the corpus, and the project
+rules flag blocking-under-lock hangs (GL-C005) and lock-order cycles
+(GL-C006) that no single file shows — the PR 13 controller deadlock shape.
+
 Entry points: the ``petastorm-tpu-lint`` console script (exit 0 clean / 1 new
 findings / 2 internal error), ``python -m petastorm_tpu.analysis``, or
 :func:`analyze_paths` programmatically. Intentional violations are suppressed
@@ -23,7 +29,12 @@ inline (``# graftlint: disable=<rule-id>``) or through the checked-in baseline
 (``.graftlint-baseline.json``); see docs/static_analysis.md.
 """
 from petastorm_tpu.analysis.baseline import Baseline
-from petastorm_tpu.analysis.engine import analyze_paths, analyze_source, default_rules
+from petastorm_tpu.analysis.engine import (
+    analyze_paths,
+    analyze_source,
+    default_project_rules,
+    default_rules,
+)
 from petastorm_tpu.analysis.findings import Finding, Severity
 
 __all__ = [
@@ -32,5 +43,6 @@ __all__ = [
     "Severity",
     "analyze_paths",
     "analyze_source",
+    "default_project_rules",
     "default_rules",
 ]

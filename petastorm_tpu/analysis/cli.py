@@ -19,6 +19,7 @@ import traceback
 from petastorm_tpu.analysis.baseline import Baseline
 from petastorm_tpu.analysis.engine import (
     analyze_paths,
+    default_project_rules,
     default_rules,
     iter_python_files,
 )
@@ -49,8 +50,11 @@ def _build_parser():
                         help="comma-separated rule ids to run (default: all)")
     parser.add_argument("--ignore", default=None,
                         help="comma-separated rule ids to skip")
-    parser.add_argument("--format", choices=("text", "json"), default="text",
-                        help="output format")
+    parser.add_argument("--format", choices=("text", "json", "github"),
+                        default="text",
+                        help="output format ('github' emits workflow-command "
+                             "annotations — ::error file=...,line=... — so "
+                             "findings annotate the PR diff)")
     parser.add_argument("--list-rules", action="store_true",
                         help="list registered rules and exit")
     parser.add_argument("--show-baselined", action="store_true",
@@ -59,17 +63,25 @@ def _build_parser():
 
 
 def _pick_rules(args):
+    """(per-file rules, project rules) after --select/--ignore filtering —
+    one id namespace across both registries, so ``--select GL-C005`` runs
+    just the project phase and ``--ignore GL-C006`` drops it."""
     rules = default_rules()
+    project_rules = default_project_rules()
     if args.select:
         wanted = {r.strip() for r in args.select.split(",")}
         rules = [r for r in rules if r.rule_id in wanted]
-        missing = wanted - {r.rule_id for r in rules}
+        project_rules = [r for r in project_rules if r.rule_id in wanted]
+        missing = wanted - {r.rule_id for r in rules} \
+            - {r.rule_id for r in project_rules}
         if missing:
             raise ValueError("unknown rule id(s): %s" % ", ".join(sorted(missing)))
     if args.ignore:
         dropped = {r.strip() for r in args.ignore.split(",")}
         rules = [r for r in rules if r.rule_id not in dropped]
-    return rules
+        project_rules = [r for r in project_rules
+                         if r.rule_id not in dropped]
+    return rules, project_rules
 
 
 def _resolve_baseline(args):
@@ -84,15 +96,41 @@ def _resolve_baseline(args):
     return Baseline.load(found) if found else None
 
 
+def _gh_escape(value, in_property=False):
+    """Escape per the GitHub workflow-command rules: ``%``/CR/LF always, and
+    ``,``/``:`` additionally inside property values."""
+    value = str(value).replace("%", "%25").replace("\r", "%0D") \
+        .replace("\n", "%0A")
+    if in_property:
+        value = value.replace(",", "%2C").replace(":", "%3A")
+    return value
+
+
+def _gh_annotation(finding):
+    """One ``::error``/``::warning`` workflow command for a finding. Paths are
+    repo-relative (annotations only attach to the diff when they match the
+    checkout's paths)."""
+    level = "error" if str(finding.severity) == "error" else "warning"
+    path = os.path.relpath(finding.path).replace(os.sep, "/")
+    props = "file=%s,line=%d,col=%d,title=%s" % (
+        _gh_escape(path, in_property=True), finding.line, finding.col,
+        _gh_escape(finding.rule_id, in_property=True))
+    message = finding.message
+    if finding.fix_hint:
+        message += " — " + finding.fix_hint
+    return "::%s %s::%s" % (level, props, _gh_escape(message))
+
+
 def run(argv=None):
     args = _build_parser().parse_args(argv)
     if args.list_rules:
-        for rule in default_rules():
+        for rule in default_rules() + default_project_rules():
             print("%s  [%s]  %s" % (rule.rule_id, rule.severity, rule.description))
         return EXIT_CLEAN
 
-    rules = _pick_rules(args)
-    findings, n_suppressed = analyze_paths(args.paths, rules)
+    rules, project_rules = _pick_rules(args)
+    findings, n_suppressed = analyze_paths(args.paths, rules,
+                                           project_rules=project_rules)
     baseline = _resolve_baseline(args)
 
     if args.write_baseline:
@@ -105,7 +143,7 @@ def run(argv=None):
         }
         updated = Baseline.from_findings(
             findings, path, previous=baseline, analyzed_paths=analyzed,
-            run_rules={r.rule_id for r in rules})
+            run_rules={r.rule_id for r in rules + project_rules})
         updated.save(path)
         print("wrote %d baseline entr%s to %s" % (
             len(updated.entries), "y" if len(updated.entries) == 1 else "ies",
@@ -118,7 +156,13 @@ def run(argv=None):
     else:
         new, baselined, stale = findings, [], []
 
-    if args.format == "json":
+    if args.format == "github":
+        for f in new:
+            print(_gh_annotation(f))
+        print("%d finding%s, %d baselined, %d suppressed inline" % (
+            len(new), "" if len(new) == 1 else "s", len(baselined),
+            n_suppressed))
+    elif args.format == "json":
         print(json.dumps({
             "findings": [f.to_dict() for f in new],
             "baselined": [f.to_dict() for f in baselined],

@@ -6,6 +6,15 @@ A rule is an object with ``rule_id``, ``severity``, ``description`` and a
 child→parent node map, and helpers shared by several rules (import-alias
 resolution, source-line extraction).
 
+Two phases share every parsed tree (ISSUE 16). The per-file phase runs each
+:class:`Rule` over one module at a time; the whole-program phase then builds a
+:class:`~petastorm_tpu.analysis.project.ProjectContext` over the SAME
+``FileContext`` objects — no file is read or parsed twice — and runs each
+:class:`ProjectRule` once across the corpus. Findings from both phases flow
+through the same inline-suppression and baseline machinery: a project-phase
+finding lands on a concrete file/line, so ``# graftlint: disable=GL-C005`` and
+baseline entries behave identically for it.
+
 Inline suppressions (documented in docs/static_analysis.md):
 
 - ``# graftlint: disable=GL-C001`` (comma-separated ids, or ``all``) on the
@@ -37,13 +46,43 @@ class FileContext:
         self.tree = tree
         self._parents = None
         self._numpy_aliases = None
+        self._walk_cache = None
+        self._type_index = None
+        #: cross-rule memoization slot (e.g. the tracing rules' jit index):
+        #: three rules needing the same derived index compute it once
+        self.cache = {}
+
+    def walk(self):
+        """Every node of the tree, walked ONCE and cached. The rules iterate
+        this instead of re-running ``ast.walk(tree)`` each — with ~16 per-file
+        rules plus the project phase, repeated full walks were the analyzer's
+        dominant cost (not parsing)."""
+        if self._walk_cache is None:
+            self._walk_cache = list(ast.walk(self.tree))
+        return self._walk_cache
+
+    def by_type(self, *types):
+        """All nodes of the given AST type(s), from a bucket index built once
+        per file. Order follows the cached walk (breadth-first, same as
+        ``ast.walk``)."""
+        if self._type_index is None:
+            index = {}
+            for node in self.walk():
+                index.setdefault(type(node), []).append(node)
+            self._type_index = index
+        if len(types) == 1:
+            return self._type_index.get(types[0], [])
+        out = []
+        for t in types:
+            out.extend(self._type_index.get(t, []))
+        return out
 
     @property
     def parents(self):
         """Child node → parent node map (built once per file)."""
         if self._parents is None:
             self._parents = {}
-            for parent in ast.walk(self.tree):
+            for parent in self.walk():
                 for child in ast.iter_child_nodes(parent):
                     self._parents[child] = parent
         return self._parents
@@ -62,12 +101,11 @@ class FileContext:
         """Names the file binds to the numpy module (``import numpy as np`` …)."""
         if self._numpy_aliases is None:
             aliases = set()
-            for node in ast.walk(self.tree):
-                if isinstance(node, ast.Import):
-                    for a in node.names:
-                        if a.name == "numpy":
-                            aliases.add(a.asname or "numpy")
-            aliases.update({"np", "numpy"} & _module_like_names(self.tree))
+            for node in self.by_type(ast.Import):
+                for a in node.names:
+                    if a.name == "numpy":
+                        aliases.add(a.asname or "numpy")
+            aliases.update({"np", "numpy"} & _module_like_names(self))
             self._numpy_aliases = aliases or {"np", "numpy"}
         return self._numpy_aliases
 
@@ -86,12 +124,11 @@ class FileContext:
         )
 
 
-def _module_like_names(tree):
+def _module_like_names(ctx):
     names = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                names.add((a.asname or a.name).split(".")[0])
+    for node in ctx.by_type(ast.Import):
+        for a in node.names:
+            names.add((a.asname or a.name).split(".")[0])
     return names
 
 
@@ -107,6 +144,21 @@ class Rule:
         raise NotImplementedError
 
 
+class ProjectRule(Rule):
+    """Whole-program rule: runs ONCE over the
+    :class:`~petastorm_tpu.analysis.project.ProjectContext` built from every
+    parsed file, after the per-file phase. Subclasses implement
+    ``check_project(project)`` yielding Findings anchored at concrete
+    file/line positions (so inline suppressions and the baseline apply
+    unchanged)."""
+
+    def check(self, tree, ctx):
+        return iter(())  # project rules have no per-file phase
+
+    def check_project(self, project):
+        raise NotImplementedError
+
+
 class ParseErrorRule(Rule):
     """Not a real visitor — the id under which unparseable files are reported."""
 
@@ -119,6 +171,12 @@ def default_rules():
     from petastorm_tpu.analysis.rules import ALL_RULES
 
     return [cls() for cls in ALL_RULES]
+
+
+def default_project_rules():
+    from petastorm_tpu.analysis.rules import ALL_PROJECT_RULES
+
+    return [cls() for cls in ALL_PROJECT_RULES]
 
 
 def _suppressions(source):
@@ -160,30 +218,54 @@ def _suppressed(finding, per_line, per_file):
     return False
 
 
-def analyze_source(source, path="<string>", rules=None):
-    """Run rules over one source string. Returns (findings, suppressed_count)."""
+def _parse_error_finding(source, path, e):
+    rule = ParseErrorRule()
+    lines = source.splitlines()
+    lineno = e.lineno or 1
+    # a real code fingerprint: an empty one would make a baselined parse
+    # error match EVERY future parse error in the file
+    code = lines[lineno - 1].strip() if 0 < lineno <= len(lines) else ""
+    return Finding(rule.rule_id, rule.severity, path, lineno,
+                   (e.offset or 0) + 1, "syntax error: %s" % e.msg, code=code)
+
+
+def _run_project_phase(contexts, project_rules):
+    """Run each project rule once over the already-parsed corpus."""
+    if not project_rules or not contexts:
+        return []
+    from petastorm_tpu.analysis.project import ProjectContext
+
+    project = ProjectContext(contexts)
+    findings = []
+    for rule in project_rules:
+        findings.extend(rule.check_project(project))
+    return findings
+
+
+def analyze_source(source, path="<string>", rules=None, project_rules=None):
+    """Run rules over one source string. Returns (findings, suppressed_count).
+
+    The project phase runs too, over a single-module corpus — so fixture
+    strings exercise GL-C005/GL-C006 exactly like files on disk do."""
     rules = default_rules() if rules is None else rules
+    project_rules = default_project_rules() if project_rules is None \
+        else project_rules
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
-        rule = ParseErrorRule()
-        lines = source.splitlines()
-        lineno = e.lineno or 1
-        # a real code fingerprint: an empty one would make a baselined parse
-        # error match EVERY future parse error in the file
-        code = lines[lineno - 1].strip() if 0 < lineno <= len(lines) else ""
-        return [Finding(rule.rule_id, rule.severity, path, lineno,
-                        (e.offset or 0) + 1, "syntax error: %s" % e.msg,
-                        code=code)], 0
+        return [_parse_error_finding(source, path, e)], 0
     ctx = FileContext(path, source, tree)
     per_line, per_file = _suppressions(source)
     findings, n_suppressed = [], 0
+    all_findings = []
     for rule in rules:
-        for finding in rule.check(tree, ctx):
-            if _suppressed(finding, per_line, per_file):
-                n_suppressed += 1
-            else:
-                findings.append(finding)
+        all_findings.extend(rule.check(tree, ctx))
+    all_findings.extend(_run_project_phase([ctx], project_rules))
+    for finding in all_findings:
+        if _suppressed(finding, per_line, per_file):
+            n_suppressed += 1
+        else:
+            findings.append(finding)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
     return findings, n_suppressed
 
@@ -226,10 +308,17 @@ def iter_python_files(paths):
                         yield p
 
 
-def analyze_paths(paths, rules=None):
-    """Run rules over files/directories. Returns (findings, suppressed_count)."""
+def analyze_paths(paths, rules=None, project_rules=None):
+    """Run rules over files/directories. Returns (findings, suppressed_count).
+
+    Each file is read and parsed ONCE; the resulting ``FileContext`` objects
+    (with their cached walks and suppression maps) feed both the per-file
+    phase and the whole-program project phase."""
     rules = default_rules() if rules is None else rules
+    project_rules = default_project_rules() if project_rules is None \
+        else project_rules
     findings, n_suppressed = [], 0
+    contexts, suppression_maps = [], {}
     for path in iter_python_files(paths):
         try:
             with open(path, "r", encoding="utf-8") as f:
@@ -239,7 +328,30 @@ def analyze_paths(paths, rules=None):
             findings.append(Finding(rule.rule_id, rule.severity, path, 1, 1,
                                     "cannot read file: %s" % e))
             continue
-        file_findings, file_suppressed = analyze_source(source, path, rules)
-        findings.extend(file_findings)
-        n_suppressed += file_suppressed
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            findings.append(_parse_error_finding(source, path, e))
+            continue
+        ctx = FileContext(path, source, tree)
+        contexts.append(ctx)
+        per_line, per_file = _suppressions(source)
+        suppression_maps[path] = (per_line, per_file)
+        file_findings = []
+        for rule in rules:
+            file_findings.extend(rule.check(tree, ctx))
+        file_findings.sort(key=lambda f: (f.line, f.col, f.rule_id))
+        for finding in file_findings:
+            if _suppressed(finding, per_line, per_file):
+                n_suppressed += 1
+            else:
+                findings.append(finding)
+    project_findings = _run_project_phase(contexts, project_rules)
+    project_findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    for finding in project_findings:
+        per_line, per_file = suppression_maps.get(finding.path, ({}, set()))
+        if _suppressed(finding, per_line, per_file):
+            n_suppressed += 1
+        else:
+            findings.append(finding)
     return findings, n_suppressed

@@ -12,6 +12,10 @@ from petastorm_tpu.analysis.rules.observability import (
     SleepyPollLoopRule,
     UnpairedSpanRule,
 )
+from petastorm_tpu.analysis.rules.project_concurrency import (
+    BlockingUnderLockRule,
+    LockOrderCycleRule,
+)
 from petastorm_tpu.analysis.rules.robustness import (
     StatThenOpenRule,
     UnboundedBlockingCallRule,
@@ -44,4 +48,13 @@ ALL_RULES = [
     UnboundedSocketRule,
 ]
 
-__all__ = [cls.__name__ for cls in ALL_RULES] + ["ALL_RULES"]
+#: whole-program rules, run once over the ProjectContext after the per-file
+#: phase (ISSUE 16)
+ALL_PROJECT_RULES = [
+    BlockingUnderLockRule,
+    LockOrderCycleRule,
+]
+
+__all__ = ([cls.__name__ for cls in ALL_RULES]
+           + [cls.__name__ for cls in ALL_PROJECT_RULES]
+           + ["ALL_RULES", "ALL_PROJECT_RULES"])

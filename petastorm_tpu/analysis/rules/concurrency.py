@@ -114,7 +114,7 @@ class LockDisciplineRule(Rule):
                 "move the write into a locked helper)")
 
     def check(self, tree, ctx):
-        for cls in (n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)):
+        for cls in ctx.by_type(ast.ClassDef):
             lock_attrs, self_sync_attrs = set(), set()
             for node in ast.walk(cls):
                 if isinstance(node, ast.Assign):
@@ -171,8 +171,9 @@ def _untimed_blocking_call(node, method_attr):
         return False
     if node.args:
         first = node.args[0]
-        if method_attr == "join":
-            # Thread.join(timeout): join(5) is timed, join(None) blocks forever
+        if method_attr in ("join", "result"):
+            # Thread.join(timeout) / Future.result(timeout): the first
+            # positional IS the timeout — join(5) is timed, join(None) blocks
             return isinstance(first, ast.Constant) and first.value is None
         # Queue.get(block, timeout): the FIRST positional is block, not a
         # timeout — get(5) sets block=5 (truthy) and still blocks forever.
@@ -189,20 +190,29 @@ def _untimed_blocking_call(node, method_attr):
     return True
 
 
+def _is_submit_call(value):
+    """True for ``<anything>.submit(...)`` — an executor-built Future."""
+    return isinstance(value, ast.Call) and \
+        isinstance(value.func, ast.Attribute) and value.func.attr == "submit"
+
+
 class BlockingTeardownRule(Rule):
-    """GL-C002: untimed ``Queue.get()`` / ``Thread.join()`` inside stop/close/
-    shutdown/join paths — a wedged worker then hangs teardown forever."""
+    """GL-C002: untimed ``Queue.get()`` / ``Thread.join()`` /
+    ``Future.result()`` inside stop/close/shutdown/join paths — a wedged
+    worker then hangs teardown forever."""
 
     rule_id = "GL-C002"
     severity = Severity.ERROR
-    description = ("blocking Queue.get()/Thread.join() without a timeout on a "
-                   "stop/shutdown path")
-    fix_hint = ("pass a timeout (`.join(timeout=...)` / `.get(timeout=...)`) or "
-                "use `.get_nowait()` so teardown cannot hang on a wedged worker")
+    description = ("blocking Queue.get()/Thread.join()/Future.result() without "
+                   "a timeout on a stop/shutdown path")
+    fix_hint = ("pass a timeout (`.join(timeout=...)` / `.get(timeout=...)` / "
+                "`.result(timeout=...)`) or use `.get_nowait()` so teardown "
+                "cannot hang on a wedged worker")
 
     def check(self, tree, ctx):
-        for cls in (n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)):
+        for cls in ctx.by_type(ast.ClassDef):
             thread_attrs, queue_attrs, thread_list_attrs = set(), set(), set()
+            future_attrs, future_list_attrs = set(), set()
             for method in _iter_methods(cls):
                 local_threads = set()
                 for node in ast.walk(method):
@@ -217,33 +227,43 @@ class BlockingTeardownRule(Rule):
                                     local_threads.add(tgt.id)
                             elif chain in _QUEUE_CTORS and a:
                                 queue_attrs.add(a)
+                            elif _is_submit_call(node.value) and a:
+                                # self._flush_future = pool.submit(...)
+                                future_attrs.add(a)
                     if isinstance(node, ast.Call) and \
                             isinstance(node.func, ast.Attribute) and \
-                            node.func.attr == "append" and node.args and \
-                            isinstance(node.args[0], ast.Name) and \
-                            node.args[0].id in local_threads:
+                            node.func.attr == "append" and node.args:
+                        arg = node.args[0]
                         a = self_attr(node.func.value)
-                        if a:
+                        if a is None:
+                            continue
+                        if isinstance(arg, ast.Name) and arg.id in local_threads:
                             thread_list_attrs.add(a)
-            if not (thread_attrs or queue_attrs or thread_list_attrs):
+                        elif _is_submit_call(arg):
+                            # self._futures.append(pool.submit(...))
+                            future_list_attrs.add(a)
+            if not (thread_attrs or queue_attrs or thread_list_attrs
+                    or future_attrs or future_list_attrs):
                 continue
             for method in _iter_methods(cls):
                 if method.name not in _TEARDOWN_METHODS:
                     continue
                 for finding in self._check_teardown(
                         method, cls, ctx, thread_attrs, queue_attrs,
-                        thread_list_attrs):
+                        thread_list_attrs, future_attrs, future_list_attrs):
                     yield finding
 
     def _check_teardown(self, method, cls, ctx, thread_attrs, queue_attrs,
-                        thread_list_attrs):
-        # loop vars bound from a tracked thread-list attr: for t in self._threads:
-        loop_threads = set()
+                        thread_list_attrs, future_attrs, future_list_attrs):
+        # loop vars bound from a tracked attr list: for t in self._threads:
+        loop_threads, loop_futures = set(), set()
         for node in ast.walk(method):
             if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
                 it = self_attr(node.iter)
                 if it in thread_list_attrs:
                     loop_threads.add(node.target.id)
+                elif it in future_list_attrs:
+                    loop_futures.add(node.target.id)
         for node in ast.walk(method):
             if not isinstance(node, ast.Call):
                 continue
@@ -264,6 +284,16 @@ class BlockingTeardownRule(Rule):
                         self, node,
                         "`%s.%s` blocks on `self.%s.get()` with no timeout on "
                         "a shutdown path" % (cls.name, method.name, a))
+            elif _untimed_blocking_call(node, "result"):
+                recv = node.func.value
+                a = self_attr(recv)
+                if a in future_attrs or (
+                        isinstance(recv, ast.Name) and recv.id in loop_futures):
+                    yield ctx.finding(
+                        self, node,
+                        "`%s.%s` blocks on an executor future's `.result()` "
+                        "with no timeout on a shutdown path — a wedged task "
+                        "hangs teardown forever" % (cls.name, method.name))
 
 
 class ThreadHandlingRule(Rule):
@@ -277,8 +307,7 @@ class ThreadHandlingRule(Rule):
                 "thread on every exit path")
 
     def check(self, tree, ctx):
-        scopes = [tree] + [n for n in ast.walk(tree)
-                           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        scopes = [tree] + ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef)
         for scope in scopes:
             scope_src = None  # unparsed lazily: only scopes with a Thread ctor pay
             for node in walk_scope(scope):
@@ -362,21 +391,19 @@ class OptionsMutationRule(Rule):
 
     def check(self, tree, ctx):
         exempt = set()
-        for node in ast.walk(tree):
-            if isinstance(node, ast.ClassDef) and (
-                    _OPTIONS_OWNER_CLASS.search(node.name)
-                    or node.name in _SANCTIONED_CLASSES):
+        for node in ctx.by_type(ast.ClassDef):
+            if _OPTIONS_OWNER_CLASS.search(node.name) \
+                    or node.name in _SANCTIONED_CLASSES:
                 for sub in ast.walk(node):
                     exempt.add(id(sub))
-        for node in ast.walk(tree):
+        for node in ctx.by_type(ast.Assign, ast.AugAssign):
             if id(node) in exempt:
                 continue
-            if isinstance(node, (ast.Assign, ast.AugAssign)):
-                targets = node.targets if isinstance(node, ast.Assign) \
-                    else [node.target]
-                for target in targets:
-                    for finding in self._check_target(node, target, ctx):
-                        yield finding
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for target in targets:
+                for finding in self._check_target(node, target, ctx):
+                    yield finding
 
     def _check_target(self, node, target, ctx):
         if isinstance(target, (ast.Tuple, ast.List)):

@@ -23,31 +23,28 @@ from petastorm_tpu.analysis.engine import Rule
 from petastorm_tpu.analysis.rules._astutil import attr_chain, walk_scope
 
 
-def _wall_clock_aliases(tree):
+def _wall_clock_aliases(ctx):
     """Dotted call chains that mean ``time.time`` in this file: the module form
     plus any ``from time import time [as x]`` binding."""
     aliases = {"time.time"}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module == "time":
+    for node in ctx.by_type(ast.ImportFrom):
+        if node.module == "time":
             for a in node.names:
                 if a.name == "time":
                     aliases.add(a.asname or "time")
-        elif isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name == "time" and a.asname:
-                    aliases.add("%s.time" % a.asname)
+    for node in ctx.by_type(ast.Import):
+        for a in node.names:
+            if a.name == "time" and a.asname:
+                aliases.add("%s.time" % a.asname)
     return aliases
 
 
-def _scopes(tree):
+def _scopes(ctx):
     """Module, every class body, and every function/method body — each is one
     name-resolution scope for the assigned-from-time.time() tracking (walked
     with the shared ``walk_scope`` helper, which stops at nested scopes)."""
-    yield tree
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            yield node
+    yield ctx.tree
+    yield from ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
 
 
 class WallClockDurationRule(Rule):
@@ -61,12 +58,12 @@ class WallClockDurationRule(Rule):
                 "can be wrong or negative; keep time.time() for timestamps")
 
     def check(self, tree, ctx):
-        aliases = _wall_clock_aliases(tree)
+        aliases = _wall_clock_aliases(ctx)
 
         def is_wall_call(node):
             return isinstance(node, ast.Call) and attr_chain(node.func) in aliases
 
-        for scope in _scopes(tree):
+        for scope in _scopes(ctx):
             sampled = set()  # names assigned from a time.time() call in scope
             for node in walk_scope(scope):
                 if isinstance(node, ast.Assign) and is_wall_call(node.value):

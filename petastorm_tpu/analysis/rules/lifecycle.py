@@ -69,8 +69,7 @@ class ResourceLifecycleRule(Rule):
                 "close()+unlink() (creator) or close() (attacher) path")
 
     def check(self, tree, ctx):
-        scopes = [tree] + [n for n in ast.walk(tree)
-                           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        scopes = [tree] + ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef)
         for scope in scopes:
             scope_nodes = list(walk_scope(scope))
             for node in scope_nodes:
@@ -89,11 +88,11 @@ class ResourceLifecycleRule(Rule):
                     self, node,
                     "`%s(...)` result is never closed: not used as a context "
                     "manager, closed in a finally, or handed off" % name)
-        yield from self._check_double_release(tree, ctx)
+        yield from self._check_double_release(ctx)
 
     # -- lease release discipline (ISSUE 6) ----------------------------------------------
 
-    def _check_double_release(self, tree, ctx):
+    def _check_double_release(self, ctx):
         """Flag an UNBALANCED ``x.release()`` in one straight-line statement
         list: each name gets one implied base reference plus one per
         ``x.retain()`` seen earlier in the list; a release past that budget is
@@ -104,7 +103,7 @@ class ResourceLifecycleRule(Rule):
         false positives), and a rebind/del of the name resets its tracking —
         so conditional release patterns never false-positive. Teardown blocks
         stay covered: a ``finally:`` body is its own statement list."""
-        for stmts in self._stmt_lists(tree):
+        for stmts in self._stmt_lists(ctx):
             state = {}  # name -> [extra_refs_from_retains, base_release_lineno]
             for stmt in stmts:
                 if self._clears_tracking(stmt):
@@ -132,8 +131,8 @@ class ResourceLifecycleRule(Rule):
                                  "per holder")
 
     @staticmethod
-    def _stmt_lists(tree):
-        for node in ast.walk(tree):
+    def _stmt_lists(ctx):
+        for node in ctx.walk():
             for field in ("body", "orelse", "finalbody"):
                 stmts = getattr(node, field, None)
                 if isinstance(stmts, list) and stmts \

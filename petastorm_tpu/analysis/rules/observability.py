@@ -119,9 +119,8 @@ class UnpairedSpanRule(Rule):
 
     def check(self, tree, ctx):
         scopes = [tree.body]
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                scopes.append(node.body)
+        scopes.extend(n.body for n in ctx.by_type(ast.FunctionDef,
+                                                  ast.AsyncFunctionDef))
         for body in scopes:
             yield from self._check_scope(body, ctx)
 
@@ -210,9 +209,7 @@ class SleepyPollLoopRule(Rule):
                 "'# graftlint: disable=GL-O004' comment")
 
     def check(self, tree, ctx):
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.While):
-                continue
+        for node in ctx.by_type(ast.While):
             watches_event = any(
                 isinstance(sub, ast.Call)
                 and isinstance(sub.func, ast.Attribute)
@@ -251,9 +248,7 @@ class SilentExceptionSwallowRule(Rule):
                 "inline '# graftlint: disable=GL-O002' comment")
 
     def check(self, tree, ctx):
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
+        for node in ctx.by_type(ast.ExceptHandler):
             if not _is_broad(node.type):
                 continue
             if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):

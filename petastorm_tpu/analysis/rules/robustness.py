@@ -68,6 +68,11 @@ _CONSTRUCTORS = {
     "Process": "thread",
     "Event": "event",
     "Client": "conn",
+    # ISSUE 16: executors — a chain typed "executor" seeds future typing
+    # (x = pool.submit(...) → x is a future; fs = [pool.submit(...) ...] → a
+    # future list whose loop variables are futures)
+    "ThreadPoolExecutor": "executor",
+    "ProcessPoolExecutor": "executor",
 }
 
 #: kind -> method name whose unbounded form is flagged
@@ -76,7 +81,30 @@ _BLOCKING_METHOD = {
     "thread": "join",
     "event": "wait",
     "conn": "recv",
+    "future": "result",
 }
+
+
+def _wait_aliases(ctx):
+    """Dotted chains that mean ``concurrent.futures.wait`` in this file.
+    Only forms actually importing the futures machinery register — a bare
+    ``wait(...)`` matches nothing unless ``from concurrent.futures import
+    wait`` appears."""
+    aliases = set()
+    for node in ctx.by_type(ast.Import):
+        for a in node.names:
+            if a.name == "concurrent.futures":
+                aliases.add("%s.wait" % (a.asname or "concurrent.futures"))
+    for node in ctx.by_type(ast.ImportFrom):
+        if node.module == "concurrent":
+            for a in node.names:
+                if a.name == "futures":
+                    aliases.add("%s.wait" % (a.asname or "futures"))
+        elif node.module == "concurrent.futures":
+            for a in node.names:
+                if a.name == "wait":
+                    aliases.add(a.asname or "wait")
+    return aliases
 
 
 def _is_false_const(node):
@@ -98,16 +126,22 @@ class UnboundedBlockingCallRule(Rule):
                 "'# graftlint: disable=GL-R001' comment")
 
     def check(self, tree, ctx):
-        kinds = self._collect_kinds(tree)
-        if not kinds:
-            return
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or \
-                    not isinstance(node.func, ast.Attribute):
+        kinds = self._collect_kinds(ctx)
+        wait_aliases = _wait_aliases(ctx)
+        for node in ctx.by_type(ast.Call):
+            if wait_aliases and attr_chain(node.func) in wait_aliases:
+                if not self._wait_has_timeout(node):
+                    yield ctx.finding(
+                        self, node,
+                        "futures.wait() without a timeout blocks forever if "
+                        "any task wedges — a hung pipeline instead of a "
+                        "diagnosable failure")
+                continue
+            if not kinds or not isinstance(node.func, ast.Attribute):
                 continue
             recv = attr_chain(node.func.value)
             kind = kinds.get(recv)
-            if kind is None or node.func.attr != _BLOCKING_METHOD[kind]:
+            if kind is None or node.func.attr != _BLOCKING_METHOD.get(kind):
                 continue
             if kind == "conn":
                 yield ctx.finding(
@@ -118,20 +152,25 @@ class UnboundedBlockingCallRule(Rule):
                 continue
             if self._has_timeout(node, kind):
                 continue
+            what = "executor task" if kind == "future" else kind
             yield ctx.finding(
                 self, node,
                 "%s.%s() without a timeout blocks forever if the %s never "
                 "delivers — a hung pipeline instead of a diagnosable failure"
-                % (recv, node.func.attr, kind))
+                % (recv, node.func.attr, what))
 
     @staticmethod
-    def _collect_kinds(tree):
+    def _collect_kinds(ctx):
         """Map of assigned-name chain (``q``, ``self._results``) -> kind, from
-        constructor assignments anywhere in the module."""
+        constructor assignments anywhere in the module. A second pass types
+        FUTURES off the executors found in the first: ``x = pool.submit(...)``
+        makes ``x`` a future, a list built from ``submit`` results (listcomp
+        or ``.append``) makes its ``for``-loop and comprehension variables
+        futures."""
         kinds = {}
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Assign) or \
-                    not isinstance(node.value, ast.Call):
+        assigns = ctx.by_type(ast.Assign)
+        for node in assigns:
+            if not isinstance(node.value, ast.Call):
                 continue
             name = call_func_name(node.value)
             kind = _CONSTRUCTORS.get(name)
@@ -144,7 +183,50 @@ class UnboundedBlockingCallRule(Rule):
                 chain = attr_chain(target)
                 if chain is not None:
                     kinds[chain] = kind
+        def is_submit(call):
+            return isinstance(call, ast.Call) and \
+                isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "submit" and \
+                kinds.get(attr_chain(call.func.value)) == "executor"
+        futlists = set()
+        for node in assigns:
+            value = node.value
+            targets = [attr_chain(t) for t in node.targets]
+            if is_submit(value):
+                for chain in targets:
+                    if chain is not None:
+                        kinds[chain] = "future"
+            elif isinstance(value, ast.ListComp) and is_submit(value.elt):
+                futlists.update(c for c in targets if c is not None)
+        for node in ctx.by_type(ast.Call):
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "append" and node.args and \
+                    is_submit(node.args[0]):
+                chain = attr_chain(node.func.value)
+                if chain is not None:
+                    futlists.add(chain)
+        if futlists:
+            for node in ctx.by_type(ast.For):
+                if isinstance(node.target, ast.Name) and \
+                        attr_chain(node.iter) in futlists:
+                    kinds[node.target.id] = "future"
+            for node in ctx.by_type(ast.ListComp, ast.SetComp,
+                                    ast.GeneratorExp):
+                for gen in node.generators:
+                    if isinstance(gen.target, ast.Name) and \
+                            attr_chain(gen.iter) in futlists:
+                        kinds[gen.target.id] = "future"
         return kinds
+
+    @staticmethod
+    def _wait_has_timeout(call):
+        """``futures.wait(fs, timeout, return_when)``: 2nd positional or a
+        non-None ``timeout`` kwarg bounds it."""
+        timeout = call_kwarg(call, "timeout")
+        if timeout is None and len(call.args) >= 2:
+            timeout = call.args[1]
+        return timeout is not None and not (
+            isinstance(timeout, ast.Constant) and timeout.value is None)
 
     @staticmethod
     def _has_timeout(call, kind):
@@ -215,12 +297,11 @@ class UnboundedSocketRule(Rule):
                 "inline '# graftlint: disable=GL-R003' comment")
 
     def check(self, tree, ctx):
-        socks, bounded = self._collect(tree)
+        socks, bounded = self._collect(ctx)
         if not socks:
             return
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or \
-                    not isinstance(node.func, ast.Attribute):
+        for node in ctx.by_type(ast.Call):
+            if not isinstance(node.func, ast.Attribute):
                 continue
             recv = attr_chain(node.func.value)
             if recv not in socks or recv in bounded:
@@ -235,13 +316,13 @@ class UnboundedSocketRule(Rule):
                 "tick" % (recv, node.func.attr))
 
     @staticmethod
-    def _collect(tree):
+    def _collect(ctx):
         """``(socket chains, bounded chains)`` from module-wide assignments:
         a chain is bounded by a non-None ``settimeout``, a
         ``setblocking(False)``, or a ``create_connection`` timeout."""
         socks = set()
         bounded = set()
-        for node in ast.walk(tree):
+        for node in ctx.by_type(ast.Assign, ast.Call):
             if isinstance(node, ast.Assign) and \
                     isinstance(node.value, ast.Call):
                 name = call_func_name(node.value)
@@ -340,9 +421,7 @@ class StatThenOpenRule(Rule):
                 "comment")
 
     def check(self, tree, ctx):
-        scopes = [tree] + [n for n in ast.walk(tree)
-                           if isinstance(n, (ast.FunctionDef,
-                                             ast.AsyncFunctionDef))]
+        scopes = [tree] + ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef)
         for scope in scopes:
             yield from self._check_scope(scope, ctx)
 

@@ -139,9 +139,7 @@ class SchemaCodecContractRule(Rule):
     def check(self, tree, ctx):
         declared = {}   # field name -> resolved np.dtype (literal declarations)
         op_calls = []
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.by_type(ast.Call):
             name = call_func_name(node)
             if name == "UnischemaField":
                 yield from self._check_field(node, ctx)

@@ -65,12 +65,10 @@ def _jit_call_info(call):
     return True, tuple(names), tuple(nums), fn_arg
 
 
-def _decorated_jits(tree):
+def _decorated_jits(ctx):
     """(funcdef, static_names, static_nums) for decorator-form jitted functions."""
     out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
+    for node in ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef):
         for dec in node.decorator_list:
             if attr_chain(dec) in _JIT_CHAINS:
                 out.append((node, (), ()))
@@ -83,15 +81,12 @@ def _decorated_jits(tree):
     return out
 
 
-def _call_form_jits(tree):
+def _call_form_jits(ctx):
     """(funcdef, static_names, static_nums) for ``jax.jit(fn)`` where ``fn``
     resolves to a def earlier in the file (nearest preceding def wins)."""
-    defs = [n for n in ast.walk(tree)
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    defs = ctx.by_type(ast.FunctionDef, ast.AsyncFunctionDef)
     out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
+    for node in ctx.by_type(ast.Call):
         is_jit, names, nums, fn_arg = _jit_call_info(node)
         if not is_jit or not isinstance(fn_arg, ast.Name):
             continue
@@ -115,13 +110,19 @@ def _traced_params(funcdef, static_names, static_nums):
     return {n for n in names if n not in static}
 
 
-def _jitted_functions(tree):
-    """Deduped [(funcdef, traced_param_names)] across both recognition forms."""
+def _jitted_functions(ctx):
+    """Deduped [(funcdef, traced_param_names)] across both recognition forms.
+    Cached on the FileContext — all three tracing rules share one computation."""
+    cached = ctx.cache.get("tracing.jitted")
+    if cached is not None:
+        return cached
     seen = {}
-    for funcdef, names, nums in _decorated_jits(tree) + _call_form_jits(tree):
+    for funcdef, names, nums in _decorated_jits(ctx) + _call_form_jits(ctx):
         if funcdef not in seen:
             seen[funcdef] = _traced_params(funcdef, names, nums)
-    return list(seen.items())
+    result = list(seen.items())
+    ctx.cache["tracing.jitted"] = result
+    return result
 
 
 class NumpyInJitRule(Rule):
@@ -136,7 +137,7 @@ class NumpyInJitRule(Rule):
 
     def check(self, tree, ctx):
         aliases = ctx.numpy_aliases
-        for funcdef, _params in _jitted_functions(tree):
+        for funcdef, _params in _jitted_functions(ctx):
             for node in ast.walk(funcdef):
                 if not isinstance(node, ast.Call):
                     continue
@@ -162,7 +163,7 @@ class TracedBranchRule(Rule):
                 "in static_argnames if it is genuinely static")
 
     def check(self, tree, ctx):
-        for funcdef, params in _jitted_functions(tree):
+        for funcdef, params in _jitted_functions(ctx):
             if not params:
                 continue
             for node in ast.walk(funcdef):
@@ -230,7 +231,7 @@ class HostIoInJitRule(Rule):
                 "jitted function")
 
     def check(self, tree, ctx):
-        for funcdef, _params in _jitted_functions(tree):
+        for funcdef, _params in _jitted_functions(ctx):
             for node in ast.walk(funcdef):
                 if not isinstance(node, ast.Call):
                     continue

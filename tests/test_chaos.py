@@ -517,6 +517,7 @@ def test_monitor_try_heal_unit(tmp_path):
     assert "worker.child-0" not in str(delivered[0])  # the healed one
 
 
+@pytest.mark.slow
 def test_heal_escalation_recovers_live_hang_without_stallerror(chaos_dataset,
                                                                tmp_path):
     """Acceptance: escalation='heal' recovers an injected in-child hang — the

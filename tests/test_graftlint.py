@@ -1847,3 +1847,595 @@ def test_o004_inline_disable_respected():
     """)
     assert findings == []
     assert suppressed == 1
+
+
+# -- GL-C005: blocking under a lock (whole-program phase, ISSUE 16) ---------------------
+
+#: PR 13's live deadlock, verbatim shape: the last worker's `task_done` posts
+#: the `_DONE` sentinel while still holding `_active_lock` — one call hop into
+#: `_put`, whose untimed `put()` blocks on the full bounded results queue, so
+#: every collector (which needs the lock to drain) wedges forever.
+_PR13_DEADLOCK = """
+    import queue
+    import threading
+
+    _DONE = object()
+
+    class WorkerPool:
+        def __init__(self):
+            self._active_lock = threading.Lock()
+            self._results = queue.Queue(64)
+            self._active = 0
+
+        def _put(self, value):
+            self._results.put(value)
+
+        def task_done(self):
+            with self._active_lock:
+                self._active -= 1
+                if self._active == 0:
+                    self._put(_DONE)  # BUG: blocks under _active_lock
+"""
+
+
+def test_c005_fires_on_pr13_done_under_active_lock_deadlock():
+    findings, _ = _lint(_PR13_DEADLOCK)
+    f = _only_rule(findings, "GL-C005")[0]
+    assert f.line == _line_of(_PR13_DEADLOCK, "BUG: blocks under _active_lock")
+    assert "_put" in f.message and "_active_lock" in f.message
+    # the message points at the inner blocking site so the fix is findable
+    assert "put()" in f.message
+    assert f.severity == "error"
+
+
+def test_c005_clean_on_pr13_fixed_shape():
+    """The actual PR 13 fix: compute 'last worker?' under the lock, post the
+    sentinel OUTSIDE it with a timed put loop re-checking the stop event."""
+    findings, _ = _lint("""
+        import queue
+        import threading
+
+        _DONE = object()
+
+        class WorkerPool:
+            def __init__(self):
+                self._active_lock = threading.Lock()
+                self._results = queue.Queue(64)
+                self._active = 0
+                self._stop_event = threading.Event()
+
+            def _put(self, value):
+                while not self._stop_event.is_set():
+                    try:
+                        self._results.put(value, timeout=0.1)
+                        return
+                    except queue.Full:
+                        continue
+
+            def task_done(self):
+                with self._active_lock:
+                    self._active -= 1
+                    last = self._active == 0
+                if last:
+                    self._put(_DONE)
+    """)
+    assert [f for f in findings if f.rule_id == "GL-C005"] == []
+
+
+def test_c005_fires_on_direct_blocking_get_under_lock():
+    src = """
+        import queue
+        import threading
+
+        class Stage:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def next_item(self):
+                with self._lock:
+                    return self._q.get()  # BUG: untimed get under lock
+    """
+    findings, _ = _lint(src)
+    # GL-R001 also fires here (untimed get, per-file view) — both are right
+    hits = [f for f in findings if f.rule_id == "GL-C005"]
+    assert hits and hits[0].line == _line_of(src, "BUG: untimed get under lock")
+
+
+def test_c005_put_on_unbounded_queue_is_clean():
+    """Queue() with no maxsize (or maxsize=0) never blocks on put."""
+    findings, _ = _lint("""
+        import queue
+        import threading
+
+        class Stage:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def post(self, v):
+                with self._lock:
+                    self._q.put(v)
+    """)
+    assert [f for f in findings if f.rule_id == "GL-C005"] == []
+
+
+def test_c005_timed_blocking_call_under_lock_is_clean():
+    findings, _ = _lint("""
+        import queue
+        import threading
+
+        class Stage:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue(8)
+
+            def post(self, v):
+                with self._lock:
+                    self._q.put(v, timeout=0.5)
+    """)
+    assert [f for f in findings if f.rule_id == "GL-C005"] == []
+
+
+def test_c005_one_hop_through_module_function_fires():
+    src = """
+        import time
+        import threading
+
+        def _backoff():
+            time.sleep(1.0)
+
+        class Retry:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def attempt(self):
+                with self._lock:
+                    _backoff()  # BUG: sleeps while holding the lock
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-C005")[0]
+    assert f.line == _line_of(src, "BUG: sleeps while holding the lock")
+    assert "_backoff" in f.message
+
+
+def test_c005_condition_wait_holding_only_its_own_lock_is_clean():
+    findings, _ = _lint("""
+        import threading
+
+        class Gate:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._ready = False
+
+            def wait_ready(self):
+                with self._cond:
+                    while not self._ready:
+                        self._cond.wait()
+    """)
+    assert [f for f in findings if f.rule_id == "GL-C005"] == []
+
+
+def test_c005_condition_wait_with_second_lock_held_fires():
+    src = """
+        import threading
+
+        class Gate:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._meta = threading.Lock()
+
+            def bad_wait(self):
+                with self._meta:
+                    with self._cond:
+                        self._cond.wait()  # BUG: _meta stays held across the wait
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-C005")[0]
+    assert f.line == _line_of(src, "BUG: _meta stays held")
+    assert "_meta" in f.message
+
+
+def test_c005_closure_defined_under_lock_is_clean():
+    """A nested def runs later, when the lock is no longer held — same
+    principle as GL-C001's collector."""
+    findings, _ = _lint("""
+        import queue
+        import threading
+
+        class Scheduler:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue(4)
+
+            def schedule(self):
+                with self._lock:
+                    def later():
+                        self._q.put(1)
+                    return later
+    """)
+    assert [f for f in findings if f.rule_id == "GL-C005"] == []
+
+
+def test_c005_inline_disable_respected():
+    findings, suppressed = _lint("""
+        import queue
+        import threading
+
+        class Stage:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue(8)
+
+            def post(self, v):
+                with self._lock:
+                    self._q.put(v)  # graftlint: disable=GL-C005 (consumer drains lock-free)
+    """)
+    assert [f for f in findings if f.rule_id == "GL-C005"] == []
+    assert suppressed == 1
+
+
+# -- GL-C006: lock-order cycles (whole-program phase, ISSUE 16) -------------------------
+
+_ABBA = """
+    import threading
+
+    class Ledger:
+        def __init__(self):
+            self._accounts = threading.Lock()
+            self._audit = threading.Lock()
+
+        def credit(self):
+            with self._accounts:
+                with self._audit:  # WITNESS: accounts -> audit
+                    pass
+
+        def reconcile(self):
+            with self._audit:
+                with self._accounts:
+                    pass
+"""
+
+
+def test_c006_fires_on_abba_with_both_witnesses():
+    findings, _ = _lint(_ABBA)
+    f = _only_rule(findings, "GL-C006")[0]
+    assert f.line == _line_of(_ABBA, "WITNESS: accounts -> audit")
+    # both witness paths named, with function and location each
+    assert "credit" in f.message and "reconcile" in f.message
+    assert f.message.count("fixture.py:") == 2
+    assert f.severity == "warning"
+
+
+def test_c006_consistent_order_is_clean():
+    findings, _ = _lint("""
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._accounts = threading.Lock()
+                self._audit = threading.Lock()
+
+            def credit(self):
+                with self._accounts:
+                    with self._audit:
+                        pass
+
+            def reconcile(self):
+                with self._accounts:
+                    with self._audit:
+                        pass
+    """)
+    assert [f for f in findings if f.rule_id == "GL-C006"] == []
+
+
+def test_c006_one_hop_acquisition_contributes_order_edge():
+    """holding A and calling a helper that takes B is an A->B edge; a direct
+    B->A elsewhere completes the cycle."""
+    src = """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def _under_b(self):
+                with self._b:
+                    pass
+
+            def forward(self):
+                with self._a:  # WITNESS: a -> b via helper
+                    self._under_b()
+
+            def backward(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-C006")[0]
+    assert "_under_b" in f.message
+    assert "forward" in f.message and "backward" in f.message
+
+
+def test_c006_ctor_passed_lock_unifies_identity_across_classes(tmp_path):
+    """A lock passed into another class's constructor is ONE identity: the
+    owner takes meta->lock, the helper (holding the same lock under its own
+    attribute name) takes lock->meta — an ABBA the per-file view cannot see.
+    Split across two modules to exercise the corpus-level index."""
+    owner = tmp_path / "owner.py"
+    owner.write_text(textwrap.dedent("""
+        import threading
+
+        from helper import Flusher
+
+        class Cache:
+            def __init__(self):
+                self._slots = threading.Lock()
+                self._meta = threading.Lock()
+                self._flusher = Flusher(self._slots, self._meta)
+
+            def evict(self):
+                with self._meta:
+                    with self._slots:
+                        pass
+    """))
+    helper = tmp_path / "helper.py"
+    helper.write_text(textwrap.dedent("""
+        class Flusher:
+            def __init__(self, slots_lock, meta_lock):
+                self._guard = slots_lock
+                self._m = meta_lock
+
+            def flush(self):
+                with self._guard:
+                    with self._m:
+                        pass
+    """))
+    from petastorm_tpu.analysis.engine import analyze_paths
+
+    findings, _ = analyze_paths([str(owner), str(helper)])
+    cycles = [f for f in findings if f.rule_id == "GL-C006"]
+    assert cycles, "ctor-passed lock identities did not unify"
+    assert "evict" in cycles[0].message and "flush" in cycles[0].message
+
+
+def test_c006_three_lock_cycle_reported_once():
+    findings, _ = _lint("""
+        import threading
+
+        class Triad:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._c = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def bc(self):
+                with self._b:
+                    with self._c:
+                        pass
+
+            def ca(self):
+                with self._c:
+                    with self._a:
+                        pass
+    """)
+    cycles = _only_rule(findings, "GL-C006")
+    assert len(cycles) == 1
+    msg = cycles[0].message
+    assert "_a" in msg and "_b" in msg and "_c" in msg
+
+
+def test_project_phase_finding_round_trips_through_baseline(tmp_path):
+    """A GL-C005 finding flows through --write-baseline and back to exit 0
+    exactly like a per-file finding."""
+    mod = tmp_path / "stage.py"
+    mod.write_text(textwrap.dedent("""
+        import queue
+        import threading
+
+        class Stage:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue(8)
+
+            def post(self, v):
+                with self._lock:
+                    self._q.put(v)
+    """))
+    bl_path = tmp_path / ".graftlint-baseline.json"
+    assert lint_main([str(mod), "--no-baseline"]) == 1
+    assert lint_main([str(mod), "--baseline", str(bl_path),
+                      "--write-baseline"]) == 0
+    entries = json.loads(bl_path.read_text())["entries"]
+    assert any(e["rule"] == "GL-C005" for e in entries)
+    assert lint_main([str(mod), "--baseline", str(bl_path)]) == 0
+
+
+def test_cli_select_project_rule_id(tmp_path, capsys):
+    mod = tmp_path / "stage.py"
+    mod.write_text(textwrap.dedent("""
+        import queue
+        import threading
+
+        class Stage:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue(8)
+
+            def post(self, v):
+                with self._lock:
+                    self._q.put(v)
+    """))
+    assert lint_main([str(mod), "--no-baseline", "--select", "GL-C005"]) == 1
+    assert lint_main([str(mod), "--no-baseline", "--ignore", "GL-C005"]) == 0
+    out = capsys.readouterr().out
+    assert "GL-C005" in out
+
+
+# -- concurrent.futures typing for GL-R001/GL-C002 (ISSUE 16) ---------------------------
+
+
+def test_r001_untimed_future_result_fires():
+    src = """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def fetch(url):
+            pool = ThreadPoolExecutor(4)
+            fut = pool.submit(load, url)
+            return fut.result()  # BUG: untimed result
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-R001")[0]
+    assert f.line == _line_of(src, "BUG: untimed result")
+    assert "result" in f.message
+
+
+def test_r001_timed_future_result_is_clean():
+    findings, _ = _lint("""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def fetch(url):
+            pool = ThreadPoolExecutor(4)
+            fut = pool.submit(load, url)
+            return fut.result(timeout=30.0)
+    """)
+    assert [f for f in findings if f.rule_id == "GL-R001"] == []
+
+
+def test_r001_future_list_loop_variable_is_typed():
+    src = """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def fetch_all(urls):
+            pool = ThreadPoolExecutor(4)
+            futs = [pool.submit(load, u) for u in urls]
+            out = []
+            for f in futs:
+                out.append(f.result())  # BUG: untimed result in loop
+            return out
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-R001")[0]
+    assert f.line == _line_of(src, "BUG: untimed result in loop")
+
+
+def test_r001_future_list_comprehension_variable_is_typed():
+    src = """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def fetch_all(urls):
+            pool = ThreadPoolExecutor(4)
+            futs = [pool.submit(load, u) for u in urls]
+            return [f.result() for f in futs]  # BUG: untimed result in comp
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-R001")[0]
+    assert f.line == _line_of(src, "BUG: untimed result in comp")
+
+
+def test_r001_untimed_futures_wait_fires_via_import_alias():
+    src = """
+        from concurrent import futures
+
+        def drain(fs):
+            futures.wait(fs)  # BUG: untimed wait
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-R001")[0]
+    assert f.line == _line_of(src, "BUG: untimed wait")
+
+
+def test_r001_bare_wait_without_futures_import_is_clean():
+    findings, _ = _lint("""
+        def drain(cond):
+            cond.wait()
+    """)
+    assert [f for f in findings if f.rule_id == "GL-R001"] == []
+
+
+def test_r001_timed_futures_wait_is_clean():
+    findings, _ = _lint("""
+        import concurrent.futures
+
+        def drain(fs):
+            concurrent.futures.wait(fs, timeout=10.0)
+    """)
+    assert [f for f in findings if f.rule_id == "GL-R001"] == []
+
+
+def test_c002_untimed_future_result_on_teardown_fires():
+    src = """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Flusher:
+            def __init__(self):
+                self._pool = ThreadPoolExecutor(2)
+                self._flush_future = self._pool.submit(self._flush)
+
+            def stop(self):
+                self._flush_future.result()  # BUG: untimed result in stop()
+    """
+    findings, _ = _lint(src)
+    hits = [f for f in findings if f.rule_id == "GL-C002"]
+    assert hits and hits[0].line == _line_of(src, "BUG: untimed result in stop()")
+
+
+def test_c002_timed_future_result_on_teardown_is_clean():
+    findings, _ = _lint("""
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Flusher:
+            def __init__(self):
+                self._pool = ThreadPoolExecutor(2)
+                self._futures = []
+                self._futures.append(self._pool.submit(self._flush))
+
+            def stop(self):
+                for fut in self._futures:
+                    fut.result(timeout=5.0)
+    """)
+    assert [f for f in findings if f.rule_id == "GL-C002"] == []
+
+
+# -- --format github --------------------------------------------------------------------
+
+
+def test_cli_github_format_emits_workflow_annotations(tmp_path, capsys, monkeypatch):
+    mod = tmp_path / "stage.py"
+    mod.write_text(textwrap.dedent("""
+        import queue
+        import threading
+
+        class Stage:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue(8)
+
+            def post(self, v):
+                with self._lock:
+                    self._q.put(v)
+    """))
+    monkeypatch.chdir(tmp_path)
+    assert lint_main(["stage.py", "--no-baseline", "--format", "github"]) == 1
+    out = capsys.readouterr().out
+    line = next(l for l in out.splitlines() if l.startswith("::"))
+    assert line.startswith("::error file=stage.py,line=")
+    assert "title=GL-C005" in line
+    # message is escaped per workflow-command rules: no raw newlines possible,
+    # and the body follows the :: separator
+    assert "::`self._q.put()`" in line.replace("%60", "`") or "::" in line
+
+
+def test_cli_github_format_clean_exit_zero(tmp_path, capsys):
+    mod = tmp_path / "ok.py"
+    mod.write_text("x = 1\n")
+    assert lint_main([str(mod), "--no-baseline", "--format", "github"]) == 0
+    out = capsys.readouterr().out
+    assert "::error" not in out and "::warning" not in out

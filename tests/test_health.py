@@ -303,6 +303,7 @@ def _hang_high_groups(df):
     return df
 
 
+@pytest.mark.slow
 def test_hung_pool_child_flight_record_has_child_stacks(tmp_path):
     if not hasattr(__import__("signal"), "SIGUSR1"):
         pytest.skip("no SIGUSR1 on this platform")

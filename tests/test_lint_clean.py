@@ -29,3 +29,18 @@ def test_package_lints_clean_without_any_suppression_mechanism():
     the concurrency fixes in workers.py/loader.py are real, not baselined."""
     rc = lint_main([os.path.join(REPO_ROOT, "petastorm_tpu"), "--no-baseline"])
     assert rc == 0
+
+
+def test_executor_loader_carry_no_deadlock_rule_suppressions():
+    """The whole-program deadlock rules (GL-C005/GL-C006) must hold on
+    workers.py/loader.py WITHOUT inline disables: PR 13's deadlock was fixed
+    by restructuring (post the sentinel outside the lock), and that fix
+    staying real — not suppressed — is the point of the project phase."""
+    for name in ("workers.py", "loader.py"):
+        path = os.path.join(REPO_ROOT, "petastorm_tpu", name)
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        for rule in ("GL-C005", "GL-C006"):
+            assert rule not in source, (
+                "%s suppresses %s inline — the deadlock rules must pass on "
+                "the executor/loader layer by construction" % (name, rule))

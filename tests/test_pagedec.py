@@ -364,6 +364,7 @@ def test_passthrough_materialize_columns_helper():
 # -- device kernels (interpret mode, like the JPEG tests) -------------------------------
 
 
+@pytest.mark.slow
 def test_kernel_chunk_identity_vs_reference():
     from petastorm_tpu.ops import pagedec_kernels as pk
 
@@ -446,6 +447,7 @@ def test_covering_pages_window_selection():
                           want[starts[1] + 3:starts[1] + 8])
 
 
+@pytest.mark.slow
 def test_kernel_rle_expand_matches_reference():
     from petastorm_tpu.ops import pagedec_kernels as pk
 
@@ -588,6 +590,7 @@ def test_loaderless_reader_materializes(tmp_path):
         assert isinstance(b.cat, np.ndarray) and b.cat.dtype == np.int64
 
 
+@pytest.mark.slow
 def test_lease_accounting_and_copy_census(tmp_path):
     """shm-view process pool with pass-through on: zero leaked leases, and
     the pass-through columns add no loader-side host copies (the census

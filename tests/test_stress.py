@@ -138,7 +138,11 @@ def test_loader_abandoned_during_staged_decode(tmp_path):
                 assert not loader._transfer_thread.is_alive()
 
 
-@pytest.mark.parametrize("pool", ["thread", "process"])
+@pytest.mark.parametrize("pool", [
+    "thread",
+    # the process variant pays full pool spawn/teardown twice (~8s) — slow lane
+    pytest.param("process", marks=pytest.mark.slow),
+])
 def test_reset_races_in_flight_results(scalar_dataset, pool):
     """reset() issued while the pool still has work in flight: the restarted epoch
     stream must be exact (every row exactly once per epoch) with no residue from the

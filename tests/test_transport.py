@@ -308,6 +308,7 @@ def _leaked_total():
     return default_registry().counter("ptpu_lease_leaked_total").value
 
 
+@pytest.mark.slow
 def test_sigkill_mid_epoch_with_checkpoint_watermark_resume(transport_dataset):
     """SIGKILL a remote-side (tcp) worker mid-epoch, checkpoint AFTER the
     kill was absorbed, resume in a fresh reader: the union of both passes is
