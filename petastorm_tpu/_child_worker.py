@@ -124,6 +124,14 @@ def main():
         from petastorm_tpu import chaos as _chaos
 
         _chaos.arm_from_env(in_child=True)
+        # host-wide cache arena (ISSUE 17): a parent that owns a mapped warm
+        # set exports PTPU_ARENA_ATTACH; attaching here — before the first
+        # item — means even a freshly RESPAWNED child's first read of a warm
+        # piece maps shared footers/columns instead of refilling cold.
+        # Failure-tolerant: attach trouble degrades warn-once inside resolve.
+        from petastorm_tpu.io import arena as _arena_mod
+
+        _arena_mod.attach_from_env()
         # provenance (ISSUE 10): children always record their per-item causal
         # spans (a handful of perf_counter pairs per row-group item — the same
         # always-on justification as the trace piggyback above) and ship them

@@ -41,6 +41,12 @@ CLOSEABLE_FACTORIES = frozenset({
     # TieredCache pins the mem tier's process-wide bytes (clear() releases
     # both)
     "RemoteReadEngine", "FooterCache", "TieredCache",
+    # ISSUE-17 host-wide cache arena: a CacheArena owns named /dev/shm
+    # segments (creator: close() unlinks the whole set; attacher: close()/
+    # detach() drops the mappings and deregisters the pid) — leaking one
+    # strands host-wide shared memory past process exit, same failure class
+    # as a bare SharedMemory
+    "CacheArena",
 })
 
 #: calls that merely CONSUME an iterable without taking ownership of it
@@ -49,7 +55,7 @@ _CONSUMERS = frozenset({"list", "iter", "next", "enumerate", "sorted", "zip",
                         "print", "repr", "str", "isinstance", "type"})
 
 _CLOSERS = frozenset({"stop", "close", "join", "terminate", "shutdown", "unlink",
-                      "clear", "release"})
+                      "clear", "release", "detach"})
 
 
 class ResourceLifecycleRule(Rule):

@@ -144,6 +144,16 @@ def main(argv=None):
         from petastorm_tpu.benchmark import decompress as decompress_bench
 
         return decompress_bench.main(argv[1:])
+    if argv and argv[0] == "shmcache":
+        # `petastorm-tpu-bench shmcache ...`: the host-wide cache arena
+        # acceptance harness — a second process attaches the first's mapped
+        # warm set and must drain byte-identical batches with ZERO store
+        # reads, >=90% arena hits, zero copy-census bytes on serves, and
+        # host-wide resident bytes <=1.2x one process's warm set — see
+        # benchmark/shmcache.py
+        from petastorm_tpu.benchmark import shmcache as shmcache_bench
+
+        return shmcache_bench.main(argv[1:])
     if argv and argv[0] == "diff":
         # `petastorm-tpu-bench diff run_a run_b`: regression forensics over
         # two trend entries — names WHICH site's critical-path self time

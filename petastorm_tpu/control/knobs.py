@@ -210,6 +210,17 @@ class KnobSet:
         return out
 
 
+def _process_arena():
+    from petastorm_tpu.io import arena as arena_mod
+
+    return arena_mod.process_arena()
+
+
+def _arena_budget():
+    arena_obj = _process_arena()
+    return arena_obj.budget if arena_obj is not None else 0
+
+
 def build_knobset(reader):
     """The standard :class:`KnobSet` over a running reader's live components.
 
@@ -238,6 +249,10 @@ def build_knobset(reader):
       the controller's live revert-to-host-inflate lever;
     - ``mem_cache_bytes`` — the mem tier's byte budget (the hot-row-group
       promotion lever) when a mem tier exists (in-process only);
+    - ``arena_bytes`` — the host-wide shared cache arena budget (ISSUE 17):
+      bound for EVERY pool type because the budget lives in the arena's
+      shared control segment — one parent-side actuation governs admissions
+      in all attached processes, and the shrink path evicts host-wide;
     - ``disk_admit`` — the tiered admission policy enum (in-process only —
       a process pool's cache tiers live in the children with no parent-side
       truth to read back).
@@ -312,6 +327,16 @@ def build_knobset(reader):
                 get=worker.live_pagedec,
                 apply_fn=reader.apply_pagedec,
                 values=("auto", "on", "off"), default=opts.pagedec)
+    if _process_arena() is not None and getattr(opts, "arena_bytes", 0):
+        # the host-wide arena budget (ISSUE 17): registered for process pools
+        # too — the budget lives in the SHARED control segment (the parent is
+        # the creator), so this parent-side actuation governs every attached
+        # child's admissions without needing the broadcast frame
+        ks.numeric("arena_bytes",
+                   get=_arena_budget,
+                   apply_fn=worker.apply_arena_bytes,
+                   lo=8 << 20, hi=64 << 30, default=opts.arena_bytes,
+                   unit="bytes")
     if not in_process:
         # the cache tiers live only in the children for process pools —
         # budget/admission stay construction-time there (their retunes have

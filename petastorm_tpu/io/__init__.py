@@ -85,6 +85,16 @@ class IoOptions:
     memcache_bytes      PTPU_MEMCACHE_BYTES        in-memory decoded-row-group
                                                    LRU budget (0 = off, the
                                                    default)
+    arena_bytes         PTPU_ARENA_BYTES           host-wide shared-memory
+                                                   cache arena budget (ISSUE
+                                                   17): decoded columns,
+                                                   footer blobs and page-index
+                                                   memos live in ONE mapped
+                                                   warm set shared by every
+                                                   process on the host (0 =
+                                                   off, the default;
+                                                   PTPU_ARENA=off kills it
+                                                   even when budgeted)
     memcache_writable_  PTPU_MEMCACHE_WRITABLE_    legacy pre-lease contract:
     hits                HITS                       deep-copy every memcache
                                                    serve writable (default off:
@@ -110,12 +120,13 @@ class IoOptions:
 
     __slots__ = ("readahead", "readahead_depth", "readahead_bytes", "io_threads",
                  "coalesce", "coalesce_max_run", "work_stealing", "memcache_bytes",
-                 "memcache_writable_hits", "pagedec", "remote")
+                 "memcache_writable_hits", "arena_bytes", "pagedec", "remote")
 
     def __init__(self, readahead=None, readahead_depth=None, readahead_bytes=None,
                  io_threads=None, coalesce=None, coalesce_max_run=None,
                  work_stealing=None, memcache_bytes=None,
-                 memcache_writable_hits=None, pagedec=None, remote=None):
+                 memcache_writable_hits=None, arena_bytes=None, pagedec=None,
+                 remote=None):
         self.readahead = _env_bool("PTPU_READAHEAD", True) \
             if readahead is None else bool(readahead)
         self.readahead_depth = max(1, _env_int("PTPU_READAHEAD_DEPTH", 3)
@@ -140,6 +151,11 @@ class IoOptions:
         self.memcache_writable_hits = \
             _env_bool("PTPU_MEMCACHE_WRITABLE_HITS", False) \
             if memcache_writable_hits is None else bool(memcache_writable_hits)
+        # host-wide shared cache arena budget (ISSUE 17): 0 keeps today's
+        # per-process caches; >0 makes the creating reader own one mapped warm
+        # set that pool children and co-resident readers attach to
+        self.arena_bytes = max(0, _env_int("PTPU_ARENA_BYTES", 0)
+                               if arena_bytes is None else int(arena_bytes))
         # compressed-page pass-through (ISSUE 14): "auto" engages only when a
         # non-CPU jax backend is already initialized in the worker process
         # (host inflate is strictly cheaper when there is no PCIe link to

@@ -1,0 +1,1045 @@
+"""Host-wide zero-copy cache arena: one mapped warm set shared by every process.
+
+Before ISSUE 17 every pool child warmed its own ``FooterCache`` / ``MemCache``
+/ ``PageIndexCache`` — the explicit remaining headroom from PR 8 — so a host
+running N decode workers plus a trainer paid N× the parse cost, N× the
+resident bytes and N× the cold-start for one identical warm set. This module
+is the Zerrow answer ("Zerrow: True Zero-Copy Arrow Pipelines in Bauplan",
+PAPERS.md): put the hot bytes in ONE named shared-memory segment set and make
+the per-process caches *views* that map instead of copy.
+
+Architecture (extends the PR 6 ``Lease``/``SlabRing`` discipline from wire
+transport to resident cache):
+
+- One **creator** process (the first reader to ask, via :func:`host_arena`)
+  owns a small fixed-size **control segment** holding a pickled byte-budgeted
+  LRU index — ``{key -> (segment name, nbytes, generation token, LRU tick,
+  per-pid holder refcounts)}`` — plus one shm segment per cached entry.
+- **Attachers** (pool children at bootstrap via :func:`attach_from_env`, or
+  any process handed a picklable :class:`ArenaSpec`) map the same segments
+  read-mostly; promote/evict decisions go through the control segment under a
+  cross-process ``fcntl.flock`` (serialized per-process by a ``threading``
+  lock — one global order, lint-visible to GL-C006).
+- Every serve is a **zero-copy read-only view** over the mapped entry segment
+  pinned by a :class:`~petastorm_tpu.io.lease.Lease` (``kind="arena"`` — the
+  existing ``ptpu_lease_*`` counters and leak census apply unchanged). The
+  per-pid holder refcount in the control segment keeps an entry unevictable
+  while ANY process holds it; :meth:`CacheArena.reclaim` drops the refcounts
+  of dead pids (SIGKILLed children) exactly like ``SlabRing.reclaim``.
+- **Generation tokens** (ISSUE 11) validate entries across the dataset-watch
+  plane: a ``get`` under a different generation invalidates and misses, so a
+  rewritten source file can never serve its predecessor's shared payload.
+- Admission pays ONE copy into shm, charged to the ``arena_admit`` site of
+  the copy census (``ptpu_copy_bytes_total``); serves add zero census bytes —
+  the ``petastorm-tpu-bench shmcache`` gate pins both.
+
+POSIX semantics make eviction safe without a coherence protocol: unlinking a
+segment removes its NAME but never invalidates existing mappings, so peers'
+live views survive any eviction/invalidation; only new attaches miss.
+
+Degradations (never a raise on the read path): ``arena_unavailable`` (shm or
+flock missing, creation failed, ``PTPU_ARENA=off``) falls back to today's
+per-process caches; ``arena_full`` declines admission; ``arena_lease_revoked``
+counts holder refcounts reclaimed from dead processes.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import pickle
+import struct
+import tempfile
+import threading
+
+import numpy as np
+
+from petastorm_tpu.io.lease import Lease, count_copy
+from petastorm_tpu.obs.log import degradation
+
+#: /dev/shm segment name prefix — the test suite's leak fixture and operators
+#: debugging a wedged host both grep for it (same convention as
+#: ``shm_ring.SEGMENT_PREFIX``).
+ARENA_PREFIX = "ptpu_arena_"
+
+_CTL_MAGIC = b"PTAC"
+_ENTRY_MAGIC = b"PTAE"
+_HEADER = struct.Struct("<4sQ")  # magic, payload length
+_ALIGN = 64  # ndarray blob slots align to cache lines (clean dtype views)
+
+#: default control-segment size: holds the pickled index for a few thousand
+#: entries; admission degrades (``arena_full``) when the index outgrows it
+DEFAULT_CTL_BYTES = 1 << 20
+
+
+class ArenaSpec:
+    """Picklable attach handle: everything a process needs to map an existing
+    arena (segment names derive from the token). Rides worker pickles and the
+    ``PTPU_ARENA_ATTACH`` env var (the ``PTPU_CHAOS_SPEC`` convention) so
+    freshly respawned or elastically-grown children start warm."""
+
+    __slots__ = ("token",)
+
+    def __init__(self, token):
+        self.token = str(token)
+
+    def __repr__(self):
+        return "ArenaSpec(%r)" % self.token
+
+    def __eq__(self, other):
+        return isinstance(other, ArenaSpec) and other.token == self.token
+
+    def __hash__(self):
+        return hash(self.token)
+
+
+# -- zero-copy payload codec -----------------------------------------------------------
+#
+# A cached payload (column dict / row list / nested containers) is split into
+# a picklable META tree — real ndarrays replaced by _ND placeholders — and one
+# contiguous ndarray BLOB. Decode rebuilds the tree with np.frombuffer views
+# over the mapped blob (read-only): fresh containers, shared buffers.
+
+
+class _ND:
+    """Placeholder for one non-object ndarray in the meta tree."""
+
+    __slots__ = ("dtype", "shape", "off", "nbytes")
+
+    def __init__(self, dtype, shape, off, nbytes):
+        self.dtype = dtype
+        self.shape = shape
+        self.off = off
+        self.nbytes = nbytes
+
+    def __reduce__(self):
+        return (_ND, (self.dtype, self.shape, self.off, self.nbytes))
+
+
+class _NDObj:
+    """Placeholder for an object-dtype ndarray: shape + encoded elements."""
+
+    __slots__ = ("shape", "elems")
+
+    def __init__(self, shape, elems):
+        self.shape = shape
+        self.elems = elems
+
+    def __reduce__(self):
+        return (_NDObj, (self.shape, self.elems))
+
+
+def _encode_payload(value):
+    """``(meta, parts, blob_len)`` — ``parts`` is ``[(offset, contiguous
+    ndarray)]`` to be copied into the entry segment's blob region."""
+    parts = []
+    state = [0]  # running blob offset
+
+    def enc(v):
+        if isinstance(v, np.ndarray):
+            if v.dtype == object:
+                return _NDObj(v.shape, [enc(e) for e in v.reshape(-1)])
+            arr = np.ascontiguousarray(v)
+            off = (state[0] + _ALIGN - 1) & ~(_ALIGN - 1)
+            state[0] = off + arr.nbytes
+            parts.append((off, arr))
+            return _ND(arr.dtype.str, arr.shape, off, arr.nbytes)
+        if isinstance(v, dict):
+            return {k: enc(e) for k, e in v.items()}
+        if isinstance(v, list):
+            return [enc(e) for e in v]
+        if isinstance(v, tuple):
+            return tuple(enc(e) for e in v)
+        return v
+
+    meta = enc(value)
+    return meta, parts, state[0]
+
+
+def _decode_payload(meta, buf, blob_base):
+    """Rebuild the payload with read-only zero-copy views over ``buf``."""
+
+    def dec(m):
+        if isinstance(m, _ND):
+            arr = np.frombuffer(buf, dtype=np.dtype(m.dtype),
+                                count=m.nbytes // np.dtype(m.dtype).itemsize
+                                if np.dtype(m.dtype).itemsize else 0,
+                                offset=blob_base + m.off)
+            arr = arr.reshape(m.shape)
+            arr.flags.writeable = False
+            return arr
+        if isinstance(m, _NDObj):
+            out = np.empty(m.shape, dtype=object)
+            flat = out.reshape(-1)
+            for i, e in enumerate(m.elems):
+                flat[i] = dec(e)
+            return out
+        if isinstance(m, dict):
+            return {k: dec(e) for k, e in m.items()}
+        if isinstance(m, list):
+            return [dec(e) for e in m]
+        if isinstance(m, tuple):
+            return tuple(dec(e) for e in m)
+        return m
+
+    return dec(meta)
+
+
+# -- metrics ---------------------------------------------------------------------------
+
+
+class _ArenaMetrics:
+    """Process-local ``ptpu_io_arena_*`` family (built on first arena)."""
+
+    __slots__ = ("hits", "misses", "admits", "evictions", "invalidations",
+                 "attaches", "revoked", "bytes", "entries")
+
+    def __init__(self):
+        from petastorm_tpu.obs.metrics import default_registry
+
+        reg = default_registry()
+        self.hits = reg.counter("ptpu_io_arena_hits_total",
+                                help="reads served from the shared cache arena")
+        self.misses = reg.counter("ptpu_io_arena_misses_total",
+                                  help="arena lookups that missed")
+        self.admits = reg.counter("ptpu_io_arena_admits_total",
+                                  help="entries copied into the arena")
+        self.evictions = reg.counter("ptpu_io_arena_evictions_total",
+                                     help="entries LRU-evicted for budget")
+        self.invalidations = reg.counter(
+            "ptpu_io_arena_invalidations_total",
+            help="entries dropped by keyed/generation invalidation")
+        self.attaches = reg.counter("ptpu_io_arena_attaches_total",
+                                    help="processes that mapped this arena")
+        self.revoked = reg.counter(
+            "ptpu_io_arena_holders_revoked_total",
+            help="dead-process holder refcounts dropped by reclaim()")
+        self.bytes = reg.gauge("ptpu_io_arena_bytes",
+                               help="payload bytes resident in the arena")
+        self.entries = reg.gauge("ptpu_io_arena_entries",
+                                 help="entries resident in the arena")
+
+
+_metrics_lock = threading.Lock()
+_metrics_cache = [None]
+
+
+def _arena_metrics():
+    if _metrics_cache[0] is None:
+        with _metrics_lock:
+            if _metrics_cache[0] is None:
+                _metrics_cache[0] = _ArenaMetrics()
+    return _metrics_cache[0]
+
+
+class _CtlFull(Exception):
+    """Pickled index outgrew the control segment (admission declined)."""
+
+
+class CacheArena:
+    """The host-wide shared cache arena: one control segment + one shm segment
+    per entry, cross-process coordinated under a flock'd lock file.
+
+    Construct with ``budget_bytes`` to CREATE (this process owns the segments
+    and unlinks them at :meth:`close`), or with ``spec=`` to ATTACH to an
+    existing arena (:meth:`close` then merely detaches — never unlinks).
+    Graftlint GL-L001 tracks construction; ``close()``/``detach()`` are the
+    closers.
+    """
+
+    def __init__(self, budget_bytes=None, spec=None, ctl_bytes=DEFAULT_CTL_BYTES):
+        from multiprocessing import shared_memory
+
+        if (budget_bytes is None) == (spec is None):
+            raise ValueError("pass exactly one of budget_bytes (create) or "
+                             "spec (attach)")
+        import fcntl  # noqa: F401 — POSIX-only; ImportError → arena unavailable
+
+        self._fcntl = fcntl
+        self._tlock = threading.Lock()
+        self._closed = False
+        self._creator = spec is None
+        self._maps = {}  # segment name -> SharedMemory (entry segments)
+        self._pid = os.getpid()
+        self._ctl_bytes = int(ctl_bytes)
+        if self._creator:
+            token = "%d_%s" % (os.getpid(), os.urandom(4).hex())
+            self.spec = ArenaSpec(token)
+            self._lock_path = _lock_path(token)
+            lock_fd = os.open(self._lock_path,
+                              os.O_CREAT | os.O_RDWR, 0o600)
+            self._lock_fd = lock_fd
+            ctl = shared_memory.SharedMemory(
+                create=True, size=self._ctl_bytes, name=_ctl_name(token))
+            self._ctl = ctl
+            index = {"budget": int(budget_bytes), "serial": 0, "tick": 0,
+                     "total": 0, "attached": {self._pid: True}, "entries": {}}
+            with self._tlock:
+                self._flock()
+                try:
+                    self._write_index(index)
+                finally:
+                    self._funlock()
+        else:
+            token = spec.token
+            self.spec = ArenaSpec(token)
+            self._lock_path = _lock_path(token)
+            lock_fd = os.open(self._lock_path, os.O_RDWR)  # must pre-exist
+            self._lock_fd = lock_fd
+            ctl = shared_memory.SharedMemory(name=_ctl_name(token))
+            _untrack_segment(ctl)
+            self._ctl = ctl
+            self._ctl_bytes = ctl.size
+            with self._tlock:
+                self._flock()
+                try:
+                    index = self._read_index()
+                    index["attached"][self._pid] = True
+                    self._write_index(index)
+                finally:
+                    self._funlock()
+        _arena_metrics().attaches.inc()
+
+    # -- cross-process lock (order: _tlock -> flock, everywhere) ------------------------
+
+    def _flock(self):
+        self._fcntl.flock(self._lock_fd, self._fcntl.LOCK_EX)
+
+    def _funlock(self):
+        self._fcntl.flock(self._lock_fd, self._fcntl.LOCK_UN)
+
+    # -- control-segment index ----------------------------------------------------------
+
+    def _read_index(self):
+        buf = self._ctl.buf
+        magic, length = _HEADER.unpack_from(buf, 0)
+        if magic != _CTL_MAGIC or length > self._ctl_bytes - _HEADER.size:
+            raise RuntimeError("arena control segment corrupt")
+        return pickle.loads(bytes(buf[_HEADER.size:_HEADER.size + length]))
+
+    def _write_index(self, index):
+        blob = pickle.dumps(index, protocol=pickle.HIGHEST_PROTOCOL)
+        if _HEADER.size + len(blob) > self._ctl_bytes:
+            raise _CtlFull()
+        buf = self._ctl.buf
+        _HEADER.pack_into(buf, 0, _CTL_MAGIC, len(blob))
+        buf[_HEADER.size:_HEADER.size + len(blob)] = blob
+
+    # -- admission ----------------------------------------------------------------------
+
+    def put(self, key, value, gen=None):
+        """Admit ``value`` under ``key`` (idempotent: an existing same-
+        generation entry is kept, not re-copied). Returns True when the entry
+        is resident after the call. The one copy — payload bytes into shm —
+        is charged to the ``arena_admit`` census site."""
+        try:
+            meta, parts, blob_len = _encode_payload(value)
+        except Exception:  # noqa: BLE001 — unpicklable/exotic payloads stay local
+            return False
+        return self._admit(key, gen, meta, parts, blob_len)
+
+    def put_bytes(self, key, data, gen=None):
+        """Admit a raw blob (serialized footer, pickled page-boundary memo)."""
+        arr = np.frombuffer(bytes(data), dtype=np.uint8)
+        return self._admit(key, gen, _ND("|u1", arr.shape, 0, arr.nbytes),
+                           [(0, arr)], arr.nbytes)
+
+    def _admit(self, key, gen, meta, parts, blob_len):
+        from multiprocessing import shared_memory
+
+        if self._closed:
+            return False
+        try:
+            meta_blob = pickle.dumps(meta, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:  # noqa: BLE001 — unpicklable meta leaf: stay local
+            return False
+        blob_base = _blob_base(len(meta_blob))
+        seg_size = max(16, blob_base + blob_len)
+        # budget/census charge = EVERYTHING written into shm: the ndarray
+        # blob plus the pickled meta tree (bytes-leaf payloads — binary
+        # columns — live in the meta, and must not ride the budget for free)
+        nbytes = blob_len + len(meta_blob)
+        with self._tlock:
+            self._flock()
+            try:
+                try:
+                    index = self._read_index()
+                except Exception:  # noqa: BLE001 — corrupt ctl: decline, keep serving locally
+                    return False
+                entry = index["entries"].get(key)
+                if entry is not None and entry["gen"] == gen:
+                    return True  # another process admitted it first
+                if entry is not None:
+                    self._drop_entry(index, key, entry, invalidation=True)
+                if nbytes > index["budget"]:
+                    degradation(
+                        "arena_full",
+                        "arena admission declined: %d-byte payload exceeds "
+                        "the whole arena budget (%d)", nbytes,
+                        index["budget"])
+                    return False
+                self._evict_for(index, nbytes)
+                if index["total"] + nbytes > index["budget"]:
+                    degradation(
+                        "arena_full",
+                        "arena admission declined: budget %d full with "
+                        "held/hot entries", index["budget"])
+                    return False
+                index["serial"] += 1
+                seg_name = _entry_name(self.spec.token, index["serial"])
+                try:
+                    seg = shared_memory.SharedMemory(
+                        create=True, size=seg_size, name=seg_name)
+                except Exception as e:  # noqa: BLE001 — /dev/shm full, etc.
+                    degradation("arena_full",
+                                "arena entry segment create failed (%s)", e)
+                    return False
+                if not self._creator:
+                    _untrack_segment(seg)
+                self._maps[seg_name] = seg
+                buf = seg.buf
+                _HEADER.pack_into(buf, 0, _ENTRY_MAGIC, len(meta_blob))
+                buf[_HEADER.size:_HEADER.size + len(meta_blob)] = meta_blob
+                for off, arr in parts:
+                    if arr.nbytes:
+                        start = blob_base + off
+                        buf[start:start + arr.nbytes] = \
+                            memoryview(arr).cast("B")
+                index["tick"] += 1
+                index["entries"][key] = {
+                    "seg": seg_name, "nbytes": nbytes, "gen": gen,
+                    "tick": index["tick"], "holders": {}}
+                index["total"] += nbytes
+                try:
+                    self._write_index(index)
+                except _CtlFull:
+                    # index outgrew the control segment: back the entry out
+                    del index["entries"][key]
+                    index["total"] -= nbytes
+                    self._unlink_seg(seg_name)
+                    self._rewrite_best_effort(index)
+                    degradation(
+                        "arena_full",
+                        "arena index outgrew the %d-byte control segment; "
+                        "admission declined", self._ctl_bytes)
+                    return False
+            finally:
+                self._funlock()
+        count_copy("arena_admit", nbytes)
+        m = _arena_metrics()
+        m.admits.inc()
+        m.bytes.set(index["total"])
+        m.entries.set(len(index["entries"]))
+        return True
+
+    def _rewrite_best_effort(self, index):
+        try:
+            self._write_index(index)
+        except Exception:  # noqa: BLE001 — ctl already held a larger index
+            pass  # graftlint: disable=GL-O002 (backout path; next write retries)
+
+    def _evict_for(self, index, incoming):
+        """LRU-evict unheld entries until ``incoming`` fits (lock held).
+        Entries with live holders are skipped — a mapped view pinned by a
+        lease must never have its bytes budget-reclaimed out from under the
+        budget accounting; dead holders are self-healed here."""
+        if index["total"] + incoming <= index["budget"]:
+            return
+        order = sorted(index["entries"].items(), key=lambda kv: kv[1]["tick"])
+        for key, entry in order:
+            if index["total"] + incoming <= index["budget"]:
+                break
+            self._prune_dead_holders(entry)
+            if any(entry["holders"].values()):
+                continue
+            self._drop_entry(index, key, entry, invalidation=False)
+
+    @staticmethod
+    def _prune_dead_holders(entry):
+        for pid in list(entry["holders"]):
+            if not _pid_alive(pid):
+                del entry["holders"][pid]
+
+    def _drop_entry(self, index, key, entry, invalidation):
+        del index["entries"][key]
+        index["total"] -= entry["nbytes"]
+        self._unlink_seg(entry["seg"])
+        m = _arena_metrics()
+        if invalidation:
+            m.invalidations.inc()
+        else:
+            m.evictions.inc()
+
+    def _unlink_seg(self, seg_name):
+        """Remove a segment's NAME (POSIX keeps peers' live mappings valid).
+        Our own mapping is kept in ``_maps`` — outstanding local views stay
+        backed; the mapping frees when the map entry drops and the last view
+        dies (numpy refcounting)."""
+        from multiprocessing import shared_memory
+
+        seg = self._maps.pop(seg_name, None)  # graftlint: disable=GL-C001 (every caller holds self._tlock: _admit, _lookup and invalidate take it before the index mutation that reaches here)
+        if seg is None:
+            try:
+                seg = shared_memory.SharedMemory(name=seg_name)
+                _untrack_segment(seg)
+            except FileNotFoundError:
+                return
+            except Exception:  # noqa: BLE001 — best-effort per segment
+                return
+        _tracked_unlink(seg)
+        _close_mappings([seg])
+
+    # -- serves -------------------------------------------------------------------------
+
+    def get(self, key, gen=None):
+        """``(value, lease)`` — zero-copy read-only views pinned by a
+        ``kind="arena"`` lease — or ``None`` on miss/generation mismatch.
+        The caller (a per-process cache admitting the views) releases the
+        lease when its entry drops; the holder refcount in the control
+        segment keeps the entry unevictable until then."""
+        got = self._lookup(key, gen)
+        if got is None:
+            return None
+        seg, meta_blob = got
+        try:
+            meta = pickle.loads(meta_blob)
+            value = _decode_payload(meta, seg.buf, _blob_base(len(meta_blob)))
+        except Exception:  # noqa: BLE001 — undecodable entry: release + miss
+            self._drop_holder(key, seg.name)
+            return None
+        lease = Lease(release_cb=_release_cb(self, key, seg.name),
+                      kind="arena")
+        return value, lease
+
+    def get_bytes(self, key, gen=None):
+        """A raw blob admitted with :meth:`put_bytes`, as ``bytes`` — or
+        ``None``. The (small, metadata-plane) blob is copied out and the
+        holder refcount dropped before returning: blob consumers parse once
+        per process and memoize the parse, not the bytes."""
+        got = self._lookup(key, gen)
+        if got is None:
+            return None
+        seg, meta_blob = got
+        try:
+            meta = pickle.loads(meta_blob)
+            base = _blob_base(len(meta_blob))
+            data = bytes(seg.buf[base:base + meta.nbytes])
+        except Exception:  # noqa: BLE001 — undecodable entry: miss
+            data = None
+        self._drop_holder(key, seg.name)
+        return data
+
+    def _lookup(self, key, gen):
+        """Hit: bump LRU + this pid's holder refcount, return the mapped
+        segment and its meta blob. Generation mismatch invalidates."""
+        from multiprocessing import shared_memory
+
+        if self._closed:
+            return None
+        m = _arena_metrics()
+        with self._tlock:
+            self._flock()
+            try:
+                try:
+                    index = self._read_index()
+                except Exception:  # noqa: BLE001 — corrupt ctl: miss
+                    m.misses.inc()
+                    return None
+                entry = index["entries"].get(key)
+                if entry is not None and gen is not None \
+                        and entry["gen"] != gen:
+                    self._drop_entry(index, key, entry, invalidation=True)
+                    self._rewrite_best_effort(index)
+                    entry = None
+                if entry is None:
+                    m.misses.inc()
+                    return None
+                seg = self._maps.get(entry["seg"])
+                if seg is None:
+                    try:
+                        seg = shared_memory.SharedMemory(name=entry["seg"])
+                    except Exception:  # noqa: BLE001 — vanished segment: self-heal
+                        self._drop_entry(index, key, entry, invalidation=True)
+                        self._rewrite_best_effort(index)
+                        m.misses.inc()
+                        return None
+                    if not self._creator:
+                        _untrack_segment(seg)
+                    self._maps[entry["seg"]] = seg
+                index["tick"] += 1
+                entry["tick"] = index["tick"]
+                holders = entry["holders"]
+                holders[self._pid] = holders.get(self._pid, 0) + 1
+                self._rewrite_best_effort(index)
+            finally:
+                self._funlock()
+        try:
+            magic, meta_len = _HEADER.unpack_from(seg.buf, 0)
+            if magic != _ENTRY_MAGIC:
+                raise RuntimeError("arena entry segment corrupt")
+            meta_blob = bytes(seg.buf[_HEADER.size:_HEADER.size + meta_len])
+        except Exception:  # noqa: BLE001 — torn entry: release holder, miss
+            self._drop_holder(key, seg.name)
+            m.misses.inc()
+            return None
+        m.hits.inc()
+        return seg, meta_blob
+
+    def _drop_holder(self, key, seg_name):
+        """Lease release callback: drop one of this pid's holder refcounts.
+        The entry may already be gone (invalidated/evicted after the holder's
+        process died and was reclaimed) — then there is nothing to do; the
+        local mapping stays until its views die."""
+        if self._closed:
+            return
+        with self._tlock:
+            self._flock()
+            try:
+                try:
+                    index = self._read_index()
+                except Exception:  # noqa: BLE001 — corrupt ctl during teardown
+                    return
+                entry = index["entries"].get(key)
+                if entry is None or entry["seg"] != seg_name:
+                    return
+                holders = entry["holders"]
+                n = holders.get(self._pid, 0)
+                if n <= 1:
+                    holders.pop(self._pid, None)
+                else:
+                    holders[self._pid] = n - 1
+                self._rewrite_best_effort(index)
+            finally:
+                self._funlock()
+
+    def contains(self, key):
+        with self._tlock:
+            if self._closed:
+                return False
+            self._flock()
+            try:
+                try:
+                    return key in self._read_index()["entries"]
+                except Exception:  # noqa: BLE001 — corrupt ctl reads as empty
+                    return False
+            finally:
+                self._funlock()
+
+    # -- invalidation / reclaim ---------------------------------------------------------
+
+    def invalidate(self, key):
+        """Drop one entry by key (ISSUE 11: dataset mutation). Peers' live
+        views stay valid — unlink removes the name, not the mappings."""
+        if self._closed:
+            return
+        with self._tlock:
+            self._flock()
+            try:
+                try:
+                    index = self._read_index()
+                except Exception:  # noqa: BLE001
+                    return
+                entry = index["entries"].get(key)
+                if entry is None:
+                    return
+                self._drop_entry(index, key, entry, invalidation=True)
+                self._rewrite_best_effort(index)
+            finally:
+                self._funlock()
+
+    def reclaim(self, pid=None):
+        """Drop the holder refcounts (and attach record) of dead processes —
+        the SIGKILLed-child path, same semantics as ``SlabRing.reclaim``:
+        the dead holder's pins vanish so its entries become evictable again;
+        live peers' views are untouched. ``pid=None`` sweeps every recorded
+        pid; returns the number of holder refcounts revoked."""
+        if self._closed:
+            return 0
+        revoked = 0
+        with self._tlock:
+            self._flock()
+            try:
+                try:
+                    index = self._read_index()
+                except Exception:  # noqa: BLE001
+                    return 0
+                targets = [pid] if pid is not None else None
+                for entry in index["entries"].values():
+                    for holder in list(entry["holders"]):
+                        dead = (holder in targets) if targets is not None \
+                            else not _pid_alive(holder)
+                        if dead:
+                            revoked += entry["holders"].pop(holder)
+                for holder in list(index["attached"]):
+                    dead = (holder in targets) if targets is not None \
+                        else not _pid_alive(holder)
+                    if dead:
+                        del index["attached"][holder]
+                if revoked:
+                    self._rewrite_best_effort(index)
+            finally:
+                self._funlock()
+        if revoked:
+            _arena_metrics().revoked.inc(revoked)
+            degradation(
+                "arena_lease_revoked",
+                "%d arena holder refcount(s) of dead process(es) reclaimed; "
+                "their entries are evictable again (live peers' views stay "
+                "valid)", revoked, once=False)
+        return revoked
+
+    # -- budget / stats -----------------------------------------------------------------
+
+    @property
+    def budget(self):
+        with self._tlock:
+            if self._closed:
+                return 0
+            self._flock()
+            try:
+                try:
+                    return self._read_index()["budget"]
+                except Exception:  # noqa: BLE001
+                    return 0
+            finally:
+                self._funlock()
+
+    def set_budget(self, nbytes):
+        """Live budget retune (ISSUE 13) — host-wide: the budget lives in the
+        control segment, so a parent-side retune governs every attached
+        process's admissions. Shrinking evicts unheld entries immediately."""
+        nbytes = max(0, int(nbytes))
+        if self._closed:
+            return 0
+        with self._tlock:
+            self._flock()
+            try:
+                try:
+                    index = self._read_index()
+                except Exception:  # noqa: BLE001
+                    return 0
+                index["budget"] = nbytes
+                self._evict_for(index, 0)
+                self._rewrite_best_effort(index)
+            finally:
+                self._funlock()
+        m = _arena_metrics()
+        m.bytes.set(index["total"])
+        m.entries.set(len(index["entries"]))
+        return nbytes
+
+    def stats(self):
+        with self._tlock:
+            if self._closed:
+                return {}
+            self._flock()
+            try:
+                try:
+                    index = self._read_index()
+                except Exception:  # noqa: BLE001
+                    return {}
+            finally:
+                self._funlock()
+        m = _arena_metrics()
+        m.bytes.set(index["total"])
+        m.entries.set(len(index["entries"]))
+        return {
+            "arena_entries": len(index["entries"]),
+            "arena_payload_bytes": index["total"],
+            "arena_budget_bytes": index["budget"],
+            "arena_attached": len(index["attached"]),
+            "arena_held_entries": sum(
+                1 for e in index["entries"].values() if e["holders"]),
+            # process-LOCAL funnel counters (each process warms independently)
+            "arena_hits": m.hits.value,
+            "arena_misses": m.misses.value,
+            "arena_admits": m.admits.value,
+            "arena_evictions": m.evictions.value,
+            "arena_invalidations": m.invalidations.value,
+        }
+
+    # -- teardown -----------------------------------------------------------------------
+
+    def close(self):
+        """Creator: unlink every entry segment, the control segment and the
+        lock file — nothing survives in ``/dev/shm`` (peers' live views stay
+        backed by their own mappings). Attacher: detach only. Idempotent."""
+        with self._tlock:
+            if self._closed:
+                return
+            self._closed = True
+            maps, self._maps = self._maps, {}
+            entry_names = []
+            try:
+                self._flock()
+                try:
+                    try:
+                        index = self._read_index()
+                        if self._creator:
+                            entry_names = [e["seg"] for e in
+                                           index["entries"].values()]
+                        else:
+                            index["attached"].pop(self._pid, None)
+                            for entry in index["entries"].values():
+                                entry["holders"].pop(self._pid, None)
+                            self._rewrite_best_effort(index)
+                    except Exception:  # noqa: BLE001 — corrupt ctl: unlink what we mapped
+                        if self._creator:
+                            entry_names = list(maps)
+                finally:
+                    self._funlock()
+            except Exception:  # noqa: BLE001 — lock fd already gone (exit races)
+                if self._creator:
+                    entry_names = list(maps)
+        for name in entry_names:
+            seg = maps.pop(name, None)
+            _unlink_by_name(name, seg)
+        _close_mappings(maps.values())
+        if self._creator:
+            _unlink_by_name(self._ctl.name, self._ctl)
+            try:
+                os.unlink(self._lock_path)
+            except OSError:
+                pass
+        else:
+            _close_mappings([self._ctl])
+        try:
+            os.close(self._lock_fd)
+        except OSError:
+            pass
+
+    def detach(self):
+        """Alias of :meth:`close` for attachers — the GL-L001 closer name the
+        arena's lifecycle contract documents."""
+        self.close()
+
+    def __repr__(self):
+        return "<CacheArena %s token=%s%s>" % (
+            "creator" if self._creator else "attached", self.spec.token,
+            " CLOSED" if self._closed else "")
+
+
+def _release_cb(arena, key, seg_name):
+    def release():
+        arena._drop_holder(key, seg_name)
+    return release
+
+
+def _close_mappings(segs):
+    for seg in segs:
+        try:
+            seg.close()
+        except BufferError:
+            # exported views still alive (a consumer kept a served batch):
+            # the mapping frees with the last view; shadow close() so the
+            # segment's teardown does not retry and spam at GC
+            seg.close = _noop
+        except Exception:  # noqa: BLE001 — exit path
+            pass  # graftlint: disable=GL-O002 (exit path: mapping frees at process exit)
+
+
+def _unlink_by_name(name, seg):
+    from multiprocessing import shared_memory
+
+    if seg is None:
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+            _untrack_segment(seg)
+        except Exception:  # noqa: BLE001 — already gone
+            return
+    _tracked_unlink(seg)
+    _close_mappings([seg])
+
+
+def _tracked_unlink(seg):
+    """Unlink with BALANCED resource_tracker bookkeeping: ``unlink()`` always
+    sends an unregister, but attached segments were deliberately deregistered
+    (gh-82300) — re-register first (the tracker's cache is a set; re-adding a
+    creator-registered name is a no-op) so the pair never underflows into
+    tracker KeyError spam at exit."""
+    registered = False
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.register(seg._name, "shared_memory")
+        registered = True
+    except Exception:  # noqa: BLE001 — tracker internals vary
+        pass  # graftlint: disable=GL-O002 (bookkeeping only; unlink below still runs)
+    try:
+        seg.unlink()  # sends the matching unregister on success
+        registered = False
+    except FileNotFoundError:
+        pass  # another process already unlinked it
+    except Exception:  # noqa: BLE001 — unlink is best-effort per segment
+        pass  # graftlint: disable=GL-O002 (name removal; mappings stay valid)
+    if registered:
+        # unlink raised before its internal unregister: take the name back
+        # out or the tracker would warn about (and re-unlink) it at exit
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(seg._name, "shared_memory")
+        except Exception:  # noqa: BLE001
+            pass  # graftlint: disable=GL-O002 (bookkeeping only)
+
+
+def _noop():
+    pass
+
+
+def _ctl_name(token):
+    return "%s%s_ctl" % (ARENA_PREFIX, token)
+
+
+def _entry_name(token, serial):
+    return "%s%s_e%d" % (ARENA_PREFIX, token, serial)
+
+
+def _lock_path(token):
+    return os.path.join(tempfile.gettempdir(), "%s%s.lock"
+                        % (ARENA_PREFIX, token))
+
+
+def _blob_base(meta_len):
+    return (_HEADER.size + meta_len + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return False
+
+
+def _untrack_segment(seg):
+    """gh-82300: deregister an ATTACHED segment from this process's
+    resource_tracker (the shm_ring helper — one fix, one place)."""
+    from petastorm_tpu.parallel.shm_ring import untrack_attachment
+
+    untrack_attachment(seg)
+
+
+# -- process-wide singleton + env handoff ----------------------------------------------
+#
+# One arena handle per process, whoever asked first: the creating reader
+# (host_arena), a pool child's bootstrap (attach_from_env), or a cache lazily
+# resolving a pickled spec (resolve). Stored in a dict (not a bare global) so
+# ownership is visibly held for GL-L001.
+
+ENV_ATTACH = "PTPU_ARENA_ATTACH"
+
+_state_lock = threading.Lock()
+_STATE = {"arena": None, "failed_tokens": set()}
+
+
+def arena_enabled():
+    """The ``PTPU_ARENA=off`` kill switch (also accepts 0/false/no)."""
+    raw = (os.environ.get("PTPU_ARENA") or "").strip().lower()
+    return raw not in ("0", "off", "false", "no")
+
+
+def process_arena():
+    """This process's arena handle, or ``None`` (never creates/attaches)."""
+    with _state_lock:
+        arena = _STATE["arena"]
+    return arena if arena is not None and not arena._closed else None
+
+
+def current_token():
+    """The attach token children should receive via ``PTPU_ARENA_ATTACH``."""
+    arena = process_arena()
+    return arena.spec.token if arena is not None else None
+
+
+def host_arena(create_bytes, ctl_bytes=DEFAULT_CTL_BYTES):
+    """Create (or return) this process's arena with ``create_bytes`` budget.
+
+    Returns ``None`` — with a warn-once ``arena_unavailable`` degradation —
+    when the kill switch is set or shared memory/flock is unusable on this
+    platform; callers then keep today's per-process caches (byte-identical
+    output, just N× resident)."""
+    if not create_bytes or not arena_enabled():
+        return None
+    with _state_lock:
+        arena = _STATE["arena"]
+        if arena is not None and not arena._closed:
+            return arena
+        from petastorm_tpu.parallel.shm_ring import shm_supported
+
+        if not shm_supported():
+            degradation("arena_unavailable",
+                        "shared-memory cache arena unavailable (no shm); "
+                        "per-process caches in effect")
+            return None
+        try:
+            arena = CacheArena(budget_bytes=int(create_bytes),
+                               ctl_bytes=ctl_bytes)
+        except Exception as e:  # noqa: BLE001 — any failure degrades to local caches
+            degradation("arena_unavailable",
+                        "shared-memory cache arena create failed (%s); "
+                        "per-process caches in effect", e)
+            return None
+        _STATE["arena"] = arena
+        _register_atexit()
+    return arena
+
+
+def resolve(spec):
+    """Attach to the arena named by ``spec`` (memoized per process). A pool
+    child that already attached at bootstrap — or IS the creator (thread
+    pools) — gets the existing handle. Returns ``None`` on failure (the
+    creator died and unlinked, spec from another host, ...) with a warn-once
+    degradation."""
+    if spec is None or not arena_enabled():
+        return None
+    with _state_lock:
+        arena = _STATE["arena"]
+        if arena is not None and not arena._closed:
+            return arena
+        if spec.token in _STATE["failed_tokens"]:
+            return None
+        try:
+            arena = CacheArena(spec=spec)
+        except Exception as e:  # noqa: BLE001 — attach failure degrades to local caches
+            _STATE["failed_tokens"].add(spec.token)
+            degradation("arena_unavailable",
+                        "cache arena attach failed for token %s (%s); "
+                        "per-process caches in effect", spec.token, e)
+            return None
+        _STATE["arena"] = arena
+        _register_atexit()
+    return arena
+
+
+def attach_from_env():
+    """Pool-child bootstrap hook (the ``PTPU_CHAOS_SPEC`` convention): attach
+    the parent's arena named by ``PTPU_ARENA_ATTACH`` so a freshly spawned —
+    or RESPAWNED (the env survives on the executor's ``_child_env``) — child
+    starts warm. Failure-tolerant; returns the arena or ``None``."""
+    token = os.environ.get(ENV_ATTACH)
+    if not token:
+        return None
+    return resolve(ArenaSpec(token))
+
+
+_atexit_armed = []
+
+
+def _register_atexit():
+    if not _atexit_armed:
+        _atexit_armed.append(True)
+        atexit.register(close_process_arena)
+
+
+def close_process_arena():
+    """Close/detach this process's arena (atexit safety net + test hook).
+    The creator unlinks every segment; attachers detach."""
+    with _state_lock:
+        arena, _STATE["arena"] = _STATE["arena"], None
+    if arena is not None:
+        arena.close()
+    return arena is not None

@@ -28,6 +28,13 @@ the etag/generation; pyarrow's filesystem API gives us size-for-free from the
 open handle, which catches the realistic mutation (a re-written dataset) with
 zero extra round trips. Same-size in-place rewrites — not a thing object
 stores can even express non-atomically — are documented as unseen.
+
+Host-wide sharing (ISSUE 17): when this process has a mapped
+:mod:`petastorm_tpu.io.arena`, each admitted footer's **serialized thrift
+blob** is published under ``("ft", path)`` with the size/stat identity as the
+generation token, and a local miss consults the arena before touching
+storage — parse-on-map, memoized per process by the local LRU. The whole host
+then pays ONE footer read per file instead of one per process.
 """
 from __future__ import annotations
 
@@ -70,6 +77,49 @@ def metadata_crc(metadata):
 #: parsed FileMetaData are a few KB to a few hundred KB (wide schemas); the
 #: default budget holds ~1k typical ImageNet-Parquet footers
 DEFAULT_BUDGET_BYTES = 64 << 20
+
+
+def _host_arena():
+    """This process's mapped cache arena, or None (lazy — the footer cache is
+    a module singleton, so it rides :func:`petastorm_tpu.io.arena.process_arena`
+    rather than a pickled spec)."""
+    from petastorm_tpu.io import arena as arena_mod
+
+    return arena_mod.process_arena()
+
+
+def _arena_gen(size, stat_token):
+    """The arena generation token for a footer blob: the stat identity when
+    known (ISSUE 11), else the observed file size — the same validation
+    ladder :meth:`FooterCache.lookup` applies locally."""
+    if stat_token is not None:
+        return "st:%s" % (stat_token,)
+    if size is not None:
+        return "sz:%d" % int(size)
+    return None
+
+
+def _serialize_metadata(metadata):
+    """The footer's thrift bytes (what ``pq.read_metadata`` parses), or None —
+    serialization failure just keeps the footer process-local."""
+    try:
+        import pyarrow as pa
+
+        sink = pa.BufferOutputStream()
+        metadata.write_metadata_file(sink)
+        return sink.getvalue().to_pybytes()
+    except Exception:  # noqa: BLE001 — exotic metadata: stay local
+        return None
+
+
+def _parse_metadata_blob(blob):
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        return pq.read_metadata(pa.BufferReader(blob))
+    except Exception:  # noqa: BLE001 — torn/foreign blob: treat as a miss
+        return None
 
 
 class FooterEntry:
@@ -213,9 +263,22 @@ class FooterCache:
                 self._total -= entry.nbytes
                 self._bytes_gauge.set(self._total)
                 self._invalidations.inc()
+        arena_obj = _host_arena()
+        if arena_obj is not None:
+            # the replaced file's blob must not be re-mapped by ANY process
+            arena_obj.invalidate(("ft", path))
 
-    def put(self, path, metadata, size=None, stat_token=None):
-        """Admit a parsed footer; returns its :class:`FooterEntry`."""
+    def put(self, path, metadata, size=None, stat_token=None, _share=True):
+        """Admit a parsed footer; returns its :class:`FooterEntry`. Unless
+        the footer just CAME from the arena (``_share=False``), its serialized
+        blob is also published host-wide."""
+        if _share:
+            arena_obj = _host_arena()
+            if arena_obj is not None:
+                blob = _serialize_metadata(metadata)
+                if blob is not None:
+                    arena_obj.put_bytes(("ft", path), blob,
+                                        gen=_arena_gen(size, stat_token))
         entry = FooterEntry(metadata, size, stat_token=stat_token)
         with self._lock:
             old = self._entries.pop(path, None)
@@ -251,6 +314,17 @@ class FooterCache:
         entry = self.lookup(path, size, stat_token=stat_token)
         if entry is not None:
             return entry
+        # host-shared plane: another process may have parsed this footer
+        # already — map its serialized blob, parse once locally, skip storage
+        arena_obj = _host_arena()
+        if arena_obj is not None:
+            blob = arena_obj.get_bytes(("ft", path),
+                                       gen=_arena_gen(size, stat_token))
+            if blob is not None:
+                metadata = _parse_metadata_blob(blob)
+                if metadata is not None:
+                    return self.put(path, metadata, size,
+                                    stat_token=stat_token, _share=False)
         import pyarrow.parquet as pq
 
         if source is not None:

@@ -315,6 +315,7 @@ KNOWN_SITES = (
     "loader_pad",       # last_batch='pad' index gather
     "h2d_stage",        # pinned staging copy before device_put
     "h2d_owned_copy",   # owned copy before an aliasing (CPU) device_put
+    "arena_admit",      # the ONE copy into the shared cache arena (io/arena.py)
 )
 
 _census_lock = threading.Lock()

@@ -205,12 +205,18 @@ class _Store:
         if entry is not None:
             entry[2].release()
 
-    def put(self, key, value):
+    def put(self, key, value, lease=None):
         """Admit ``value`` (already frozen read-only by the caller); returns
         True when it was stored. Because the stored arrays are read-only and
         every serve is a read-only view, storing may SHARE buffers with what
         the consumer receives — mutation is impossible, so the old
-        defensive-copy-per-admit is gone."""
+        defensive-copy-per-admit is gone.
+
+        ``lease`` carries an externally-owned pin (the arena holder lease for
+        a shm-backed entry): the store releases it at eviction/clear exactly
+        like its own accounting lease, so an arena entry stays unevictable
+        host-wide while this process's cache holds views of it. The caller
+        keeps ownership when put returns False (oversized)."""
         nbytes = payload_nbytes(value)
         evicted = []
         with self._lock:
@@ -222,7 +228,9 @@ class _Store:
                 if old is not None:
                     self._total -= old[1]
                     evicted.append(old[2])
-                self._entries[key] = (value, nbytes, Lease(kind="memcache"))
+                self._entries[key] = (
+                    value, nbytes,
+                    lease if lease is not None else Lease(kind="memcache"))
                 self._total += nbytes
                 while self._total > self._budget and self._entries:
                     _, (_, old_bytes, old_lease) = self._entries.popitem(last=False)
@@ -295,10 +303,19 @@ class MemCache(CacheBase):
     escalation; ``writable_hits=True`` restores the legacy deep-copy-per-serve
     behavior (both directions byte-identical — only mutability and memcpy
     count differ).
+
+    ``arena=`` (an :class:`petastorm_tpu.io.arena.ArenaSpec` or a live
+    ``CacheArena``) layers the host-wide shared arena between the local store
+    and the inner cache (ISSUE 17): a local miss maps the shared entry as
+    zero-copy views pinned by the arena holder lease (released when the local
+    entry drops), and a true fill is admitted host-wide on the way back up —
+    every other process on the host then serves it without re-decoding. The
+    spec is picklable, so pool children carry it through the worker pickle;
+    resolution to a mapped arena is lazy per process.
     """
 
     def __init__(self, size_limit_bytes, inner=None, store=None,
-                 writable_hits=False):
+                 writable_hits=False, arena=None):
         if not size_limit_bytes or int(size_limit_bytes) <= 0:
             raise ValueError("MemCache needs a positive size_limit_bytes; use "
                              "the inner cache alone to disable it")
@@ -309,11 +326,24 @@ class MemCache(CacheBase):
         #: the process-wide store and its raise-only budget); not picklable —
         #: dropped on pickling, the unpickled instance reverts to the shared one
         self._private_store = store
+        if arena is None:
+            self._arena_spec, self._arena_obj = None, None
+        elif hasattr(arena, "token"):  # ArenaSpec
+            self._arena_spec, self._arena_obj = arena, None
+        else:  # a live CacheArena (thread pools / the creating reader)
+            self._arena_spec, self._arena_obj = arena.spec, arena
 
     def __getstate__(self):
         state = dict(self.__dict__)
         state["_private_store"] = None
+        state["_arena_obj"] = None  # children re-resolve from the spec
         return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        # worker pickles from pre-arena readers lack the arena fields
+        self.__dict__.setdefault("_arena_spec", None)
+        self.__dict__.setdefault("_arena_obj", None)
 
     def _store(self):
         store = self._private_store if self._private_store is not None \
@@ -321,39 +351,78 @@ class MemCache(CacheBase):
         store.raise_budget(self._budget)
         return store
 
-    def get(self, key, fill_cache_func):
+    def _arena(self):
+        """The mapped arena for this process, or None (lazy, failure-tolerant:
+        an unattachable spec degrades warn-once inside ``resolve``)."""
+        if self._arena_spec is None:
+            return None
+        obj = self._arena_obj
+        if obj is not None and not obj._closed:
+            return obj
+        from petastorm_tpu.io import arena as arena_mod
+
+        obj = arena_mod.resolve(self._arena_spec)
+        self._arena_obj = obj
+        return obj
+
+    def get(self, key, fill_cache_func, served=None):
         """Zero-copy serve: hits AND the admit path hand out fresh containers
         over the stored READ-ONLY buffers. Only an oversized (uncached) value
-        passes through writable."""
-        store = self._store()
-        hit, value = store.lookup(key)
-        if not hit:
-            value = self._inner.get(key, fill_cache_func)
-            frozen = readonly_view(value)
-            if not store.put(key, frozen):
-                return value  # oversized: uncached, nothing aliases it
-            value = frozen
+        passes through writable. ``served`` (a 1-slot out-list, the tiered
+        funnel's attribution channel) is set to ``"arena"`` when the payload
+        came off the host-shared mapping rather than this process's store."""
+        origin, value = self._fetch(key, fill_cache_func)
+        if served is not None and origin in ("arena", "arena_uncached"):
+            served[0] = "arena"
         if self._writable_hits:
             # legacy contract: every serve is an owned writable deep copy
             copy = _defensive_copy(value)
-            count_copy("memcache_hit" if hit else "memcache_admit",
+            count_copy("memcache_hit" if origin == "mem" else "memcache_admit",
                        _copied_nbytes(copy))
             return copy
+        if origin == "uncached":
+            return value  # oversized true fill: uncached, nothing aliases it
         return readonly_view(value)
 
-    def get_writable(self, key, fill_cache_func):
+    def _fetch(self, key, fill_cache_func):
+        """``(origin, stored_value)`` — the funnel: local store, then the
+        host-wide arena, then the inner cache / real fill (admitted back up
+        both levels). Origins: ``mem`` local hit; ``arena`` mapped from the
+        shared arena and admitted locally; ``arena_uncached`` mapped but the
+        local store declined (views stay valid — POSIX mappings outlive the
+        name); ``fill`` decoded fresh and admitted; ``uncached`` oversized."""
+        store = self._store()
+        hit, value = store.lookup(key)
+        if hit:
+            return "mem", value
+        arena_obj = self._arena()
+        if arena_obj is not None:
+            got = arena_obj.get(("mc", key))
+            if got is not None:
+                value, lease = got
+                if store.put(key, value, lease=lease):
+                    return "arena", value
+                lease.release()
+                return "arena_uncached", value
+        value = self._inner.get(key, fill_cache_func)
+        frozen = readonly_view(value)
+        if arena_obj is not None:
+            arena_obj.put(("mc", key), frozen)
+        if not store.put(key, frozen):
+            return "uncached", value
+        return "fill", frozen
+
+    def get_writable(self, key, fill_cache_func, served=None):
         """Copy-on-write escalation: a consumer that will WRITE (host
         TransformSpec) gets an owned writable deep copy of the entry — the one
         remaining memcpy on the memcache path, charged to ``memcache_cow``."""
-        store = self._store()
-        hit, value = store.lookup(key)
-        if not hit:
-            value = self._inner.get(key, fill_cache_func)
-            if not store.put(key, readonly_view(value)):
-                return value  # oversized: uncached and unaliased, already owned
-            # `value` still aliases the stored buffers — escalate below exactly
-            # like a hit (returning it writable would let the consumer poison
-            # the entry it just admitted)
+        origin, value = self._fetch(key, fill_cache_func)
+        if served is not None and origin in ("arena", "arena_uncached"):
+            served[0] = "arena"
+        if origin == "uncached":
+            return value  # oversized: uncached and unaliased, already owned
+        # anything resident (or arena-mapped) aliases shared buffers —
+        # escalate: returning it writable would poison the cached entry
         copy = _defensive_copy(value)
         count_copy("memcache_cow", _copied_nbytes(copy))
         return copy
@@ -387,8 +456,13 @@ class MemCache(CacheBase):
         return self._store().contains(key) or self._inner.contains(key)
 
     def invalidate(self, key):
-        """Keyed invalidation through both layers (ISSUE 11)."""
+        """Keyed invalidation through every layer (ISSUE 11) — including the
+        host-shared arena, so a rewritten source file's decoded payload
+        cannot be re-mapped by ANY process on the host."""
         self._store().invalidate(key)
+        arena_obj = self._arena()
+        if arena_obj is not None:
+            arena_obj.invalidate(("mc", key))
         self._inner.invalidate(key)
 
     def clear(self):

@@ -806,7 +806,13 @@ class PageIndexCache:
     big chunk fetch *at page boundaries* once a previous walk has seen them.
     First read of a chunk fetches it at request-size granularity; re-reads
     split page-granular. Bounded count LRU (gets refresh recency — hot
-    re-read chunks must not be evicted by insertion age)."""
+    re-read chunks must not be evicted by insertion age).
+
+    Walked boundaries are also published through the host-shared cache arena
+    (ISSUE 17, key ``("pi", path, rg, column)``): a process that never walked
+    a chunk still splits its FIRST fetch page-granular when any peer on the
+    host has — the walk result is tiny (a tuple of ints), so a local miss
+    maps the pickled memo and admits it locally."""
 
     def __init__(self, max_entries=4096):
         from collections import OrderedDict
@@ -815,21 +821,54 @@ class PageIndexCache:
         self._entries = OrderedDict()
         self._max = int(max_entries)
 
+    @staticmethod
+    def _arena():
+        from petastorm_tpu.io import arena as arena_mod
+
+        return arena_mod.process_arena()
+
     def put(self, path, rg, column, chunk_offset, page_offsets):
         key = (path, rg, column)
+        entry = (int(chunk_offset), tuple(page_offsets))
         with self._lock:
             if key in self._entries:
                 self._entries.move_to_end(key)
             elif len(self._entries) >= self._max:
                 self._entries.popitem(last=False)
-            self._entries[key] = (int(chunk_offset), tuple(page_offsets))
+            self._entries[key] = entry
+        arena_obj = self._arena()
+        if arena_obj is not None:
+            import pickle
+
+            arena_obj.put_bytes(("pi",) + key,
+                                pickle.dumps(entry, protocol=2))
 
     def get(self, path, rg, column):
+        key = (path, rg, column)
         with self._lock:
-            entry = self._entries.get((path, rg, column))
+            entry = self._entries.get(key)
             if entry is not None:
-                self._entries.move_to_end((path, rg, column))
+                self._entries.move_to_end(key)
+        if entry is not None:
             return entry
+        arena_obj = self._arena()
+        if arena_obj is None:
+            return None
+        blob = arena_obj.get_bytes(("pi",) + key)
+        if blob is None:
+            return None
+        import pickle
+
+        try:
+            entry = pickle.loads(blob)
+            chunk_offset, page_offsets = entry
+        except Exception:  # noqa: BLE001 — torn/foreign memo: treat as a miss
+            return None
+        with self._lock:  # admit locally: later gets skip the arena map
+            if key not in self._entries and len(self._entries) >= self._max:
+                self._entries.popitem(last=False)
+            self._entries[key] = (int(chunk_offset), tuple(page_offsets))
+            return self._entries[key]
 
     def clear(self):
         with self._lock:

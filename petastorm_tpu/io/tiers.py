@@ -36,7 +36,11 @@ from petastorm_tpu.io.memcache import payload_nbytes
 from petastorm_tpu.obs import provenance as _prov
 from petastorm_tpu.obs.metrics import default_registry
 
-TIERS = ("mem", "disk", "remote")
+#: serve-attribution tiers, hot-to-cold: ``arena`` (ISSUE 17) sits between
+#: this process's mem store and the disk tier — a host-shared mapping is
+#: cheaper than a disk read but costs a cross-process lock + map vs a local
+#: dict hit. The mem tier reports which of the two actually served.
+TIERS = ("mem", "arena", "disk", "remote")
 
 
 class TieredCache(CacheBase):
@@ -125,8 +129,11 @@ class TieredCache(CacheBase):
     def get(self, key, fill_cache_func):
         served = ["mem"]
         if self._mem is not None:
+            # the mem tier flips served[0] to "arena" when the payload came
+            # off the host-shared mapping instead of the local store
             value = self._mem.get(
-                key, lambda: self._through_disk(key, fill_cache_func, served))
+                key, lambda: self._through_disk(key, fill_cache_func, served),
+                served=served)
         else:
             value = self._through_disk(key, fill_cache_func, served)
         self._count(served[0], value)
@@ -140,7 +147,8 @@ class TieredCache(CacheBase):
         served = ["mem"]
         if self._mem is not None:
             value = self._mem.get_writable(
-                key, lambda: self._through_disk(key, fill_cache_func, served))
+                key, lambda: self._through_disk(key, fill_cache_func, served),
+                served=served)
         else:
             value = self._through_disk(key, fill_cache_func, served)
         self._count(served[0], value)

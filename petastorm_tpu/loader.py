@@ -2028,6 +2028,19 @@ class DataLoader:
                     q.put_nowait(_SENTINEL)
                 except Exception:  # noqa: BLE001
                     pass  # graftlint: disable=GL-O002 (interpreter teardown: queue globals may be None)
+        # host-wide cache arena (ISSUE 17): the consumer is going away — sweep
+        # holder refcounts left by processes that died without releasing (a
+        # SIGKILLed pool child mid-read), so their pinned entries become
+        # evictable again. Live peers' views are untouched; same exit-drain
+        # discipline as the lease release above.
+        try:
+            from petastorm_tpu.io import arena as _arena_mod
+
+            arena_obj = _arena_mod.process_arena()
+            if arena_obj is not None:
+                arena_obj.reclaim()
+        except Exception:  # noqa: BLE001
+            pass  # graftlint: disable=GL-O002 (interpreter teardown: arena module may be torn down)
 
     def join(self):
         if self._producer is not None:

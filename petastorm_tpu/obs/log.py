@@ -42,7 +42,13 @@ tcp-unavailable fallback to the pipe pool), ``transport_frame_corrupt`` (a
 crc32-trailer/magic rejection, link torn down), ``transport_reconnected``
 (the child redialed and the hub re-adopted; un-acked items re-dispatched),
 and ``transport_shm_bypass`` (slab wire disabled over tcp — payloads ride
-the framed socket frames).
+the framed socket frames) — and, from the host-wide cache arena (ISSUE 17),
+``arena_unavailable`` (shm/flock unusable, creation or attach failed, or
+``PTPU_ARENA=off`` — per-process caches in effect, byte-identical output),
+``arena_full`` (an admission declined: payload over budget, budget full of
+held entries, or the index outgrew the control segment) and
+``arena_lease_revoked`` (a dead process's holder refcounts were reclaimed;
+its pinned entries are evictable again).
 """
 from __future__ import annotations
 

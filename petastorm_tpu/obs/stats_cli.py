@@ -192,7 +192,7 @@ def _render_trends(lines, history):
 
     def mem_share(m):
         hits = {t: m.get('ptpu_io_tier_hits_total{tier="%s"}' % t, 0)
-                for t in ("mem", "disk", "remote")}
+                for t in ("mem", "arena", "disk", "remote")}
         total = sum(v for v in hits.values() if isinstance(v, (int, float)))
         return (hits.get("mem", 0) / total) if total else None
 
@@ -323,7 +323,25 @@ def render_dashboard(metrics, title="", history=None):
         lines.append("cache tiers:  " + "  ".join(
             "%s hits=%d (%.1f MB)" % (t, int(tier_hits.get(t, 0)),
                                       tier_bytes.get(t, 0) / 1e6)
-            for t in ("mem", "disk", "remote") if tier_hits.get(t)))
+            for t in ("mem", "arena", "disk", "remote") if tier_hits.get(t)))
+
+    # -- host-wide cache arena (ISSUE 17 — dedicated panel, not "other")
+    arena_entries = metrics.get("ptpu_io_arena_entries", 0)
+    arena_admits = metrics.get("ptpu_io_arena_admits_total", 0)
+    if arena_entries or arena_admits:
+        arena_hits = metrics.get("ptpu_io_arena_hits_total", 0)
+        arena_misses = metrics.get("ptpu_io_arena_misses_total", 0)
+        looked = arena_hits + arena_misses
+        lines.append(
+            "cache arena:  mapped=%.1f MB in %d entries  attaches=%d  "
+            "hit-rate=%s  admits=%d  evict=%d inval=%d revoked=%d"
+            % (metrics.get("ptpu_io_arena_bytes", 0) / 1e6, int(arena_entries),
+               int(metrics.get("ptpu_io_arena_attaches_total", 0)),
+               ("%.0f%%" % (100.0 * arena_hits / looked)) if looked else "n/a",
+               int(arena_admits),
+               int(metrics.get("ptpu_io_arena_evictions_total", 0)),
+               int(metrics.get("ptpu_io_arena_invalidations_total", 0)),
+               int(metrics.get("ptpu_io_arena_holders_revoked_total", 0))))
 
     # -- remote read path (ISSUE 8): GETs, hedging, footer cache
     r = {name: metrics[name] for name in metrics
@@ -515,7 +533,8 @@ def render_dashboard(metrics, title="", history=None):
                       "ptpu_io_tier_", "ptpu_io_remote_", "ptpu_io_hedge",
                       "ptpu_io_footer_cache_", "ptpu_transform_",
                       "ptpu_prov_", "ptpu_dataset_", "ptpu_slo_",
-                      "ptpu_ctl_", "ptpu_pagedec_", "ptpu_net_")
+                      "ptpu_ctl_", "ptpu_pagedec_", "ptpu_net_",
+                      "ptpu_io_arena_")
     rest = {n: v for n, v in metrics.items()
             if not n.startswith(shown_prefixes)}
     scalars = [(n, v) for n, v in sorted(rest.items())

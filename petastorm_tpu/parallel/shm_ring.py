@@ -93,6 +93,13 @@ def _untrack(segment):
         pass           # spurious unlink warning at child exit, not a leak  # graftlint: disable=GL-O002
 
 
+def untrack_attachment(segment):
+    """Public gh-82300 seam: the cache arena (``io/arena.py``) attaches
+    segments by name exactly like :class:`SlabClient` and needs the same
+    tracker deregistration — one fix, one place."""
+    _untrack(segment)
+
+
 class SlabLease:
     """One consumer-held reference to an acquired slab.
 

@@ -131,6 +131,10 @@ class _Tagged:
         fn = getattr(self._worker, "apply_pagedec", None)
         return fn(mode) if fn is not None else None
 
+    def apply_arena_bytes(self, nbytes):
+        fn = getattr(self._worker, "apply_arena_bytes", None)
+        return fn(nbytes) if fn is not None else None
+
     def live_io_knobs(self):
         fn = getattr(self._worker, "live_io_knobs", None)
         return fn() if fn is not None else {}
@@ -606,6 +610,11 @@ class _WorkerBase:
         footers = self._footer_cache()
         if footers is not None:
             out.update(footers.stats())
+        from petastorm_tpu.io import arena as _arena_mod
+
+        arena_obj = _arena_mod.process_arena()
+        if arena_obj is not None:
+            out.update(arena_obj.stats())
         return out
 
     def set_trace(self, tracer):
@@ -693,6 +702,18 @@ class _WorkerBase:
         if mem is None:
             return 0
         return mem.apply_budget(nbytes)
+
+    def apply_arena_bytes(self, nbytes):
+        """Retune the host-wide arena budget (ISSUE 17). The budget lives in
+        the shared control segment, so one actuation — wherever it lands —
+        governs every attached process's admissions; shrinking evicts unheld
+        entries host-wide immediately. No-op returning 0 without an arena."""
+        from petastorm_tpu.io import arena as _arena_mod
+
+        arena_obj = _arena_mod.process_arena()
+        if arena_obj is None:
+            return 0
+        return arena_obj.set_budget(nbytes)
 
     # -- compressed-page pass-through (ISSUE 14) ----------------------------------------
     #
@@ -2401,6 +2422,20 @@ class Reader:
 # --------------------------------------------------------------------------------------
 
 
+def _host_arena_early(io_opts):
+    """Create (or join) the host-wide cache arena BEFORE dataset discovery:
+    the factory's own footer reads (schema inference, row-group planning) go
+    through the shared :class:`FooterCache`, and publishing those parses
+    host-wide only happens when :func:`petastorm_tpu.io.arena.process_arena`
+    already exists — an arena born later (in ``_build_read_funnel``) would
+    miss the metadata plane, and every attaching process would re-read the
+    footers it came here to share."""
+    if getattr(io_opts, "arena_bytes", 0):
+        from petastorm_tpu.io import arena as arena_mod
+
+        arena_mod.host_arena(io_opts.arena_bytes)
+
+
 def _build_read_funnel(cache, io_opts, num_epochs=None):
     """The tiered read funnel (ISSUE 8): ``MemCache → LocalDiskCache →
     remote`` as ONE :class:`petastorm_tpu.io.tiers.TieredCache` with per-tier
@@ -2408,16 +2443,31 @@ def _build_read_funnel(cache, io_opts, num_epochs=None):
     the old ad-hoc ``MemCache(inner=...)`` stacking. The mem tier exists when
     ``io_options.memcache_bytes`` (or PTPU_MEMCACHE_BYTES) asks for one;
     ``num_epochs == 1`` is the scan hint the ``scan-resistant`` policy
-    consumes."""
+    consumes.
+
+    ``io_options.arena_bytes`` (ISSUE 17) additionally creates — or joins —
+    this process's host-wide shared cache arena and threads its picklable
+    spec into the mem tier, so every pool child (and any co-resident reader)
+    maps ONE warm set of decoded columns instead of refilling its own. The
+    arena alone implies a mem tier (local-store budget defaults to the arena
+    budget); creation failure degrades warn-once inside ``host_arena``."""
     from petastorm_tpu.io.tiers import TieredCache
 
+    arena_obj = None
+    if getattr(io_opts, "arena_bytes", 0):
+        from petastorm_tpu.io import arena as arena_mod
+
+        arena_obj = arena_mod.host_arena(io_opts.arena_bytes)
     mem = None
-    if io_opts.memcache_bytes:
+    mem_budget = io_opts.memcache_bytes or (
+        io_opts.arena_bytes if arena_obj is not None else 0)
+    if mem_budget:
         from petastorm_tpu.io.memcache import MemCache
 
-        mem = MemCache(io_opts.memcache_bytes,
+        mem = MemCache(mem_budget,
                        writable_hits=getattr(io_opts, "memcache_writable_hits",
-                                             False))
+                                             False),
+                       arena=arena_obj)
     return TieredCache(mem=mem, disk=cache,
                        disk_admit=io_opts.remote.disk_admit,
                        single_epoch=num_epochs == 1)
@@ -2549,6 +2599,8 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     network). Also via ``PTPU_TRANSPORT``. See docs/robustness.md
     "The network fault model".
     """
+    io_opts = IoOptions.normalize(io_options)
+    _host_arena_early(io_opts)
     fs, path = get_filesystem_and_path_or_paths(dataset_url, storage_options, filesystem)
     stored_schema = get_schema(fs, path)
 
@@ -2570,7 +2622,6 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     ngram, read_schema = _resolve_ngram_schema(schema_fields, stored_schema,
                                                predicate)
 
-    io_opts = IoOptions.normalize(io_options)
     rec = RecoveryOptions.resolve(recovery, io_retries=io_retries,
                                   io_retry_backoff_s=io_retry_backoff_s,
                                   worker_respawns=worker_respawns)
@@ -2660,6 +2711,8 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     ISSUE 15). The shm slab wire is bypassed over tcp (a network link cannot
     carry slab grants); payloads ride the framed socket wire instead.
     """
+    io_opts = IoOptions.normalize(io_options)
+    _host_arena_early(io_opts)
     fs, path = get_filesystem_and_path_or_paths(
         dataset_url_or_urls, storage_options, filesystem
     )
@@ -2688,7 +2741,6 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     # namedtuple attributes)
     ngram, read_schema = _resolve_ngram_schema(schema_fields, stored_schema,
                                                predicate)
-    io_opts = IoOptions.normalize(io_options)
     rec = RecoveryOptions.resolve(recovery, io_retries=io_retries,
                                   io_retry_backoff_s=io_retry_backoff_s,
                                   worker_respawns=worker_respawns)

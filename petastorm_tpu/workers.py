@@ -785,6 +785,16 @@ class ProcessExecutor(ExecutorBase):
         self._worker = worker  # respawned replacements re-handshake the same worker
         self._child_env = {**os.environ, "PYTHONPATH": child_pp,
                            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")}
+        # host-wide cache arena handoff (ISSUE 17): children attach the
+        # parent's mapped warm set at bootstrap. On _child_env — the SAME env
+        # every respawn/resize spawn reuses (_popen_child) — so a replacement
+        # child's first read of a warm piece is served from the arena, not a
+        # cold store refill (the respawned-child cold-start satellite).
+        from petastorm_tpu.io import arena as _arena_mod
+
+        arena_token = _arena_mod.current_token()
+        if arena_token is not None:
+            self._child_env[_arena_mod.ENV_ATTACH] = arena_token
         if self._transport_name == "tcp":
             # the child's link policy (redial backoff, heartbeat cadence,
             # half-open threshold) rides the environment: the transport must

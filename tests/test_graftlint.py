@@ -703,6 +703,53 @@ def test_lifecycle_lease_clean_forms():
     assert findings == []
 
 
+_L001_ARENA_POSITIVE = """
+    from petastorm_tpu.io.arena import CacheArena
+
+    def leak_shm_segments(nbytes):
+        arena = CacheArena(budget_bytes=nbytes)  # BUG: segments never unlinked
+        arena.put(("mc", "k"), b"warm")
+"""
+
+
+def test_lifecycle_fires_on_unclosed_cache_arena():
+    """ISSUE-17 extension: a CacheArena owns named /dev/shm segments host-wide;
+    a creator leaked without close() strands them past process exit — the same
+    failure class as a bare SharedMemory, so GL-L001 covers it."""
+    findings, _ = _lint(_L001_ARENA_POSITIVE)
+    f = _only_rule(findings, "GL-L001")[0]
+    assert f.line == _line_of(_L001_ARENA_POSITIVE,
+                              "BUG: segments never unlinked")
+
+
+def test_lifecycle_cache_arena_clean_forms():
+    findings, _ = _lint("""
+        from petastorm_tpu.io.arena import ArenaSpec, CacheArena
+
+        def creator_try_finally(nbytes):
+            arena = CacheArena(budget_bytes=nbytes)
+            try:
+                arena.put(("mc", "k"), b"warm")
+            finally:
+                arena.close()
+
+        def attacher_detaches(token):
+            arena = CacheArena(spec=ArenaSpec(token))
+            try:
+                return arena.get(("mc", "k"))
+            finally:
+                arena.detach()
+
+        def handed_to_cache(nbytes, make_cache):
+            return make_cache(arena=CacheArena(budget_bytes=nbytes))
+
+        class Owner:
+            def start(self, nbytes):
+                self._arena = CacheArena(budget_bytes=nbytes)
+    """)
+    assert findings == []
+
+
 # -- GL-J001/J002/J003: JAX tracing hazards ---------------------------------------------
 
 _J001_POSITIVE = """
