@@ -52,6 +52,13 @@ from multiprocessing.connection import Client
 def main():
     address = sys.argv[1]
     authkey = sys.stdin.buffer.read(32)
+    # tenant adoption (ISSUE 18): BEFORE the transport dial, so the tcp hello
+    # already carries the slug and every charge this child makes — tier bytes,
+    # arena admits, worker seconds — bills the owning tenant. The parent set
+    # PTPU_TENANT in our env at exec time; absent/invalid ⇒ untagged.
+    from petastorm_tpu.obs import tenant as _tenant_mod
+
+    _tenant_mod.attach_from_env()
     link_down = ()  # a dead pipe cannot heal: EOF/reset = parent gone
     if address.startswith("tcp:"):
         # framed tcp transport (ISSUE 15): the child dials the parent's hub

@@ -10,6 +10,7 @@ from petastorm_tpu.analysis.rules.lifecycle import ResourceLifecycleRule
 from petastorm_tpu.analysis.rules.observability import (
     SilentExceptionSwallowRule,
     SleepyPollLoopRule,
+    UnboundedLabelRule,
     UnpairedSpanRule,
 )
 from petastorm_tpu.analysis.rules.project_concurrency import (
@@ -43,6 +44,7 @@ ALL_RULES = [
     SilentExceptionSwallowRule,
     UnpairedSpanRule,
     SleepyPollLoopRule,
+    UnboundedLabelRule,
     UnboundedBlockingCallRule,
     StatThenOpenRule,
     UnboundedSocketRule,

@@ -235,6 +235,136 @@ class SleepyPollLoopRule(Rule):
                     "condition instead")
 
 
+#: registry metric-factory method names whose keyword arguments (minus
+#: ``help``) become label dimensions on the series name
+_METRIC_FACTORIES = ("counter", "gauge", "histogram")
+
+#: call names whose return value has unbounded cardinality — a label built
+#: from one mints a fresh series per process/occurrence/path and walks the
+#: registry straight into the DEFAULT_MAX_SERIES cap
+_UNBOUNDED_CALLS = frozenset((
+    "getpid", "getppid", "get_ident", "get_native_id",
+    "uuid1", "uuid3", "uuid4", "uuid5",
+    "time", "time_ns", "monotonic", "monotonic_ns", "perf_counter",
+    "token_hex", "token_urlsafe", "hexdigest", "urandom",
+    "mkdtemp", "mkstemp", "gettempdir", "getcwd",
+    "abspath", "realpath", "basename", "dirname", "normpath", "expanduser",
+))
+
+#: through these the taint flows unchanged (str(pid) is as unbounded as pid)
+_TAINT_TRANSPARENT = ("str", "repr", "format")
+
+
+def _tainted(expr, env, depth=0):
+    """The unbounded source feeding ``expr``, or None. ``env`` maps local
+    names to their taint reason (loop targets over unbounded iterables,
+    one-hop assignments from tainted expressions)."""
+    if depth > 6:
+        return None
+    if isinstance(expr, ast.Call):
+        name = _call_name(expr)
+        if name in _UNBOUNDED_CALLS:
+            return "%s()" % name
+        if name in _TAINT_TRANSPARENT:
+            for arg in expr.args:
+                reason = _tainted(arg, env, depth + 1)
+                if reason:
+                    return reason
+        return None
+    if isinstance(expr, ast.Attribute) and expr.attr == "pid":
+        return ".pid"
+    if isinstance(expr, ast.Name):
+        return env.get(expr.id)
+    if isinstance(expr, ast.BinOp):  # "%s" % pid, prefix + path
+        return _tainted(expr.left, env, depth + 1) \
+            or _tainted(expr.right, env, depth + 1)
+    if isinstance(expr, ast.JoinedStr):  # f"w{os.getpid()}"
+        for value in expr.values:
+            if isinstance(value, ast.FormattedValue):
+                reason = _tainted(value.value, env, depth + 1)
+                if reason:
+                    return reason
+    return None
+
+
+def _bounded_iter(expr):
+    """True when a ``for`` target over ``expr`` stays a bounded label set:
+    a literal tuple/list/set of constants, or (by convention) an ALL-CAPS
+    module constant like ``TIERS`` — a closed enum frozen at import time."""
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        return all(isinstance(e, ast.Constant) for e in expr.elts)
+    if isinstance(expr, ast.Name):
+        return expr.id.isupper()
+    if isinstance(expr, ast.Attribute):
+        return expr.attr.isupper()
+    return False
+
+
+class UnboundedLabelRule(Rule):
+    """GL-O005: a metric label value that flows from an unbounded source.
+
+    Every distinct label value mints a separate series; the temporal plane
+    caps total series at ``DEFAULT_MAX_SERIES`` and then silently drops new
+    ones. A label built from a pid, uuid, timestamp, filesystem path, or a
+    loop variable over an open-ended collection is the classic way to burn
+    that budget: the dashboard goes blind precisely when the fleet scales.
+    Bounded enums (a loop over an ALL-CAPS constant tuple like ``TIERS``)
+    and validated slugs (``tenant=`` labels pass through
+    :class:`petastorm_tpu.obs.tenant.TenantContext`, which enforces a
+    bounded closed-alphabet grammar precisely so this rule never has to
+    flag them) stay clean."""
+
+    rule_id = "GL-O005"
+    severity = Severity.WARNING
+    description = ("metric label value flows from an unbounded source "
+                   "(pid/uuid/time/path call or a loop variable over an "
+                   "open-ended iterable) — each value mints a new series "
+                   "and exhausts the cardinality cap")
+    fix_hint = ("label with a bounded validated slug (see obs.tenant"
+                ".TenantContext), a fixed enum, or aggregate the dimension "
+                "away; justify a genuinely bounded dynamic label with an "
+                "inline '# graftlint: disable=GL-O005' comment")
+
+    def check(self, tree, ctx):
+        scopes = [tree.body]
+        scopes.extend(n.body for n in ctx.by_type(ast.FunctionDef,
+                                                  ast.AsyncFunctionDef))
+        for body in scopes:
+            yield from self._check_scope(body, ctx)
+
+    def _check_scope(self, body, ctx):
+        env = {}
+        calls = []
+        for node in _walk_scope(body):
+            if isinstance(node, (ast.For, ast.AsyncFor)) \
+                    and not _bounded_iter(node.iter):
+                for target in ast.walk(node.target):
+                    if isinstance(target, ast.Name):
+                        env[target.id] = ("the loop over %s"
+                                          % (ast.unparse(node.iter)[:40]))
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                reason = _tainted(node.value, {})
+                if reason:
+                    env[node.targets[0].id] = reason
+            elif isinstance(node, ast.Call) \
+                    and _call_name(node) in _METRIC_FACTORIES \
+                    and isinstance(node.func, ast.Attribute):
+                calls.append(node)
+        for call in calls:
+            for kw in call.keywords:
+                if kw.arg is None or kw.arg == "help":
+                    continue
+                reason = _tainted(kw.value, env)
+                if reason:
+                    yield ctx.finding(
+                        self, call,
+                        "label %s= flows from %s — an unbounded label value "
+                        "mints a fresh series per occurrence and exhausts "
+                        "the registry's cardinality cap"
+                        % (kw.arg, reason))
+
+
 class SilentExceptionSwallowRule(Rule):
     """GL-O002: ``except Exception: pass`` / bare ``except: pass``."""
 

@@ -154,6 +154,16 @@ def main(argv=None):
         from petastorm_tpu.benchmark import shmcache as shmcache_bench
 
         return shmcache_bench.main(argv[1:])
+    if argv and argv[0] == "tenants":
+        # `petastorm-tpu-bench tenants ...`: the per-tenant accounting-plane
+        # acceptance harness — two concurrent loaders on one host/arena, the
+        # noisy tenant named by the usage report AND a per-tenant burn alert
+        # (site + tenant), cross-tenant sums reconciled against the untagged
+        # totals, tenant frame-header compat, and the tagged-vs-untagged
+        # overhead arms — see benchmark/tenants.py
+        from petastorm_tpu.benchmark import tenants as tenants_bench
+
+        return tenants_bench.main(argv[1:])
     if argv and argv[0] == "diff":
         # `petastorm-tpu-bench diff run_a run_b`: regression forensics over
         # two trend entries — names WHICH site's critical-path self time

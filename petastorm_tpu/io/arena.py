@@ -191,12 +191,14 @@ class _ArenaMetrics:
     """Process-local ``ptpu_io_arena_*`` family (built on first arena)."""
 
     __slots__ = ("hits", "misses", "admits", "evictions", "invalidations",
-                 "attaches", "revoked", "bytes", "entries")
+                 "attaches", "revoked", "bytes", "entries", "_reg", "_tagged")
 
     def __init__(self):
         from petastorm_tpu.obs.metrics import default_registry
 
         reg = default_registry()
+        self._reg = reg
+        self._tagged = {}  # (family, tenant) -> Counter (ISSUE 18 twins)
         self.hits = reg.counter("ptpu_io_arena_hits_total",
                                 help="reads served from the shared cache arena")
         self.misses = reg.counter("ptpu_io_arena_misses_total",
@@ -217,6 +219,16 @@ class _ArenaMetrics:
                                help="payload bytes resident in the arena")
         self.entries = reg.gauge("ptpu_io_arena_entries",
                                  help="entries resident in the arena")
+
+    def tagged(self, family, tenant):
+        """The per-tenant twin of an arena counter (ISSUE 18) — charged
+        alongside the untagged total, never instead of it. The label is a
+        validated bounded slug, so cardinality stays bounded."""
+        key = (family, tenant)
+        c = self._tagged.get(key)
+        if c is None:
+            c = self._tagged[key] = self._reg.counter(family, tenant=tenant)
+        return c
 
 
 _metrics_lock = threading.Lock()
@@ -405,9 +417,15 @@ class CacheArena:
                         buf[start:start + arr.nbytes] = \
                             memoryview(arr).cast("B")
                 index["tick"] += 1
+                from petastorm_tpu.obs import tenant as _tenant_ctx
+
+                admit_tenant = _tenant_ctx.current_label()
                 index["entries"][key] = {
                     "seg": seg_name, "nbytes": nbytes, "gen": gen,
-                    "tick": index["tick"], "holders": {}}
+                    "tick": index["tick"], "holders": {},
+                    # who admitted it (ISSUE 18): evictions/invalidations
+                    # debit the OWNER's residency, not the evictor's
+                    "tenant": admit_tenant}
                 index["total"] += nbytes
                 try:
                     self._write_index(index)
@@ -429,6 +447,11 @@ class CacheArena:
         m.admits.inc()
         m.bytes.set(index["total"])
         m.entries.set(len(index["entries"]))
+        if admit_tenant is not None:
+            m.tagged("ptpu_io_arena_admits_total", admit_tenant).inc()
+            from petastorm_tpu.obs import tenant as _tenant_ctx
+
+            _tenant_ctx.meter().arena_adjust(admit_tenant, nbytes)
         return True
 
     def _rewrite_best_effort(self, index):
@@ -468,6 +491,18 @@ class CacheArena:
             m.invalidations.inc()
         else:
             m.evictions.inc()
+        owner = entry.get("tenant")
+        if owner is not None:
+            family = "ptpu_io_arena_invalidations_total" if invalidation \
+                else "ptpu_io_arena_evictions_total"
+            m.tagged(family, owner).inc()
+            from petastorm_tpu.obs import tenant as _tenant_ctx
+
+            # debit the OWNER's residency meter (byte*seconds integral closes
+            # here). Exact in-process; a peer-process eviction debits the
+            # peer's meter best-effort — the index-derived per-tenant bytes in
+            # stats() stay the host-wide ground truth.
+            _tenant_ctx.meter().arena_adjust(owner, -entry["nbytes"])
 
     def _unlink_seg(self, seg_name):
         """Remove a segment's NAME (POSIX keeps peers' live mappings valid).
@@ -582,6 +617,11 @@ class CacheArena:
             m.misses.inc()
             return None
         m.hits.inc()
+        from petastorm_tpu.obs import tenant as _tenant_ctx
+
+        reader_tenant = _tenant_ctx.current_label()
+        if reader_tenant is not None:
+            m.tagged("ptpu_io_arena_hits_total", reader_tenant).inc()
         return seg, meta_blob
 
     def _drop_holder(self, key, seg_name):
@@ -742,6 +782,11 @@ class CacheArena:
         m = _arena_metrics()
         m.bytes.set(index["total"])
         m.entries.set(len(index["entries"]))
+        tenant_bytes = {}
+        for e in index["entries"].values():
+            owner = e.get("tenant")
+            if owner is not None:
+                tenant_bytes[owner] = tenant_bytes.get(owner, 0) + e["nbytes"]
         return {
             "arena_entries": len(index["entries"]),
             "arena_payload_bytes": index["total"],
@@ -749,6 +794,12 @@ class CacheArena:
             "arena_attached": len(index["attached"]),
             "arena_held_entries": sum(
                 1 for e in index["entries"].values() if e["holders"]),
+            # host-wide per-tenant residency, index-derived (ISSUE 18): the
+            # ground truth the per-process meters approximate
+            "arena_tenant_bytes": tenant_bytes,
+            "arena_held_leases": sum(
+                sum(h.values()) for e in index["entries"].values()
+                for h in (e["holders"],)),
             # process-LOCAL funnel counters (each process warms independently)
             "arena_hits": m.hits.value,
             "arena_misses": m.misses.value,

@@ -300,7 +300,7 @@ class _GetState:
 
     __slots__ = ("lock", "done", "data", "lease", "winner_role", "errors",
                  "outstanding", "hedged", "deadline_s", "abandoned",
-                 "exec_start", "exec_started")
+                 "exec_start", "exec_started", "tenant")
 
     def __init__(self):
         self.lock = threading.Lock()
@@ -319,6 +319,9 @@ class _GetState:
         #: pool-queued, so deadline timing is exact from execution start
         #: instead of drifting by a poll slice
         self.exec_started = threading.Event()
+        #: tenant slug captured at ISSUE time on the requesting thread — the
+        #: pool threads that execute attempts carry no tenant context
+        self.tenant = None
 
     def take(self):
         """Claim the winning payload (exactly once) and abandon the slot."""
@@ -386,6 +389,21 @@ class RemoteReadEngine:
         # process-wide; these are this engine's own)
         self._n = {"gets": 0, "bytes": 0, "hedges": 0, "hedge_wins": 0,
                    "sparse_fallbacks": 0, "footer_fetches": 0}
+        self._reg = reg
+        self._tenant_twins = {}  # (family, tenant) -> Counter (ISSUE 18)
+
+    def _twin(self, family, tenant):
+        """Per-tenant twin of a remote counter — charged beside the untagged
+        total so cross-tenant sums reconcile with it exactly."""
+        key = (family, tenant)
+        c = self._tenant_twins.get(key)
+        if c is None:
+            with self._lock:
+                c = self._tenant_twins.get(key)
+                if c is None:
+                    c = self._reg.counter(family, tenant=tenant)
+                    self._tenant_twins[key] = c
+        return c
 
     # -- footer plane -------------------------------------------------------------------
 
@@ -560,6 +578,9 @@ class RemoteReadEngine:
         latency model is consulted once, at issue time)."""
         state = _GetState()
         state.outstanding = 1
+        from petastorm_tpu.obs import tenant as _tenant_ctx
+
+        state.tenant = _tenant_ctx.current_label()
         if self._opts.hedge:
             state.deadline_s = self._model.deadline(
                 self._store, length, self._hedge_quantile,
@@ -611,6 +632,12 @@ class RemoteReadEngine:
                     self._hedges.inc()
                     with self._lock:
                         self._n["hedges"] += 1
+                    if state.tenant is not None:
+                        self._twin("ptpu_io_hedges_total", state.tenant).inc()
+                        from petastorm_tpu.obs import tenant as _tenant_ctx
+
+                        _tenant_ctx.charge("hedged_gets", 1,
+                                           label=state.tenant)
                     if _prov.ACTIVE is not None:
                         # supervision runs on the item's own thread, so the
                         # annotation binds to the right record exactly
@@ -669,6 +696,10 @@ class RemoteReadEngine:
         with self._lock:
             self._n["gets"] += 1
             self._n["bytes"] += len(data)
+        if state.tenant is not None:
+            self._twin("ptpu_io_remote_gets_total", state.tenant).inc()
+            self._twin("ptpu_io_remote_bytes_total",
+                       state.tenant).inc(len(data))
         lease = Lease(kind="remote_get")
         deliver = False
         with state.lock:
@@ -683,6 +714,8 @@ class RemoteReadEngine:
                 self._hedge_wins.inc()
                 with self._lock:
                     self._n["hedge_wins"] += 1
+                if state.tenant is not None:
+                    self._twin("ptpu_io_hedge_wins_total", state.tenant).inc()
             state.done.set()
         else:
             # the drained loser: release the accounting lease, drop the bytes

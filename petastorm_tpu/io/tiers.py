@@ -57,7 +57,7 @@ class TieredCache(CacheBase):
     """
 
     def __init__(self, mem=None, disk=None, disk_admit="always",
-                 single_epoch=False):
+                 single_epoch=False, tenant=None):
         if disk_admit not in ("always", "scan-resistant"):
             raise ValueError("disk_admit must be 'always' or 'scan-resistant', "
                              "got %r" % (disk_admit,))
@@ -65,6 +65,9 @@ class TieredCache(CacheBase):
         self._disk = disk if disk is not None else NullCache()
         self._disk_admit = disk_admit
         self._single_epoch = bool(single_epoch)
+        #: tenant slug (ISSUE 18): a plain string survives pickling into pool
+        #: children, so a child-side rebuild keeps charging the same tenant
+        self._tenant = tenant
         self._metrics = None  # lazy; a registry handle must not cross pickling
 
     def __getstate__(self):
@@ -82,15 +85,29 @@ class TieredCache(CacheBase):
                     reg.counter("ptpu_io_tier_bytes_total",
                                 help="payload bytes served per cache tier",
                                 tier=t),
-                    [0, 0])
+                    [0, 0],
+                    # tenant twins (ISSUE 18): charged ALONGSIDE the untagged
+                    # totals above, never instead — per-tenant sums reconcile
+                    # against the totals by construction
+                    None if self._tenant is None else
+                    (reg.counter("ptpu_io_tier_hits_total",
+                                 tier=t, tenant=self._tenant),
+                     reg.counter("ptpu_io_tier_bytes_total",
+                                 tier=t, tenant=self._tenant)))
                 for t in TIERS
             }
-        hits, nbytes, local = metrics[tier]
+        hits, nbytes, local, tagged = metrics[tier]
         hits.inc()
         n = payload_nbytes(value)
         nbytes.inc(n)
         local[0] += 1
         local[1] += n
+        if tagged is not None:
+            tagged[0].inc()
+            tagged[1].inc(n)
+            from petastorm_tpu.obs import tenant as _tenant_ctx
+
+            _tenant_ctx.charge("read_bytes", n, label=self._tenant)
 
     def _admit_disk(self, value):
         """Should this remote-filled ``value`` be written to the disk tier?
@@ -205,7 +222,7 @@ class TieredCache(CacheBase):
             out.update(stats_fn())
         metrics = self._metrics
         if metrics is not None:
-            for tier, (_h, _b, local) in metrics.items():
+            for tier, (_h, _b, local, _tagged) in metrics.items():
                 out["tier_%s_hits" % tier] = local[0]
                 out["tier_%s_bytes" % tier] = local[1]
         return out

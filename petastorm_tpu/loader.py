@@ -712,9 +712,19 @@ class DataLoader:
                  to_device=True, host_queue_size=8, pad_shapes=None,
                  device_shuffle_capacity=0, device_decode_resize=None, trace=None,
                  metrics=None, health=None, staging=None, provenance=None,
-                 slos=None, controller=None):
+                 slos=None, controller=None, tenant=None):
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
+        #: per-tenant accounting (ISSUE 18): explicit tenant= wins, else the
+        #: reader's resolved context, else ambient/PTPU_TENANT; None ⇒ untagged
+        from petastorm_tpu.obs import tenant as _tenant_mod
+
+        self.tenant_context = _tenant_mod.resolve(tenant, env_default=False) \
+            if tenant is not None else None
+        if self.tenant_context is None:
+            self.tenant_context = getattr(reader, "tenant_context", None)
+        if self.tenant_context is None:
+            self.tenant_context = _tenant_mod.current()
         if last_batch not in ("drop", "pad", "partial"):
             raise ValueError("last_batch must be drop|pad|partial, got %r" % last_batch)
         if device_shuffle_capacity and not to_device:
@@ -1631,6 +1641,11 @@ class DataLoader:
         batch, staged = self._decode_staged(batch)
         dt = time.perf_counter() - t0
         self.stats.decode_s += dt
+        if self.tenant_context is not None:
+            from petastorm_tpu.obs import tenant as _tenant_mod
+
+            _tenant_mod.charge("decode_s", dt,
+                               label=self.tenant_context.tenant)
         if self._trace is not None:
             self._trace.add("decode.dispatch", t0, dt)
         if self._obs is not None:
@@ -2178,15 +2193,16 @@ class DataLoader:
         delivery. Requires ``provenance=``."""
         return self._require_provenance().last_batch()
 
-    def attribution_report(self):
+    def attribution_report(self, tenant=None):
         """Fold the recorded batch window into a critical-path step-time
         attribution (:class:`~petastorm_tpu.obs.critical_path
         .AttributionReport`): per-site self seconds and shares on the
         critical path, step-gap p50/p99 split by cache tier and degradation
         cause, and the "your p99 batch spent N% in <site>" verdict — the
         refinement of :meth:`bottleneck_report` down to a concrete site.
-        Requires ``provenance=``."""
-        return self._require_provenance().report()
+        ``tenant=`` (ISSUE 18) narrows the batch window to batches whose
+        items that tenant delivered. Requires ``provenance=``."""
+        return self._require_provenance().report(tenant=tenant)
 
     def __enter__(self):
         return self

@@ -31,6 +31,14 @@ attribution snapshot naming the culprit site;
 :mod:`petastorm_tpu.obs.serve` is the opt-in loopback HTTP scrape endpoint
 (Prometheus text + JSON timelines) that ``petastorm-tpu-stats --merge``
 aggregates into fleet panels.
+
+The TENANT plane (ISSUE 18): :mod:`petastorm_tpu.obs.tenant` threads a
+validated :class:`TenantContext` (bounded slug — always a safe metric label)
+through every layer a batch touches, so shared resources answer "who ate
+it?" — ``tenant=``-labeled twins beside every untagged total, a
+fleet-mergeable :class:`TenantUsageReport`, per-tenant ``SloSpec``
+dimensioning, and a tenant panel in ``petastorm-tpu-stats``. See
+docs/observability.md "Tenant accounting".
 """
 from petastorm_tpu.obs.flight import FlightRecorder
 from petastorm_tpu.obs.health import HealthMonitor, HealthOptions
@@ -43,9 +51,10 @@ from petastorm_tpu.obs.metrics import (
 )
 from petastorm_tpu.obs.serve import MetricsServer
 from petastorm_tpu.obs.slo import AnomalyDetector, SloEngine, SloSpec
+from petastorm_tpu.obs.tenant import TenantContext, TenantUsageReport
 from petastorm_tpu.obs.timeseries import TimelineStore
 
 __all__ = ["AnomalyDetector", "Counter", "FlightRecorder", "Gauge",
            "HealthMonitor", "HealthOptions", "Histogram", "MetricsRegistry",
-           "MetricsServer", "SloEngine", "SloSpec", "TimelineStore",
-           "default_registry"]
+           "MetricsServer", "SloEngine", "SloSpec", "TenantContext",
+           "TenantUsageReport", "TimelineStore", "default_registry"]

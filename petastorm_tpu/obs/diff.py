@@ -130,6 +130,9 @@ def diff_runs(run_a, run_b):
     hedge_note = _hedge_note(run_a, run_b)
     if hedge_note:
         parts.append(hedge_note)
+    tenant_breakdown, tenant_note = _tenant_breakdown(run_a, run_b)
+    if tenant_note:
+        parts.append(tenant_note)
     p99_a, p99_b = run_a.get("step_p99_s"), run_b.get("step_p99_s")
     if p99_a and p99_b and p99_a > 0 and p99_b / p99_a >= _MIN_RATIO:
         parts.append("step p99 %.1fx (%.1fms -> %.1fms)"
@@ -147,9 +150,43 @@ def diff_runs(run_a, run_b):
         "regressed_site_ratio": regressed_ratio,
         "workload_a": run_a.get("workload"),
         "workload_b": run_b.get("workload"),
+        "tenant_breakdown": tenant_breakdown,
         "verdict": ": ".join([parts[0], ", ".join(parts[1:])]) if parts[1:]
         else parts[0],
     }
+
+
+def _tenant_breakdown(run_a, run_b):
+    """Per-tenant forensics (ISSUE 18 satellite): when BOTH runs carry the
+    tenant-dimensioned site map (``"tenants": {tenant: {site: self_s}}`` —
+    written by workloads that ran with ``tenant=``-labeled series), diff each
+    tenant's critical-path self-times independently and name the worst
+    offender: "tenant b's io.remote self-time 2.1x". Returns
+    ``(breakdown_dict_or_None, note_or_None)``."""
+    from petastorm_tpu.obs.critical_path import diff_self_times
+
+    tenants_a = run_a.get("tenants")
+    tenants_b = run_b.get("tenants")
+    if not isinstance(tenants_a, dict) or not isinstance(tenants_b, dict):
+        return None, None
+    breakdown = {}
+    worst = None  # (ratio, tenant, site)
+    for tenant in sorted(set(tenants_a) & set(tenants_b)):
+        diffs = diff_self_times(tenants_a[tenant] or {},
+                                tenants_b[tenant] or {},
+                                min_share=_MIN_SITE_SHARE)
+        breakdown[tenant] = {site: round(ratio, 3)
+                             for site, ratio, _a, _b in diffs}
+        if diffs and diffs[0][1] >= _MIN_RATIO \
+                and (worst is None or diffs[0][1] > worst[0]):
+            worst = (diffs[0][1], tenant, diffs[0][0])
+    if not breakdown:
+        return None, None
+    note = None
+    if worst is not None:
+        note = "tenant %s's %s self-time %.1fx" % (worst[1], worst[2],
+                                                   worst[0])
+    return breakdown, note
 
 
 def _hedge_note(run_a, run_b):
@@ -190,6 +227,17 @@ def render(verdict, run_a, run_b):
                      % (site, a, b,
                         "  (%.2fx)" % ratio if ratio is not None else "",
                         flag))
+    breakdown = verdict.get("tenant_breakdown")
+    if breakdown:
+        lines.append("  per-tenant self-time ratios:")
+        for tenant in sorted(breakdown):
+            ratios = breakdown[tenant]
+            worst = max(ratios.items(), key=lambda kv: kv[1]) \
+                if ratios else None
+            lines.append("    %-16s %s" % (tenant, "  ".join(
+                "%s %.2fx" % (site, ratios[site])
+                for site in sorted(ratios, key=lambda s: -ratios[s])[:4])
+                if worst else "(no significant sites)"))
     lines.append("  verdict: %s" % verdict["verdict"])
     return "\n".join(lines)
 

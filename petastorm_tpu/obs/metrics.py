@@ -331,6 +331,16 @@ class MetricsRegistry:
             except Exception:  # noqa: BLE001 — a dead source must not kill export
                 continue
             for suffix, value in (polled or {}).items():
+                if isinstance(value, dict):
+                    # tenant-keyed breakdowns (ISSUE 18: the arena's
+                    # index-derived ``arena_tenant_bytes``) flatten into
+                    # tenant-labeled series — export and timelines only
+                    # speak scalars
+                    for tenant, v in value.items():
+                        if isinstance(v, (int, float)):
+                            out['ptpu_%s_%s{tenant="%s"}'
+                                % (prefix, suffix, tenant)] = v
+                    continue
                 out["ptpu_%s_%s" % (prefix, suffix)] = value
         return out
 
@@ -353,6 +363,8 @@ class MetricsRegistry:
             else:
                 out.append((m.full_name, m.kind, m.value))
         for name, value in self._collect().items():
+            if not isinstance(value, (int, float)):
+                continue  # non-scalar collector payloads never window
             kind = "counter" if name.endswith("_total") else "gauge"
             out.append((name, kind, float(value)))
         return out

@@ -185,6 +185,14 @@ def begin_item(tagged_item):
         return None
     rec = ACTIVE.open_item(tagged_item)
     _tls.item = rec
+    # stamp the tenant (ISSUE 18) as a plain annotation: it rides the child
+    # piggyback blob and absorb_child's annotation merge unchanged, so child
+    # spans land in the right tenant with zero new wire format
+    from petastorm_tpu.obs import tenant as _tenant_ctx
+
+    label = _tenant_ctx.current_label()
+    if label is not None and "tenant" not in rec.annotations:
+        rec.annotations["tenant"] = label
     return rec
 
 
@@ -622,12 +630,26 @@ class ProvenanceRecorder:
         with self._lock:
             return list(self._quarantined)
 
-    def report(self):
+    def report(self, tenant=None):
         """Fold the completed batches into a step-time
-        :class:`~petastorm_tpu.obs.critical_path.AttributionReport`."""
+        :class:`~petastorm_tpu.obs.critical_path.AttributionReport`.
+        ``tenant`` (ISSUE 18) restricts the fold to batches whose
+        contributing items carry that tenant annotation — "whose tail is
+        this" becomes a per-tenant question."""
         from petastorm_tpu.obs.critical_path import analyze_batches
 
-        return analyze_batches(self.batches())
+        batches = self.batches()
+        if tenant is not None:
+            batches = [b for b in batches if any(
+                (item.get("annotations") or {}).get("tenant") == tenant
+                for item in b.get("item_records") or ())]
+        return analyze_batches(batches)
+
+    def attribution_report(self, tenant=None):
+        """Alias of :meth:`report` under the loader's public name, so a bare
+        recorder answers ``attribution_report(tenant=...)`` the same way
+        ``DataLoader.attribution_report`` does."""
+        return self.report(tenant=tenant)
 
     def summary(self):
         """Flat numeric summary for the flight recorder and the metrics

@@ -31,6 +31,7 @@ thread), never on the loader/reader paths.
 from __future__ import annotations
 
 import dataclasses
+import re
 import threading
 import time
 
@@ -43,6 +44,23 @@ _OPS = {
 
 #: stats resolvable from a window point (see SloSpec.stat)
 _STATS = ("value", "delta", "rate", "p50", "p99", "share")
+
+_TENANT_LABEL_RE = re.compile(r'tenant="([^"]*)"')
+
+
+def _strip_tenant(full_name):
+    """``'base{a="1",tenant="x"}'`` → ``('base{a="1"}', 'x')``; a series with
+    no ``tenant=`` label returns ``(full_name, None)``. Used by per-tenant
+    spec expansion to match every tenant dimension of one base metric."""
+    m = _TENANT_LABEL_RE.search(full_name)
+    if m is None:
+        return full_name, None
+    tenant = m.group(1)
+    base = full_name[:m.start()] + full_name[m.end():]
+    base = base.replace("{,", "{").replace(",,", ",").replace(",}", "}")
+    if base.endswith("{}"):
+        base = base[:-2]
+    return base, tenant
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +98,11 @@ class SloSpec:
     #: histogram windows with fewer observations than this are skipped
     min_count: int = 1
     description: str = ""
+    #: per-tenant dimensioning (ISSUE 18): evaluate this spec independently
+    #: against EVERY ``metric{...,tenant="X"}`` series in the window —
+    #: debounce streaks and latches are kept per (spec, tenant), and a firing
+    #: alert names the culprit tenant alongside the culprit site
+    per_tenant: bool = False
 
     def __post_init__(self):
         if self.op not in _OPS:
@@ -89,9 +112,11 @@ class SloSpec:
             raise ValueError("SloSpec stat must be one of %s, got %r"
                              % (_STATS, self.stat))
 
-    def resolve(self, window, window_s=None):
-        """The spec's statistic from one window dict, or None to skip."""
-        point = window.get(self.metric)
+    def resolve(self, window, window_s=None, metric=None):
+        """The spec's statistic from one window dict, or None to skip.
+        ``metric`` overrides the looked-up series name (per-tenant expansion
+        resolves the same spec against each tenant-labeled twin)."""
+        point = window.get(metric if metric is not None else self.metric)
         if point is None:
             return None
         if self.stat in ("p50", "p99"):
@@ -203,6 +228,9 @@ class SloAlert:
     attribution: dict | None = None
     #: the attribution snapshot's slow-decile culprit site (convenience)
     culprit: str | None = None
+    #: culprit tenant for ``per_tenant`` specs (ISSUE 18): the tenant whose
+    #: series breached — None for untagged specs and anomalies
+    tenant: str | None = None
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -274,19 +302,19 @@ class SloEngine:
             self.windows_evaluated += 1
             fired = []
             for spec in self._specs:
-                value = spec.resolve(window, window_s=window_s)
-                if value is None:
-                    continue  # sparse window: neither breaches nor clears
-                if spec.ok(value):
-                    self._breach_streak[spec.name] = 0
-                    self._breach_latched[spec.name] = False
+                if spec.per_tenant:
+                    # per-tenant expansion (ISSUE 18): one independent
+                    # debounce per tenant-labeled twin of the base series
+                    for series in window:
+                        base, tenant = _strip_tenant(series)
+                        if tenant is None or base != spec.metric:
+                            continue
+                        value = spec.resolve(window, window_s=window_s,
+                                             metric=series)
+                        self._judge(spec, value, fired, tenant=tenant)
                     continue
-                streak = self._breach_streak.get(spec.name, 0) + 1
-                self._breach_streak[spec.name] = streak
-                if streak >= spec.breach_windows \
-                        and not self._breach_latched.get(spec.name):
-                    self._breach_latched[spec.name] = True
-                    fired.append((spec, value, streak))
+                value = spec.resolve(window, window_s=window_s)
+                self._judge(spec, value, fired)
             anomalies = []
             for metric, stat in self._anomaly_watch:
                 point = window.get(metric)
@@ -299,19 +327,46 @@ class SloEngine:
                 if det.observe(value):
                     anomalies.append((metric, stat, value, det.last_z))
         out = []
-        for spec, value, streak in fired:
-            out.append(self._fire_breach(spec, value, streak, t))
+        for spec, value, streak, tenant in fired:
+            out.append(self._fire_breach(spec, value, streak, t,
+                                         tenant=tenant))
         for metric, stat, value, z in anomalies:
             out.append(self._fire_anomaly(metric, stat, value, z, t))
         return out
 
+    def _judge(self, spec, value, fired, tenant=None):
+        """One spec × one (possibly tenant-dimensioned) value through the
+        debounce state machine. Caller holds ``self._lock``."""
+        if value is None:
+            return  # sparse window: neither breaches nor clears
+        key = spec.name if tenant is None else (spec.name, tenant)
+        if spec.ok(value):
+            self._breach_streak[key] = 0
+            self._breach_latched[key] = False
+            return
+        streak = self._breach_streak.get(key, 0) + 1
+        self._breach_streak[key] = streak
+        if streak >= spec.breach_windows \
+                and not self._breach_latched.get(key):
+            self._breach_latched[key] = True
+            fired.append((spec, value, streak, tenant))
+
     # -- alert plumbing -----------------------------------------------------------------
 
-    def _attribution_snapshot(self):
+    def _attribution_snapshot(self, tenant=None):
         if self._attribution is None:
             return None, None
         try:
-            report = self._attribution()
+            if tenant is not None:
+                # tenant-scoped attribution when the source takes the kwarg
+                # (ProvenanceRecorder/DataLoader do); older callables fall
+                # back to the unscoped report
+                try:
+                    report = self._attribution(tenant=tenant)
+                except TypeError:
+                    report = self._attribution()
+            else:
+                report = self._attribution()
         except Exception:  # noqa: BLE001 — a broken source must not kill alerting
             from petastorm_tpu.obs.log import degradation
 
@@ -331,23 +386,29 @@ class SloEngine:
             self._alerts.append(alert)
             del self._alerts[:-self._max_alerts]
         if self._registry is not None:
+            labels = {"slo": alert.name}
+            if alert.tenant is not None:
+                labels["tenant"] = alert.tenant
             self._registry.counter(
                 "ptpu_slo_alerts_total",
-                help="debounced SLO-breach/anomaly alerts", slo=alert.name).inc()
+                help="debounced SLO-breach/anomaly alerts", **labels).inc()
         # count + warn-once log + flight mirror of the CAUSE; then the full
         # alert (culprit included) into every live flight recorder
         degradation(alert.cause, "%s", alert.message)
         for recorder in _flight.active_recorders():
             recorder.record("slo_alert", name=alert.name, cause=alert.cause,
                             metric=alert.metric, value=alert.value,
-                            threshold=alert.threshold, culprit=alert.culprit)
+                            threshold=alert.threshold, culprit=alert.culprit,
+                            tenant=alert.tenant)
         return alert
 
-    def _fire_breach(self, spec, value, streak, t):
-        attribution, culprit = self._attribution_snapshot()
-        message = ("SLO %r breached: %s %s = %.6g violates %s %.6g for %d "
+    def _fire_breach(self, spec, value, streak, t, tenant=None):
+        attribution, culprit = self._attribution_snapshot(tenant=tenant)
+        message = ("SLO %r breached%s: %s %s = %.6g violates %s %.6g for %d "
                    "consecutive windows%s"
-                   % (spec.name, spec.metric, spec.stat, value, spec.op,
+                   % (spec.name,
+                      " by tenant %r" % tenant if tenant is not None else "",
+                      spec.metric, spec.stat, value, spec.op,
                       spec.threshold, streak,
                       " — critical path owned by %s" % culprit
                       if culprit else ""))
@@ -355,7 +416,7 @@ class SloEngine:
             name=spec.name, cause="slo_breach", metric=spec.metric,
             stat=spec.stat, t=t, value=round(float(value), 6),
             threshold=spec.threshold, windows=streak, message=message,
-            attribution=attribution, culprit=culprit))
+            attribution=attribution, culprit=culprit, tenant=tenant))
 
     def _fire_anomaly(self, metric, stat, value, z, t):
         attribution, culprit = self._attribution_snapshot()
@@ -378,9 +439,11 @@ class SloEngine:
             return list(self._alerts)
 
     def breaching(self):
-        """Specs currently in a breach streak: ``{name: streak}``."""
+        """Specs currently in a breach streak: ``{name: streak}`` —
+        per-tenant expansions key as ``'name{tenant="x"}'``."""
         with self._lock:
-            return {n: s for n, s in self._breach_streak.items() if s}
+            return {n if isinstance(n, str) else '%s{tenant="%s"}' % n: s
+                    for n, s in self._breach_streak.items() if s}
 
     def collect(self):
         """Pull-collector shape (``ptpu_slo_*``): alert totals + live breach

@@ -197,11 +197,33 @@ def _render_trends(lines, history):
         return (hits.get("mem", 0) / total) if total else None
 
     shares = _history_series(history, mem_share)
+
+    # arena trends (ISSUE 18 satellite): hit ratio from window deltas of the
+    # hit/miss counters (the CUMULATIVE ratio the static panel shows goes
+    # flat the moment the warm set stabilizes — the windowed one moves), and
+    # resident bytes straight off the gauge
+    arena_hit_rates = _delta_series(history, "ptpu_io_arena_hits_total")
+    arena_miss_rates = _delta_series(history, "ptpu_io_arena_misses_total")
+    arena_ratio = []
+    for h, miss in zip(arena_hit_rates, arena_miss_rates):
+        if h is None and miss is None:
+            arena_ratio.append(None)
+            continue
+        h, miss = h or 0.0, miss or 0.0
+        arena_ratio.append(h / (h + miss) if (h + miss) else None)
+
+    def arena_bytes(m):
+        v = m.get("ptpu_io_arena_bytes")
+        return v if isinstance(v, (int, float)) and v else None
+
+    arena_res = _history_series(history, arena_bytes)
     panel = []
     for label, series, fmt in (
             ("rows/s", rows, lambda v: "%.0f" % v),
             ("read p99 ms (cum)", p99s, lambda v: "%.2f" % (v * 1e3)),
-            ("mem-tier share", shares, lambda v: "%.0f%%" % (100 * v))):
+            ("mem-tier share", shares, lambda v: "%.0f%%" % (100 * v)),
+            ("arena hit ratio", arena_ratio, lambda v: "%.0f%%" % (100 * v)),
+            ("arena res MB", arena_res, lambda v: "%.1f" % (v / 1e6))):
         present = [v for v in series if v is not None]
         if not present:
             continue
@@ -342,6 +364,13 @@ def render_dashboard(metrics, title="", history=None):
                int(metrics.get("ptpu_io_arena_evictions_total", 0)),
                int(metrics.get("ptpu_io_arena_invalidations_total", 0)),
                int(metrics.get("ptpu_io_arena_holders_revoked_total", 0))))
+
+    # -- per-tenant accounting (ISSUE 18 — who ate the shared resources)
+    from petastorm_tpu.obs.tenant import TenantUsageReport
+
+    tenant_report = TenantUsageReport.from_metrics(metrics)
+    if tenant_report.tenants():
+        lines.extend(tenant_report.render())
 
     # -- remote read path (ISSUE 8): GETs, hedging, footer cache
     r = {name: metrics[name] for name in metrics
@@ -534,7 +563,7 @@ def render_dashboard(metrics, title="", history=None):
                       "ptpu_io_footer_cache_", "ptpu_transform_",
                       "ptpu_prov_", "ptpu_dataset_", "ptpu_slo_",
                       "ptpu_ctl_", "ptpu_pagedec_", "ptpu_net_",
-                      "ptpu_io_arena_")
+                      "ptpu_io_arena_", "ptpu_tenant_")
     rest = {n: v for n, v in metrics.items()
             if not n.startswith(shown_prefixes)}
     scalars = [(n, v) for n, v in sorted(rest.items())

@@ -1,0 +1,509 @@
+"""Per-tenant accounting plane (ISSUE 18): who ate the shared resource?
+
+Every observability plane below this module — provenance (ISSUE 10),
+time-series/SLO (ISSUE 12), the cache arena counters (ISSUE 17) — aggregates
+all consumers of a host into one anonymous stream. A multi-tenant dispatcher
+built on that substrate could never bill a noisy neighbor. This module is the
+missing dimension: a validated, *bounded* tenant label threaded through every
+layer a batch touches, so decode seconds, store bytes, hedged GETs, arena
+resident bytes and quarantined rows all answer "who ate it?".
+
+Three pieces:
+
+- :class:`TenantContext` — tenant id + job id + priority hint. The tenant id
+  is a validated slug (``[a-z0-9][a-z0-9._-]{0,31}``) so it is ALWAYS a
+  bounded metric label: the cardinality cap
+  (:data:`petastorm_tpu.obs.timeseries.DEFAULT_MAX_SERIES`) never has to
+  defend against tenant labels, and graftlint GL-O005 whitelists them
+  statically for the same reason.
+- resolution + propagation — explicit ``make_reader(tenant=)`` /
+  ``DataLoader(tenant=)`` argument wins, then the ``PTPU_TENANT`` /
+  ``PTPU_TENANT_JOB`` / ``PTPU_TENANT_PRIORITY`` environment (which is also
+  how pool children inherit the parent's tenant: the executor stamps the env,
+  ``_child_worker`` calls :func:`attach_from_env`). Worker threads activate
+  the context thread-locally around each item so IO layers deep in the stack
+  (tiers, arena, remote) can ask :func:`current_label` without plumbing.
+  An invalid env slug degrades (``tenant_label_invalid``) instead of raising
+  — a typo in a launcher script must not kill the job; an invalid *explicit*
+  argument raises, because the caller is right there to fix it.
+- the meter — ``ptpu_tenant_*`` counter families charged at the resource
+  sites, plus :class:`TenantUsageReport`, a fleet-mergeable rollup built from
+  any metrics snapshot (a live registry's, a JSONL export's, or the summed
+  totals of ``petastorm-tpu-stats --merge``).
+
+The disabled cost contract matches ``trace.py``: with no tenant resolved,
+every instrumented site pays one ``is None`` check (``current()`` is a
+thread-local read + a module global read) — the ``tenants --smoke`` bench
+asserts the whole plane at <=1% untagged overhead. Untagged runs charge
+NOTHING here: the pre-existing untagged families remain the single source of
+totals, and per-tenant twins appear only when a tenant is known, so
+cross-tenant sums reconcile exactly against the untagged totals (no
+double-count, no leak).
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from contextlib import contextmanager
+
+#: the reserved label rendered for unattributed flow in reports and panels.
+#: Never a valid tenant id (the slug grammar forbids it), so it cannot
+#: collide with a real tenant.
+UNTAGGED = "-"
+
+#: bounded-slug grammar — lowercase alphanumerics plus ``._-``, 1..32 chars,
+#: leading alphanumeric. Bounded length + restricted alphabet keep
+#: ``tenant=`` a safe metric label (and a safe 1-byte-length wire field).
+_SLUG_RE = re.compile(r"^[a-z0-9][a-z0-9._-]{0,31}$")
+
+_PRIORITIES = ("low", "normal", "high")
+
+#: env knobs (resolution order: explicit argument > environment > none)
+ENV_TENANT = "PTPU_TENANT"
+ENV_JOB = "PTPU_TENANT_JOB"
+ENV_PRIORITY = "PTPU_TENANT_PRIORITY"
+
+
+def valid_slug(value):
+    """True when ``value`` is a legal bounded tenant/job slug."""
+    return isinstance(value, str) and _SLUG_RE.match(value) is not None
+
+
+class TenantContext:
+    """Who this pipeline's work is billed to.
+
+    Immutable-by-convention (instances are shared across threads and pickled
+    into pool workers); compares and hashes by value so plan stamping and
+    per-tenant dict keys behave.
+    """
+
+    __slots__ = ("tenant", "job", "priority")
+
+    def __init__(self, tenant, job=None, priority=None):
+        if not valid_slug(tenant):
+            raise ValueError(
+                "tenant id %r is not a bounded slug (%s) — tenant ids become "
+                "metric labels and wire-frame fields, so they must be small "
+                "and closed-alphabet" % (tenant, _SLUG_RE.pattern))
+        if job is not None and not valid_slug(job):
+            raise ValueError("tenant job id %r is not a bounded slug (%s)"
+                             % (job, _SLUG_RE.pattern))
+        if priority is not None and priority not in _PRIORITIES:
+            raise ValueError("tenant priority %r not in %r"
+                             % (priority, _PRIORITIES))
+        object.__setattr__(self, "tenant", tenant)
+        object.__setattr__(self, "job", job)
+        object.__setattr__(self, "priority", priority)
+
+    def __setattr__(self, name, value):
+        raise AttributeError("TenantContext is immutable")
+
+    def __eq__(self, other):
+        return (isinstance(other, TenantContext)
+                and other.tenant == self.tenant and other.job == self.job
+                and other.priority == self.priority)
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __hash__(self):
+        return hash((self.tenant, self.job, self.priority))
+
+    def __repr__(self):
+        parts = [repr(self.tenant)]
+        if self.job:
+            parts.append("job=%r" % self.job)
+        if self.priority:
+            parts.append("priority=%r" % self.priority)
+        return "TenantContext(%s)" % ", ".join(parts)
+
+    def __getstate__(self):
+        return (self.tenant, self.job, self.priority)
+
+    def __setstate__(self, state):
+        tenant, job, priority = state
+        object.__setattr__(self, "tenant", tenant)
+        object.__setattr__(self, "job", job)
+        object.__setattr__(self, "priority", priority)
+
+    def env(self):
+        """The env-var dict that propagates this context to a child process
+        (the executor merges it into the child env)."""
+        out = {ENV_TENANT: self.tenant}
+        if self.job:
+            out[ENV_JOB] = self.job
+        if self.priority:
+            out[ENV_PRIORITY] = self.priority
+        return out
+
+
+def from_env(environ=None):
+    """The environment's tenant context, or None.
+
+    An invalid ``PTPU_TENANT`` slug fires the ``tenant_label_invalid``
+    degradation and resolves to None (untagged) instead of raising: the env
+    path is set by launchers, and a launcher typo must degrade attribution,
+    not kill the job. Invalid job/priority fields are dropped the same way
+    but keep the (valid) tenant id.
+    """
+    environ = os.environ if environ is None else environ
+    raw = environ.get(ENV_TENANT)
+    if not raw:
+        return None
+    if not valid_slug(raw):
+        from petastorm_tpu.obs.log import degradation
+
+        degradation("tenant_label_invalid",
+                    "%s=%r is not a bounded slug (%s) — running untagged",
+                    ENV_TENANT, raw, _SLUG_RE.pattern)
+        return None
+    job = environ.get(ENV_JOB) or None
+    priority = environ.get(ENV_PRIORITY) or None
+    if job is not None and not valid_slug(job):
+        from petastorm_tpu.obs.log import degradation
+
+        degradation("tenant_label_invalid",
+                    "%s=%r is not a bounded slug — dropping job id",
+                    ENV_JOB, job)
+        job = None
+    if priority is not None and priority not in _PRIORITIES:
+        from petastorm_tpu.obs.log import degradation
+
+        degradation("tenant_label_invalid",
+                    "%s=%r not in %r — dropping priority hint",
+                    ENV_PRIORITY, priority, _PRIORITIES)
+        priority = None
+    return TenantContext(raw, job=job, priority=priority)
+
+
+def resolve(tenant=None, env_default=True):
+    """Resolution order: explicit argument > environment > None.
+
+    ``tenant`` may be a :class:`TenantContext`, a bare slug string, or None.
+    An invalid *explicit* value raises (the caller is present to fix it);
+    the env path degrades instead (see :func:`from_env`).
+    """
+    if isinstance(tenant, TenantContext):
+        return tenant
+    if isinstance(tenant, str):
+        return TenantContext(tenant)
+    if tenant is not None:
+        raise TypeError("tenant= must be a TenantContext, a slug string, or "
+                        "None, not %r" % (tenant,))
+    return from_env() if env_default else None
+
+
+# ---------------------------------------------------------------------------
+# current-context plumbing: a thread-local activation (worker threads, around
+# each item) layered over a process default (children, via attach_from_env).
+
+_tls = threading.local()
+_process_default = None
+
+
+def set_default(ctx):
+    """Install ``ctx`` (or None) as the process-wide default tenant."""
+    global _process_default
+    _process_default = ctx
+
+
+def attach_from_env():
+    """Adopt the environment's tenant as the process default — the pool-child
+    bootstrap hook (``_child_worker`` calls this beside the arena attach, so
+    IO and span charges inside the child land on the parent's tenant)."""
+    set_default(from_env())
+    return _process_default
+
+
+def current():
+    """The active :class:`TenantContext` (thread activation, else process
+    default), or None. The untagged fast path is two attribute reads."""
+    ctx = getattr(_tls, "ctx", None)
+    return ctx if ctx is not None else _process_default
+
+
+def current_label():
+    """The active tenant id, or None when untagged."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        ctx = _process_default
+    return None if ctx is None else ctx.tenant
+
+
+def label_of(ctx):
+    """Render a context as its report/panel label (None => ``"-"``)."""
+    return UNTAGGED if ctx is None else ctx.tenant
+
+
+@contextmanager
+def activate(ctx):
+    """Thread-locally activate ``ctx`` for the duration (no-op for None —
+    the surrounding default keeps applying)."""
+    if ctx is None:
+        yield
+        return
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield
+    finally:
+        _tls.ctx = prev
+
+
+# ---------------------------------------------------------------------------
+# the meter: ptpu_tenant_* families charged at the resource sites.
+
+#: resource key -> (metric family, unit note). The report and the stats panel
+#: iterate this table, so adding a resource is one row.
+RESOURCES = {
+    "rows": ("ptpu_tenant_rows_total", "delivered rows"),
+    "read_bytes": ("ptpu_tenant_read_bytes_total", "bytes read (all tiers)"),
+    "decode_s": ("ptpu_tenant_decode_seconds_total", "decode seconds"),
+    "worker_s": ("ptpu_tenant_worker_seconds_total", "worker item seconds"),
+    "arena_byte_s": ("ptpu_tenant_arena_byte_seconds_total",
+                     "arena residency (byte*seconds)"),
+    "arena_bytes": ("ptpu_tenant_arena_resident_bytes",
+                    "arena resident bytes (gauge)"),
+    "hedged_gets": ("ptpu_tenant_hedged_gets_total", "hedged remote GETs"),
+    "quarantined": ("ptpu_tenant_quarantined_rows_total", "quarantined rows"),
+    "wire_bytes": ("ptpu_tenant_wire_bytes_total",
+                   "transport frame bytes (tagged frames)"),
+}
+
+
+class _Meter:
+    """Per-registry cache of tenant-labeled counters (building a counter is a
+    dict lookup after the first charge — the tagged hot path stays cheap)."""
+
+    __slots__ = ("_registry", "_counters", "_gauges", "_lock", "_arena")
+
+    def __init__(self, registry):
+        self._registry = registry
+        self._counters = {}
+        self._gauges = {}
+        self._lock = threading.Lock()
+        #: tenant label -> [resident_bytes, last_adjust_monotonic]; the
+        #: byte*seconds integral accrues event-driven on every adjustment.
+        self._arena = {}
+
+    def counter(self, family, label):
+        key = (family, label)
+        c = self._counters.get(key)
+        if c is None:
+            with self._lock:
+                c = self._counters.get(key)
+                if c is None:
+                    c = self._registry.counter(family, tenant=label)
+                    self._counters[key] = c
+        return c
+
+    def gauge(self, family, label):
+        key = (family, label)
+        g = self._gauges.get(key)
+        if g is None:
+            with self._lock:
+                g = self._gauges.get(key)
+                if g is None:
+                    g = self._registry.gauge(family, tenant=label)
+                    self._gauges[key] = g
+        return g
+
+    def charge(self, resource, label, amount=1):
+        if amount:
+            self.counter(RESOURCES[resource][0], label).inc(amount)
+
+    def arena_adjust(self, label, delta_bytes, now=None):
+        """Account an arena residency change for ``label``: accrue the
+        byte*seconds integral since the last adjustment, then move the
+        resident-bytes gauge by ``delta_bytes`` (negative on evict/release).
+        Event-driven integration: no sampler thread, exact between events."""
+        now = time.monotonic() if now is None else now
+        # metric building happens OUTSIDE the meter lock: counter() takes the
+        # same (non-reentrant) lock on a cache miss, and the registry has its
+        # own locks this meter must never nest under
+        with self._lock:
+            state = self._arena.get(label)
+            if state is None:
+                state = [0.0, now]
+                self._arena[label] = state
+            resident, last = state
+            accrued = resident * (now - last) \
+                if resident > 0 and now > last else 0.0
+            state[0] = max(0.0, resident + delta_bytes)
+            state[1] = now
+            resident_now = state[0]
+        if accrued:
+            self.counter(RESOURCES["arena_byte_s"][0], label).inc(accrued)
+        self.gauge(RESOURCES["arena_bytes"][0], label).set(resident_now)
+
+    def arena_settle(self, now=None):
+        """Flush the byte*seconds integral up to ``now`` for every tenant
+        (report time: residency since the last event must still bill)."""
+        now = time.monotonic() if now is None else now
+        accrued = []
+        with self._lock:
+            for label, state in self._arena.items():
+                resident, last = state
+                if resident > 0 and now > last:
+                    accrued.append((label, resident * (now - last)))
+                state[1] = now
+        for label, amount in accrued:
+            self.counter(RESOURCES["arena_byte_s"][0], label).inc(amount)
+
+
+_meters = {}
+_meters_lock = threading.Lock()
+
+
+def meter(registry=None):
+    """The tenant meter bound to ``registry`` (default: the process default
+    registry — where the io/arena families already live)."""
+    if registry is None:
+        from petastorm_tpu.obs.metrics import default_registry
+
+        registry = default_registry()
+    m = _meters.get(id(registry))
+    if m is None:
+        with _meters_lock:
+            m = _meters.get(id(registry))
+            if m is None:
+                m = _Meter(registry)
+                _meters[id(registry)] = m
+    return m
+
+
+def charge(resource, amount=1, label=None, registry=None):
+    """Charge ``amount`` of ``resource`` to ``label`` (default: the current
+    tenant). No-op when untagged — the untagged families stay the totals."""
+    if label is None:
+        label = current_label()
+        if label is None:
+            return
+    meter(registry).charge(resource, label, amount)
+
+
+# ---------------------------------------------------------------------------
+# TenantUsageReport: the fleet-mergeable rollup.
+
+_LABELED_RE = re.compile(r'^(?P<family>\w+)\{(?P<labels>[^}]*)\}$')
+_TENANT_LABEL_RE = re.compile(r'(?:^|,)tenant="(?P<tenant>[^"]*)"')
+
+
+def _tenant_of(full_name):
+    """``(family, tenant)`` of a flat metric name carrying a tenant= label,
+    else ``(None, None)``."""
+    m = _LABELED_RE.match(full_name)
+    if not m:
+        return None, None
+    t = _TENANT_LABEL_RE.search(m.group("labels"))
+    if not t:
+        return None, None
+    return m.group("family"), t.group("tenant")
+
+
+class TenantUsageReport:
+    """Per-tenant resource rollup built from any flat metrics snapshot.
+
+    Works identically on a live registry's :meth:`MetricsRegistry.snapshot`,
+    a loaded export's ``metrics`` dict, or the summed ``totals`` of a fleet
+    merge — counters sum per full labeled name in
+    :func:`petastorm_tpu.obs.timeseries.merge_exports`, so per-tenant fleet
+    totals come free. ``usage`` maps tenant label -> resource key -> value
+    (resource keys from :data:`RESOURCES`).
+    """
+
+    __slots__ = ("usage",)
+
+    def __init__(self, usage=None):
+        self.usage = dict(usage or {})
+
+    @classmethod
+    def from_metrics(cls, metrics):
+        family_to_resource = {fam: res
+                              for res, (fam, _note) in RESOURCES.items()}
+        usage = {}
+        for name, value in metrics.items():
+            family, tenant = _tenant_of(name)
+            if family is None or not isinstance(value, (int, float)):
+                continue
+            resource = family_to_resource.get(family)
+            if resource is None:
+                continue
+            usage.setdefault(tenant, {})[resource] = \
+                usage.get(tenant, {}).get(resource, 0.0) + value
+        return cls(usage)
+
+    @classmethod
+    def from_registry(cls, registry=None):
+        if registry is None:
+            from petastorm_tpu.obs.metrics import default_registry
+
+            registry = default_registry()
+        meter(registry).arena_settle()
+        return cls.from_metrics(registry.snapshot())
+
+    def tenants(self):
+        return sorted(self.usage)
+
+    def get(self, tenant, resource, default=0.0):
+        return self.usage.get(tenant, {}).get(resource, default)
+
+    def top_consumer(self, resource):
+        """``(tenant, value)`` of the heaviest consumer of ``resource``
+        (``(None, 0.0)`` when nothing is charged)."""
+        best, best_v = None, 0.0
+        for tenant in sorted(self.usage):
+            v = self.usage[tenant].get(resource, 0.0)
+            if v > best_v:
+                best, best_v = tenant, v
+        return best, best_v
+
+    def merge(self, other):
+        """Sum another report into a new one (fleet aggregation)."""
+        usage = {t: dict(r) for t, r in self.usage.items()}
+        for tenant, resources in other.usage.items():
+            mine = usage.setdefault(tenant, {})
+            for resource, value in resources.items():
+                mine[resource] = mine.get(resource, 0.0) + value
+        return TenantUsageReport(usage)
+
+    def to_dict(self):
+        return {t: dict(r) for t, r in sorted(self.usage.items())}
+
+    def render(self, top=8):
+        """The stats-panel table (a list of lines): tenants ranked by worker
+        seconds (the scarcest shared resource), one row per tenant."""
+        lines = ["tenants (ptpu_tenant_*):"]
+        order = sorted(
+            self.usage,
+            key=lambda t: (-self.usage[t].get("worker_s", 0.0),
+                           -self.usage[t].get("read_bytes", 0.0), t))
+        for tenant in order[:top]:
+            r = self.usage[tenant]
+            lines.append(
+                "  %-16s rows=%-8d read=%-9s worker=%6.2fs decode=%6.2fs  "
+                "arena=%s*s  hedges=%d  quarantined=%d"
+                % (tenant, int(r.get("rows", 0)),
+                   "%.1fMB" % (r.get("read_bytes", 0.0) / 1e6),
+                   r.get("worker_s", 0.0), r.get("decode_s", 0.0),
+                   "%.1fMB" % (r.get("arena_byte_s", 0.0) / 1e6),
+                   int(r.get("hedged_gets", 0)),
+                   int(r.get("quarantined", 0))))
+        if len(order) > top:
+            lines.append("  ... and %d more tenants" % (len(order) - top))
+        return lines
+
+    def __repr__(self):
+        return "TenantUsageReport(%d tenants)" % len(self.usage)
+
+
+def _reset_for_tests():
+    """Drop all meter/default state (test isolation)."""
+    global _process_default
+    _process_default = None
+    if getattr(_tls, "ctx", None) is not None:
+        _tls.ctx = None
+    with _meters_lock:
+        _meters.clear()

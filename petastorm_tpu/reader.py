@@ -76,12 +76,35 @@ class _Tagged:
     surface (``prefetch``/``close``/``io_stats``/``set_trace``) so executors and
     pool children talk to the tagged wrapper as if it were the worker."""
 
-    def __init__(self, worker):
+    def __init__(self, worker, tenant=None):
         self._worker = worker
+        #: resolved TenantContext (ISSUE 18) — pickles into pool children and
+        #: read by ProcessExecutor.start to seed the child env (PTPU_TENANT)
+        self.tenant_context = tenant
 
     def __call__(self, tagged_item):
         epoch, ordinal, item = tagged_item
-        return (epoch, ordinal, self._worker(item))
+        ctx = self.tenant_context
+        if ctx is None:
+            return (epoch, ordinal, self._worker(item))
+        # activate the tenant around the worker call so every IO charge on
+        # this thread (tier bytes, arena admits, hedges) bills the owner, and
+        # meter the worker-seconds the item actually consumed
+        from petastorm_tpu.obs import tenant as _tenant_mod
+
+        with _tenant_mod.activate(ctx):
+            # the executor's begin_item ran BEFORE this activation, so stamp
+            # the tenant annotation here — per-tenant attribution folds
+            # filter on it
+            from petastorm_tpu.obs import provenance as _prov
+
+            _prov.annotate("tenant", ctx.tenant)
+            t0 = time.perf_counter()
+            try:
+                return (epoch, ordinal, self._worker(item))
+            finally:
+                _tenant_mod.charge("worker_s", time.perf_counter() - t0,
+                                   label=ctx.tenant)
 
     def prefetch(self, tagged_items):
         """Readahead hint: strip the dispatch tags, hand the plan items down."""
@@ -1709,7 +1732,12 @@ class Reader:
                  is_batched_reader=False, ngram=None, results_timeout_s=300.0,
                  wire_serializer="pickle", worker_respawns=None, io_options=None,
                  recovery=None, provenance=None, watch=None, watch_paths=None,
-                 transport=None):
+                 transport=None, tenant=None):
+        from petastorm_tpu.obs import tenant as _tenant_mod
+
+        #: resolved TenantContext (ISSUE 18) or None: explicit arg wins, else
+        #: the ambient context / PTPU_TENANT env; invalid explicit slugs raise
+        self.tenant_context = _tenant_mod.resolve(tenant)
         self._fs = filesystem
         self._path = path
         self.schema = schema
@@ -1825,7 +1853,8 @@ class Reader:
             fn = getattr(self._executor, "set_provenance", None)
             if fn is not None:
                 fn(self._prov)
-        self._executor.start(_Tagged(self._worker), self._plan)
+        self._executor.start(_Tagged(self._worker, tenant=self.tenant_context),
+                             self._plan)
         self._results_iter = self._executor.results()
         self.stopped = False
         watcher = getattr(self, "_watcher", None)
@@ -1958,6 +1987,11 @@ class Reader:
                                 marker.error, marker.attempts, kind)
         self.quarantine_report.add(entry)
         count_quarantined(num_rows)
+        if self.tenant_context is not None:
+            from petastorm_tpu.obs import tenant as _tenant_mod
+
+            _tenant_mod.charge("quarantined", max(0, num_rows or 0),
+                               label=self.tenant_context.tenant)
         from petastorm_tpu.obs.log import degradation
 
         degradation(
@@ -2035,6 +2069,13 @@ class Reader:
                 continue
             if self._prov is not None:
                 self._prov.note_delivery(epoch, ordinal, len(payload))
+            if self.tenant_context is not None:
+                # charged at DELIVERY (the consumer-visible boundary), so
+                # per-tenant rows == what the tenant actually received
+                from petastorm_tpu.obs import tenant as _tenant_mod
+
+                _tenant_mod.charge("rows", len(payload),
+                                   label=self.tenant_context.tenant)
             self._buffer = payload
             self._buffer_pos = 0
             self._buffer_tag = (epoch, ordinal)
@@ -2076,6 +2117,11 @@ class Reader:
             if self._prov is not None:
                 self._prov.note_delivery(
                     epoch, ordinal, len(next(iter(columns.values()))))
+            if self.tenant_context is not None:
+                from petastorm_tpu.obs import tenant as _tenant_mod
+
+                _tenant_mod.charge("rows", len(next(iter(columns.values()))),
+                                   label=self.tenant_context.tenant)
             if not self.keep_passthrough:
                 # no loader adopted the pass-through: this consumer expects
                 # decoded arrays — the numpy reference twin IS the designed
@@ -2436,7 +2482,7 @@ def _host_arena_early(io_opts):
         arena_mod.host_arena(io_opts.arena_bytes)
 
 
-def _build_read_funnel(cache, io_opts, num_epochs=None):
+def _build_read_funnel(cache, io_opts, num_epochs=None, tenant=None):
     """The tiered read funnel (ISSUE 8): ``MemCache → LocalDiskCache →
     remote`` as ONE :class:`petastorm_tpu.io.tiers.TieredCache` with per-tier
     hit/byte accounting and the ``disk_admit`` admission policy — replacing
@@ -2470,7 +2516,7 @@ def _build_read_funnel(cache, io_opts, num_epochs=None):
                        arena=arena_obj)
     return TieredCache(mem=mem, disk=cache,
                        disk_admit=io_opts.remote.disk_admit,
-                       single_epoch=num_epochs == 1)
+                       single_epoch=num_epochs == 1, tenant=tenant)
 
 
 def _maybe_compile_pipeline(spec, schema, fs, pieces, cache):
@@ -2545,7 +2591,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
                 results_timeout_s=300.0, decode_on_device=False, wire_serializer=None,
                 io_retries=None, io_retry_backoff_s=None, worker_respawns=None,
                 io_options=None, recovery=None, provenance=None, watch=None,
-                transport=None):
+                transport=None, tenant=None):
     """Open a petastorm(-tpu) dataset for per-row decoded reading (reference ~L60).
 
     ``schema_fields`` may be a list of names/regexes/UnischemaFields or an :class:`NGram`.
@@ -2598,7 +2644,16 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
     through the quarantine path — exactly-once-or-quarantined survives the
     network). Also via ``PTPU_TRANSPORT``. See docs/robustness.md
     "The network fault model".
+
+    ``tenant``: per-tenant accounting (ISSUE 18) — a bounded slug (or
+    :class:`petastorm_tpu.obs.TenantContext`) that tags every shared-resource
+    metric this reader's batches touch with a ``tenant=`` label; defaults to
+    the ambient context / ``PTPU_TENANT`` env, absent ⇒ untagged (zero-cost).
+    See docs/observability.md "Tenant accounting".
     """
+    from petastorm_tpu.obs import tenant as _tenant_mod
+
+    tenant_ctx = _tenant_mod.resolve(tenant)
     io_opts = IoOptions.normalize(io_options)
     _host_arena_early(io_opts)
     fs, path = get_filesystem_and_path_or_paths(dataset_url, storage_options, filesystem)
@@ -2627,7 +2682,9 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
                                   worker_respawns=worker_respawns)
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
-    cache = _build_read_funnel(cache, io_opts, num_epochs)
+    cache = _build_read_funnel(
+        cache, io_opts, num_epochs,
+        tenant=tenant_ctx.tenant if tenant_ctx is not None else None)
     transform_spec = _maybe_compile_pipeline(transform_spec, read_schema, fs,
                                              stats_pieces, cache)
     final_schema = read_schema
@@ -2653,7 +2710,7 @@ def make_reader(dataset_url, schema_fields=None, reader_pool_type="thread", work
         wire_serializer=wire_serializer or "pickle",
         io_options=io_opts, recovery=rec,
         provenance=_prov.resolve(provenance), watch=watch,
-        watch_paths=watch_paths, transport=transport,
+        watch_paths=watch_paths, transport=transport, tenant=tenant_ctx,
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields
@@ -2677,7 +2734,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                       filesystem=None, results_timeout_s=300.0, decode_on_device=False,
                       wire_serializer=None, io_retries=None, io_retry_backoff_s=None,
                       worker_respawns=None, io_options=None, recovery=None,
-                      provenance=None, watch=None, transport=None):
+                      provenance=None, watch=None, transport=None, tenant=None):
     """Open ANY Parquet store for vectorized columnar batches (reference ~L200).
 
     ``decode_on_device``: see :func:`make_reader` — device-decodable codec columns come
@@ -2710,7 +2767,12 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     (``'pipe'`` default / ``'tcp'`` framed partition-tolerant sockets,
     ISSUE 15). The shm slab wire is bypassed over tcp (a network link cannot
     carry slab grants); payloads ride the framed socket wire instead.
+
+    ``tenant``: see :func:`make_reader` — per-tenant accounting (ISSUE 18).
     """
+    from petastorm_tpu.obs import tenant as _tenant_mod
+
+    tenant_ctx = _tenant_mod.resolve(tenant)
     io_opts = IoOptions.normalize(io_options)
     _host_arena_early(io_opts)
     fs, path = get_filesystem_and_path_or_paths(
@@ -2746,7 +2808,9 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
                                   worker_respawns=worker_respawns)
     cache = make_cache(cache_type, cache_location, cache_size_limit,
                        cache_row_size_estimate, cache_extra_settings)
-    cache = _build_read_funnel(cache, io_opts, num_epochs)
+    cache = _build_read_funnel(
+        cache, io_opts, num_epochs,
+        tenant=tenant_ctx.tenant if tenant_ctx is not None else None)
     transform_spec = _maybe_compile_pipeline(transform_spec, read_schema, fs,
                                              stats_pieces, cache)
     final_schema = read_schema
@@ -2773,7 +2837,7 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
             wire_serializer, wire_serializer) or "arrow",
         io_options=io_opts, recovery=rec,
         provenance=_prov.resolve(provenance), watch=watch,
-        watch_paths=watch_paths, transport=transport,
+        watch_paths=watch_paths, transport=transport, tenant=tenant_ctx,
     )
     r.transform_spec = transform_spec
     r.device_decode_fields = device_fields

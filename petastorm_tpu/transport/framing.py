@@ -41,17 +41,68 @@ K_HB_ACK = 4   #: heartbeat echo (same payload) — the sender's rtt sample
 K_HELLO = 5    #: connection bootstrap: token + session id + dial attempt
 K_HELLO_ACK = 6
 
+#: kind-byte flag (ISSUE 18): the payload is prefixed with an optional tenant
+#: header — one length byte + that many ascii slug bytes — before the real
+#: payload. Version-negotiated in the hello exchange: a sender only sets the
+#: flag after the peer advertised the ``tenant`` feature, so old peers never
+#: see a flagged kind (and :func:`split_tenant` makes a new receiver treat an
+#: unflagged frame as untagged — old senders keep working unchanged). The crc
+#: covers the flagged kind byte plus the prefixed payload, so the tenant
+#: header enjoys the same corruption detection as the body.
+K_TENANT_FLAG = 0x80
+
 #: hard bound on one frame's payload — a desynced length field must fail fast,
 #: not allocate gigabytes (result payloads are row-group batches, well under)
 MAX_FRAME = 1 << 31
 
 
-def pack_frame(kind, payload):
-    """One wire frame for ``payload`` (bytes-like)."""
+def pack_frame(kind, payload, tenant=None):
+    """One wire frame for ``payload`` (bytes-like).
+
+    ``tenant`` (a validated bounded slug, or None) rides an optional header
+    in front of the payload, marked by :data:`K_TENANT_FLAG` on the kind
+    byte. Callers must only pass a tenant after hello negotiation confirmed
+    the peer understands the flag.
+    """
     payload = bytes(payload)
+    if tenant is not None:
+        slug = tenant.encode("ascii")
+        if not 0 < len(slug) < 256:
+            raise ValueError("tenant frame header slug %r out of bounds"
+                             % (tenant,))
+        kind |= K_TENANT_FLAG
+        payload = bytes((len(slug),)) + slug + payload
     crc = zlib.crc32(bytes((kind,)) + payload) & 0xFFFFFFFF
     return _HEADER.pack(MAGIC, kind, len(payload)) + payload \
         + _TRAILER.pack(crc)
+
+
+def split_tenant(kind, payload):
+    """``(kind, payload, tenant-or-None)`` with the tenant header stripped.
+
+    Receivers call this on every frame :func:`take_frame` yields: unflagged
+    frames (every frame an old peer sends) pass through untouched with
+    ``tenant=None``; flagged frames lose the flag bit and the slug prefix. A
+    flagged frame whose header is truncated or non-ascii is corrupt — same
+    teardown path as a crc mismatch.
+    """
+    if not kind & K_TENANT_FLAG:
+        return kind, payload, None
+    kind &= ~K_TENANT_FLAG
+    if not payload:
+        raise TransportFrameCorrupt(
+            "tenant-flagged frame (kind=%d) carries no tenant header" % kind)
+    n = payload[0]
+    if len(payload) < 1 + n:
+        raise TransportFrameCorrupt(
+            "tenant frame header truncated (kind=%d want %d slug bytes, "
+            "frame has %d)" % (kind, n, len(payload) - 1))
+    try:
+        tenant = payload[1:1 + n].decode("ascii")
+    except UnicodeDecodeError:
+        raise TransportFrameCorrupt(
+            "tenant frame header is not ascii (kind=%d)" % kind)
+    return kind, payload[1 + n:], tenant
 
 
 def frame_size(buf):

@@ -68,6 +68,7 @@ from petastorm_tpu.transport.framing import (
     K_OBJ,
     K_RAW,
     pack_frame,
+    split_tenant,
     take_frame,
 )
 
@@ -126,6 +127,44 @@ class _FramedLink(Transport):
         self._hb_thread = None
         self._inflight = None
         self._inflight_gen = -1
+        #: per-tenant frame tagging (ISSUE 18): armed only after the hello
+        #: exchange proved the peer understands K_TENANT_FLAG — an old peer
+        #: must never receive a flagged kind byte it would read as garbage
+        self._tenant_frames = False
+        self._tx_tenant = None
+        self._warned_downgrade = False
+        self.peer_tenant = None
+
+    # -- tenant tagging (ISSUE 18) ------------------------------------------------------
+
+    def set_tenant(self, label):
+        """Pin the tenant slug outbound app frames are tagged with (the
+        executor calls this with the reader's resolved tenant; None falls
+        back to the thread/process tenant context at send time)."""
+        self._tx_tenant = label
+
+    def _frame_tenant(self):
+        """The slug to tag the next outbound app frame with, or None. A
+        tenant that WANTS tagging on an un-negotiated link degrades once
+        (``tenant_frame_downgrade``) and ships untagged — old peers keep
+        working, attribution loses the wire dimension only."""
+        label = self._tx_tenant
+        if label is None:
+            from petastorm_tpu.obs import tenant as _tenant_ctx
+
+            label = _tenant_ctx.current_label()
+        if label is None:
+            return None
+        if not self._tenant_frames:
+            if not self._warned_downgrade:
+                self._warned_downgrade = True
+                _degradation(
+                    "tenant_frame_downgrade",
+                    "transport link %s peer did not negotiate tenant frame "
+                    "headers — sending untagged (per-tenant wire accounting "
+                    "is lost on this link)", self._site_key)
+            return None
+        return label
 
     # -- in-flight ledger ---------------------------------------------------------------
 
@@ -266,10 +305,11 @@ class _FramedLink(Transport):
     # -- send path ----------------------------------------------------------------------
 
     def send(self, obj):
-        self._send_wire(pack_frame(K_OBJ, pickle.dumps(obj, protocol=4)))
+        self._send_wire(pack_frame(K_OBJ, pickle.dumps(obj, protocol=4),
+                                   tenant=self._frame_tenant()))
 
     def send_bytes(self, data):
-        self._send_wire(pack_frame(K_RAW, data))
+        self._send_wire(pack_frame(K_RAW, data, tenant=self._frame_tenant()))
 
     def _send_wire(self, frame):
         with self._cv:
@@ -428,9 +468,17 @@ class _FramedLink(Transport):
                 raw = out
             try:
                 kind, payload = take_frame(bytearray(raw))
+                kind, payload, frame_tenant = split_tenant(kind, payload)
             except TransportFrameCorrupt as e:
                 self._frame_corrupt(e, sock)
             net_metrics().frames_rx.inc()
+            if frame_tenant is not None:
+                # rx-side only: both endpoints of an in-process test share the
+                # default registry, so a tx-side twin would double-count
+                self.peer_tenant = frame_tenant
+                from petastorm_tpu.obs import tenant as _tenant_ctx
+
+                _tenant_ctx.charge("wire_bytes", len(raw), label=frame_tenant)
             self._handle_frame(kind, payload, sock)
 
     def _handle_frame(self, kind, payload, sock):
@@ -618,8 +666,13 @@ class TcpChildTransport(_FramedLink):
                                         timeout=timeout)
         try:
             sock.settimeout(TICK)
+            from petastorm_tpu.obs import tenant as _tenant_ctx
+
             hello = json.dumps({"token": self._token, "session": self.session,
-                                "attempt": self._dialed}).encode("utf-8")
+                                "attempt": self._dialed,
+                                "features": ["tenant"],
+                                "tenant": _tenant_ctx.current_label(),
+                                }).encode("utf-8")
             self._sendall(sock, pack_frame(K_HELLO, hello))
             buf = bytearray()
             while True:
@@ -635,10 +688,22 @@ class TcpChildTransport(_FramedLink):
                 elif time.monotonic() > deadline:
                     raise OSError("transport hello ack did not arrive within "
                                   "%.0fs" % timeout)
-            kind, _payload = frame
+            kind, ack_payload = frame
             if kind != K_HELLO_ACK:
                 raise OSError("unexpected transport hello response kind %d"
                               % kind)
+            # version negotiation (ISSUE 18): a new hub answers with a JSON
+            # feature list; an old hub's empty ack simply negotiates nothing
+            # (pre-ISSUE-18 children never parse the ack payload, so the
+            # asymmetric upgrade is safe in both directions)
+            features = ()
+            if ack_payload:
+                try:
+                    features = json.loads(
+                        ack_payload.decode("utf-8")).get("features") or ()
+                except (ValueError, AttributeError):
+                    features = ()
+            self._tenant_frames = "tenant" in features
         except BaseException:
             try:
                 sock.close()
@@ -785,7 +850,17 @@ class TcpHub:
         if transport is None:
             raise OSError("transport hello for unknown session %r"
                           % hello.get("session"))
-        sock.sendall(pack_frame(K_HELLO_ACK, b""))
+        # feature negotiation (ISSUE 18): only a child that advertised the
+        # tenant feature gets a feature-list ack (and tagged frames); an old
+        # child gets the historical empty ack and an untagged link
+        features = hello.get("features") or ()
+        tenant_ok = "tenant" in features
+        transport._tenant_frames = tenant_ok
+        if hello.get("tenant"):
+            transport.peer_tenant = hello["tenant"]
+        ack = json.dumps({"features": ["tenant"]}).encode("utf-8") \
+            if tenant_ok else b""
+        sock.sendall(pack_frame(K_HELLO_ACK, ack))
         transport.adopt(sock, leftover=bytes(buf))
 
     def close(self):

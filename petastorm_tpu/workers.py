@@ -699,6 +699,7 @@ class ProcessExecutor(ExecutorBase):
         #: a tcp setup failure degrades the pool back to 'pipe'.
         self._transport_name = normalize_transport(transport)
         self._hub = None
+        self._tenant_label = None
         self._authkey = None
         self._session_counter = 0
         self._procs = []
@@ -795,6 +796,20 @@ class ProcessExecutor(ExecutorBase):
         arena_token = _arena_mod.current_token()
         if arena_token is not None:
             self._child_env[_arena_mod.ENV_ATTACH] = arena_token
+        # tenant propagation (ISSUE 18): the reader stamps its resolved
+        # context on the worker; children adopt it as their process default
+        # via attach_from_env at bootstrap — the SAME env every respawn/resize
+        # spawn reuses, so replacements keep billing the right tenant
+        tenant_ctx = getattr(worker, "tenant_context", None)
+        if tenant_ctx is None:
+            from petastorm_tpu.obs import tenant as _tenant_mod
+
+            tenant_ctx = _tenant_mod.current()
+        if tenant_ctx is not None:
+            self._child_env.update(tenant_ctx.env())
+            self._tenant_label = tenant_ctx.tenant
+        else:
+            self._tenant_label = None
         if self._transport_name == "tcp":
             # the child's link policy (redial backoff, heartbeat cadence,
             # half-open threshold) rides the environment: the transport must
@@ -908,6 +923,8 @@ class ProcessExecutor(ExecutorBase):
                 sid = self._session_counter
                 self._session_counter += 1
             transport = self._hub.create_session(sid)
+            if self._tenant_label is not None:
+                transport.set_tenant(self._tenant_label)
             child = self._popen_child(self._hub.address_for(sid), authkey)
             with self._respawn_lock:
                 self._procs.append(child)
@@ -1407,6 +1424,8 @@ class ProcessExecutor(ExecutorBase):
             sid = self._session_counter
             self._session_counter += 1
         transport = self._hub.create_session(sid)
+        if getattr(self, "_tenant_label", None) is not None:
+            transport.set_tenant(self._tenant_label)
         p = None
         try:
             p = self._popen_child(self._hub.address_for(sid), self._authkey)

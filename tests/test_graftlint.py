@@ -1896,6 +1896,100 @@ def test_o004_inline_disable_respected():
     assert suppressed == 1
 
 
+# -- GL-O005: unbounded metric label values (ISSUE 18) ----------------------------------
+
+
+def test_o005_fires_on_pid_label():
+    src = """
+        import os
+
+        def register(reg):
+            reg.counter("ptpu_worker_rows_total", worker=os.getpid())
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-O005")[0]
+    assert f.line == _line_of(src, "worker=os.getpid()")
+    assert "worker=" in f.message and "cardinality" in f.message
+
+
+def test_o005_taint_survives_str_wrapping():
+    findings, _ = _lint("""
+        import os
+
+        def register(reg):
+            reg.counter("x_total", worker=str(os.getpid()))
+    """)
+    assert len(_only_rule(findings, "GL-O005")) == 1
+
+
+def test_o005_one_hop_assignment_tracked():
+    src = """
+        import os
+
+        def register(reg):
+            wid = os.getpid()
+            reg.gauge("x_bytes", worker=wid)
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-O005")[0]
+    assert f.line == _line_of(src, "worker=wid")
+
+
+def test_o005_fires_on_fstring_uuid():
+    findings, _ = _lint("""
+        import uuid
+
+        def register(reg):
+            reg.counter("x_total", run=f"run-{uuid.uuid4()}")
+    """)
+    assert len(_only_rule(findings, "GL-O005")) == 1
+
+
+def test_o005_loop_over_unbounded_iterable_fires():
+    findings, _ = _lint("""
+        def register(reg, paths):
+            for p in paths:
+                reg.counter("x_total", path=p)
+    """)
+    f = _only_rule(findings, "GL-O005")[0]
+    assert "loop over" in f.message
+
+
+def test_o005_allcaps_constant_loop_is_clean():
+    findings, _ = _lint("""
+        TIERS = ("ram", "local", "remote")
+
+        def register(reg):
+            for t in TIERS:
+                reg.counter("x_total", tier=t)
+            for cause in ("timeout", "poison"):
+                reg.counter("y_total", cause=cause)
+    """)
+    assert [f for f in findings if f.rule_id == "GL-O005"] == []
+
+
+def test_o005_plain_parameter_label_is_clean():
+    # a bare parameter is the caller's contract (e.g. a validated tenant
+    # slug) — only values PRODUCED unbounded in this scope are flagged
+    findings, _ = _lint("""
+        def charge(reg, label, key):
+            reg.counter("ptpu_tenant_rows_total", tenant=label)
+            reg.counter("x_total", kind=str(key), help="rows by kind")
+    """)
+    assert [f for f in findings if f.rule_id == "GL-O005"] == []
+
+
+def test_o005_inline_disable_respected():
+    findings, suppressed = _lint("""
+        import os
+
+        def register(reg):
+            reg.counter("x_total", worker=os.getpid())  # graftlint: disable=GL-O005 (bounded pool)
+    """)
+    assert [f for f in findings if f.rule_id == "GL-O005"] == []
+    assert suppressed == 1
+
+
 # -- GL-C005: blocking under a lock (whole-program phase, ISSUE 16) ---------------------
 
 #: PR 13's live deadlock, verbatim shape: the last worker's `task_done` posts

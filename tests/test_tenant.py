@@ -1,0 +1,333 @@
+"""Per-tenant accounting plane (ISSUE 18): context resolution and validation,
+the resource meter (counters + the event-driven arena byte·seconds integral),
+the fleet-mergeable usage report, per-tenant SLO dimensioning, tenant frame
+headers on the transport wire, and the end-to-end delivery charge."""
+import pickle
+import threading
+
+import pytest
+
+from petastorm_tpu.obs import tenant as tenant_mod
+from petastorm_tpu.obs.metrics import MetricsRegistry, default_registry
+from petastorm_tpu.obs.tenant import (
+    TenantContext,
+    TenantUsageReport,
+    UNTAGGED,
+)
+
+
+@pytest.fixture(autouse=True)
+def _tenant_reset():
+    tenant_mod._reset_for_tests()
+    yield
+    tenant_mod._reset_for_tests()
+
+
+# -- context validation + resolution ---------------------------------------------------
+
+
+def test_context_validates_bounded_slug():
+    ctx = TenantContext("team-a.prod_1", job="j42", priority="high")
+    assert (ctx.tenant, ctx.job, ctx.priority) == ("team-a.prod_1", "j42",
+                                                   "high")
+    for bad in ("", "UPPER", "-leading", "a" * 33, "sp ace", 'q"uote',
+                "unié"):
+        with pytest.raises(ValueError):
+            TenantContext(bad)
+    with pytest.raises(ValueError):
+        TenantContext("ok", job="Bad Job")
+    with pytest.raises(ValueError):
+        TenantContext("ok", priority="urgent")
+
+
+def test_context_immutable_picklable_value_semantics():
+    ctx = TenantContext("a", job="j", priority="low")
+    with pytest.raises(AttributeError):
+        ctx.tenant = "b"
+    assert ctx == TenantContext("a", job="j", priority="low")
+    assert ctx != TenantContext("a")
+    assert hash(ctx) == hash(TenantContext("a", job="j", priority="low"))
+    assert pickle.loads(pickle.dumps(ctx)) == ctx
+    assert ctx.env() == {"PTPU_TENANT": "a", "PTPU_TENANT_JOB": "j",
+                         "PTPU_TENANT_PRIORITY": "low"}
+
+
+def test_from_env_degrades_on_invalid_slug():
+    """A launcher typo must run untagged (tenant_label_invalid), not raise."""
+    assert tenant_mod.from_env({}) is None
+    assert tenant_mod.from_env({"PTPU_TENANT": "NOT A SLUG"}) is None
+    # invalid job/priority are dropped, the valid tenant id survives
+    ctx = tenant_mod.from_env({"PTPU_TENANT": "a", "PTPU_TENANT_JOB": "B AD",
+                               "PTPU_TENANT_PRIORITY": "urgent"})
+    assert (ctx.tenant, ctx.job, ctx.priority) == ("a", None, None)
+    counter = default_registry().counter("ptpu_degradations_total",
+                                         cause="tenant_label_invalid")
+    assert counter.value >= 1
+
+
+def test_resolve_order_explicit_beats_env(monkeypatch):
+    monkeypatch.setenv("PTPU_TENANT", "env-tenant")
+    assert tenant_mod.resolve("arg-tenant").tenant == "arg-tenant"
+    ctx = TenantContext("ctx-tenant")
+    assert tenant_mod.resolve(ctx) is ctx
+    assert tenant_mod.resolve(None).tenant == "env-tenant"
+    assert tenant_mod.resolve(None, env_default=False) is None
+    # explicit invalid RAISES (the caller is right there to fix it)
+    with pytest.raises(ValueError):
+        tenant_mod.resolve("NOT A SLUG")
+    with pytest.raises(TypeError):
+        tenant_mod.resolve(42)
+
+
+def test_activation_is_thread_local():
+    ctx = TenantContext("a")
+    assert tenant_mod.current() is None
+    seen = {}
+
+    def other_thread():
+        seen["label"] = tenant_mod.current_label()
+
+    with tenant_mod.activate(ctx):
+        assert tenant_mod.current() is ctx
+        t = threading.Thread(target=other_thread)
+        t.start()
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+    assert seen["label"] is None  # the activation never leaked across threads
+    assert tenant_mod.current() is None
+    # process default applies where no thread activation is armed
+    tenant_mod.set_default(ctx)
+    assert tenant_mod.current_label() == "a"
+    assert tenant_mod.label_of(None) == UNTAGGED
+
+
+# -- the meter -------------------------------------------------------------------------
+
+
+def test_charge_noop_untagged_and_labels_when_tagged():
+    reg = MetricsRegistry()
+    tenant_mod.charge("rows", 10, registry=reg)  # untagged: charges NOTHING
+    assert not any(n.startswith("ptpu_tenant_") for n in reg.snapshot())
+    with tenant_mod.activate(TenantContext("a")):
+        tenant_mod.charge("rows", 10, registry=reg)
+        tenant_mod.charge("read_bytes", 4096, registry=reg)
+    tenant_mod.charge("rows", 5, label="b", registry=reg)
+    snap = reg.snapshot()
+    assert snap['ptpu_tenant_rows_total{tenant="a"}'] == 10
+    assert snap['ptpu_tenant_read_bytes_total{tenant="a"}'] == 4096
+    assert snap['ptpu_tenant_rows_total{tenant="b"}'] == 5
+
+
+def test_arena_byte_seconds_integral_is_event_driven():
+    """resident·time accrues exactly between adjustment events (explicit
+    ``now=`` stamps make the integral deterministic)."""
+    reg = MetricsRegistry()
+    m = tenant_mod.meter(reg)
+    m.arena_adjust("a", 1000.0, now=10.0)   # 1000 bytes resident from t=10
+    m.arena_adjust("a", 1000.0, now=12.0)   # +2.0s * 1000B accrued
+    m.arena_adjust("a", -1500.0, now=13.0)  # +1.0s * 2000B accrued; 500 left
+    m.arena_settle(now=15.0)                # +2.0s * 500B accrued
+    snap = reg.snapshot()
+    assert snap['ptpu_tenant_arena_byte_seconds_total{tenant="a"}'] == \
+        pytest.approx(2.0 * 1000 + 1.0 * 2000 + 2.0 * 500)
+    assert snap['ptpu_tenant_arena_resident_bytes{tenant="a"}'] == 500.0
+    # releases can never drive residency negative
+    m.arena_adjust("a", -9999.0, now=16.0)
+    assert reg.snapshot()[
+        'ptpu_tenant_arena_resident_bytes{tenant="a"}'] == 0.0
+
+
+# -- the usage report ------------------------------------------------------------------
+
+
+def _usage_metrics():
+    return {
+        'ptpu_tenant_rows_total{tenant="a"}': 100.0,
+        'ptpu_tenant_worker_seconds_total{tenant="a"}': 1.5,
+        'ptpu_tenant_rows_total{tenant="b"}': 900.0,
+        'ptpu_tenant_worker_seconds_total{tenant="b"}': 6.0,
+        'ptpu_tenant_hedged_gets_total{tenant="b"}': 3.0,
+        "ptpu_io_tier_bytes_total": 1e6,  # untagged families never report
+        'ptpu_other_total{tenant="a"}': 5.0,  # non-RESOURCES family ignored
+    }
+
+
+def test_report_from_metrics_and_top_consumer():
+    report = TenantUsageReport.from_metrics(_usage_metrics())
+    assert report.tenants() == ["a", "b"]
+    assert report.get("a", "rows") == 100.0
+    assert report.top_consumer("worker_s") == ("b", 6.0)
+    assert report.top_consumer("quarantined") == (None, 0.0)
+    assert "other" not in str(report.to_dict())
+
+
+def test_report_merge_sums_per_tenant():
+    a = TenantUsageReport.from_metrics(_usage_metrics())
+    b = TenantUsageReport({"b": {"rows": 100.0}, "c": {"rows": 7.0}})
+    merged = a.merge(b)
+    assert merged.get("b", "rows") == 1000.0
+    assert merged.get("c", "rows") == 7.0
+    assert a.get("b", "rows") == 900.0  # merge never mutates the inputs
+
+
+def test_report_render_ranks_by_worker_seconds():
+    lines = TenantUsageReport.from_metrics(_usage_metrics()).render()
+    assert lines[0].startswith("tenants (ptpu_tenant_")
+    assert lines[1].lstrip().startswith("b ")  # heaviest worker_s first
+    assert lines[2].lstrip().startswith("a ")
+
+
+# -- per-tenant SLO dimensioning -------------------------------------------------------
+
+
+def test_slo_per_tenant_expansion_names_the_tenant():
+    from petastorm_tpu.obs.slo import SloEngine, SloSpec, _strip_tenant
+
+    assert _strip_tenant('m{tenant="x"}') == ("m", "x")
+    assert _strip_tenant('m{a="1",tenant="x"}') == ('m{a="1"}', "x")
+    assert _strip_tenant('m{tenant="x",a="1"}') == ('m{a="1"}', "x")
+    assert _strip_tenant("m") == ("m", None)
+
+    spec = SloSpec(name="burn", metric="ptpu_tenant_rows_total",
+                   stat="delta", op="<=", threshold=100.0, breach_windows=2,
+                   per_tenant=True)
+    engine = SloEngine(specs=[spec])
+    noisy = 'ptpu_tenant_rows_total{tenant="b"}'
+    quiet = 'ptpu_tenant_rows_total{tenant="a"}'
+    window = lambda qa, qb: {quiet: {"delta": qa}, noisy: {"delta": qb}}
+    assert engine.evaluate(window(10.0, 500.0), t=1.0) == []  # streak 1
+    assert engine.breaching() == {'burn{tenant="b"}': 1}
+    alerts = engine.evaluate(window(10.0, 500.0), t=2.0)
+    assert len(alerts) == 1
+    alert = alerts[0]
+    assert alert.tenant == "b" and alert.cause == "slo_breach"
+    assert "by tenant 'b'" in alert.message
+    # latched: a third breaching window must not re-fire
+    assert engine.evaluate(window(10.0, 500.0), t=3.0) == []
+    # the quiet tenant's debounce is independent — it can fire on its own
+    assert engine.evaluate(window(400.0, 0.0), t=4.0) == []
+    quiet_alerts = engine.evaluate(window(400.0, 0.0), t=5.0)
+    assert [a.tenant for a in quiet_alerts] == ["a"]
+
+
+def test_slo_per_tenant_alert_counter_carries_tenant_label():
+    from petastorm_tpu.obs.slo import SloEngine, SloSpec
+
+    reg = MetricsRegistry()
+    spec = SloSpec(name="burn", metric="m", stat="value", op="<=",
+                   threshold=1.0, breach_windows=1, per_tenant=True)
+    engine = SloEngine(specs=[spec], registry=reg)
+    engine.evaluate({'m{tenant="b"}': {"value": 9.0}}, t=1.0)
+    assert reg.snapshot()[
+        'ptpu_slo_alerts_total{slo="burn",tenant="b"}'] == 1
+
+
+def test_slo_per_tenant_attribution_scoped_to_tenant():
+    from petastorm_tpu.obs.slo import SloEngine, SloSpec
+
+    calls = []
+
+    class _Report:
+        slow_top = "io.remote"
+
+        def to_dict(self):
+            return {"slow_top": "io.remote"}
+
+    def attribution(tenant=None):
+        calls.append(tenant)
+        return _Report()
+
+    spec = SloSpec(name="burn", metric="m", stat="value", op="<=",
+                   threshold=1.0, breach_windows=1, per_tenant=True)
+    engine = SloEngine(specs=[spec], attribution=attribution)
+    alerts = engine.evaluate({'m{tenant="b"}': {"value": 9.0}}, t=1.0)
+    assert calls == ["b"]
+    assert alerts[0].culprit == "io.remote" and alerts[0].tenant == "b"
+
+
+# -- transport frame headers -----------------------------------------------------------
+
+
+def test_frame_tenant_header_round_trip_and_old_peer_compat():
+    from petastorm_tpu.errors import TransportFrameCorrupt
+    from petastorm_tpu.transport.framing import (
+        K_OBJ,
+        K_TENANT_FLAG,
+        pack_frame,
+        split_tenant,
+        take_frame,
+    )
+
+    payload = b"result-bytes"
+    buf = bytearray(pack_frame(K_OBJ, payload, tenant="team-a"))
+    kind, body = take_frame(buf)
+    assert kind == K_OBJ | K_TENANT_FLAG
+    assert split_tenant(kind, body) == (K_OBJ, payload, "team-a")
+    # old sender -> new receiver: unflagged passes through untagged
+    buf = bytearray(pack_frame(K_OBJ, payload))
+    kind, body = take_frame(buf)
+    assert split_tenant(kind, body) == (K_OBJ, payload, None)
+    # new sender on an un-negotiated link ships the OLD byte format exactly
+    assert pack_frame(K_OBJ, payload, tenant=None) == \
+        pack_frame(K_OBJ, payload)
+    # a truncated tenant header is a corrupt frame, not garbage delivery
+    with pytest.raises(TransportFrameCorrupt):
+        split_tenant(K_OBJ | K_TENANT_FLAG, b"")
+    with pytest.raises(TransportFrameCorrupt):
+        split_tenant(K_OBJ | K_TENANT_FLAG, b"\x10ab")
+
+
+# -- end-to-end: delivery charges + provenance annotation ------------------------------
+
+
+def test_reader_delivery_charges_rows_to_the_tenant(scalar_dataset):
+    from petastorm_tpu.reader import make_batch_reader
+
+    registry = default_registry()
+    name = 'ptpu_tenant_rows_total{tenant="t-e2e"}'
+    worker_name = 'ptpu_tenant_worker_seconds_total{tenant="t-e2e"}'
+    before = registry.snapshot().get(name, 0)
+    rows = 0
+    with make_batch_reader(scalar_dataset.url, num_epochs=1,
+                           workers_count=1, tenant="t-e2e") as reader:
+        assert reader.tenant_context.tenant == "t-e2e"
+        for batch in reader:
+            rows += len(batch.id)
+    snap = registry.snapshot()
+    assert rows == 30
+    assert snap[name] - before == rows
+    assert snap.get(worker_name, 0) > 0
+
+
+def test_untagged_reader_charges_nothing(scalar_dataset):
+    from petastorm_tpu.reader import make_batch_reader
+
+    registry = default_registry()
+    before = {n: v for n, v in registry.snapshot().items()
+              if n.startswith("ptpu_tenant_")}
+    with make_batch_reader(scalar_dataset.url, num_epochs=1,
+                           workers_count=1) as reader:
+        rows = sum(len(b.id) for b in reader)
+    assert rows == 30
+    after = {n: v for n, v in registry.snapshot().items()
+             if n.startswith("ptpu_tenant_")}
+    assert after == before
+
+
+def test_tagged_worker_stamps_provenance_annotation(scalar_dataset):
+    """The per-tenant attribution fold filters on the item annotation the
+    tagged worker stamps — the alert's "whose tail is this" seam."""
+    from petastorm_tpu.reader import make_batch_reader
+
+    with make_batch_reader(scalar_dataset.url, num_epochs=1,
+                           workers_count=1, provenance=True,
+                           tenant="t-prov") as reader:
+        rows = sum(len(b.id) for b in reader)
+        recorder = reader._prov
+        assert rows == 30
+        items = recorder.items()
+        assert items, "provenance recorded no items"
+        assert all(rec["annotations"].get("tenant") == "t-prov"
+                   for rec in items.values())
+        # the tenant-scoped fold sees the batches; a stranger sees none
+        assert recorder.report(tenant="nobody").batches == 0

@@ -449,6 +449,52 @@ def test_merge_histogram_summaries_conservatively(tmp_path):
     assert agg["p99"] == 0.4  # max across sources: conservative upper bound
 
 
+def test_fleet_merge_keeps_tenant_labels_as_distinct_series(tmp_path):
+    """Tenant-labeled counters (ISSUE 18) merge per full labeled name: the
+    fleet total for ``...{tenant="a"}`` is the sum of THAT label across
+    sources, never folded into the untagged family or another tenant."""
+    docs = []
+    for i, (a_rows, b_rows) in enumerate(((100, 900), (50, 100))):
+        docs.append({"source": "h%d" % i, "anchor": None,
+                     "metrics": {
+                         "ptpu_pipeline_rows": a_rows + b_rows,
+                         'ptpu_tenant_rows_total{tenant="a"}': a_rows,
+                         'ptpu_tenant_rows_total{tenant="b"}': b_rows},
+                     "series": {}})
+    merged = merge_exports(docs)
+    assert merged["totals"]['ptpu_tenant_rows_total{tenant="a"}'] == 150
+    assert merged["totals"]['ptpu_tenant_rows_total{tenant="b"}'] == 1000
+    # per-tenant fleet total == Σ per-source, per label
+    for name in ('ptpu_tenant_rows_total{tenant="a"}',
+                 'ptpu_tenant_rows_total{tenant="b"}'):
+        assert merged["totals"][name] == sum(
+            m.get(name, 0) for m in merged["per_source"].values())
+    # the untagged family stays the sole all-traffic total
+    assert merged["totals"]["ptpu_pipeline_rows"] == 1150
+
+
+def test_tenant_usage_report_from_merged_fleet_totals(tmp_path):
+    """Folding the merged fleet totals through TenantUsageReport equals
+    merging the per-source reports — the report is fleet-mergeable."""
+    from petastorm_tpu.obs.tenant import TenantUsageReport
+
+    per_source = [
+        {'ptpu_tenant_rows_total{tenant="a"}': 100.0,
+         'ptpu_tenant_worker_seconds_total{tenant="a"}': 1.0},
+        {'ptpu_tenant_rows_total{tenant="a"}': 40.0,
+         'ptpu_tenant_rows_total{tenant="b"}': 700.0,
+         'ptpu_tenant_worker_seconds_total{tenant="b"}': 5.0},
+    ]
+    docs = [{"source": "h%d" % i, "anchor": None, "metrics": dict(m),
+             "series": {}} for i, m in enumerate(per_source)]
+    fleet = TenantUsageReport.from_metrics(merge_exports(docs)["totals"])
+    by_parts = TenantUsageReport.from_metrics(per_source[0]).merge(
+        TenantUsageReport.from_metrics(per_source[1]))
+    assert fleet.to_dict() == by_parts.to_dict()
+    assert fleet.get("a", "rows") == 140.0
+    assert fleet.top_consumer("worker_s") == ("b", 5.0)
+
+
 def test_uniquify_sources_keeps_collisions_visible():
     exports = [{"source": "h:1", "metrics": {"x": 1}, "series": {}},
                {"source": "h:1", "metrics": {"x": 2}, "series": {}}]
