@@ -164,6 +164,16 @@ def main(argv=None):
         from petastorm_tpu.benchmark import tenants as tenants_bench
 
         return tenants_bench.main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # `petastorm-tpu-bench fleet ...`: the disaggregated data-service
+        # acceptance harness — 3 trainers on one decode fleet vs 3 dedicated
+        # pipelines (decode worker-seconds per delivered row cut >=2x),
+        # mid-epoch detach+reattach watermark exactness, per-tenant QoS
+        # naming the noisy neighbor, and a seeded link-death arm asserting
+        # re-dispatch-not-quarantine — see benchmark/fleet.py
+        from petastorm_tpu.benchmark import fleet as fleet_bench
+
+        return fleet_bench.main(argv[1:])
     if argv and argv[0] == "diff":
         # `petastorm-tpu-bench diff run_a run_b`: regression forensics over
         # two trend entries — names WHICH site's critical-path self time

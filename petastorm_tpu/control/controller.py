@@ -143,6 +143,29 @@ class WindowContext:
             return None
         return part / total
 
+    def tenant_share(self, tenant, resource="worker_s", min_total=1e-3):
+        """``tenant``'s share of this window's per-tenant resource deltas
+        (the ISSUE 18 ``ptpu_tenant_*`` twins) — the fleet-fairness signal
+        behind :func:`tenant_qos_rules`. None when the window's total charge
+        across all tenants is below ``min_total`` (an idle fleet proves
+        nothing about fairness)."""
+        from petastorm_tpu.obs.tenant import RESOURCES
+
+        family = RESOURCES[resource][0]
+        prefix = family + '{tenant="'
+        total = 0.0
+        part = 0.0
+        for name in self.window:
+            if not name.startswith(prefix):
+                continue
+            delta = self.delta(name) or 0.0
+            total += max(0.0, delta)
+            if name == '%s%s"}' % (prefix, tenant):
+                part = max(0.0, delta)
+        if total < min_total:
+            return None
+        return part / total
+
     def model_latency_s(self):
         """The learned remote-GET p50 of the busiest (store, size-class)
         histogram — the latency-model input to the Little's-law pool sizing.
@@ -306,6 +329,44 @@ def default_rules():
                         "shrink-workers: its own guard reverts on a rows/s "
                         "drop)"),
     ]
+
+
+def _tenant_share_signal(tenant, resource):
+    def signal(ctx):
+        return ctx.tenant_share(tenant, resource=resource)
+    return signal
+
+
+def _halve(ctx, current):
+    return current / 2.0
+
+
+def tenant_qos_rules(tenants, resource="worker_s", fire_above=0.6,
+                     clear_below=0.35, windows=2, cooldown=3):
+    """Per-tenant fleet-fairness rules for the data service (ISSUE 19): when
+    one tenant's share of the fleet's ``resource`` charge (default decode
+    worker-seconds) stays above ``fire_above``, halve that tenant's
+    ``svc_weight:<tenant>`` stride-scheduling weight. Efficiency rules
+    (``guarded=False``): fairness is the objective, not throughput — the
+    safety guard still reverts a weight cut that tanks delivered rows/s.
+
+    Attach the knobs with
+    :meth:`petastorm_tpu.service.server.DataService.register_knobs`; rules
+    whose knob is absent are skipped, so the table composes with
+    :func:`default_rules` unconditionally."""
+    rules = []
+    for tenant in tenants:
+        rules.append(PolicyRule(
+            "throttle-tenant-%s" % tenant, "svc_weight:%s" % tenant,
+            signal=_tenant_share_signal(tenant, resource),
+            fire_above=fire_above, clear_below=clear_below,
+            windows=windows, cooldown=cooldown,
+            propose=_halve, guarded=False,
+            description="tenant %r eats >%d%% of the fleet's %s -> halve "
+                        "its stride-scheduling weight so the other jobs "
+                        "stop queueing behind it"
+                        % (tenant, int(fire_above * 100), resource)))
+    return rules
 
 
 class ControlOptions:

@@ -303,6 +303,38 @@ class Eligibility:
         return self.eligible
 
 
+_codec_ineligible_counters = {}
+_codec_ineligible_lock = threading.Lock()
+
+
+def _count_codec_ineligible(codec):
+    """One column read locked out of the pass-through by a classified-but-
+    kernel-less codec: warn once per codec
+    (``cause=pagedec_codec_ineligible{codec=...}``) and count every
+    occurrence, so operators can size the win of landing that kernel."""
+    label = codec.lower()
+    counter = _codec_ineligible_counters.get(label)
+    if counter is None:
+        with _codec_ineligible_lock:
+            counter = _codec_ineligible_counters.get(label)
+            if counter is None:
+                counter = default_registry().counter(
+                    "ptpu_pagedec_codec_ineligible_columns_total",
+                    help="column reads whose codec the classifier knows but "
+                         "has no device kernel for (full classic read)",
+                    codec=label)
+                _codec_ineligible_counters[label] = counter
+    counter.inc()
+    from petastorm_tpu.obs.log import degradation
+
+    degradation(
+        "pagedec_codec_ineligible{codec=%s}" % label,
+        "pagedec: %s chunks are classified but have no device inflate "
+        "kernel yet — these columns take the full classic host read "
+        "(ptpu_pagedec_codec_ineligible_columns_total{codec=%s} counts how "
+        "much of the store is locked out)", codec, label)
+
+
 def classify_chunk(metadata, rg, col_idx):
     """Footer-only eligibility of row group ``rg``'s ``col_idx``-th column.
 
@@ -319,8 +351,15 @@ def classify_chunk(metadata, rg, col_idx):
                            "non-fixed-width physical type %s" % col.physical_type)
     codec = col.compression
     if codec not in _PASSTHROUGH_CODECS:
-        reason = ("codec %s classified but no device kernel yet" % codec
-                  if codec in _KNOWN_CODECS else "unsupported codec %s" % codec)
+        if codec in _KNOWN_CODECS:
+            # zstd (ISSUE 19 satellite): the walker classifies these chunks
+            # fine, but without a device inflate kernel they silently take
+            # the full classic read — surface how much of the store is
+            # locked out until the kernel lands
+            _count_codec_ineligible(codec)
+            reason = "codec %s classified but no device kernel yet" % codec
+        else:
+            reason = "unsupported codec %s" % codec
         return Eligibility(False, reason, dtype=dtype, codec=codec)
     max_def = sch.max_definition_level
     if max_def > 1:

@@ -573,6 +573,10 @@ class DataLoader:
     ----------
     reader : petastorm_tpu.reader.Reader
         Batch reader (columnar) or per-row reader (rows are stacked host-side).
+        A :class:`petastorm_tpu.service.client.ServiceReader` (ISSUE 19 —
+        :func:`petastorm_tpu.reader.make_service_reader`) plugs in here
+        unchanged: batches then come from a shared decode fleet instead of a
+        local pool, with the same batch/checkpoint semantics.
     batch_size : int
         GLOBAL batch size: rows per yielded batch across all processes. Under
         multi-process JAX with a ``NamedSharding`` whose batch axis spans processes,
@@ -882,6 +886,20 @@ class DataLoader:
                                  else None)(ref()),
                     prefix=scope_prefix),
             )
+            if self._health_owned and metrics:
+                # route per-worker latency histograms onto the metrics=
+                # registry BEFORE the live executor is rewired below: workers
+                # observe latencies the moment set_health lands, and
+                # set_registry no-ops once observations exist (re-homing a
+                # live family would split it) — wiring it at the _obs block
+                # further down raced those first observations onto the
+                # default registry
+                from petastorm_tpu.obs.metrics import MetricsRegistry, \
+                    default_registry
+
+                monitor.set_registry(
+                    metrics if isinstance(metrics, MetricsRegistry)
+                    else default_registry())
             if hasattr(reader, "set_health"):
                 reader.set_health(self._health_scope)
             monitor.start()

@@ -270,6 +270,8 @@ RESOURCES = {
     "quarantined": ("ptpu_tenant_quarantined_rows_total", "quarantined rows"),
     "wire_bytes": ("ptpu_tenant_wire_bytes_total",
                    "transport frame bytes (tagged frames)"),
+    "svc_items": ("ptpu_tenant_svc_items_total",
+                  "data-service items served (ISSUE 19)"),
 }
 
 

@@ -2844,6 +2844,27 @@ def make_batch_reader(dataset_url_or_urls, schema_fields=None, reader_pool_type=
     return r
 
 
+def make_service_reader(address, token, job, trainer=None, tenant=None,
+                        recovery=None, credits=8, arena=True):
+    """Attach to a :class:`petastorm_tpu.service.server.DataService` job
+    (ISSUE 19): the disaggregated twin of :func:`make_batch_reader`. Instead
+    of decoding locally, the returned
+    :class:`~petastorm_tpu.service.client.ServiceReader` consumes the shared
+    decode fleet's output — batched columnar delivery with the same
+    ``state_dict()`` consumed-watermark checkpoint contract, pluggable into
+    :class:`~petastorm_tpu.loader.DataLoader` unchanged.
+
+    ``address``/``token`` come from the service
+    (:meth:`~petastorm_tpu.service.server.DataService.trainer_address` /
+    ``.token``); ``arena=True`` maps co-hosted payloads zero-copy out of the
+    PR 17 host arena. See ``docs/service.md``.
+    """
+    from petastorm_tpu.service.client import ServiceReader
+
+    return ServiceReader(address, token, job, trainer=trainer, tenant=tenant,
+                         recovery=recovery, credits=credits, arena=arena)
+
+
 def _resolve_partitions(pieces, filters):
     """Hive partitioning at plan time: typed :class:`~petastorm_tpu.partitions.PartitionInfo`
     from the piece paths + directory-level pruning of ``filters`` (reference

@@ -1,0 +1,35 @@
+"""Disaggregated data service (ISSUE 19): one decode fleet, many trainers.
+
+- :class:`DataService` — the server: owns each job's plan, leases items to
+  decode workers over the PR 15 tcp transport, fans every decoded payload
+  out to all attached trainers (decode-once / serve-many), and runs
+  per-tenant QoS between jobs sharing the fleet.
+- :class:`DecodeWorker` — one fleet member: dials a hub session and decodes
+  leases until stopped.
+- :class:`ServiceReader` — the trainer-side batched reader: plugs into
+  :class:`~petastorm_tpu.loader.DataLoader` unchanged and checkpoints the
+  consumed-ordinal watermark the service resumes from.
+- :class:`JobSpec` / :func:`parquet_job` — job definitions.
+
+See ``docs/service.md`` for the wire protocol and the attach/detach
+contract.
+"""
+from petastorm_tpu.service.client import ServiceAttachRejected, ServiceReader
+from petastorm_tpu.service.protocol import PROTOCOL_VERSION, JobSpec, \
+    svc_metrics
+from petastorm_tpu.service.server import DataService, ServiceOptions
+from petastorm_tpu.service.worker import DecodeWorker, \
+    ParquetRowGroupDecoder, parquet_job
+
+__all__ = [
+    "DataService",
+    "DecodeWorker",
+    "JobSpec",
+    "PROTOCOL_VERSION",
+    "ParquetRowGroupDecoder",
+    "ServiceAttachRejected",
+    "ServiceOptions",
+    "ServiceReader",
+    "parquet_job",
+    "svc_metrics",
+]

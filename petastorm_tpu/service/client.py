@@ -1,0 +1,273 @@
+"""Trainer side of the data service: :class:`ServiceReader` (ISSUE 19).
+
+A ServiceReader attaches to one job on a :class:`DataService` and duck-types
+the batched-reader surface the :class:`~petastorm_tpu.loader.DataLoader`
+consumes — iteration yields the schema's namedtuple of numpy columns, and
+``state_dict()``/``load_state_dict()`` checkpoint the same consumed-ordinal
+watermark the in-process :class:`~petastorm_tpu.reader.Reader` keeps. The
+service never tracks delivery acks: this watermark, presented at every
+(re)attach, IS the resume contract — a link death mid-epoch turns into a
+fresh attach that recomputes the remaining shard exactly (no loss from the
+detach, no replay into the trainer).
+
+Delivery is credit-flow push: the reader grants the service a small window
+of pushes (``credits``) and replenishes as it consumes, so a stalled trainer
+backpressures the service instead of ballooning its socket. Co-hosted
+trainers negotiate the PR 17 host arena at attach: items then arrive as an
+``arena_key`` instead of pickled columns, and the payload is mapped zero-
+copy out of the shared warm set (a miss — evicted between admit and get —
+is re-served via ``refetch``).
+"""
+from __future__ import annotations
+
+import threading
+
+from petastorm_tpu.errors import TransportLinkDown
+from petastorm_tpu.recovery import RecoveryOptions
+from petastorm_tpu.service.protocol import (
+    OP_ATTACH,
+    OP_ATTACHED,
+    OP_DETACH,
+    OP_DETACHED,
+    OP_END,
+    OP_ITEM,
+    OP_QUARANTINED,
+    OP_REFETCH,
+    OP_REJECTED,
+    OP_WANT,
+)
+
+
+class ServiceAttachRejected(RuntimeError):
+    """The service's admission control refused the attach."""
+
+
+class ServiceReader:
+    """Batched reader over a data-service job. Plugs into
+    :class:`~petastorm_tpu.loader.DataLoader` unchanged::
+
+        reader = ServiceReader(svc.trainer_address(), svc.token, job="train")
+        loader = DataLoader(reader, batch_size=256)
+    """
+
+    is_batched_reader = True
+
+    def __init__(self, address, token, job, trainer=None, tenant=None,
+                 recovery=None, credits=8, arena=True):
+        from petastorm_tpu.transport.tcp import TcpChildTransport, \
+            parse_address
+
+        self._rec = recovery or RecoveryOptions()
+        host, port, session = parse_address(address)
+        self.job = job
+        self.trainer = trainer or "trainer-%d" % session
+        self.tenant = tenant
+        self._want_arena = bool(arena)
+        self._credit_target = max(1, int(credits))
+        self._credits_out = 0
+        self._consumed = {}          # epoch -> set(ordinal) — THE watermark
+        self.quarantined = {}        # (epoch, ordinal) -> cause
+        self._arena = None
+        self._arena_leases = []
+        self._refetching = set()     # keys re-requested after an arena miss
+        self._end_seen = False
+        self._stopped = False
+        self._lock = threading.Lock()
+        self.schema = None
+        self.num_epochs = 0
+        self.epoch_sizes = {}
+        # loader duck surface
+        self.keep_passthrough = False
+        self.transform_spec = None
+        self.last_row_consumed = False
+        self.cur_shard = None
+        self.shard_count = None
+        self._transport = TcpChildTransport(host, port, session, token,
+                                            self._rec)
+        self._transport.dial()
+        self._transport.mark_ready()
+        self._attach()
+
+    # -- attach / detach ----------------------------------------------------------------
+
+    def _attach(self):
+        """(Re)attach with the current watermark; retries across link deaths
+        until the service answers or the redial ceiling kills the link."""
+        out = {"op": OP_ATTACH, "job": self.job, "trainer": self.trainer,
+               "tenant": self.tenant, "arena": self._want_arena,
+               "consumed": {e: sorted(v)
+                            for e, v in self._consumed.items()}}
+        while True:
+            try:
+                self._transport.send(out)
+                while True:
+                    reply = self._transport.recv()
+                    op = reply.get("op")
+                    if op in (OP_ATTACHED, OP_REJECTED):
+                        break
+                    # stale pushes from the dead conversation: unconsumed,
+                    # so the fresh attach re-serves them — drop here
+            except TransportLinkDown:
+                continue
+            break
+        if reply["op"] == OP_REJECTED:
+            raise ServiceAttachRejected(reply.get("reason", "rejected"))
+        self.schema = reply["schema"]
+        self.num_epochs = reply["num_epochs"]
+        self.epoch_sizes = dict(reply["epoch_sizes"])
+        self._row_type = self.schema.make_namedtuple_type()
+        self._credits_out = 0
+        self._refetching = set()
+        self._end_seen = False
+        if reply.get("arena") and self._arena is None:
+            from petastorm_tpu.io.arena import process_arena
+
+            self._arena = process_arena()
+
+    def detach(self):
+        """Clean mid-epoch detach: unconsumed work returns to the pool with
+        no loss; a later :class:`ServiceReader` restored from this reader's
+        :meth:`state_dict` resumes watermark-exact."""
+        try:
+            self._transport.send({"op": OP_DETACH})
+            while True:
+                reply = self._transport.recv()
+                if reply.get("op") == OP_DETACHED:
+                    break
+        except (TransportLinkDown, EOFError, OSError):
+            pass  # a dead link IS a detach server-side
+
+    # -- iteration ----------------------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def _mark_consumed(self, epoch, ordinal):
+        self._consumed.setdefault(int(epoch), set()).add(int(ordinal))
+
+    def _materialize(self, msg):
+        """Columns for one item push: inline payload, or an arena mapping
+        pinned by a lease the reader holds until :meth:`stop`. Returns None
+        when the arena missed (a refetch was sent)."""
+        payload = msg.get("payload")
+        if payload is not None:
+            return payload
+        key = msg.get("arena_key")
+        got = self._arena.get(tuple(key)) if self._arena is not None else None
+        if got is None:
+            self._refetching.add((int(msg["epoch"]), int(msg["ordinal"])))
+            self._transport.send({"op": OP_REFETCH, "epoch": msg["epoch"],
+                                  "ordinal": msg["ordinal"]})
+            return None
+        value, lease = got
+        self._arena_leases.append(lease)
+        return value
+
+    def __next__(self):
+        if self._stopped:
+            raise StopIteration
+        while True:
+            if self._end_seen and not self._refetching:
+                # "end" marks the plan complete, but an in-flight refetch
+                # (arena miss) still owes us its item — drain those first
+                self.last_row_consumed = True
+                raise StopIteration
+            low_water = max(1, self._credit_target // 2)
+            try:
+                if self._credits_out < low_water:
+                    grant = self._credit_target - self._credits_out
+                    self._transport.send({"op": OP_WANT, "credits": grant})
+                    self._credits_out += grant
+                msg = self._transport.recv()
+            except TransportLinkDown:
+                self._attach()  # link is back: resume watermark-exact
+                continue
+            except (EOFError, OSError):
+                self.last_row_consumed = True
+                raise StopIteration from None
+            op = msg.get("op")
+            if op == OP_ITEM:
+                self._credits_out = max(0, self._credits_out - 1)
+                try:
+                    cols = self._materialize(msg)
+                except TransportLinkDown:
+                    self._attach()
+                    continue
+                if cols is None:
+                    continue  # arena miss: the refetch re-serves it
+                self._refetching.discard(
+                    (int(msg["epoch"]), int(msg["ordinal"])))
+                self._mark_consumed(msg["epoch"], msg["ordinal"])
+                return self._row_type(**cols)
+            if op == OP_QUARANTINED:
+                self._credits_out = max(0, self._credits_out - 1)
+                self._refetching.discard(
+                    (int(msg["epoch"]), int(msg["ordinal"])))
+                self._mark_consumed(msg["epoch"], msg["ordinal"])
+                self.quarantined[(int(msg["epoch"]), int(msg["ordinal"]))] \
+                    = msg.get("cause")
+                continue
+            if op == OP_END:
+                self._end_seen = True
+
+    def next(self):
+        return self.__next__()
+
+    # -- checkpoint ---------------------------------------------------------------------
+
+    def state_dict(self):
+        """The consumed-work watermark — restoring it into a fresh
+        ServiceReader (or this one) resumes exactly where this shard
+        stopped, quarantined items charged exactly once."""
+        return {
+            "service": 1,
+            "job": self.job,
+            "consumed": {int(e): sorted(v)
+                         for e, v in self._consumed.items()},
+        }
+
+    def load_state_dict(self, state):
+        if state.get("service") != 1 or "consumed" not in state:
+            raise ValueError(
+                "not a ServiceReader state (keys: %s)" % sorted(state))
+        if state.get("job") != self.job:
+            raise ValueError(
+                "checkpoint belongs to job %r; this reader is attached to "
+                "%r — resuming would replay the wrong plan"
+                % (state.get("job"), self.job))
+        self.detach()
+        self._consumed = {int(e): set(v)
+                          for e, v in state["consumed"].items()}
+        self.last_row_consumed = False
+        self._attach()
+
+    # -- loader duck surface ------------------------------------------------------------
+
+    def set_trace(self, tracer):
+        pass
+
+    def set_provenance(self, recorder):
+        pass
+
+    def set_health(self, monitor):
+        pass
+
+    def reset(self):
+        """Fresh pass over the full plan (clears the watermark)."""
+        self.detach()
+        self._consumed = {}
+        self.last_row_consumed = False
+        self._attach()
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        self.detach()
+        leases, self._arena_leases = self._arena_leases, []
+        for lease in leases:
+            lease.release()
+        self._transport.close()
+
+    def join(self):
+        pass

@@ -1,0 +1,198 @@
+"""Data-service wire protocol (ISSUE 19): ops, job specs, metrics.
+
+The disaggregated data service speaks pickled-object frames over the PR 15
+framed tcp transport (:mod:`petastorm_tpu.transport.tcp`) — every message is
+a plain dict with an ``"op"`` key, so old and new peers can skip fields they
+do not understand. Two conversations share the hub:
+
+Decode worker <-> service (strict request/response per lease)::
+
+    worker:  {"op": "ready", "worker": name}          once per fresh link
+    service: {"op": "lease", "lease": id, "job": j, "epoch": e,
+              "ordinal": o, "item": spec_item, ["spec": JobSpec]}
+    worker:  {"op": "done", "lease": id, "payload": cols, "rows": n,
+              "meta": {"decode_s": ..., "wall_s": ...}}
+          |  {"op": "fail", "lease": id, "error": str, "permanent": bool}
+    service: {"op": "stop"}                            shutdown
+
+A lease conversation is pinned to its link generation by the transport's
+in-flight ledger: a link death mid-conversation re-dispatches the un-acked
+lease (never twice — frames from a dead generation are discarded with it).
+
+Trainer <-> service (credit-flow push)::
+
+    trainer: {"op": "attach", "job": j, "trainer": t, "tenant": slug,
+              "consumed": {epoch: [ordinals]}, "arena": bool}
+    service: {"op": "attached", "schema": Unischema, "num_epochs": n,
+              "epoch_sizes": {epoch: count}, "arena": bool, "version": 1}
+          |  {"op": "rejected", "reason": str}
+    trainer: {"op": "want", "credits": n}               grants n more pushes
+    service: {"op": "item", "epoch": e, "ordinal": o, "rows": n,
+              "payload": cols | None, ["arena_key": key]}
+          |  {"op": "quarantined", "epoch": e, "ordinal": o, "cause": str}
+          |  {"op": "end"}
+    trainer: {"op": "refetch", "epoch": e, "ordinal": o}  arena-key miss
+    trainer: {"op": "detach", "consumed": {...}}
+    service: {"op": "detached"}
+
+``consumed`` is the trainer's checkpoint watermark — the exact same
+``{epoch: set(ordinal)}`` map the :class:`~petastorm_tpu.reader.Reader`
+keeps. The service never tracks delivery acks: a (re)attach recomputes the
+remaining shard from the client-presented map, so detach returns unconsumed
+work with no loss, and reattach resumes watermark-exact with no replay.
+"""
+from __future__ import annotations
+
+PROTOCOL_VERSION = 1
+
+# worker <-> service
+OP_READY = "ready"
+OP_LEASE = "lease"
+OP_DONE = "done"
+OP_FAIL = "fail"
+OP_STOP = "stop"
+
+# trainer <-> service
+OP_ATTACH = "attach"
+OP_ATTACHED = "attached"
+OP_REJECTED = "rejected"
+OP_WANT = "want"
+OP_ITEM = "item"
+OP_QUARANTINED = "quarantined"
+OP_END = "end"
+OP_DETACH = "detach"
+OP_DETACHED = "detached"
+OP_REFETCH = "refetch"
+
+#: scheduler tiers for the TenantContext priority hints (lower = first)
+PRIORITY_TIERS = {"high": 0, "normal": 1, None: 1, "low": 2}
+
+
+class JobSpec:
+    """One job the fleet decodes: a plan over picklable items plus the decode
+    callable that turns one item into a columns dict.
+
+    ``decode(item)`` must be picklable (module-level function or
+    ``functools.partial`` over one) and return either ``{name: ndarray}`` or
+    ``({name: ndarray}, rows)``; without an explicit row count the first
+    column's length is used. ``schema`` is the
+    :class:`~petastorm_tpu.unischema.Unischema` trainers receive at attach —
+    the :class:`~petastorm_tpu.service.client.ServiceReader` exposes it to
+    the :class:`~petastorm_tpu.loader.DataLoader` unchanged.
+    """
+
+    __slots__ = ("job", "items", "decode", "schema", "tenant", "priority",
+                 "num_epochs", "shuffle", "seed")
+
+    def __init__(self, job, items, decode, schema, tenant=None, priority=None,
+                 num_epochs=1, shuffle=False, seed=0):
+        if not items:
+            raise ValueError("JobSpec %r needs at least one plan item" % job)
+        if priority not in PRIORITY_TIERS:
+            raise ValueError("priority must be one of %r, got %r"
+                             % (sorted(k for k in PRIORITY_TIERS if k),
+                                priority))
+        self.job = str(job)
+        self.items = list(items)
+        self.decode = decode
+        self.schema = schema
+        self.tenant = tenant
+        self.priority = priority
+        self.num_epochs = num_epochs
+        self.shuffle = bool(shuffle)
+        self.seed = seed
+
+    def wire_spec(self):
+        """The worker-facing subset: just enough to run ``decode`` (the plan
+        and trainer bookkeeping never leave the service)."""
+        return {"job": self.job, "decode": self.decode,
+                "tenant": self.tenant}
+
+
+# -- metrics ---------------------------------------------------------------------------
+
+_default_metrics = None
+
+
+def svc_metrics(registry=None):
+    """The ``ptpu_svc_*`` family (memoized for the default registry — the
+    lease and delivery hot paths resolve handles once per process)."""
+    global _default_metrics
+    from petastorm_tpu.obs.metrics import default_registry
+
+    if registry is None or registry is default_registry():
+        if _default_metrics is None:
+            _default_metrics = _build_metrics(default_registry())
+        return _default_metrics
+    return _build_metrics(registry)
+
+
+def _build_metrics(reg):
+    return {
+        "workers": reg.gauge(
+            "ptpu_svc_workers",
+            help="decode workers currently connected to the data service"),
+        "trainers": reg.gauge(
+            "ptpu_svc_trainers",
+            help="trainers currently attached to the data service"),
+        "jobs": reg.gauge(
+            "ptpu_svc_jobs", help="jobs registered with the data service"),
+        "leases": reg.counter(
+            "ptpu_svc_leases_total",
+            help="decode leases dispatched to the worker fleet"),
+        "lease_redispatch": reg.counter(
+            "ptpu_svc_lease_redispatch_total",
+            help="leases returned to the pool by a dead link / transient "
+                 "failure and dispatched again"),
+        "lease_leaked": reg.counter(
+            "ptpu_svc_lease_leaked_total",
+            help="leases still outstanding when the service stopped — "
+                 "should be 0; growth is a dispatcher bug"),
+        "leases_outstanding": reg.gauge(
+            "ptpu_svc_leases_outstanding",
+            help="decode leases currently held by workers"),
+        "decodes": reg.counter(
+            "ptpu_svc_decodes_total",
+            help="plan items decoded by the fleet (decode-once: compare "
+                 "with served items for the fan-out ratio)"),
+        "redecodes": reg.counter(
+            "ptpu_svc_redecodes_total",
+            help="items decoded again after their payload was dropped "
+                 "(reattach after eviction — correctness, not the hot path)"),
+        "decode_seconds": reg.counter(
+            "ptpu_svc_decode_seconds_total",
+            help="fleet decode seconds (the worker-seconds numerator of the "
+                 "decode-once acceptance ratio)"),
+        "served_items": reg.counter(
+            "ptpu_svc_served_items_total",
+            help="decoded items pushed to trainers (each decode serves "
+                 "every attached trainer that still needs it)"),
+        "served_rows": reg.counter(
+            "ptpu_svc_served_rows_total",
+            help="rows pushed to trainers"),
+        "fanout_serves": reg.counter(
+            "ptpu_svc_fanout_serves_total",
+            help="serves beyond the first per decoded item — the rows a "
+                 "dedicated pipeline would have decoded again"),
+        "quarantined": reg.counter(
+            "ptpu_svc_quarantined_total",
+            help="plan items quarantined service-wide (broadcast to every "
+                 "trainer's watermark exactly once)"),
+        "attaches": reg.counter(
+            "ptpu_svc_attaches_total", help="trainer attach handshakes"),
+        "detaches": reg.counter(
+            "ptpu_svc_detaches_total",
+            help="trainer detaches (clean requests + link deaths)"),
+        "rejected": reg.counter(
+            "ptpu_svc_rejected_total",
+            help="attach requests refused by admission control"),
+        "refetches": reg.counter(
+            "ptpu_svc_refetches_total",
+            help="arena-key misses a trainer asked the service to re-serve"),
+        "cache_items": reg.gauge(
+            "ptpu_svc_cache_items",
+            help="decoded payloads resident in the serve cache"),
+        "cache_bytes": reg.gauge(
+            "ptpu_svc_cache_bytes",
+            help="decoded payload bytes resident in the serve cache"),
+    }

@@ -143,6 +143,17 @@ class PullDispatcher:
             claim.clear()
         return n
 
+    def return_items(self, items):
+        """Hand specific already-dispatched items back to the pool (the data
+        service's wire-lease requeue seam, ISSUE 19): a dead link's un-acked
+        lease, a transiently failed decode, or a re-attached trainer's
+        evicted payload re-enters dispatch ahead of the plan iterator — the
+        same no-loss/no-duplicate discipline as :meth:`withdraw`, for items
+        that had already left their claim deque."""
+        with self._lock:
+            self._returned.extend(items)
+        return len(items)
+
     def has_work(self):
         """Is anything left to dispatch — handed-back items, claimed items,
         or an unexhausted plan? The executors' last-worker exit gate: a

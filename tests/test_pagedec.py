@@ -147,6 +147,34 @@ def test_classifier_codec_gates():
             assert fragment in el.reason
 
 
+def test_codec_ineligible_counted_and_degraded():
+    """ISSUE 19 satellite: a classified-but-kernel-less codec (zstd) must not
+    silently take the classic read — every locked-out column bumps the
+    labeled counter and the cause is recorded once."""
+    t = _simple_table(400)
+    counter = default_registry().counter(
+        "ptpu_pagedec_codec_ineligible_columns_total", codec="zstd")
+    cause = default_registry().counter(
+        "ptpu_degradations_total", cause="pagedec_codec_ineligible{codec=zstd}")
+    before = counter.value
+    deg_before = cause.value
+
+    data = _write(t, compression="zstd")
+    md = pq.read_metadata(io.BytesIO(data))
+    for c in range(md.num_columns):
+        el = pagedec.classify_chunk(md, 0, c)
+        assert not el.eligible and "no device kernel" in el.reason
+    assert counter.value - before == md.num_columns
+    assert cause.value - deg_before == md.num_columns
+
+    # an UNKNOWN codec (gzip) is a plain rejection, not a kernel gap — it
+    # must not inflate the zstd lockout accounting
+    mid = counter.value
+    md2 = pq.read_metadata(io.BytesIO(_write(t, compression="gzip")))
+    assert not pagedec.classify_chunk(md2, 0, 0).eligible
+    assert counter.value == mid
+
+
 def test_no_saving_gate_degrades_incompressible_chunks():
     # pure float noise dictionary-encodes BIGGER than raw — pass-through
     # must decline (shipping more bytes than raw helps nobody)

@@ -102,9 +102,11 @@ def test_link_death_reconnect_and_inflight_ledger():
         # conversation to the fresh link generation)
         parent.track(("item", 7))
         parent.send(("item", 7))  # the re-dispatch
-        assert child.poll(2.0) and child.recv() == ("item", 7)
+        # generous deadline: the redial + adoption handshake can lag on a
+        # loaded box, and poll returns as soon as the frame lands
+        assert child.poll(10.0) and child.recv() == ("item", 7)
         child.send(("ok", 7))
-        assert parent.poll(2.0) and parent.recv() == ("ok", 7)
+        assert parent.poll(10.0) and parent.recv() == ("ok", 7)
         parent.settle()
         assert parent.inflight() is None
     finally:
@@ -405,3 +407,161 @@ def test_chaos_partition_sentinel_and_corrupt_frame():
     assert corrupted != frame and len(corrupted) == len(frame)
     with pytest.raises(TransportFrameCorrupt):
         take_frame(bytearray(corrupted))
+
+
+# -- tenant frame header re-negotiation across mid-epoch reconnects (ISSUE 19) -----------
+
+
+def _quiet_session(rec=None):
+    """Hub + parent endpoint WITHOUT mark_ready: no heartbeat thread, so a
+    raw-socket 'old peer' below never has to echo K_HB frames."""
+    from petastorm_tpu.transport.tcp import TcpHub
+
+    hub = TcpHub(rec or _fast_links())
+    parent = hub.create_session(0)
+    return hub, parent
+
+
+def _old_peer_dial(hub, session=0):
+    """Dial like a pre-tenant-feature peer: hello WITHOUT ``features``. The
+    hub must answer with the historical EMPTY ack — byte-exact downgrade."""
+    import json
+    import socket
+
+    from petastorm_tpu.transport.framing import K_HELLO, K_HELLO_ACK
+
+    sock = socket.create_connection(("127.0.0.1", hub.port), timeout=5.0)
+    sock.settimeout(0.2)
+    hello = json.dumps({"token": hub.token, "session": session})
+    sock.sendall(pack_frame(K_HELLO, hello.encode("utf-8")))
+    buf = bytearray()
+    kind, ack = _raw_recv(sock, buf)
+    assert kind == K_HELLO_ACK
+    return sock, ack, buf
+
+
+def _raw_recv(sock, buf, timeout_s=5.0):
+    import socket as _socket
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        frame = take_frame(buf)
+        if frame is not None:
+            return frame
+        try:
+            data = sock.recv(1 << 12)
+        except _socket.timeout:
+            data = b""
+        if data:
+            buf += data
+        else:
+            assert time.monotonic() < deadline, "raw peer recv timed out"
+
+
+def _wait_adoptions(parent, n, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while parent._adopted < n:
+        assert time.monotonic() < deadline, "hub never adopted the redial"
+        time.sleep(0.01)
+
+
+def _wire_billed(tenant):
+    from petastorm_tpu.obs.metrics import default_registry
+
+    return default_registry().counter("ptpu_tenant_wire_bytes_total",
+                                      tenant=tenant).value
+
+
+def test_reconnect_downgrades_tenant_frames_for_old_peer():
+    """New-feature peer session first, then a mid-epoch reconnect from an OLD
+    peer (no ``features`` in its hello): the hub re-negotiates DOWN — empty
+    ack, and every subsequent frame is the exact legacy byte format (no
+    K_TENANT_FLAG, no slug header) the old peer can parse."""
+    import pickle
+
+    from petastorm_tpu.transport.framing import K_TENANT_FLAG, split_tenant
+    from petastorm_tpu.transport.tcp import connect_child_tcp
+
+    hub, parent = _quiet_session()
+    child = connect_child_tcp(hub.address_for(0), bytes.fromhex(hub.token))
+    try:
+        assert parent.wait_connected(5.0)
+        assert parent._tenant_frames and child._tenant_frames
+        parent.set_tenant("acme")
+        billed0 = _wire_billed("acme")
+        parent.send({"epoch": 1, "n": 0})
+        assert child.poll(2.0) and child.recv() == {"epoch": 1, "n": 0}
+        # negotiated link: the frame carried the slug and rx-side billed it
+        assert child.peer_tenant == "acme"
+        expected_tagged = pack_frame(
+            K_OBJ, pickle.dumps({"epoch": 1, "n": 0}, protocol=4),
+            tenant="acme")
+        assert _wire_billed("acme") - billed0 == len(expected_tagged)
+
+        # mid-epoch link death; an OLD peer takes over the session
+        child.close()
+        sock, ack, buf = _old_peer_dial(hub)
+        try:
+            assert ack == b""  # the historical empty ack, byte-exact
+            _wait_adoptions(parent, 2)
+            assert parent._tenant_frames is False  # re-negotiated DOWN
+            billed1 = _wire_billed("acme")
+            msg = {"epoch": 1, "n": 1}
+            parent.send(msg)  # still set_tenant("acme") — must downgrade
+            kind, payload = _raw_recv(sock, buf)
+            # exact legacy byte format: unflagged kind, payload IS the pickle
+            assert kind == K_OBJ and not kind & K_TENANT_FLAG
+            assert split_tenant(kind, payload) == (K_OBJ, payload, None)
+            assert pickle.loads(payload) == msg
+            # and billing stopped — untagged frames charge no tenant
+            assert _wire_billed("acme") == billed1
+        finally:
+            sock.close()
+    finally:
+        child.close()
+        parent.close()
+        hub.close()
+
+
+def test_reconnect_upgrades_tenant_frames_after_old_peer():
+    """The reverse direction: an old peer holds the session first (untagged,
+    unbilled), dies mid-epoch, and a NEW peer's redial re-negotiates UP — the
+    feature ack returns and tagging + per-tenant wire billing resume on the
+    fresh generation with no hub restart."""
+    import pickle
+
+    from petastorm_tpu.transport.tcp import connect_child_tcp
+
+    hub, parent = _quiet_session()
+    parent.set_tenant("acme")
+    sock, ack, buf = _old_peer_dial(hub)
+    try:
+        assert ack == b""
+        assert parent.wait_connected(5.0)
+        assert parent._tenant_frames is False
+        billed0 = _wire_billed("acme")
+        parent.send({"epoch": 2, "n": 0})
+        kind, payload = _raw_recv(sock, buf)
+        assert kind == K_OBJ and pickle.loads(payload) == {"epoch": 2, "n": 0}
+        assert _wire_billed("acme") == billed0  # old peer: nothing billed
+
+        sock.close()  # the old peer dies mid-epoch
+        child = connect_child_tcp(hub.address_for(0),
+                                  bytes.fromhex(hub.token))
+        try:
+            _wait_adoptions(parent, 2)
+            assert parent._tenant_frames is True  # re-negotiated UP
+            assert child._tenant_frames is True  # ack carried the features
+            msg = {"epoch": 2, "n": 1}
+            parent.send(msg)
+            assert child.poll(2.0) and child.recv() == msg
+            assert child.peer_tenant == "acme"
+            expected = pack_frame(K_OBJ, pickle.dumps(msg, protocol=4),
+                                  tenant="acme")
+            assert _wire_billed("acme") - billed0 == len(expected)
+        finally:
+            child.close()
+    finally:
+        sock.close()
+        parent.close()
+        hub.close()
