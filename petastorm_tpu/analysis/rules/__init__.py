@@ -12,6 +12,7 @@ from petastorm_tpu.analysis.rules.observability import (
     SleepyPollLoopRule,
     UnboundedLabelRule,
     UnpairedSpanRule,
+    WallClockSpanRule,
 )
 from petastorm_tpu.analysis.rules.project_concurrency import (
     BlockingUnderLockRule,
@@ -45,6 +46,7 @@ ALL_RULES = [
     UnpairedSpanRule,
     SleepyPollLoopRule,
     UnboundedLabelRule,
+    WallClockSpanRule,
     UnboundedBlockingCallRule,
     StatThenOpenRule,
     UnboundedSocketRule,

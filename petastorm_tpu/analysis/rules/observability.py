@@ -365,6 +365,100 @@ class UnboundedLabelRule(Rule):
                         % (kw.arg, reason))
 
 
+#: provenance/trace span-recording calls whose time arguments MUST be
+#: ``perf_counter`` samples: spans land on the recorder's perf timeline and
+#: cross-process blobs are aligned through a (wall, perf) anchor pair, so a
+#: wall-clock sample fed here is on the wrong timeline entirely
+_SPAN_SINKS = frozenset((
+    "add_span", "add_item_span", "batch_span", "transfer_span",
+))
+
+
+class WallClockSpanRule(Rule):
+    """GL-O006: a wall-clock sample fed to a span sink (or a ``perf_anchor``).
+
+    The provenance/trace planes keep every span on the process-local
+    ``perf_counter`` timeline; wall time enters exactly once, as the
+    ``(wall, perf)`` anchor pair that clock-aligns cross-process and
+    cross-wire merges (``absorb_child``, the fleet ``merge_exports``). A
+    ``time.time()`` sample passed as a span endpoint puts the span on the
+    wrong timeline — after anchor alignment it lands decades off and every
+    fold/merge built on it is garbage; a wall sample passed as a
+    ``perf_anchor=`` poisons the alignment base itself, skewing EVERY span
+    absorbed through it. GL-O001 catches wall-minus-wall durations; this
+    rule catches the wall value escaping into the span plane before any
+    subtraction happens. Keyword arguments whose names start with ``wall``
+    (``wall_anchor=``) are the one sanctioned wall entry point and stay
+    clean."""
+
+    rule_id = "GL-O006"
+    severity = Severity.WARNING
+    description = ("wall-clock (time.time()) sample fed to a provenance/"
+                   "trace span sink or perf_anchor — spans live on the "
+                   "perf_counter timeline; anchored fleet merges break")
+    fix_hint = ("sample time.perf_counter() for span endpoints and "
+                "perf anchors; wall time belongs only in wall_anchor= "
+                "(the clock-alignment pair), or justify with an inline "
+                "'# graftlint: disable=GL-O006' comment")
+
+    def check(self, tree, ctx):
+        from petastorm_tpu.analysis.rules._astutil import attr_chain, \
+            walk_scope
+        from petastorm_tpu.analysis.rules.hotpath import _scopes, \
+            _wall_clock_aliases
+
+        aliases = _wall_clock_aliases(ctx)
+
+        def is_wall_call(node):
+            return isinstance(node, ast.Call) \
+                and attr_chain(node.func) in aliases
+
+        for scope in _scopes(ctx):
+            sampled = set()  # names assigned from a time.time() call in scope
+            for node in walk_scope(scope):
+                if isinstance(node, ast.Assign) and is_wall_call(node.value):
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            sampled.add(target.id)
+
+            def derives(node):
+                return is_wall_call(node) or (
+                    isinstance(node, ast.Name) and node.id in sampled)
+
+            for node in walk_scope(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _call_name(node) in _SPAN_SINKS:
+                    for arg in node.args:
+                        if derives(arg):
+                            yield ctx.finding(
+                                self, node,
+                                "span endpoint derives from time.time() — "
+                                "spans live on the perf_counter timeline; "
+                                "after anchor alignment this span lands on "
+                                "the wrong clock and breaks every fold/"
+                                "merge over it")
+                            break
+                    for kw in node.keywords:
+                        if kw.arg and not kw.arg.startswith("wall") \
+                                and derives(kw.value):
+                            yield ctx.finding(
+                                self, node,
+                                "span %s= derives from time.time() — spans "
+                                "live on the perf_counter timeline; wall "
+                                "time enters only through wall_anchor="
+                                % kw.arg)
+                else:
+                    for kw in node.keywords:
+                        if kw.arg == "perf_anchor" and derives(kw.value):
+                            yield ctx.finding(
+                                self, node,
+                                "perf_anchor= derives from time.time() — a "
+                                "wall sample as the perf anchor skews the "
+                                "alignment base of EVERY span absorbed "
+                                "through it")
+
+
 class SilentExceptionSwallowRule(Rule):
     """GL-O002: ``except Exception: pass`` / bare ``except: pass``."""
 

@@ -25,6 +25,17 @@ Scenarios (``--scenarios`` selects a subset; default runs the first three):
   trainer), the run must stay exact: delivered == plan, ZERO quarantined
   items (link faults re-dispatch, they do not poison), zero leaked leases,
   and at least one observed reconnect.
+- ``attribution``: the ISSUE-20 fleet-observability acceptance arm. Latency
+  is injected into ONE of two decode workers for a bounded window; every
+  layer must name that worker: the trainer's cross-wire provenance fold
+  (``attribution_report().slow_top`` == ``svc.decode@<worker>``), the
+  ``/fleet`` straggler alert scraped live during the drain, and the
+  FleetAdvisor (``ptpu_svc_advised_workers`` rises above the actual fleet
+  size during the injection and returns once it clears). Exactness and
+  zero leaked leases still hold with provenance riding every frame.
+- ``provoverhead``: the same 2-worker drain with the cross-wire provenance
+  plane fully off vs fully on; wall-clock overhead must stay under the CI
+  ceiling (20% — the paper target is <=1%, but CI hosts are noisy).
 
 The last stdout line is a one-line JSON summary for BENCH artifacts.
 """
@@ -84,6 +95,25 @@ def decode_quiet(item):
 def decode_noisy(item):
     time.sleep(NOISY_COST_S)
     return {"id": np.full(ROWS_PER_ITEM, item, dtype=np.int64)}
+
+
+SLOW_WORKER = "w-slow"
+FAST_WORKER = "w-fast"
+ATTR_COST_S = 0.01
+ATTR_LAG_S = 0.05
+#: gate for the injected straggler: ``decode_attr`` lags ONLY on the thread
+#: named ``ptpu-w-slow`` (``DecodeWorker.start`` names its thread after the
+#: worker) and only until this monotonic deadline — armed by the scenario
+_ATTR_LAG_UNTIL = [0.0]
+
+
+def decode_attr(item):
+    time.sleep(ATTR_COST_S)
+    if (threading.current_thread().name == "ptpu-%s" % SLOW_WORKER
+            and time.monotonic() < _ATTR_LAG_UNTIL[0]):
+        time.sleep(ATTR_LAG_S)
+    return {"id": np.arange(ROWS_PER_ITEM, dtype=np.int64)
+            + item * ROWS_PER_ITEM}
 
 
 def _svc_snapshot():
@@ -374,11 +404,204 @@ def scenario_linkdeath(smoke):
             "chaos": plan.stats(), "ok": not failures}, failures
 
 
+def scenario_attribution(smoke):
+    """Inject latency into ONE decode worker: the trainer's provenance fold,
+    the live ``/fleet`` scrape, and the advisor must all name it."""
+    import urllib.request
+
+    from petastorm_tpu.loader import DataLoader
+    from petastorm_tpu.obs.metrics import MetricsRegistry
+
+    failures = []
+    n_items = 160 if smoke else 320
+    rec = _rec()
+    before = _svc_snapshot()
+    svc = DataService(options=ServiceOptions(
+        arena=False, sample_s=_SAMPLE_S,
+        straggler_decode_p99_s=ATTR_COST_S + ATTR_LAG_S / 2), recovery=rec)
+    svc.add_job(JobSpec("fleet", list(range(n_items)), decode_attr, SCHEMA,
+                        tenant="attr-tenant"))
+    # each worker homes its counters on a PRIVATE registry (the worker-side
+    # homing contract): /fleet must still merge both sources by name
+    workers = [DecodeWorker(svc.worker_address(), svc.token, recovery=rec,
+                            name=name, registry=MetricsRegistry(),
+                            telemetry_s=0.5)
+               for name in (SLOW_WORKER, FAST_WORKER)]
+    _ATTR_LAG_UNTIL[0] = time.monotonic() + (2.0 if smoke else 2.5)
+    for w in workers:
+        w.start()
+    # ordered delivery pins the lagged worker's latency to its own items
+    # (head of line) so the step-gap decile can name it
+    reader = ServiceReader(svc.trainer_address(), svc.token, job="fleet",
+                           trainer="attr", recovery=rec, arena=False,
+                           ordered=True)
+    loader = DataLoader(reader, batch_size=ROWS_PER_ITEM, to_device=False,
+                        provenance=True)
+    ms = svc.metrics_server()
+
+    samples = []      # (advised, actual) at ~10Hz while the watcher runs
+    fleet_docs = []   # /fleet scrapes taken DURING the drain
+    scrape_errors = []
+    draining = threading.Event()
+    draining.set()
+    done = threading.Event()
+
+    def _watch():
+        m = svc_metrics()
+        next_scrape = time.monotonic()
+        while not done.wait(0.1):
+            samples.append((m["advised_workers"].value, m["workers"].value))
+            if draining.is_set() and time.monotonic() >= next_scrape:
+                next_scrape = time.monotonic() + 0.5
+                try:
+                    with urllib.request.urlopen(ms.url + "/fleet",
+                                                timeout=2) as resp:
+                        fleet_docs.append(json.loads(resp.read()))
+                except Exception as exc:  # noqa: BLE001 — reported once below
+                    scrape_errors.append(repr(exc))
+
+    watcher = threading.Thread(target=_watch, daemon=True)
+    watcher.start()
+    items = []
+    with loader:
+        for batch in loader:
+            items.append(int(batch["id"][0]) // ROWS_PER_ITEM)
+        report = loader.attribution_report()
+    draining.clear()
+    # let the injection window close and the advisor walk back down
+    time.sleep(8 * _SAMPLE_S)
+    done.set()
+    watcher.join(timeout=5)
+    advised_after = svc_metrics()["advised_workers"].value
+    leases = svc.outstanding_leases()
+    ms.stop()
+    svc.stop()
+
+    _exactness("attribution trainer", items, range(n_items), failures)
+    if leases or _svc_delta(before, "lease_leaked"):
+        failures.append("leases outstanding/leaked with provenance riding "
+                        "every frame (%d/%d)"
+                        % (leases, _svc_delta(before, "lease_leaked")))
+    culprit_site = "svc.decode@%s" % SLOW_WORKER
+    if report.slow_top != culprit_site:
+        failures.append("slow_top is %r, expected %r — cross-wire "
+                        "provenance did not name the lagged worker (slow "
+                        "share: %s)" % (report.slow_top, culprit_site,
+                                        report.slow_share))
+    if scrape_errors:
+        failures.append("/fleet scrape failed %d times (first: %s)"
+                        % (len(scrape_errors), scrape_errors[0]))
+    if not fleet_docs:
+        failures.append("no /fleet document captured during the drain")
+    alert_workers = {a.get("worker") for doc in fleet_docs
+                     for a in doc.get("alerts", ())}
+    if SLOW_WORKER not in alert_workers:
+        failures.append("no /fleet straggler alert named %r (alerts over "
+                        "%d scrapes: %s)"
+                        % (SLOW_WORKER, len(fleet_docs),
+                           sorted(a for a in alert_workers if a)))
+    if FAST_WORKER in alert_workers:
+        failures.append("the healthy worker %r fired a straggler alert"
+                        % FAST_WORKER)
+    healthy = [doc for doc in fleet_docs
+               if {SLOW_WORKER, FAST_WORKER} <= set(doc.get("workers", {}))]
+    if not healthy:
+        failures.append("/fleet never showed health for both workers")
+    merged_sources = {src for doc in fleet_docs
+                      for src in doc.get("sources", ())}
+    for want in ("worker:%s" % SLOW_WORKER, "worker:%s" % FAST_WORKER,
+                 "trainer:attr"):
+        if want not in merged_sources:
+            failures.append("/fleet fleet merge never included source %r "
+                            "(saw %s)" % (want, sorted(merged_sources)))
+    actual = max((a for _adv, a in samples), default=0)
+    advised_peak = max((adv for adv, _a in samples), default=0)
+    if actual != 2:
+        failures.append("expected 2 connected workers, gauge peaked at %s"
+                        % actual)
+    if advised_peak <= actual:
+        failures.append("ptpu_svc_advised_workers never rose above the "
+                        "actual fleet size during the injection (peak %s, "
+                        "actual %s)" % (advised_peak, actual))
+    if advised_after > actual:
+        failures.append("advised workers stuck at %s after the injection "
+                        "cleared (actual %s)" % (advised_after, actual))
+    return {
+        "items": n_items,
+        "slow_top": report.slow_top,
+        "alert_workers": sorted(a for a in alert_workers if a),
+        "advised_peak": advised_peak,
+        "advised_after": advised_after,
+        "fleet_scrapes": len(fleet_docs),
+        "ok": not failures,
+    }, failures
+
+
+def _prov_arm(n_items, on, rec, failures):
+    """One 2-worker drain through the DataLoader with the cross-wire
+    provenance plane fully off or fully on; returns wall seconds."""
+    from petastorm_tpu.loader import DataLoader
+
+    svc = DataService(options=ServiceOptions(arena=False), recovery=rec)
+    svc.add_job(JobSpec("fleet", list(range(n_items)), decode_shared, SCHEMA))
+    workers = [DecodeWorker(svc.worker_address(), svc.token, recovery=rec,
+                            provenance=on, telemetry_s=2.0 if on else None)
+               for _ in range(2)]
+    for w in workers:
+        w.start()
+    reader = ServiceReader(svc.trainer_address(), svc.token, job="fleet",
+                           recovery=rec, arena=False,
+                           telemetry_s=2.0 if on else None)
+    loader = DataLoader(reader, batch_size=ROWS_PER_ITEM, to_device=False,
+                        provenance=True if on else None)
+    items = []
+    t0 = time.monotonic()
+    with loader:
+        for batch in loader:
+            items.append(int(batch["id"][0]) // ROWS_PER_ITEM)
+    wall = time.monotonic() - t0
+    leases = svc.outstanding_leases()
+    svc.stop()
+    _exactness("provenance=%s arm" % on, items, range(n_items), failures)
+    if leases:
+        failures.append("provenance=%s arm: %d leases outstanding"
+                        % (on, leases))
+    return wall
+
+
+def scenario_provoverhead(smoke):
+    """Cross-wire provenance overhead: the same drain with the plane off vs
+    on. Paper target <=1%; the CI assertion allows 20% (noisy hosts, tiny
+    absolute walls)."""
+    failures = []
+    n_items = 96 if smoke else 192
+    ceiling = 0.20
+    rec = _rec()
+    # min-of-2 per arm damps scheduler jitter on small absolute walls
+    off = min(_prov_arm(n_items, False, rec, failures) for _ in range(2))
+    on = min(_prov_arm(n_items, True, rec, failures) for _ in range(2))
+    overhead = (on - off) / max(off, 1e-9)
+    if overhead > ceiling:
+        failures.append("cross-wire provenance overhead %.1f%% exceeds the "
+                        "%.0f%% CI ceiling (off %.3fs, on %.3fs)"
+                        % (100 * overhead, 100 * ceiling, off, on))
+    return {
+        "items": n_items,
+        "wall_off_s": round(off, 4),
+        "wall_on_s": round(on, 4),
+        "overhead_pct": round(100 * overhead, 2),
+        "ceiling_pct": round(100 * ceiling, 1),
+        "ok": not failures,
+    }, failures
+
+
 SCENARIOS = {
     "shared": scenario_shared,
     "elasticity": scenario_elasticity,
     "qos": scenario_qos,
     "linkdeath": scenario_linkdeath,
+    "attribution": scenario_attribution,
+    "provoverhead": scenario_provoverhead,
 }
 DEFAULT_SCENARIOS = ("shared", "elasticity", "qos")
 

@@ -310,6 +310,17 @@ def arm_child():
     return ACTIVE
 
 
+def child_collector():
+    """A PRIVATE per-item collector for cross-wire producers (ISSUE 20: the
+    data service's decode workers). Same record/piggyback/forget contract as
+    :func:`arm_child`, but owned by the caller instead of installed as the
+    process-global ``ACTIVE``: a :class:`DecodeWorker` co-hosted with a
+    trainer thread (tests, single-host fleets) must record its ``svc.decode``
+    spans without hijacking the trainer's hook dispatch — and a dedicated
+    worker process gets the identical code path."""
+    return _ChildCollector()
+
+
 # --------------------------------------------------------------------------------------
 # parent-side recorder
 # --------------------------------------------------------------------------------------

@@ -14,7 +14,10 @@ shutdown) exposing:
   windowed time-series + the (wall, perf) clock anchor identifying this
   source — exactly what ``--merge`` consumes;
 - ``GET /alerts`` — the attached SLO engine's alert list (empty without one);
-- ``GET /healthz`` — liveness probe (200 + uptime JSON).
+- ``GET /healthz`` — liveness probe (200 + uptime JSON);
+- plus any caller-provided ``routes``: ``{path: zero-arg callable}`` served
+  as JSON per request (the data service mounts ``/fleet`` →
+  :meth:`~petastorm_tpu.service.server.DataService.fleet_document` here).
 
 **Security note:** the server binds ``127.0.0.1`` by default — metrics leak
 dataset paths, host names and operational detail, so exposing them beyond the
@@ -50,11 +53,13 @@ class MetricsServer:
     """
 
     def __init__(self, registry=None, host="127.0.0.1", port=0,
-                 slo_engine=None):
+                 slo_engine=None, routes=None):
         from petastorm_tpu.obs.metrics import default_registry
 
         self._registry = registry or default_registry()
         self._slo_engine = slo_engine
+        #: extra GET paths: {"/fleet": zero-arg callable -> JSON-able dict}
+        self._routes = dict(routes or {})
         self._host = host
         self._requested_port = int(port)
         self._httpd = None
@@ -102,11 +107,15 @@ class MetricsServer:
                             {"ok": True,
                              "uptime_s": round(time.time() - server._started,
                                                3)}), "application/json")
+                    elif path in server._routes:
+                        self._send(json.dumps(server._routes[path]()),
+                                   "application/json")
                     else:
                         self._send(json.dumps(
                             {"error": "unknown path %s" % path,
                              "paths": ["/metrics", "/timelines", "/alerts",
-                                       "/healthz"]}),
+                                       "/healthz"]
+                             + sorted(server._routes)}),
                             "application/json", status=404)
                 except BrokenPipeError:
                     pass  # scraper went away mid-response: its problem

@@ -45,22 +45,31 @@ _OPS = {
 #: stats resolvable from a window point (see SloSpec.stat)
 _STATS = ("value", "delta", "rate", "p50", "p99", "share")
 
-_TENANT_LABEL_RE = re.compile(r'tenant="([^"]*)"')
+_LABEL_RES = {
+    "tenant": re.compile(r'tenant="([^"]*)"'),
+    "worker": re.compile(r'worker="([^"]*)"'),
+}
 
 
-def _strip_tenant(full_name):
-    """``'base{a="1",tenant="x"}'`` → ``('base{a="1"}', 'x')``; a series with
-    no ``tenant=`` label returns ``(full_name, None)``. Used by per-tenant
-    spec expansion to match every tenant dimension of one base metric."""
-    m = _TENANT_LABEL_RE.search(full_name)
+def strip_label(full_name, label):
+    """``'base{a="1",tenant="x"}'`` → ``('base{a="1"}', 'x')`` for
+    ``label='tenant'``; a series without that label returns
+    ``(full_name, None)``. Per-dimension spec expansion (``per_tenant`` /
+    ``per_worker``) uses this to match every labeled twin of one base
+    metric; the fleet advisor reads worker-labeled series the same way."""
+    m = _LABEL_RES[label].search(full_name)
     if m is None:
         return full_name, None
-    tenant = m.group(1)
+    value = m.group(1)
     base = full_name[:m.start()] + full_name[m.end():]
     base = base.replace("{,", "{").replace(",,", ",").replace(",}", "}")
     if base.endswith("{}"):
         base = base[:-2]
-    return base, tenant
+    return base, value
+
+
+def _strip_tenant(full_name):
+    return strip_label(full_name, "tenant")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -103,6 +112,10 @@ class SloSpec:
     #: debounce streaks and latches are kept per (spec, tenant), and a firing
     #: alert names the culprit tenant alongside the culprit site
     per_tenant: bool = False
+    #: per-worker dimensioning (ISSUE 20): the same expansion over
+    #: ``metric{...,worker="X"}`` twins — the data service's straggler alert
+    #: debounces independently per decode worker and names the worker id
+    per_worker: bool = False
 
     def __post_init__(self):
         if self.op not in _OPS:
@@ -231,6 +244,9 @@ class SloAlert:
     #: culprit tenant for ``per_tenant`` specs (ISSUE 18): the tenant whose
     #: series breached — None for untagged specs and anomalies
     tenant: str | None = None
+    #: culprit worker for ``per_worker`` specs (ISSUE 20): the decode worker
+    #: whose series breached — the data service's straggler alert names it
+    worker: str | None = None
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -302,16 +318,18 @@ class SloEngine:
             self.windows_evaluated += 1
             fired = []
             for spec in self._specs:
-                if spec.per_tenant:
-                    # per-tenant expansion (ISSUE 18): one independent
-                    # debounce per tenant-labeled twin of the base series
+                if spec.per_tenant or spec.per_worker:
+                    # per-dimension expansion (ISSUE 18/20): one independent
+                    # debounce per labeled twin of the base series
+                    label = "tenant" if spec.per_tenant else "worker"
                     for series in window:
-                        base, tenant = _strip_tenant(series)
-                        if tenant is None or base != spec.metric:
+                        base, who = strip_label(series, label)
+                        if who is None or base != spec.metric:
                             continue
                         value = spec.resolve(window, window_s=window_s,
                                              metric=series)
-                        self._judge(spec, value, fired, tenant=tenant)
+                        self._judge(spec, value, fired,
+                                    **{label: who})
                     continue
                 value = spec.resolve(window, window_s=window_s)
                 self._judge(spec, value, fired)
@@ -327,19 +345,24 @@ class SloEngine:
                 if det.observe(value):
                     anomalies.append((metric, stat, value, det.last_z))
         out = []
-        for spec, value, streak, tenant in fired:
+        for spec, value, streak, tenant, worker in fired:
             out.append(self._fire_breach(spec, value, streak, t,
-                                         tenant=tenant))
+                                         tenant=tenant, worker=worker))
         for metric, stat, value, z in anomalies:
             out.append(self._fire_anomaly(metric, stat, value, z, t))
         return out
 
-    def _judge(self, spec, value, fired, tenant=None):
-        """One spec × one (possibly tenant-dimensioned) value through the
-        debounce state machine. Caller holds ``self._lock``."""
+    def _judge(self, spec, value, fired, tenant=None, worker=None):
+        """One spec × one (possibly tenant-/worker-dimensioned) value through
+        the debounce state machine. Caller holds ``self._lock``."""
         if value is None:
             return  # sparse window: neither breaches nor clears
-        key = spec.name if tenant is None else (spec.name, tenant)
+        if worker is not None:
+            key = (spec.name, "worker", worker)
+        elif tenant is not None:
+            key = (spec.name, tenant)
+        else:
+            key = spec.name
         if spec.ok(value):
             self._breach_streak[key] = 0
             self._breach_latched[key] = False
@@ -349,7 +372,7 @@ class SloEngine:
         if streak >= spec.breach_windows \
                 and not self._breach_latched.get(key):
             self._breach_latched[key] = True
-            fired.append((spec, value, streak, tenant))
+            fired.append((spec, value, streak, tenant, worker))
 
     # -- alert plumbing -----------------------------------------------------------------
 
@@ -389,6 +412,8 @@ class SloEngine:
             labels = {"slo": alert.name}
             if alert.tenant is not None:
                 labels["tenant"] = alert.tenant
+            if alert.worker is not None:
+                labels["worker"] = alert.worker
             self._registry.counter(
                 "ptpu_slo_alerts_total",
                 help="debounced SLO-breach/anomaly alerts", **labels).inc()
@@ -399,15 +424,19 @@ class SloEngine:
             recorder.record("slo_alert", name=alert.name, cause=alert.cause,
                             metric=alert.metric, value=alert.value,
                             threshold=alert.threshold, culprit=alert.culprit,
-                            tenant=alert.tenant)
+                            tenant=alert.tenant, worker=alert.worker)
         return alert
 
-    def _fire_breach(self, spec, value, streak, t, tenant=None):
+    def _fire_breach(self, spec, value, streak, t, tenant=None, worker=None):
         attribution, culprit = self._attribution_snapshot(tenant=tenant)
+        who = ""
+        if tenant is not None:
+            who = " by tenant %r" % tenant
+        elif worker is not None:
+            who = " by worker %r" % worker
         message = ("SLO %r breached%s: %s %s = %.6g violates %s %.6g for %d "
                    "consecutive windows%s"
-                   % (spec.name,
-                      " by tenant %r" % tenant if tenant is not None else "",
+                   % (spec.name, who,
                       spec.metric, spec.stat, value, spec.op,
                       spec.threshold, streak,
                       " — critical path owned by %s" % culprit
@@ -416,7 +445,8 @@ class SloEngine:
             name=spec.name, cause="slo_breach", metric=spec.metric,
             stat=spec.stat, t=t, value=round(float(value), 6),
             threshold=spec.threshold, windows=streak, message=message,
-            attribution=attribution, culprit=culprit, tenant=tenant))
+            attribution=attribution, culprit=culprit, tenant=tenant,
+            worker=worker))
 
     def _fire_anomaly(self, metric, stat, value, z, t):
         attribution, culprit = self._attribution_snapshot()
@@ -440,9 +470,18 @@ class SloEngine:
 
     def breaching(self):
         """Specs currently in a breach streak: ``{name: streak}`` —
-        per-tenant expansions key as ``'name{tenant="x"}'``."""
+        per-tenant expansions key as ``'name{tenant="x"}'`` and per-worker
+        ones as ``'name{worker="x"}'``."""
+
+        def _render(key):
+            if isinstance(key, str):
+                return key
+            if len(key) == 3:
+                return '%s{worker="%s"}' % (key[0], key[2])
+            return '%s{tenant="%s"}' % key
+
         with self._lock:
-            return {n if isinstance(n, str) else '%s{tenant="%s"}' % n: s
+            return {_render(n): s
                     for n, s in self._breach_streak.items() if s}
 
     def collect(self):

@@ -556,6 +556,56 @@ def render_dashboard(metrics, title="", history=None):
                             "  [RETUNED]" if retuned else "",
                             ("  actuations=%d" % acted) if acted else ""))
 
+    # -- data service fleet (ISSUE 19/20): decode-once fan-out, per-worker
+    # straggler fodder, starvation, and the advised-vs-actual fleet size
+    svc_workers = metrics.get("ptpu_svc_workers", 0)
+    svc_decodes = metrics.get("ptpu_svc_decodes_total", 0)
+    if svc_workers or svc_decodes or metrics.get("ptpu_svc_trainers", 0):
+        served = int(metrics.get("ptpu_svc_served_items_total", 0))
+        advised = metrics.get("ptpu_svc_advised_workers", 0)
+        advised_part = ""
+        if advised:
+            gap = int(advised) - int(svc_workers)
+            advised_part = "  advised=%d%s" % (
+                int(advised),
+                "  [GROW +%d]" % gap if gap > 0
+                else ("  [SHRINK %d]" % gap if gap < 0 else ""))
+        lines.append(
+            "service:      workers=%d%s  trainers=%d  jobs=%d  "
+            "leases_out=%d  redispatches=%d"
+            % (int(svc_workers), advised_part,
+               int(metrics.get("ptpu_svc_trainers", 0)),
+               int(metrics.get("ptpu_svc_jobs", 0)),
+               int(metrics.get("ptpu_svc_leases_outstanding", 0)),
+               int(metrics.get("ptpu_svc_lease_redispatch_total", 0))))
+        lines.append(
+            "  decodes=%d (redecodes=%d)  served=%d  fan-out=%s  "
+            "quarantined=%d  starved=%.1fs"
+            % (int(svc_decodes),
+               int(metrics.get("ptpu_svc_redecodes_total", 0)), served,
+               ("%.2fx" % (served / svc_decodes)) if svc_decodes else "n/a",
+               int(metrics.get("ptpu_svc_quarantined_total", 0)),
+               metrics.get("ptpu_svc_starved_seconds_total", 0.0)))
+        leaked = int(metrics.get("ptpu_svc_lease_leaked_total", 0))
+        if leaked:
+            lines.append("  LEAKED LEASES: %d (dispatcher bug)" % leaked)
+        per_worker = _labeled(metrics, "ptpu_svc_worker_decode_seconds")
+        per_worker = {k: v for k, v in per_worker.items()
+                      if isinstance(v, dict) and v.get("count")}
+        if per_worker:
+            slowest = max(v.get("p99", 0) for v in per_worker.values())
+            lines.append("  per-worker decode (ms):  %8s %8s %8s"
+                         % ("p50", "p99", "count"))
+            for w in sorted(per_worker,
+                            key=lambda w: -per_worker[w].get("p99", 0)):
+                h = per_worker[w]
+                flag = " [SLOWEST]" if len(per_worker) > 1 \
+                    and h.get("p99", 0) == slowest else ""
+                lines.append("    %-24s %s %s %8d%s"
+                             % (w, _fmt_ms(h.get("p50", 0)),
+                                _fmt_ms(h.get("p99", 0)),
+                                h.get("count", 0), flag))
+
     # -- everything else, compact (numbers only; histogram summaries as p50s)
     shown_prefixes = ("ptpu_pipeline_", "ptpu_worker_item_seconds",
                       "ptpu_health_", "ptpu_degradations_total",
@@ -563,7 +613,7 @@ def render_dashboard(metrics, title="", history=None):
                       "ptpu_io_footer_cache_", "ptpu_transform_",
                       "ptpu_prov_", "ptpu_dataset_", "ptpu_slo_",
                       "ptpu_ctl_", "ptpu_pagedec_", "ptpu_net_",
-                      "ptpu_io_arena_", "ptpu_tenant_")
+                      "ptpu_io_arena_", "ptpu_tenant_", "ptpu_svc_")
     rest = {n: v for n, v in metrics.items()
             if not n.startswith(shown_prefixes)}
     scalars = [(n, v) for n, v in sorted(rest.items())

@@ -309,6 +309,20 @@ def _anchored_t(line, anchor=None):
     return line.get("ts", 0.0)
 
 
+def export_to_merge_shape(doc, fallback_source="?"):
+    """An in-memory ``/timelines`` export document in the common merge
+    shape (``{"source", "anchor", "metrics", "series"}``) — the same
+    conversion :func:`load_export` applies to a document read from disk.
+    The service's ``/fleet`` aggregator (ISSUE 20) runs live piggybacked
+    peer documents through this before :func:`merge_exports`."""
+    series = {name: tl.get("points", [])
+              for name, tl in (doc.get("timelines") or {}).items()}
+    return {"source": doc.get("source") or fallback_source,
+            "anchor": doc.get("anchor"),
+            "metrics": doc.get("metrics") or {},
+            "series": series}
+
+
 def load_export(path):
     """Load one process's export — a ``/timelines`` JSON document or a
     Reporter JSONL stream — into the common merge shape::
@@ -325,12 +339,8 @@ def load_export(path):
     if '"%s"' % EXPORT_SCHEMA in head.split("\n", 1)[0]:
         with open(path) as f:
             doc = json.load(f)
-        series = {name: tl.get("points", [])
-                  for name, tl in (doc.get("timelines") or {}).items()}
-        return {"source": doc.get("source") or os.path.basename(path),
-                "anchor": doc.get("anchor"),
-                "metrics": doc.get("metrics") or {},
-                "series": series}
+        return export_to_merge_shape(
+            doc, fallback_source=os.path.basename(path))
 
     lines = []
     with open(path) as f:

@@ -10,20 +10,26 @@
   :class:`~petastorm_tpu.loader.DataLoader` unchanged and checkpoints the
   consumed-ordinal watermark the service resumes from.
 - :class:`JobSpec` / :func:`parquet_job` — job definitions.
+- :class:`FleetTelemetry` / :class:`FleetAdvisor` — the ISSUE 20 fleet
+  observability plane: the ``GET /fleet`` aggregator and the read-only
+  autoscaling sensor publishing ``ptpu_svc_advised_workers``.
 
 See ``docs/service.md`` for the wire protocol and the attach/detach
-contract.
+contract, and ``docs/observability.md`` for the fleet plane.
 """
 from petastorm_tpu.service.client import ServiceAttachRejected, ServiceReader
 from petastorm_tpu.service.protocol import PROTOCOL_VERSION, JobSpec, \
-    svc_metrics
+    svc_metrics, svc_worker_metrics
 from petastorm_tpu.service.server import DataService, ServiceOptions
+from petastorm_tpu.service.telemetry import FleetAdvisor, FleetTelemetry
 from petastorm_tpu.service.worker import DecodeWorker, \
     ParquetRowGroupDecoder, parquet_job
 
 __all__ = [
     "DataService",
     "DecodeWorker",
+    "FleetAdvisor",
+    "FleetTelemetry",
     "JobSpec",
     "PROTOCOL_VERSION",
     "ParquetRowGroupDecoder",
@@ -32,4 +38,5 @@ __all__ = [
     "ServiceReader",
     "parquet_job",
     "svc_metrics",
+    "svc_worker_metrics",
 ]

@@ -17,10 +17,30 @@ trainers negotiate the PR 17 host arena at attach: items then arrive as an
 ``arena_key`` instead of pickled columns, and the payload is mapped zero-
 copy out of the shared warm set (a miss — evicted between admit and get —
 is re-served via ``refetch``).
+
+Delivery follows fleet completion order by default — lowest latency, but a
+straggler worker's delay smears across whichever items arrive after it.
+``ordered=True`` re-sequences pushes into plan (epoch, ordinal) order
+through a client-side reorder buffer: deterministic delivery at
+head-of-line latency, which also pins a straggler's cost to its own items
+so the attribution fold below can name the worker.
+
+Cross-wire provenance (ISSUE 20): when a
+:class:`~petastorm_tpu.obs.provenance.ProvenanceRecorder` is wired
+(``DataLoader(provenance=...)`` calls :meth:`ServiceReader.set_provenance`),
+every item push's piggybacked entries — the decode worker's
+``svc.decode@<name>`` blob and the service's ``svc.wire`` span, each with
+its own wall/perf anchor pair — are absorbed through the recorder's
+clock-aligned child merge, and the reader adds its own ``svc.lease_wait``
+span around the blocking receive. The critical-path fold then charges the
+full cross-wire path and ``slow_top`` names the culprit worker. A
+``/timelines``-shaped telemetry document rides the ``want`` credit grants
+on a slow cadence (``telemetry_s``) for the service's ``/fleet`` view.
 """
 from __future__ import annotations
 
 import threading
+import time
 
 from petastorm_tpu.errors import TransportLinkDown
 from petastorm_tpu.recovery import RecoveryOptions
@@ -53,11 +73,26 @@ class ServiceReader:
     is_batched_reader = True
 
     def __init__(self, address, token, job, trainer=None, tenant=None,
-                 recovery=None, credits=8, arena=True):
+                 recovery=None, credits=8, arena=True, registry=None,
+                 telemetry_s=2.0, ordered=False):
         from petastorm_tpu.transport.tcp import TcpChildTransport, \
             parse_address
 
         self._rec = recovery or RecoveryOptions()
+        self._prov = None
+        self._registry = registry
+        self._telemetry_s = None if telemetry_s is None \
+            else max(0.1, float(telemetry_s))
+        self._telemetry_next = time.monotonic()
+        #: ordered=True yields items in plan (epoch, ordinal) order instead
+        #: of fleet completion order: out-of-order pushes park in a reorder
+        #: buffer until the cursor item lands. Deterministic delivery, and a
+        #: straggler worker's latency surfaces AT ITS OWN ITEMS (head-of-
+        #: line), where the provenance fold can name it — completion order
+        #: launders a straggler into uniform inter-arrival waits.
+        self._ordered = bool(ordered)
+        self._pending = {}           # (epoch, ordinal) -> buffered push
+        self._cursor = (0, 0)        # next (epoch, ordinal) to yield (ordered)
         host, port, session = parse_address(address)
         self.job = job
         self.trainer = trainer or "trainer-%d" % session
@@ -119,6 +154,11 @@ class ServiceReader:
         self._credits_out = 0
         self._refetching = set()
         self._end_seen = False
+        # stale reorder-buffered pushes belong to the dead conversation;
+        # they are unconsumed, so the fresh attach re-serves them
+        self._pending = {}
+        self._cursor = (0, 0)
+        self._advance_cursor()
         if reply.get("arena") and self._arena is None:
             from petastorm_tpu.io.arena import process_arena
 
@@ -145,6 +185,62 @@ class ServiceReader:
     def _mark_consumed(self, epoch, ordinal):
         self._consumed.setdefault(int(epoch), set()).add(int(ordinal))
 
+    def _advance_cursor(self):
+        """Move the ordered-mode cursor to the smallest unconsumed
+        (epoch, ordinal) at or after its current position."""
+        if not self._ordered:
+            return
+        e, o = self._cursor
+        while e < self.num_epochs:
+            size = int(self.epoch_sizes.get(e, 0))
+            consumed = self._consumed.get(e, ())
+            while o < size and o in consumed:
+                o += 1
+            if o < size:
+                break
+            e, o = e + 1, 0
+        self._cursor = (e, o)
+
+    def _consume_quarantine(self, msg, epoch, ordinal):
+        """A quarantine push occupies its ordinal: record the cause, mark
+        the slot consumed, and (ordered mode) advance past it."""
+        self._refetching.discard((epoch, ordinal))
+        self._mark_consumed(epoch, ordinal)
+        self.quarantined[(epoch, ordinal)] = msg.get("cause")
+        if self._prov is not None:
+            # the trainer-side twin of the service's exactly-once
+            # quarantine ledger entry
+            self._prov.note_quarantined(
+                epoch, ordinal, int(msg.get("attempts", 1)),
+                msg.get("cause") or "quarantined")
+        self._advance_cursor()
+
+    def _flush_pending(self):
+        """Deliver the reorder-buffered push parked at the cursor, if any.
+        Returns the row, or None when the head of line hasn't arrived yet
+        (or a buffered quarantine / arena miss advanced state row-lessly)."""
+        while True:
+            entry = self._pending.pop(self._cursor, None)
+            if entry is None:
+                return None
+            epoch, ordinal = self._cursor
+            if entry[0] == "quar":
+                self._consume_quarantine(entry[1], epoch, ordinal)
+                continue
+            _, msg, r0, r1 = entry
+            try:
+                cols = self._materialize(msg)
+            except TransportLinkDown:
+                self._attach()  # clears the buffer; the attach re-serves
+                return None
+            if cols is None:
+                return None  # arena miss: the refetch re-serves at cursor
+            self._refetching.discard((epoch, ordinal))
+            self._mark_consumed(epoch, ordinal)
+            self._absorb_prov(msg, epoch, ordinal, r0, r1)
+            self._advance_cursor()
+            return self._row_type(**cols)
+
     def _materialize(self, msg):
         """Columns for one item push: inline payload, or an arena mapping
         pinned by a lease the reader holds until :meth:`stop`. Returns None
@@ -167,16 +263,26 @@ class ServiceReader:
         if self._stopped:
             raise StopIteration
         while True:
-            if self._end_seen and not self._refetching:
+            if self._ordered:
+                row = self._flush_pending()
+                if row is not None:
+                    return row
+            if self._end_seen and not self._refetching and not self._pending:
                 # "end" marks the plan complete, but an in-flight refetch
-                # (arena miss) still owes us its item — drain those first
+                # (arena miss) or a reorder-buffered push still owes us its
+                # item — drain those first
                 self.last_row_consumed = True
                 raise StopIteration
             low_water = max(1, self._credit_target // 2)
+            r0 = time.perf_counter()
             try:
                 if self._credits_out < low_water:
                     grant = self._credit_target - self._credits_out
-                    self._transport.send({"op": OP_WANT, "credits": grant})
+                    out = {"op": OP_WANT, "credits": grant}
+                    doc = self._maybe_telemetry()
+                    if doc is not None:
+                        out["telemetry"] = doc
+                    self._transport.send(out)
                     self._credits_out += grant
                 msg = self._transport.recv()
             except TransportLinkDown:
@@ -185,9 +291,16 @@ class ServiceReader:
             except (EOFError, OSError):
                 self.last_row_consumed = True
                 raise StopIteration from None
+            r1 = time.perf_counter()
             op = msg.get("op")
             if op == OP_ITEM:
                 self._credits_out = max(0, self._credits_out - 1)
+                epoch, ordinal = int(msg["epoch"]), int(msg["ordinal"])
+                if self._ordered and (epoch, ordinal) != self._cursor:
+                    if ordinal not in self._consumed.get(epoch, ()):
+                        self._pending[(epoch, ordinal)] = \
+                            ("item", msg, r0, r1)
+                    continue  # head of line hasn't arrived yet
                 try:
                     cols = self._materialize(msg)
                 except TransportLinkDown:
@@ -195,20 +308,71 @@ class ServiceReader:
                     continue
                 if cols is None:
                     continue  # arena miss: the refetch re-serves it
-                self._refetching.discard(
-                    (int(msg["epoch"]), int(msg["ordinal"])))
-                self._mark_consumed(msg["epoch"], msg["ordinal"])
+                self._refetching.discard((epoch, ordinal))
+                self._mark_consumed(epoch, ordinal)
+                self._absorb_prov(msg, epoch, ordinal, r0, r1)
+                self._advance_cursor()
                 return self._row_type(**cols)
             if op == OP_QUARANTINED:
                 self._credits_out = max(0, self._credits_out - 1)
-                self._refetching.discard(
-                    (int(msg["epoch"]), int(msg["ordinal"])))
-                self._mark_consumed(msg["epoch"], msg["ordinal"])
-                self.quarantined[(int(msg["epoch"]), int(msg["ordinal"]))] \
-                    = msg.get("cause")
+                epoch, ordinal = int(msg["epoch"]), int(msg["ordinal"])
+                if self._ordered and (epoch, ordinal) != self._cursor:
+                    if ordinal not in self._consumed.get(epoch, ()):
+                        self._pending[(epoch, ordinal)] = ("quar", msg)
+                    continue
+                self._consume_quarantine(msg, epoch, ordinal)
                 continue
             if op == OP_END:
                 self._end_seen = True
+
+    def _absorb_prov(self, msg, epoch, ordinal, r0, r1):
+        """Merge the push's cross-wire provenance into the wired recorder:
+        absorb each producer blob through its own clock anchors, then record
+        this reader's blocking receive as ``svc.lease_wait``."""
+        rec = self._prov
+        if rec is None:
+            return
+        try:
+            for entry in msg.get("prov") or ():
+                blob, pid, wall_anchor, perf_anchor = entry
+                rec.absorb_child(tuple(blob), pid, wall_anchor, perf_anchor)
+            rec.add_item_span(epoch, ordinal, "svc.lease_wait", r0, r1)
+            rec.note_delivery(epoch, ordinal, int(msg.get("rows") or 0))
+        except Exception:  # noqa: BLE001 — provenance must never fail delivery
+            from petastorm_tpu.obs.log import degradation
+
+            degradation(
+                "svc_prov_absorb_error",
+                "trainer %r could not absorb cross-wire provenance for "
+                "%d:%d; the item is delivered without attribution",
+                self.trainer, epoch, ordinal)
+
+    def _maybe_telemetry(self):
+        """An export document for the next ``want`` frame when the telemetry
+        cadence elapsed, else None (the credit grants that already flow are
+        the trainer's only service-bound frames)."""
+        if self._telemetry_s is None:
+            return None
+        now = time.monotonic()
+        if now < self._telemetry_next:
+            return None
+        self._telemetry_next = now + self._telemetry_s
+        try:
+            from petastorm_tpu.obs.metrics import default_registry
+            from petastorm_tpu.obs.timeseries import export_document
+
+            reg = self._registry if self._registry is not None \
+                else default_registry()
+            reg.sample_timelines()
+            return export_document(
+                reg, extra={"source": "trainer:%s" % self.trainer})
+        except Exception:  # noqa: BLE001 — telemetry must never fail a credit grant
+            from petastorm_tpu.obs.log import degradation
+
+            degradation("svc_trainer_telemetry_error",
+                        "trainer %r could not export telemetry; the credit "
+                        "grant ships without it", self.trainer)
+            return None
 
     def next(self):
         return self.__next__()
@@ -247,7 +411,9 @@ class ServiceReader:
         pass
 
     def set_provenance(self, recorder):
-        pass
+        """Wire the loader's recorder; pushed items then absorb their
+        cross-wire spans (see the module docstring)."""
+        self._prov = recorder
 
     def set_health(self, monitor):
         pass

@@ -11,9 +11,24 @@ Decode worker <-> service (strict request/response per lease)::
     service: {"op": "lease", "lease": id, "job": j, "epoch": e,
               "ordinal": o, "item": spec_item, ["spec": JobSpec]}
     worker:  {"op": "done", "lease": id, "payload": cols, "rows": n,
-              "meta": {"decode_s": ..., "wall_s": ...}}
-          |  {"op": "fail", "lease": id, "error": str, "permanent": bool}
+              "meta": {"decode_s": ..., "wall_s": ...},
+              ["prov": (blob, pid, wall_anchor, perf_anchor)],
+              ["telemetry": export_doc]}
+          |  {"op": "fail", "lease": id, "error": str, "permanent": bool,
+              ["telemetry": export_doc]}
     service: {"op": "stop"}                            shutdown
+
+Cross-wire provenance (ISSUE 20) piggybacks on the frames that already flow
+— no new conversation ops, and old peers skip the fields they do not know.
+``prov`` is the pool-child blob shape
+(:class:`~petastorm_tpu.obs.provenance._ChildCollector`):
+``((epoch, ordinal, key, spans, annotations), pid, wall_anchor,
+perf_anchor)`` with spans on the sender's ``perf_counter`` timeline and one
+(wall, perf) anchor pair sampled at worker start for clock alignment.
+``telemetry`` is a ``/timelines``-shaped export document
+(:func:`~petastorm_tpu.obs.timeseries.export_document`) shipped on a slow
+cadence; the service's ``/fleet`` aggregator merges the latest one per peer
+on anchored clocks.
 
 A lease conversation is pinned to its link generation by the transport's
 in-flight ledger: a link death mid-conversation re-dispatches the un-acked
@@ -26,10 +41,12 @@ Trainer <-> service (credit-flow push)::
     service: {"op": "attached", "schema": Unischema, "num_epochs": n,
               "epoch_sizes": {epoch: count}, "arena": bool, "version": 1}
           |  {"op": "rejected", "reason": str}
-    trainer: {"op": "want", "credits": n}               grants n more pushes
+    trainer: {"op": "want", "credits": n, ["telemetry": export_doc]}
     service: {"op": "item", "epoch": e, "ordinal": o, "rows": n,
-              "payload": cols | None, ["arena_key": key]}
-          |  {"op": "quarantined", "epoch": e, "ordinal": o, "cause": str}
+              "payload": cols | None, ["arena_key": key],
+              ["prov": [(blob, pid, wall, perf), ...]]}
+          |  {"op": "quarantined", "epoch": e, "ordinal": o, "cause": str,
+              ["attempts": n]}
           |  {"op": "end"}
     trainer: {"op": "refetch", "epoch": e, "ordinal": o}  arena-key miss
     trainer: {"op": "detach", "consumed": {...}}
@@ -195,4 +212,36 @@ def _build_metrics(reg):
         "cache_bytes": reg.gauge(
             "ptpu_svc_cache_bytes",
             help="decoded payload bytes resident in the serve cache"),
+        "starved_seconds": reg.counter(
+            "ptpu_svc_starved_seconds_total",
+            help="seconds trainers sat with credits granted and an empty "
+                 "push queue while their plan still had work — the fleet "
+                 "undersupplied them (the autoscaling pressure signal)"),
+        "advised_workers": reg.gauge(
+            "ptpu_svc_advised_workers",
+            help="decode fleet size the FleetAdvisor currently recommends "
+                 "(read-only sensor: compare with ptpu_svc_workers)"),
+    }
+
+
+def svc_worker_metrics(registry=None):
+    """The ``ptpu_svc_worker_*`` families a :class:`DecodeWorker` owns in its
+    OWN process. Never memoized: the worker resolves these once in
+    ``__init__`` — before the serve loop starts — so the counters home on
+    the registry the caller intended (the PR 19 loader-histogram lesson: a
+    first-touch inside the hot loop races default-registry memoization when
+    a co-hosted test hands each worker a private registry)."""
+    from petastorm_tpu.obs.metrics import default_registry
+
+    reg = registry if registry is not None else default_registry()
+    return {
+        "decodes": reg.counter(
+            "ptpu_svc_worker_decodes_total",
+            help="leases this worker process decoded successfully"),
+        "decode_seconds": reg.counter(
+            "ptpu_svc_worker_decode_seconds_total",
+            help="seconds this worker process spent inside decode callables"),
+        "failures": reg.counter(
+            "ptpu_svc_worker_failures_total",
+            help="leases this worker process failed (transient + permanent)"),
     }

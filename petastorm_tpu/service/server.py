@@ -29,9 +29,25 @@ strict priority tiers (``TenantContext`` priorities high/normal/low), with a
 live per-tenant weight knob (``svc_weight:<tenant>``) the PR 13 controller
 actuates through :func:`petastorm_tpu.control.controller.tenant_qos_rules`.
 Admission control caps attached trainers globally and per tenant.
+
+Fleet observability (ISSUE 20): the service is the natural aggregation
+point for everything crossing it. Per worker it keeps labeled decode
+latency / idle / lease families (``ptpu_svc_worker_*{worker=...}``), absorbs
+the ``/timelines``-shaped telemetry documents workers and trainers piggyback
+on frames they already send, threads each item's cross-wire provenance
+(worker ``svc.decode@`` blob + a service-side ``svc.wire`` span) through to
+the trainers that receive it, counts trainer starvation seconds (credits
+granted, queue empty, plan unfinished — the undersupply signal), and serves
+the merged fleet view at ``GET /fleet`` (:meth:`DataService.fleet_document`).
+With ``ServiceOptions.straggler_decode_p99_s`` set, a ``per_worker`` SLO
+debounces a straggler alert naming the worker, and the read-only
+:class:`~petastorm_tpu.service.telemetry.FleetAdvisor` publishes
+``ptpu_svc_advised_workers`` on the TimelineStore sampling cadence
+(``ServiceOptions.sample_s`` runs that cadence in-process).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -83,11 +99,13 @@ class ServiceOptions:
     """Service-side policy knobs."""
 
     __slots__ = ("host", "max_trainers", "max_trainers_per_tenant", "arena",
-                 "link_redispatch_limit")
+                 "link_redispatch_limit", "straggler_decode_p99_s",
+                 "sample_s", "min_workers", "max_workers")
 
     def __init__(self, host="127.0.0.1", max_trainers=64,
                  max_trainers_per_tenant=None, arena=True,
-                 link_redispatch_limit=None):
+                 link_redispatch_limit=None, straggler_decode_p99_s=None,
+                 sample_s=None, min_workers=1, max_workers=64):
         self.host = host
         self.max_trainers = int(max_trainers)
         self.max_trainers_per_tenant = max_trainers_per_tenant
@@ -99,11 +117,22 @@ class ServiceOptions:
         #: None derives a generous multiple of the poison budget — plain
         #: link flaps must re-dispatch, never quarantine
         self.link_redispatch_limit = link_redispatch_limit
+        #: per-worker window decode p99 above this arms the straggler SLO
+        #: (debounced per worker) AND the advisor's replace-a-straggler term;
+        #: None disables the straggler alert
+        self.straggler_decode_p99_s = straggler_decode_p99_s
+        #: run an in-process timeline sampling cadence at this period so the
+        #: SLO engine + FleetAdvisor see windows without an external Reporter;
+        #: None = whoever owns the registry samples (loader Reporter, tests)
+        self.sample_s = sample_s
+        #: FleetAdvisor clamp — the advice never leaves [min, max]
+        self.min_workers = int(min_workers)
+        self.max_workers = int(max_workers)
 
 
 class _Trainer:
     __slots__ = ("tid", "session", "tenant", "priority", "arena", "queue",
-                 "credits", "remaining", "end_sent")
+                 "credits", "remaining", "end_sent", "transport")
 
     def __init__(self, tid, session, tenant, priority, arena):
         self.tid = tid
@@ -115,16 +144,30 @@ class _Trainer:
         self.credits = 0
         self.remaining = {}      # epoch -> set(ordinal) not yet queued
         self.end_sent = False
+        #: the serve loop's transport while attached — queue producers
+        #: ``wake()`` it so a fresh entry flushes without riding out the
+        #: poll tick (delivery latency would quantize to it otherwise)
+        self.transport = None
 
     def finished(self):
         return not self.queue and all(not s for s in self.remaining.values())
+
+
+def _nudge(trainer):
+    """Wake ``trainer``'s serve loop out of its wakeable poll so the entry
+    just queued flushes immediately. Safe anywhere: a no-op before the serve
+    loop attaches (attach replay entries flush on its first pass) and never
+    blocks or raises."""
+    transport = trainer.transport
+    if transport is not None:
+        transport.wake()
 
 
 class _Job:
     __slots__ = ("spec", "plan", "dispatcher", "epoch_sizes", "trainers",
                  "need", "done_with", "quarantined", "fail_attempts",
                  "link_attempts", "arena_admitted", "inline_keys", "rows_of",
-                 "decoded", "pass_value")
+                 "decoded", "pass_value", "prov_of")
 
     def __init__(self, spec):
         self.spec = spec
@@ -151,21 +194,26 @@ class _Job:
         self.rows_of = {}        # (epoch, ordinal) -> delivered row count
         self.decoded = set()     # keys ever completed (second pass = redecode)
         self.pass_value = 0.0    # stride-scheduling virtual time
+        #: (epoch, ordinal) -> [(blob, pid, wall, perf), ...] cross-wire
+        #: provenance entries riding every push of that item
+        self.prov_of = {}
 
     def tier(self):
         return PRIORITY_TIERS.get(self.spec.priority, 1)
 
 
 class _Lease:
-    __slots__ = ("lease_id", "job", "epoch", "ordinal", "slot", "t0")
+    __slots__ = ("lease_id", "job", "epoch", "ordinal", "slot", "t0",
+                 "worker")
 
-    def __init__(self, lease_id, job, epoch, ordinal, slot):
+    def __init__(self, lease_id, job, epoch, ordinal, slot, worker=None):
         self.lease_id = lease_id
         self.job = job
         self.epoch = epoch
         self.ordinal = ordinal
         self.slot = slot
         self.t0 = time.monotonic()
+        self.worker = worker
 
 
 class DataService:
@@ -183,11 +231,16 @@ class DataService:
     """
 
     def __init__(self, options=None, recovery=None, registry=None):
+        from petastorm_tpu.obs.metrics import default_registry
+        from petastorm_tpu.service.telemetry import FleetAdvisor, \
+            FleetTelemetry
         from petastorm_tpu.transport.tcp import TcpHub
 
         self._opt = options or ServiceOptions()
         self._rec = recovery or RecoveryOptions()
-        self._m = svc_metrics(registry)
+        self._registry = registry if registry is not None \
+            else default_registry()
+        self._m = svc_metrics(self._registry)
         self._cond = threading.Condition()
         self._stop = threading.Event()
         self._jobs = {}
@@ -198,12 +251,45 @@ class DataService:
         self._next_lease_id = 1
         self._next_slot = 0
         self._tenant_weight = {}
+        # the service's clock-anchor pair: its svc.wire spans ship perf
+        # times relative to this, exactly like a pool child's piggyback
+        self._wall_anchor = time.time()
+        self._perf_anchor = time.perf_counter()
+        self._workers = {}        # worker name -> health/handle dict
+        self._worker_tenants = {} # worker name -> set(tenant)
+        self._telemetry = FleetTelemetry(self, self._registry)
+        store = self._registry.timeline_store()
+        self._advisor = FleetAdvisor(
+            self._registry,
+            straggler_p99_s=self._opt.straggler_decode_p99_s,
+            min_workers=self._opt.min_workers,
+            max_workers=self._opt.max_workers).attach(store)
+        self._slo = None
+        if self._opt.straggler_decode_p99_s is not None:
+            from petastorm_tpu.obs.slo import SloEngine, SloSpec
+
+            self._slo = SloEngine(specs=[SloSpec(
+                name="svc-straggler",
+                metric="ptpu_svc_worker_decode_seconds",
+                stat="p99", op="<=",
+                threshold=float(self._opt.straggler_decode_p99_s),
+                per_worker=True, breach_windows=2, min_count=1,
+                description="a decode worker's window p99 ran past the "
+                            "straggler threshold — the fleet is dragging "
+                            "an outlier")],
+                registry=self._registry).attach(store)
         self._arena = None
         if self._opt.arena:
             from petastorm_tpu.io import arena as arena_mod
 
             self._arena = arena_mod.process_arena()
         self._hub = TcpHub(self._rec, host=self._opt.host)
+        if self._opt.sample_s:
+            t = threading.Thread(target=self._sample_loop,
+                                 args=(float(self._opt.sample_s),),
+                                 daemon=True, name="ptpu-svc-sampler")
+            self._threads.append(t)
+            t.start()
 
     # -- public surface -----------------------------------------------------------------
 
@@ -266,6 +352,99 @@ class DataService:
         with self._cond:
             return len(self._leases)
 
+    # -- fleet observability surface (ISSUE 20) -----------------------------------------
+
+    @property
+    def slo_engine(self):
+        """The straggler SLO engine (None unless
+        ``ServiceOptions.straggler_decode_p99_s`` is set)."""
+        return self._slo
+
+    @property
+    def advisor(self):
+        """The read-only :class:`~petastorm_tpu.service.telemetry
+        .FleetAdvisor` publishing ``ptpu_svc_advised_workers``."""
+        return self._advisor
+
+    def fleet_document(self):
+        """The ``GET /fleet`` JSON document: per-worker health, advice,
+        straggler alerts, and every peer's telemetry merged with the
+        service's own export on anchored clocks."""
+        return self._telemetry.document()
+
+    def metrics_server(self, host="127.0.0.1", port=0):
+        """A started :class:`~petastorm_tpu.obs.serve.MetricsServer` over the
+        service's registry with ``/fleet`` mounted and the straggler SLO
+        engine wired into ``/alerts``. Caller stops it."""
+        from petastorm_tpu.obs.serve import MetricsServer
+
+        return MetricsServer(self._registry, host=host, port=port,
+                             slo_engine=self._slo,
+                             routes={"/fleet": self.fleet_document}).start()
+
+    def worker_health(self):
+        """Per-worker health gauges: connection state, outstanding leases +
+        oldest lease age, cumulative decode p50/p99, idle/lease totals, and
+        the tenants the worker has decoded for."""
+        now = time.monotonic()
+        with self._cond:
+            leases = {}
+            for lease in self._leases.values():
+                if lease.worker is not None:
+                    leases.setdefault(lease.worker, []).append(lease.t0)
+            out = {}
+            for name, info in self._workers.items():
+                mine = leases.get(name, ())
+                out[name] = {
+                    "connected": info["connected"],
+                    "leases_outstanding": len(mine),
+                    "oldest_lease_age_s":
+                        round(now - min(mine), 3) if mine else 0.0,
+                    "decode_p50_s": info["hist"].percentile(0.5),
+                    "decode_p99_s": info["hist"].percentile(0.99),
+                    "leases_total": info["leases"].value,
+                    "idle_seconds_total": round(info["idle"].value, 3),
+                    "tenants": sorted(
+                        t for t in self._worker_tenants.get(name, ())
+                        if t is not None),
+                }
+            return out
+
+    def advice(self):
+        """The advisor's latest decision detail (None before the first
+        sampled window with a connected fleet)."""
+        return self._advisor.last_detail
+
+    def straggler_alerts(self):
+        """Debounced straggler alerts, enriched with the provenance site the
+        trainer-side fold charges (``svc.decode@<worker>``) and the tenants
+        the worker served — [] with no SLO engine armed."""
+        if self._slo is None:
+            return []
+        out = []
+        for alert in self._slo.alerts():
+            worker = getattr(alert, "worker", None)
+            if worker is None:
+                continue
+            with self._cond:
+                tenants = sorted(
+                    t for t in self._worker_tenants.get(worker, ())
+                    if t is not None)
+            out.append({"slo": alert.name, "worker": worker,
+                        "site": "svc.decode@%s" % worker,
+                        "tenants": tenants, "value": alert.value,
+                        "threshold": alert.threshold, "t": alert.t})
+        return out
+
+    def _sample_loop(self, period):
+        while not self._stop.wait(period):
+            try:
+                self._registry.sample_timelines()
+            except Exception:  # noqa: BLE001 — sampling must never kill the service
+                _degradation("svc_sample_error",
+                             "data service timeline sampling failed; the "
+                             "SLO/advisor cadence skipped a window")
+
     def stop(self):
         """Drain and shut down: wakes every loop, closes the hub, joins the
         loops, and counts any lease STILL outstanding after they exit as
@@ -277,6 +456,9 @@ class DataService:
             self._stop.set()
             self._cond.notify_all()
             transports = list(self._transports.values())
+        self._advisor.detach()
+        if self._slo is not None:
+            self._slo.detach()
         for transport in transports:
             transport.close()  # wakes loops blocked in recv/poll
         self._hub.close()
@@ -328,7 +510,7 @@ class DataService:
             self._next_slot += 1
             return slot
 
-    def _try_claim(self, slot):
+    def _try_claim(self, slot, worker=None):
         """One dispatch decision under the lock: strict priority tiers, then
         stride scheduling (min virtual time / tenant weight) across jobs with
         attached trainers and pending work."""
@@ -343,7 +525,8 @@ class DataService:
             (epoch, ordinal, _idx), _upcoming = claim
             weight = max(self._tenant_weight.get(job.spec.tenant, 1.0), 1e-3)
             job.pass_value += 1.0 / weight
-            lease = _Lease(self._next_lease_id, job, epoch, ordinal, slot)
+            lease = _Lease(self._next_lease_id, job, epoch, ordinal, slot,
+                           worker)
             self._next_lease_id += 1
             self._leases[lease.lease_id] = lease
             self._m["leases"].inc()
@@ -351,12 +534,12 @@ class DataService:
             return lease
         return None
 
-    def _next_lease(self, slot, timeout=0.2):
+    def _next_lease(self, slot, timeout=0.2, worker=None):
         with self._cond:
-            lease = self._try_claim(slot)
+            lease = self._try_claim(slot, worker)
             if lease is None and not self._stop.is_set():
                 self._cond.wait(timeout)
-                lease = self._try_claim(slot)
+                lease = self._try_claim(slot, worker)
             return lease
 
     def _requeue_lease(self, lease_id, link=False):
@@ -391,10 +574,18 @@ class DataService:
                 job.dispatcher.withdraw(slot)
             self._cond.notify_all()
 
-    def _complete(self, lease_id, payload, rows, meta):
+    def _complete(self, lease_id, payload, rows, meta, prov=None, wire=None):
         """A decode finished: charge its tenant, fan the payload out to every
         attached trainer that still needs it, admit it to the arena, and drop
-        the service-side reference."""
+        the service-side reference.
+
+        ``prov`` is the worker's piggybacked ``(blob, pid, wall, perf)``
+        entry; ``wire`` the service-side ``(perf0, perf1)`` send→reply stamp.
+        Both land in ``job.prov_of`` and ride every push of this item. The
+        service entry ships ``-os.getpid()`` as its pid: co-hosted fleets
+        (worker threads in the trainer's process) would otherwise collide
+        with the worker blob's pid and trip the recorder's same-pid retry
+        replacement, dropping the decode spans it just absorbed."""
         with self._cond:
             lease = self._leases.pop(lease_id, None)
             if lease is None:
@@ -403,6 +594,26 @@ class DataService:
             job, key = lease.job, (lease.epoch, lease.ordinal)
             job.done_with.add(key)
             job.rows_of[key] = rows
+            entries = []
+            if prov is not None:
+                entries.append(tuple(prov))
+            if wire is not None:
+                from petastorm_tpu.obs.provenance import item_identity
+
+                _e, _o, ikey = item_identity(
+                    (lease.epoch, lease.ordinal,
+                     job.spec.items[lease.ordinal]))
+                annotations = {} if lease.worker is None \
+                    else {"svc_worker": lease.worker}
+                entries.append((
+                    (lease.epoch, lease.ordinal, ikey,
+                     [("svc.wire", wire[0], wire[1], None)], annotations),
+                    -os.getpid(), self._wall_anchor, self._perf_anchor))
+            if entries:
+                job.prov_of[key] = entries
+            if lease.worker is not None and job.spec.tenant is not None:
+                self._worker_tenants.setdefault(
+                    lease.worker, set()).add(job.spec.tenant)
             if key in job.decoded:
                 self._m["redecodes"].inc()
             job.decoded.add(key)
@@ -416,6 +627,7 @@ class DataService:
                     lease.ordinal)
                 trainer.queue.append(("item", lease.epoch, lease.ordinal,
                                       payload, rows))
+                _nudge(trainer)
                 served += 1
             self._m["decodes"].inc()
             self._m["decode_seconds"].inc(
@@ -472,6 +684,7 @@ class DataService:
                 continue
             trainer.remaining.get(epoch, set()).discard(ordinal)
             trainer.queue.append(("quar", epoch, ordinal, cause))
+            _nudge(trainer)
         self._m["quarantined"].inc()
         self._cond.notify_all()
         _degradation(
@@ -481,6 +694,36 @@ class DataService:
             ordinal, cause, once=False)
 
     # -- worker loop --------------------------------------------------------------------
+
+    def _register_worker(self, wname, session):
+        """Resolve this worker's labeled health families ONCE at READY —
+        never inside the lease loop (get-or-create takes the registry lock).
+        A reconnecting worker of the same name reclaims its families: the
+        totals are the worker's story, not the link's."""
+        reg = self._registry
+        info = {
+            "session": session,
+            "connected": True,
+            "hist": reg.histogram(
+                "ptpu_svc_worker_decode_seconds",
+                help="per-lease decode seconds as reported by this worker "
+                     "(the straggler SLO and FleetAdvisor read the window "
+                     "p99 of this family)",
+                worker=wname),
+            "idle": reg.counter(
+                "ptpu_svc_worker_idle_seconds_total",
+                help="seconds this worker's dispatch slot waited with no "
+                     "claimable work (the fleet-shrink signal)",
+                worker=wname),
+            "leases": reg.counter(
+                "ptpu_svc_worker_leases_total",
+                help="leases dispatched to this worker that reached a "
+                     "verdict (done or fail)",
+                worker=wname),
+        }
+        with self._cond:
+            self._workers[wname] = info
+        return info
 
     def _worker_loop(self, session, transport):
         slot = self._alloc_slot()
@@ -494,12 +737,17 @@ class DataService:
                 return
             if msg.get("op") != OP_READY:
                 return
+            wname = msg.get("worker") or "worker-%d" % session
+            winfo = self._register_worker(wname, session)
             self._m["workers"].inc()
             counted = True
             announced = set()
             while not self._stop.is_set():
-                lease = self._next_lease(slot)
+                i0 = time.perf_counter()
+                lease = self._next_lease(slot, worker=wname)
                 if lease is None:
+                    # the whole timed-out claim was idle capacity
+                    winfo["idle"].inc(time.perf_counter() - i0)
                     continue
                 job = lease.job
                 out = {"op": OP_LEASE, "lease": lease.lease_id,
@@ -509,6 +757,7 @@ class DataService:
                 if job.spec.job not in announced:
                     out["spec"] = job.spec.wire_spec()
                 transport.track(lease.lease_id)
+                ws0 = time.perf_counter()
                 try:
                     transport.send(out)
                     reply = transport.recv()
@@ -523,13 +772,22 @@ class DataService:
                     self._requeue_lease(lease.lease_id, link=True)
                     self._withdraw_slot(slot)
                     return
+                ws1 = time.perf_counter()
                 transport.settle()
+                doc = reply.get("telemetry")
+                if doc:
+                    self._telemetry.note_peer("worker", wname, doc)
                 op = reply.get("op")
                 if op == OP_DONE and reply.get("lease") == lease.lease_id:
+                    meta = reply.get("meta") or {}
+                    winfo["leases"].inc()
+                    winfo["hist"].observe(
+                        max(0.0, float(meta.get("decode_s", 0.0))))
                     self._complete(lease.lease_id, reply.get("payload"),
-                                   reply.get("rows"),
-                                   reply.get("meta") or {})
+                                   reply.get("rows"), meta,
+                                   prov=reply.get("prov"), wire=(ws0, ws1))
                 elif op == OP_FAIL and reply.get("lease") == lease.lease_id:
+                    winfo["leases"].inc()
                     self._fail(lease.lease_id, reply.get("error"),
                                bool(reply.get("permanent")))
                 else:
@@ -548,6 +806,11 @@ class DataService:
             self._withdraw_slot(slot)
             if counted:
                 self._m["workers"].dec()
+                with self._cond:
+                    info = self._workers.get(wname)
+                    if info is not None and info["session"] == session:
+                        info["connected"] = False
+                self._telemetry.drop_peer("worker", wname)
             try:
                 transport.send({"op": OP_STOP})
             except Exception:  # graftlint: disable=GL-O002 — best-effort goodbye on a possibly-dead link
@@ -692,6 +955,7 @@ class DataService:
         """Remove the trainer; its unconsumed interest leaves every need set
         (no loss: a re-attach recomputes from the client's watermark)."""
         with self._cond:
+            trainer.transport = None
             job.trainers.pop(trainer.tid, None)
             for key in list(job.need):
                 s = job.need[key]
@@ -702,20 +966,27 @@ class DataService:
             self._m["detaches"].inc()
             self._m["trainers"].dec()
             self._cond.notify_all()
+        self._telemetry.drop_peer("trainer", trainer.tid)
 
     def _entry_msg(self, job, trainer, entry):
         kind = entry[0]
         if kind == "quar":
             _, epoch, ordinal, cause = entry
+            key = (epoch, ordinal)
             return {"op": OP_QUARANTINED, "epoch": epoch,
-                    "ordinal": ordinal, "cause": cause}, 0
+                    "ordinal": ordinal, "cause": cause,
+                    "attempts": max(1, job.fail_attempts.get(key, 0)
+                                    + job.link_attempts.get(key, 0))}, 0
         if kind == "arena":
             _, epoch, ordinal = entry
-            return {"op": OP_ITEM, "epoch": epoch, "ordinal": ordinal,
-                    "rows": job.rows_of.get((epoch, ordinal)),
-                    "payload": None,
-                    "arena_key": ("svc", job.spec.job, epoch, ordinal)}, \
-                job.rows_of.get((epoch, ordinal)) or 0
+            msg = {"op": OP_ITEM, "epoch": epoch, "ordinal": ordinal,
+                   "rows": job.rows_of.get((epoch, ordinal)),
+                   "payload": None,
+                   "arena_key": ("svc", job.spec.job, epoch, ordinal)}
+            prov = job.prov_of.get((epoch, ordinal))
+            if prov:
+                msg["prov"] = prov
+            return msg, job.rows_of.get((epoch, ordinal)) or 0
         _, epoch, ordinal, payload, rows = entry
         msg = {"op": OP_ITEM, "epoch": epoch, "ordinal": ordinal,
                "rows": rows}
@@ -725,6 +996,11 @@ class DataService:
             msg["arena_key"] = ("svc", job.spec.job, epoch, ordinal)
         else:
             msg["payload"] = payload
+        prov = job.prov_of.get((epoch, ordinal))
+        if prov:
+            # every fan-out push carries the item's cross-wire provenance:
+            # each receiving trainer's recorder absorbs it independently
+            msg["prov"] = prov
         return msg, rows or 0
 
     def _serve(self, transport, job, trainer):
@@ -732,6 +1008,8 @@ class DataService:
         want/refetch/detach. Returns "detach" | "dead" | "stop", or
         ``("attach", msg)`` when a redialed peer's fresh attach raced ahead
         of this side's link-death notice."""
+        with self._cond:
+            trainer.transport = transport
         while not self._stop.is_set():
             to_send = []
             with self._cond:
@@ -739,6 +1017,10 @@ class DataService:
                     to_send.append(trainer.queue.pop(0))
                     trainer.credits -= 1
                 finished = trainer.finished() and not trainer.end_sent
+                # the undersupply signal: credits granted, nothing to push,
+                # plan unfinished — the decode fleet is behind this trainer
+                starving = trainer.credits > 0 and not trainer.queue \
+                    and not trainer.finished()
             try:
                 for entry in to_send:
                     msg, rows = self._entry_msg(job, trainer, entry)
@@ -751,7 +1033,13 @@ class DataService:
                 if finished:
                     transport.send({"op": OP_END})
                     trainer.end_sent = True
-                if not transport.poll(TICK_S):
+                w0 = time.monotonic()
+                if not transport.poll(TICK_S, wakeable=True):
+                    if starving:
+                        # a wake() can end the poll early — charge the time
+                        # actually spent waiting, not the full tick
+                        self._m["starved_seconds"].inc(
+                            max(0.0, time.monotonic() - w0))
                     continue
                 msg = transport.recv()
             except (TransportLinkDown, OSError):
@@ -760,6 +1048,9 @@ class DataService:
                 return "dead"
             op = msg.get("op")
             if op == OP_WANT:
+                doc = msg.get("telemetry")
+                if doc:
+                    self._telemetry.note_peer("trainer", trainer.tid, doc)
                 with self._cond:
                     trainer.credits += max(0, int(msg.get("credits", 0)))
             elif op == OP_REFETCH:
@@ -787,6 +1078,7 @@ class DataService:
             if key in job.quarantined:
                 trainer.queue.append(("quar", epoch, ordinal,
                                       job.quarantined[key]))
+                _nudge(trainer)
                 self._cond.notify_all()
                 return
             job.need.setdefault(key, set()).add(trainer.tid)

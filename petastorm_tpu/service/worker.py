@@ -12,9 +12,21 @@ service is gone and the worker exits.
 Decode callables arrive over the wire in the first lease of each job per
 link generation (``JobSpec.wire_spec()``), so a worker process needs no
 job-specific code — only the modules the pickled callable imports.
+
+Cross-wire provenance (ISSUE 20): the worker arms a private per-item
+collector (:func:`~petastorm_tpu.obs.provenance.child_collector` — the pool
+``_child_worker`` pattern) and records one ``svc.decode@<name>`` span per
+lease on its own ``perf_counter`` timeline. The blob piggybacks on the DONE
+reply together with the (wall, perf) anchor pair sampled at construction, so
+the trainer's recorder clock-aligns it exactly like pool-child absorption
+and ``slow_top`` names the culprit worker end to end. A ``/timelines``-shaped
+telemetry document rides the same replies on a slow cadence
+(``telemetry_s``) — the service's ``/fleet`` aggregator merges the latest
+one per worker.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -23,6 +35,7 @@ from petastorm_tpu.errors import (
     PagedecCorruptError,
     TransportLinkDown,
 )
+from petastorm_tpu.obs.provenance import child_collector
 from petastorm_tpu.recovery import RecoveryOptions
 from petastorm_tpu.service.protocol import (
     OP_DONE,
@@ -30,6 +43,7 @@ from petastorm_tpu.service.protocol import (
     OP_LEASE,
     OP_READY,
     OP_STOP,
+    svc_worker_metrics,
 )
 
 
@@ -57,7 +71,8 @@ class DecodeWorker:
     the service's hello ``token`` and decode leases until told to stop."""
 
     def __init__(self, address, token, recovery=None, name=None,
-                 decoders=None):
+                 decoders=None, registry=None, provenance=True,
+                 telemetry_s=2.0):
         from petastorm_tpu.transport.tcp import TcpChildTransport, \
             parse_address
 
@@ -70,6 +85,18 @@ class DecodeWorker:
         #: from lease messages land here too
         self._decoders = dict(decoders or {})
         self._thread = None
+        #: worker-side counters resolved HERE, before the serve loop starts,
+        #: so they home on the caller's registry (see svc_worker_metrics)
+        self._registry = registry
+        self._wm = svc_worker_metrics(registry)
+        self._collector = child_collector() if provenance else None
+        # the clock-alignment anchor pair: wall trusted ONCE, here; every
+        # span ships perf_counter times relative to this anchor
+        self._wall_anchor = time.time()
+        self._perf_anchor = time.perf_counter()
+        self._telemetry_s = None if telemetry_s is None \
+            else max(0.1, float(telemetry_s))
+        self._telemetry_next = time.monotonic()
 
     def run(self):
         """Dial and serve until the service stops or the link dies for good.
@@ -111,21 +138,68 @@ class DecodeWorker:
         t0 = time.monotonic()
         decode = self._decoders.get(msg.get("job"))
         if decode is None:
-            return {"op": OP_FAIL, "lease": msg["lease"],
-                    "error": "no decoder for job %r" % msg.get("job"),
-                    "permanent": False}
+            self._wm["failures"].inc()
+            return self._with_telemetry(
+                {"op": OP_FAIL, "lease": msg["lease"],
+                 "error": "no decoder for job %r" % msg.get("job"),
+                 "permanent": False})
+        rec = None
+        if self._collector is not None:
+            rec = self._collector.open_item(
+                (msg.get("epoch", 0), msg.get("ordinal", 0), msg.get("item")))
         try:
             td0 = time.monotonic()
+            p0 = time.perf_counter()
             cols, rows = _normalize(decode(msg["item"]))
+            p1 = time.perf_counter()
             decode_s = time.monotonic() - td0
         except Exception as exc:  # noqa: BLE001 — every decode error is a wire verdict
-            return {"op": OP_FAIL, "lease": msg["lease"],
-                    "error": "%s: %s" % (type(exc).__name__, exc),
-                    "permanent": _is_permanent(exc)}
-        return {"op": OP_DONE, "lease": msg["lease"], "payload": cols,
-                "rows": rows,
-                "meta": {"decode_s": decode_s,
-                         "wall_s": time.monotonic() - t0}}
+            self._wm["failures"].inc()
+            return self._with_telemetry(
+                {"op": OP_FAIL, "lease": msg["lease"],
+                 "error": "%s: %s" % (type(exc).__name__, exc),
+                 "permanent": _is_permanent(exc)})
+        self._wm["decodes"].inc()
+        self._wm["decode_seconds"].inc(decode_s)
+        reply = {"op": OP_DONE, "lease": msg["lease"], "payload": cols,
+                 "rows": rows,
+                 "meta": {"decode_s": decode_s,
+                          "wall_s": time.monotonic() - t0}}
+        if rec is not None:
+            rec.add_span("svc.decode@%s" % self.name, p0, p1)
+            rec.annotate("svc_worker", self.name)
+            blob = self._collector.close_item(rec)
+            if blob is not None:
+                reply["prov"] = (blob, os.getpid(), self._wall_anchor,
+                                 self._perf_anchor)
+        return self._with_telemetry(reply)
+
+    def _with_telemetry(self, reply):
+        """Piggyback a ``/timelines``-shaped export on this reply when the
+        telemetry cadence elapsed (strict request/response conversation: the
+        replies that already flow are the only frames we get)."""
+        if self._telemetry_s is None:
+            return reply
+        now = time.monotonic()
+        if now < self._telemetry_next:
+            return reply
+        self._telemetry_next = now + self._telemetry_s
+        try:
+            from petastorm_tpu.obs.metrics import default_registry
+            from petastorm_tpu.obs.timeseries import export_document
+
+            reg = self._registry if self._registry is not None \
+                else default_registry()
+            reg.sample_timelines()
+            reply["telemetry"] = export_document(
+                reg, extra={"source": "worker:%s" % self.name})
+        except Exception:  # noqa: BLE001 — telemetry must never fail a lease
+            from petastorm_tpu.obs.log import degradation
+
+            degradation("svc_worker_telemetry_error",
+                        "decode worker %r could not export telemetry; the "
+                        "reply ships without it", self.name)
+        return reply
 
     def start(self):
         """Run :meth:`run` on a daemon thread; returns the thread."""

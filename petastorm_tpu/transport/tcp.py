@@ -50,6 +50,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import select
 import socket
 import struct
 import threading
@@ -134,6 +135,11 @@ class _FramedLink(Transport):
         self._tx_tenant = None
         self._warned_downgrade = False
         self.peer_tenant = None
+        #: lazy self-pipe for wakeable polls (created on first
+        #: ``poll(wakeable=True)``): lets another thread return a poller
+        #: control without a byte on the wire (``wake()``)
+        self._wake_rx = None
+        self._wake_tx = None
 
     # -- tenant tagging (ISSUE 18) ------------------------------------------------------
 
@@ -236,13 +242,16 @@ class _FramedLink(Transport):
         with self._cv:
             self._closed = True
             sock, self._sock = self._sock, None
+            wake_rx, self._wake_rx = self._wake_rx, None
+            wake_tx, self._wake_tx = self._wake_tx, None
             self._cv.notify_all()
         self._hb_stop.set()
-        if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+        for s in (sock, wake_rx, wake_tx):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
 
     # -- heartbeats ---------------------------------------------------------------------
 
@@ -379,13 +388,24 @@ class _FramedLink(Transport):
 
     # -- receive path -------------------------------------------------------------------
 
-    def poll(self, timeout=0.0):
+    def poll(self, timeout=0.0, wakeable=False):
         """True when a complete app frame is buffered; reads/demultiplexes
         inbound traffic (heartbeats, acks) meanwhile. Raises
         :class:`TransportLinkDown` on any link fault, including a link that
         was replaced mid-conversation (the in-flight ledger pins the dispatch
-        generation) and a heartbeat-detected half-open link."""
+        generation) and a heartbeat-detected half-open link.
+
+        The wait honors ``timeout`` precisely (readability is select-gated,
+        so an idle link never rounds the wait up to the socket tick). With
+        ``wakeable=True`` another thread's :meth:`wake` interrupts the wait
+        and poll returns False early — the service's serve loop uses this to
+        flush a freshly decoded item the moment it lands instead of riding
+        out the poll tick (delivery latency would otherwise quantize to it,
+        and the trainer-side provenance fold would charge the slack to
+        ``svc.lease_wait``)."""
         deadline = time.monotonic() + max(0.0, timeout)
+        if wakeable:
+            self._ensure_wake()
         while True:
             with self._cv:
                 if self._inflight is not None \
@@ -401,15 +421,69 @@ class _FramedLink(Transport):
                 if self._app:
                     return True
                 sock = self._sock
+                wake_rx = self._wake_rx if wakeable else None
             if sock is None:
                 self._link_down(TransportLinkDown(
                     "transport link %s is down" % self._site_key))
-            self._read_once(sock)
-            with self._cv:
-                if self._app:
-                    return True
+            if self._rbuf:
+                # leftover bytes from the hello/ack exchange or a previous
+                # partial parse may already complete a frame without a read
+                self._drain_frames(sock)
+                with self._cv:
+                    if self._app:
+                        return True
+            remaining = deadline - time.monotonic()
+            rlist = [sock] if wake_rx is None else [sock, wake_rx]
+            try:
+                ready = select.select(rlist, (), (),
+                                      max(0.0, min(remaining, TICK)))[0]
+            except (OSError, ValueError):
+                # fd died under us (close/generation swap): the recv path
+                # owns the canonical link-death handling
+                self._read_once(sock)
+                ready = ()
+            if wake_rx is not None and wake_rx in ready:
+                self._drain_wake(wake_rx)
+                return False  # woken: the caller's queue check is the point
+            if sock in ready:
+                self._read_once(sock)
+                with self._cv:
+                    if self._app:
+                        return True
+            else:
+                self._police_staleness(sock)
             if time.monotonic() >= deadline:
                 return False
+
+    def _ensure_wake(self):
+        with self._cv:
+            if self._wake_rx is None and not self._closed:
+                rx, tx = socket.socketpair()
+                rx.setblocking(False)
+                tx.setblocking(False)
+                self._wake_rx, self._wake_tx = rx, tx
+
+    @staticmethod
+    def _drain_wake(wake_rx):
+        try:
+            while wake_rx.recv(64):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
+    def wake(self):
+        """Nudge a thread blocked in ``poll(wakeable=True)`` so it re-checks
+        caller state now. No-op until the first wakeable poll armed the
+        self-pipe; never blocks (a pending nudge already buffered is
+        enough)."""
+        with self._cv:
+            tx = self._wake_tx
+        if tx is None:
+            return
+        try:
+            tx.send(b"\0")
+        except (BlockingIOError, OSError):
+            pass
 
     def _read_once(self, sock):
         if self._rbuf:

@@ -1990,6 +1990,107 @@ def test_o005_inline_disable_respected():
     assert suppressed == 1
 
 
+# -- GL-O006: wall-clock samples fed to the span plane (ISSUE 20) -----------------------
+
+
+def test_o006_fires_on_wall_span_endpoints():
+    src = """
+        import time
+
+        def timed_decode(rec, decode, item):
+            t0 = time.time()
+            cols = decode(item)
+            t1 = time.time()
+            rec.add_span("decode", t0, t1)
+            return cols
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-O006")[0]
+    assert f.line == _line_of(src, 'rec.add_span("decode", t0, t1)')
+    assert "perf_counter timeline" in f.message
+
+
+def test_o006_fires_on_direct_wall_call_argument():
+    src = """
+        import time
+
+        def note(rec, epoch, ordinal):
+            rec.add_item_span(epoch, ordinal, "svc.wire", time.time(),
+                              time.time())
+    """
+    findings, _ = _lint(src)
+    assert _only_rule(findings, "GL-O006")
+
+
+def test_o006_fires_on_from_import_alias():
+    findings, _ = _lint("""
+        from time import time as now
+
+        def stamp(rec):
+            w0 = now()
+            rec.batch_span("producer_cut", w0, now())
+    """)
+    assert _only_rule(findings, "GL-O006")
+
+
+def test_o006_fires_on_wall_perf_anchor():
+    src = """
+        import time
+
+        def absorb(rec, blob, pid):
+            rec.absorb_child(blob, pid, wall_anchor=time.time(),
+                             perf_anchor=time.time())
+    """
+    findings, _ = _lint(src)
+    f = _only_rule(findings, "GL-O006")[0]
+    assert "perf_anchor" in f.message
+    # the wall_anchor= keyword is the sanctioned entry point: exactly ONE
+    # finding, for the perf side
+    assert len(findings) == 1
+
+
+def test_o006_perf_counter_spans_are_clean():
+    findings, _ = _lint("""
+        import time
+
+        def timed_decode(rec, decode, item):
+            p0 = time.perf_counter()
+            cols = decode(item)
+            rec.add_span("decode", p0, time.perf_counter())
+            rec.annotate("wall_ts", time.time())  # a timestamp, not a span
+            return cols
+    """)
+    assert [f for f in findings if f.rule_id == "GL-O006"] == []
+
+
+def test_o006_wall_anchor_keyword_is_clean():
+    findings, _ = _lint("""
+        import time
+
+        class Recorder:
+            def __init__(self):
+                self._wall_origin = time.time()
+                self._origin = time.perf_counter()
+
+            def align(self, rec, blob, pid, anchor):
+                rec.absorb_child(blob, pid, wall_anchor=anchor,
+                                 perf_anchor=self._origin)
+    """)
+    assert [f for f in findings if f.rule_id == "GL-O006"] == []
+
+
+def test_o006_inline_disable_respected():
+    findings, suppressed = _lint("""
+        import time
+
+        def replay(rec, t0, t1):
+            w = time.time()
+            rec.add_span("replay", w, w + 1.0)  # graftlint: disable=GL-O006 (historical replay on wall axis)
+    """)
+    assert [f for f in findings if f.rule_id == "GL-O006"] == []
+    assert suppressed == 1
+
+
 # -- GL-C005: blocking under a lock (whole-program phase, ISSUE 16) ---------------------
 
 #: PR 13's live deadlock, verbatim shape: the last worker's `task_done` posts
